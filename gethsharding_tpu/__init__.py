@@ -1,0 +1,24 @@
+"""gethsharding_tpu — a TPU-native sharding framework.
+
+A ground-up re-architecture of the capability surface of the reference
+geth-sharding client (Prysmatic Labs' phase-1 Ethereum sharding prototype,
+see /root/reference/sharding) around JAX/XLA/Pallas/pjit:
+
+- byte-exact consensus primitives (RLP, keccak256, blob chunk codec,
+  collation types, Merkle-Patricia DeriveSha) in `utils/`, `crypto/`, `core/`
+- the Sharding Manager Contract re-expressed as a pure, deterministic,
+  vmappable state-transition function in `smc/`
+- notary / proposer / observer / syncer / simulator actor services over a
+  typed feed bus in `actors/`, `p2p/`, `node/`
+- batched TPU kernels (limb-decomposed 256-bit field arithmetic, keccak-f1600,
+  secp256k1 ECDSA recovery, bn256 optimal-ate pairing) in `ops/`
+- multi-chip scaling via `jax.sharding.Mesh` + shard_map + ICI collectives
+  in `parallel/`
+
+Nothing is ported: the reference (Go/C/asm) defines *what* must hold —
+hashes, vote outcomes, wire formats — while the implementation here is
+designed TPU-first (static shapes, batch-first APIs, integer-only consensus
+kernels).
+"""
+
+__version__ = "0.1.0"

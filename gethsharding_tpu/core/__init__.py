@@ -1,0 +1,17 @@
+"""Core consensus types and structures.
+
+Parity targets (SURVEY.md §2.1, §2.4): `sharding/collation.go`,
+`sharding/shard.go`, `core/types/` (Transaction, DeriveSha), `trie/`.
+"""
+
+from gethsharding_tpu.core.trie import Trie, EMPTY_ROOT  # noqa: F401
+from gethsharding_tpu.core.derive_sha import derive_sha, chunk_root  # noqa: F401
+from gethsharding_tpu.core.types import (  # noqa: F401
+    CollationHeader,
+    Collation,
+    Transaction,
+    serialize_txs_to_blob,
+    deserialize_blob_to_txs,
+    COLLATION_SIZE_LIMIT,
+)
+from gethsharding_tpu.core.shard import Shard  # noqa: F401

@@ -1,0 +1,52 @@
+"""DeriveSha: merklize an indexed list into a trie root.
+
+Parity with `core/types/derive_sha.go:32`: build a trie mapping
+rlp(uint index) -> item-RLP, return the root hash. The collation chunk root
+(`sharding/collation.go:115 CalculateChunkRoot`) applies this to the body
+*bytes* (the reference's `Chunks` wrapper treats each byte as a list entry —
+`collation.go:210-220` Len/GetRlp operate per byte).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from gethsharding_tpu.core.trie import Trie, EMPTY_ROOT
+from gethsharding_tpu.utils.rlp import rlp_encode, int_to_big_endian
+
+
+def derive_sha(items: Sequence[bytes]) -> bytes:
+    """Root hash over rlp(index) -> item (items are already RLP-encoded)."""
+    if not items:
+        return EMPTY_ROOT
+    trie = Trie()
+    for index, item in enumerate(items):
+        trie.update(rlp_encode(int_to_big_endian(index)), item)
+    return trie.root_hash()
+
+
+def chunk_root(body: bytes) -> bytes:
+    """Chunk root of a serialized collation body (per-byte DeriveSha).
+
+    Mirrors `Collation.CalculateChunkRoot` -> `types.DeriveSha(Chunks(body))`
+    where Chunks.GetRlp(i) RLP-encodes the single byte body[i].
+    """
+    return derive_sha([rlp_encode(bytes([b])) for b in body])
+
+
+def poc_root(body: bytes, salt: bytes) -> bytes:
+    """Proof-of-custody root: salt interleaved before every body byte.
+
+    Mirrors `Collation.CalculatePOC` (`sharding/collation.go:124-138`),
+    including the empty-body case where the POC is derived over the salt
+    alone.
+    """
+    if len(body) == 0:
+        salted = salt
+    else:
+        out = bytearray()
+        for b in body:
+            out += salt
+            out.append(b)
+        salted = bytes(out)
+    return chunk_root(bytes(salted))

@@ -1,0 +1,11 @@
+"""Consensus cryptography: keccak256, secp256k1 ECDSA, bn256 pairing.
+
+Pure-Python reference implementations (the "go" backend in the reference's
+`--sigbackend` taxonomy). The batched TPU kernels live in
+`gethsharding_tpu.ops` and are differential-tested against these.
+
+Parity targets (SURVEY.md §2.3): `crypto/sha3` (keccak asm),
+`crypto/secp256k1` (libsecp256k1 C), `crypto/bn256/cloudflare` (Go+asm).
+"""
+
+from gethsharding_tpu.crypto.keccak import keccak256, keccak_f1600  # noqa: F401

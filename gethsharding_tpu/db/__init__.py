@@ -1,0 +1,10 @@
+"""Shard storage engines.
+
+Parity targets: `ethdb/` (LevelDB wrapper + MemDatabase) and
+`sharding/database/` (ShardDB service, in-memory ShardKV). LevelDB itself is
+not available here; the persistent engine is an embedded SQLite key-value
+store with the same Get/Put/Has/Delete surface (`ethdb/interface.go`).
+"""
+
+from gethsharding_tpu.db.kv import KVStore, MemoryKV, SqliteKV  # noqa: F401
+from gethsharding_tpu.db.shard_db import ShardDB  # noqa: F401

@@ -1,0 +1,107 @@
+"""Key-value store interface + in-memory and SQLite-backed engines.
+
+Mirrors the `ethdb.Database` contract (`ethdb/interface.go`: Put/Get/Has/
+Delete/Close + batch) and `sharding/database/inmemory.go` (ShardKV map).
+SQLite (stdlib) stands in for LevelDB as the durable engine; it offers the
+same ordered-KV semantics the shard layer needs and requires no external
+dependency.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+from typing import Dict, Iterator, Optional, Tuple
+
+
+class KVStore:
+    """Abstract Get/Put/Has/Delete byte-keyed store."""
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def put(self, key: bytes, value: bytes) -> None:
+        raise NotImplementedError
+
+    def has(self, key: bytes) -> bool:
+        return self.get(key) is not None
+
+    def delete(self, key: bytes) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    def items(self) -> Iterator[Tuple[bytes, bytes]]:
+        raise NotImplementedError
+
+
+class MemoryKV(KVStore):
+    """Thread-safe in-memory map (parity: ShardKV, ethdb.MemDatabase)."""
+
+    def __init__(self):
+        self._data: Dict[bytes, bytes] = {}
+        self._lock = threading.Lock()
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            return self._data.get(bytes(key))
+
+    def put(self, key: bytes, value: bytes) -> None:
+        with self._lock:
+            self._data[bytes(key)] = bytes(value)
+
+    def delete(self, key: bytes) -> None:
+        with self._lock:
+            self._data.pop(bytes(key), None)
+
+    def items(self):
+        with self._lock:
+            return iter(list(self._data.items()))
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._data)
+
+
+class SqliteKV(KVStore):
+    """Durable KV store over stdlib SQLite (LevelDB stand-in)."""
+
+    def __init__(self, path: str):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._lock:
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS kv (k BLOB PRIMARY KEY, v BLOB NOT NULL)"
+            )
+            self._conn.commit()
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT v FROM kv WHERE k = ?", (bytes(key),)
+            ).fetchone()
+        return None if row is None else bytes(row[0])
+
+    def put(self, key: bytes, value: bytes) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO kv (k, v) VALUES (?, ?) "
+                "ON CONFLICT(k) DO UPDATE SET v = excluded.v",
+                (bytes(key), bytes(value)),
+            )
+            self._conn.commit()
+
+    def delete(self, key: bytes) -> None:
+        with self._lock:
+            self._conn.execute("DELETE FROM kv WHERE k = ?", (bytes(key),))
+            self._conn.commit()
+
+    def items(self):
+        with self._lock:
+            rows = self._conn.execute("SELECT k, v FROM kv ORDER BY k").fetchall()
+        return iter([(bytes(k), bytes(v)) for k, v in rows])
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()

@@ -1,0 +1,20 @@
+"""Independent utilities: RLP codec, blob chunk codec, typed byte wrappers.
+
+Capability parity with the reference's `rlp/`, `common/` and
+`sharding/utils/` packages (see SURVEY.md §2.1, §2.4).
+"""
+
+from gethsharding_tpu.utils.rlp import (  # noqa: F401
+    rlp_encode,
+    rlp_decode,
+    rlp_encode_int,
+    DecodingError,
+)
+from gethsharding_tpu.utils.blob import (  # noqa: F401
+    RawBlob,
+    serialize_blobs,
+    deserialize_blobs,
+    CHUNK_SIZE,
+    CHUNK_DATA_SIZE,
+)
+from gethsharding_tpu.utils.hexbytes import Hash32, Address20, to_hex  # noqa: F401

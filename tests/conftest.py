@@ -1,0 +1,16 @@
+"""Test configuration: hermetic CPU-only JAX with an 8-device virtual mesh.
+
+Multi-chip sharding paths (`gethsharding_tpu.parallel`) are exercised on a
+virtual 8-device CPU mesh (XLA host-platform device count), mirroring how the
+driver dry-runs `__graft_entry__.dryrun_multichip`. Must run before any jax
+import, hence environment mutation at conftest import time.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,73 @@
+"""Blob chunk codec: round-trips, layout, and reference edge cases."""
+
+import random
+
+import numpy as np
+import pytest
+
+from gethsharding_tpu.utils.blob import (
+    CHUNK_SIZE,
+    RawBlob,
+    deserialize_blobs,
+    serialize_blobs,
+    serialize_blobs_np,
+)
+
+
+def test_single_small_blob_layout():
+    blob = RawBlob(data=b"\x01\x02\x03", skip_evm=False)
+    out = serialize_blobs([blob])
+    assert len(out) == 32
+    assert out[0] == 3  # terminal length indicator
+    assert out[1:4] == b"\x01\x02\x03"
+    assert out[4:] == b"\x00" * 28
+
+
+def test_skip_evm_flag_bit():
+    blob = RawBlob(data=b"\xff", skip_evm=True)
+    out = serialize_blobs([blob])
+    assert out[0] == 0x80 | 1
+    round_tripped = deserialize_blobs(out)
+    assert round_tripped[0].skip_evm is True
+    assert round_tripped[0].data == b"\xff"
+
+
+def test_exact_multiple_of_31():
+    blob = RawBlob(data=bytes(range(62)))  # exactly 2 chunks
+    out = serialize_blobs([blob])
+    assert len(out) == 64
+    assert out[0] == 0  # non-terminal
+    assert out[32] == 31  # terminal with full 31 bytes
+    assert deserialize_blobs(out)[0].data == blob.data
+
+
+def test_multi_blob_roundtrip_randomized():
+    rng = random.Random(42)
+    for _ in range(20):
+        blobs = [
+            RawBlob(
+                data=rng.randbytes(rng.randint(1, 200)),
+                skip_evm=rng.random() < 0.5,
+            )
+            for _ in range(rng.randint(1, 8))
+        ]
+        out = serialize_blobs(blobs)
+        assert len(out) % CHUNK_SIZE == 0
+        back = deserialize_blobs(out)
+        assert [b.data for b in back] == [b.data for b in blobs]
+        assert [b.skip_evm for b in back] == [b.skip_evm for b in blobs]
+
+
+def test_numpy_serializer_matches_scalar():
+    rng = random.Random(7)
+    blobs = [RawBlob(data=rng.randbytes(n), skip_evm=n % 2 == 0)
+             for n in (1, 30, 31, 32, 61, 62, 63, 100)]
+    scalar = serialize_blobs(blobs)
+    vec = serialize_blobs_np(blobs)
+    assert vec.shape == (len(scalar) // 32, 32)
+    assert bytes(vec.tobytes()) == scalar
+
+
+def test_empty_blob_emits_no_chunks():
+    assert serialize_blobs([RawBlob(data=b"")]) == b""
+    assert serialize_blobs_np([RawBlob(data=b"")]).shape == (0, 32)

@@ -1,0 +1,63 @@
+"""RLP codec conformance: canonical vectors from the Ethereum RLP spec."""
+
+import pytest
+
+from gethsharding_tpu.utils.rlp import (
+    DecodingError,
+    int_to_big_endian,
+    rlp_decode,
+    rlp_encode,
+)
+
+# (python object, expected encoding hex) — spec vectors
+VECTORS = [
+    (b"", "80"),
+    (b"\x00", "00"),
+    (b"\x0f", "0f"),
+    (b"\x7f", "7f"),
+    (b"\x80", "8180"),
+    (b"dog", "83646f67"),
+    ([], "c0"),
+    ([b"cat", b"dog"], "c88363617483646f67"),
+    (b"Lorem ipsum dolor sit amet, consectetur adipisicing elit",
+     "b8384c6f72656d20697073756d20646f6c6f722073697420616d65742c20636f6e73656374657475722061646970697369636"
+     "96e6720656c6974"),
+    ([[], [[]], [[], [[]]]], "c7c0c1c0c3c0c1c0"),
+    (0, "80"),
+    (1, "01"),
+    (15, "0f"),
+    (1024, "820400"),
+]
+
+
+@pytest.mark.parametrize("obj,expected", VECTORS)
+def test_encode_vectors(obj, expected):
+    assert rlp_encode(obj).hex() == expected
+
+
+def test_roundtrip_nested():
+    obj = [b"abc", [b"", b"\x01", [b"xyz" * 40]], b"\x80" * 60]
+    assert rlp_decode(rlp_encode(obj)) == obj
+
+
+def test_decode_rejects_trailing():
+    with pytest.raises(DecodingError):
+        rlp_decode(bytes.fromhex("8180ff"))
+
+
+def test_decode_rejects_noncanonical_single_byte():
+    # 0x7f must encode as itself, not 0x817f
+    with pytest.raises(DecodingError):
+        rlp_decode(bytes.fromhex("817f"))
+
+
+def test_decode_rejects_noncanonical_long_length():
+    # length 3 must use short form, not long form 0xb803...
+    with pytest.raises(DecodingError):
+        rlp_decode(bytes.fromhex("b803646f67"))
+
+
+def test_int_to_big_endian():
+    assert int_to_big_endian(0) == b""
+    assert int_to_big_endian(127) == b"\x7f"
+    assert int_to_big_endian(256) == b"\x01\x00"

@@ -1,0 +1,98 @@
+"""Shard storage façade: CRUD, availability, canonical index, key scheme."""
+
+import pytest
+
+from gethsharding_tpu.core.shard import (
+    Shard,
+    ShardError,
+    canonical_collation_lookup_key,
+    data_availability_lookup_key,
+)
+from gethsharding_tpu.core.types import Collation, CollationHeader, Transaction, \
+    serialize_txs_to_blob
+from gethsharding_tpu.db.kv import MemoryKV
+from gethsharding_tpu.utils.hexbytes import Address20, Hash32
+
+
+def make_collation(shard_id=1, period=2, n_txs=3) -> Collation:
+    txs = [Transaction(gas_limit=i) for i in range(n_txs)]
+    body = serialize_txs_to_blob(txs)
+    header = CollationHeader(
+        shard_id=shard_id,
+        period=period,
+        proposer_address=Address20(b"\x0a" * 20),
+    )
+    collation = Collation(header=header, body=body, transactions=txs)
+    collation.calculate_chunk_root()
+    return collation
+
+
+@pytest.fixture
+def shard():
+    return Shard(shard_id=1, shard_db=MemoryKV())
+
+
+def test_lookup_keys_are_last_32_bytes_of_formatted_string():
+    root = Hash32(b"\x01" * 32)
+    key = data_availability_lookup_key(root)
+    formatted = f"availability-lookup:0x{'01' * 32}".encode()
+    assert bytes(key) == formatted[-32:]
+
+    ckey = canonical_collation_lookup_key(5, 17)
+    cformatted = b"canonical-collation-lookup:shardID=5,period=17"
+    assert bytes(ckey) == cformatted[-32:]
+
+
+def test_save_and_fetch_collation(shard):
+    collation = make_collation()
+    shard.save_collation(collation)
+    fetched = shard.collation_by_header_hash(collation.header.hash())
+    assert fetched.header == collation.header
+    assert fetched.body == collation.body
+    assert fetched.transactions == collation.transactions
+
+
+def test_availability_bit(shard):
+    collation = make_collation()
+    shard.save_collation(collation)
+    assert shard.check_availability(collation.header) is True
+    shard.set_availability(collation.header.chunk_root, False)
+    assert shard.check_availability(collation.header) is False
+
+
+def test_availability_unset_raises(shard):
+    header = CollationHeader(shard_id=1, period=1, chunk_root=Hash32(b"\x05" * 32))
+    with pytest.raises(ShardError, match="availability not set"):
+        shard.check_availability(header)
+
+
+def test_wrong_shard_rejected(shard):
+    collation = make_collation(shard_id=2)
+    with pytest.raises(ShardError, match="does not belong"):
+        shard.save_collation(collation)
+
+
+def test_save_header_requires_chunk_root(shard):
+    header = CollationHeader(shard_id=1, period=1)
+    with pytest.raises(ShardError, match="chunk root"):
+        shard.save_header(header)
+
+
+def test_canonical_flow(shard):
+    collation = make_collation(shard_id=1, period=7)
+    shard.save_collation(collation)
+    shard.set_canonical(collation.header)
+    assert shard.canonical_header_hash(1, 7) == collation.header.hash()
+    canonical = shard.canonical_collation(1, 7)
+    assert canonical.header == collation.header
+
+
+def test_set_canonical_requires_saved_header(shard):
+    collation = make_collation()
+    with pytest.raises(ShardError, match="no value set for header hash"):
+        shard.set_canonical(collation.header)
+
+
+def test_canonical_missing_raises(shard):
+    with pytest.raises(ShardError, match="no canonical collation header"):
+        shard.canonical_header_hash(1, 99)

@@ -1,0 +1,81 @@
+"""Merkle-Patricia trie: golden roots from the canonical Ethereum trie tests."""
+
+from gethsharding_tpu.core.derive_sha import chunk_root, derive_sha, poc_root
+from gethsharding_tpu.core.trie import EMPTY_ROOT, Trie
+from gethsharding_tpu.utils.rlp import rlp_encode
+
+
+def test_empty_root():
+    assert Trie().root_hash() == EMPTY_ROOT
+    assert EMPTY_ROOT.hex() == (
+        "56e81f171bcc55a6ff8345e692c0f86e5b48e01b996cadc001622fb5e363b421"
+    )
+
+
+def test_geth_insert_golden():
+    # go-ethereum trie/trie_test.go TestInsert golden root
+    t = Trie()
+    t.update(b"doe", b"reindeer")
+    t.update(b"dog", b"puppy")
+    t.update(b"dogglesworth", b"cat")
+    assert t.root_hash().hex() == (
+        "8aad789dff2f538bca5d8ea56e8abe10f4c7ba3a5dea95fea4cd6e7c3a1168d3"
+    )
+
+
+def test_ethereum_anyorder_golden():
+    # canonical trietest vector; insertion order must not matter
+    pairs = [
+        (b"do", b"verb"),
+        (b"dog", b"puppy"),
+        (b"doge", b"coin"),
+        (b"horse", b"stallion"),
+    ]
+    expected = "5991bb8c6514148a29db676a14ac506cd2cd5775ace63c30a4fe457715e9ac84"
+    for order in ([0, 1, 2, 3], [3, 2, 1, 0], [2, 0, 3, 1]):
+        t = Trie()
+        for i in order:
+            k, v = pairs[i]
+            t.update(k, v)
+        assert t.root_hash().hex() == expected
+
+
+def test_update_overwrites():
+    t = Trie()
+    t.update(b"k", b"v1")
+    r1 = t.root_hash()
+    t.update(b"k", b"v2")
+    assert t.root_hash() != r1
+    assert t.get(b"k") == b"v2"
+
+
+def test_get_semantics():
+    t = Trie()
+    t.update(b"abc", b"1")
+    t.update(b"abd", b"2")
+    t.update(b"ab", b"3")
+    assert t.get(b"abc") == b"1"
+    assert t.get(b"abd") == b"2"
+    assert t.get(b"ab") == b"3"
+    assert t.get(b"a") is None
+    assert t.get(b"abcd") is None
+
+
+def test_derive_sha_empty():
+    assert derive_sha([]) == EMPTY_ROOT
+
+
+def test_derive_sha_order_sensitivity():
+    items = [rlp_encode(b"a"), rlp_encode(b"b")]
+    assert derive_sha(items) != derive_sha(list(reversed(items)))
+
+
+def test_chunk_root_determinism():
+    body = bytes(range(64))
+    assert chunk_root(body) == chunk_root(bytes(range(64)))
+    assert chunk_root(body) != chunk_root(body[:-1])
+
+
+def test_poc_root_empty_body_uses_salt():
+    assert poc_root(b"", b"salt") == chunk_root(b"salt")
+    assert poc_root(b"ab", b"s") == chunk_root(b"s" + b"a" + b"s" + b"b")

@@ -1,0 +1,102 @@
+"""Collation/Transaction types: RLP round-trips, hashing, blob pipeline."""
+
+import pytest
+
+from gethsharding_tpu.core.types import (
+    COLLATION_SIZE_LIMIT,
+    Collation,
+    CollationHeader,
+    Transaction,
+    deserialize_blob_to_txs,
+    serialize_txs_to_blob,
+)
+from gethsharding_tpu.utils.hexbytes import Address20, Hash32
+
+
+def make_tx(gas_limit: int) -> Transaction:
+    # mirrors the reference's makeTxWithGasLimit test helper: all other
+    # fields zero/nil
+    return Transaction(gas_limit=gas_limit)
+
+
+def test_tx_rlp_roundtrip():
+    tx = Transaction(
+        nonce=3,
+        gas_price=10**9,
+        gas_limit=21000,
+        to=Address20(b"\x01" * 20),
+        value=10**18,
+        payload=b"hello",
+        v=27,
+        r=12345,
+        s=67890,
+    )
+    assert Transaction.decode_rlp(tx.encode_rlp()) == tx
+
+
+def test_tx_nil_recipient_roundtrip():
+    tx = Transaction(nonce=1, payload=b"init code")
+    decoded = Transaction.decode_rlp(tx.encode_rlp())
+    assert decoded.to is None
+    assert decoded == tx
+
+
+def test_tx_hash_stable():
+    assert make_tx(0).hash() == make_tx(0).hash()
+    assert make_tx(0).hash() != make_tx(1).hash()
+
+
+def test_header_hash_and_rlp_roundtrip():
+    header = CollationHeader(
+        shard_id=1,
+        chunk_root=Hash32(b"\x02" * 32),
+        period=5,
+        proposer_address=Address20(b"\x03" * 20),
+        proposer_signature=b"\x04" * 65,
+    )
+    decoded = CollationHeader.decode_rlp(header.encode_rlp())
+    assert decoded == header
+    assert decoded.hash() == header.hash()
+
+
+def test_header_nil_fields_like_reference():
+    # NewCollationHeader(big.NewInt(1), nil, big.NewInt(1), nil, []byte{})
+    header = CollationHeader(shard_id=1, period=1, proposer_signature=b"")
+    encoded = header.encode_rlp()
+    # [0x01, empty, 0x01, empty, empty] -> c5 01 80 01 80 80
+    assert encoded.hex() == "c50180018080"
+    assert CollationHeader.decode_rlp(encoded) == header
+
+
+def test_sig_change_changes_hash():
+    h = CollationHeader(shard_id=1, period=1)
+    before = h.hash()
+    h.add_sig(b"\x01" * 65)
+    assert h.hash() != before
+
+
+def test_serialize_deserialize_txs():
+    txs = [make_tx(0), make_tx(5), make_tx(20), make_tx(100)]
+    body = serialize_txs_to_blob(txs)
+    assert len(body) % 32 == 0
+    back = deserialize_blob_to_txs(body)
+    assert back == txs
+
+
+def test_collation_size_limit_enforced():
+    big_tx = Transaction(payload=b"\xff" * (COLLATION_SIZE_LIMIT + 100))
+    with pytest.raises(ValueError, match="size limit"):
+        serialize_txs_to_blob([big_tx])
+
+
+def test_collation_chunk_root_pipeline():
+    txs = [make_tx(i) for i in range(4)]
+    body = serialize_txs_to_blob(txs)
+    collation = Collation(
+        header=CollationHeader(shard_id=0, period=1), body=body, transactions=txs
+    )
+    root = collation.calculate_chunk_root()
+    assert collation.header.chunk_root == root
+    # same body -> same root
+    c2 = Collation(header=CollationHeader(shard_id=0, period=1), body=body)
+    assert c2.calculate_chunk_root() == root
