@@ -1,0 +1,21 @@
+"""CLI entry point: `tpu-sharding sharding --actor {notary,proposer,observer}`.
+
+Parity target: `cmd/geth/shardingcmd.go` + the sharding flags in
+`cmd/utils/flags.go:536-549`. The full node wiring lands with the actor
+services; this module keeps the console-script entry importable.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    from gethsharding_tpu.node.cli import run_cli
+
+    return run_cli(argv)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -29,9 +29,11 @@ def chunk_root(body: bytes) -> bytes:
     """Chunk root of a serialized collation body (per-byte DeriveSha).
 
     Mirrors `Collation.CalculateChunkRoot` -> `types.DeriveSha(Chunks(body))`
-    where Chunks.GetRlp(i) RLP-encodes the single byte body[i].
+    where Chunks.GetRlp(i) RLP-encodes the single byte body[i] as a *uint*
+    (Go's `rlp.EncodeToBytes(byte)` hits writeUint), so 0x00 encodes as 0x80,
+    not as a 1-byte string.
     """
-    return derive_sha([rlp_encode(bytes([b])) for b in body])
+    return derive_sha([rlp_encode(int(b)) for b in body])
 
 
 def poc_root(body: bytes, salt: bytes) -> bytes:

@@ -93,6 +93,8 @@ class Shard:
         return body
 
     def check_availability(self, header: CollationHeader) -> bool:
+        if header.chunk_root is None:
+            raise ShardError("header has no chunk root")
         key = data_availability_lookup_key(header.chunk_root)
         availability = self._db.get(bytes(key))
         if not availability:

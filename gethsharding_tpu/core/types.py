@@ -31,6 +31,21 @@ from gethsharding_tpu.utils.rlp import (
 COLLATION_SIZE_LIMIT = 1 << 20  # 1 MiB (`sharding/collation.go:45`)
 
 
+def _expect_bytes(item, name: str) -> bytes:
+    """Reject list-kind where a string-kind RLP field is required
+    (the reference's rlp.Stream enforces kind per field)."""
+    if not isinstance(item, (bytes, bytearray)):
+        raise DecodingError(f"{name}: expected RLP string, got list")
+    return bytes(item)
+
+
+def _expect_sized(item, name: str, size: int) -> bytes:
+    data = _expect_bytes(item, name)
+    if len(data) != size:
+        raise DecodingError(f"{name}: expected {size} bytes, got {len(data)}")
+    return data
+
+
 @dataclass
 class Transaction:
     """A shard transaction (phase 1: opaque payload, no shard-state execution)."""
@@ -66,17 +81,22 @@ class Transaction:
         items = rlp_decode(data)
         if not isinstance(items, list) or len(items) != 9:
             raise DecodingError("transaction must be a 9-item RLP list")
-        to = None if items[3] == b"" else Address20(items[3])
+        names = ("nonce", "gas_price", "gas_limit", "to", "value",
+                 "payload", "v", "r", "s")
+        fields = [_expect_bytes(item, name) for item, name in zip(items, names)]
+        to = None if fields[3] == b"" else Address20(
+            _expect_sized(fields[3], "to", 20)
+        )
         return cls(
-            nonce=decode_int(items[0]),
-            gas_price=decode_int(items[1]),
-            gas_limit=decode_int(items[2]),
+            nonce=decode_int(fields[0]),
+            gas_price=decode_int(fields[1]),
+            gas_limit=decode_int(fields[2]),
             to=to,
-            value=decode_int(items[4]),
-            payload=items[5],
-            v=decode_int(items[6]),
-            r=decode_int(items[7]),
-            s=decode_int(items[8]),
+            value=decode_int(fields[4]),
+            payload=fields[5],
+            v=decode_int(fields[6]),
+            r=decode_int(fields[7]),
+            s=decode_int(fields[8]),
         )
 
     def hash(self) -> Hash32:
@@ -119,12 +139,19 @@ class CollationHeader:
         items = rlp_decode(data)
         if not isinstance(items, list) or len(items) != 5:
             raise DecodingError("collation header must be a 5-item RLP list")
+        names = ("shard_id", "chunk_root", "period", "proposer_address",
+                 "proposer_signature")
+        fields = [_expect_bytes(item, name) for item, name in zip(items, names)]
         return cls(
-            shard_id=decode_int(items[0]) if items[0] != b"" else None,
-            chunk_root=Hash32(items[1]) if items[1] != b"" else None,
-            period=decode_int(items[2]) if items[2] != b"" else None,
-            proposer_address=Address20(items[3]) if items[3] != b"" else None,
-            proposer_signature=items[4],
+            shard_id=decode_int(fields[0]) if fields[0] != b"" else None,
+            chunk_root=Hash32(_expect_sized(fields[1], "chunk_root", 32))
+            if fields[1] != b"" else None,
+            period=decode_int(fields[2]) if fields[2] != b"" else None,
+            proposer_address=Address20(
+                _expect_sized(fields[3], "proposer_address", 20)
+            )
+            if fields[3] != b"" else None,
+            proposer_signature=fields[4],
         )
 
     def hash(self) -> Hash32:

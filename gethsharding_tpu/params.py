@@ -1,0 +1,32 @@
+"""Protocol configuration — the single source of truth.
+
+The reference keeps these constants in two places (`sharding/contracts/
+sharding_manager.sol:56-73` and `sharding/params/config.go`), a hazard
+SURVEY.md §5.6 flags. Here one frozen Config feeds the SMC state machine,
+the actors, and the TPU kernel shapes alike.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+ETHER = 10**18
+
+
+@dataclass(frozen=True)
+class Config:
+    """Sharding protocol constants (values per sharding_manager.sol:56-73)."""
+
+    shard_count: int = 100
+    period_length: int = 5  # mainchain blocks per period
+    notary_deposit: int = 1000 * ETHER
+    notary_lockup_length: int = 16128  # periods
+    proposer_lockup_length: int = 48  # periods (sharding/params/config.go)
+    committee_size: int = 135
+    quorum_size: int = 90
+    lookahead_length: int = 4  # periods of committee lookahead
+    challenge_period: int = 25  # proof-of-custody challenge window
+    collation_size_limit: int = 1 << 20  # bytes
+
+
+DEFAULT_CONFIG = Config()

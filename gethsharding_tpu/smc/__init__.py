@@ -1,0 +1,25 @@
+"""The Sharding Manager Contract as a native deterministic state machine.
+
+In the reference, consensus lives in an EVM contract
+(`sharding/contracts/sharding_manager.sol`) executed by geth and reached
+over RPC + abigen bindings. Here the same state machine is a native,
+deterministic transition system:
+
+- `state_machine.SMC` — the authoritative host-side implementation with
+  transaction-revert semantics matching the Solidity `require` rules
+  bit-for-bit (vote bitfields, committee sampling, quirks included).
+- `chain.SimulatedMainchain` — an in-process mainchain with
+  pending/sealed blocks, deterministic block hashes, accounts, and manual
+  `commit()` / `fast_forward()` — the SimulatedBackend-equivalent test
+  fixture (`accounts/abi/bind/backends/simulated.go:53`).
+- `vectorized` (see `gethsharding_tpu.ops`) — the fixed-shape array form
+  of the vote/committee path that runs vmapped over shards on TPU.
+"""
+
+from gethsharding_tpu.smc.state_machine import (  # noqa: F401
+    SMC,
+    SMCRevert,
+    Notary,
+    CollationRecord,
+)
+from gethsharding_tpu.smc.chain import SimulatedMainchain, Block  # noqa: F401

@@ -1,0 +1,206 @@
+"""SimulatedMainchain: in-process mainchain with manual block production.
+
+The framework's equivalent of `accounts/abi/bind/backends/simulated.go:53`
+(SimulatedBackend) fused with the narrow mainchain surface the sharding
+actors actually use (`sharding/mainchain/interfaces.go`): pending/sealed
+blocks, deterministic block hashes, account balances, head subscriptions,
+and the SMC deployed in-process instead of behind RPC+EVM.
+
+Transactions execute against the *pending* block number (sealed height + 1)
+and view calls against the latest sealed block, mirroring geth semantics.
+`commit()` seals the pending block; `fast_forward(p)` mines p full periods
+(the `MockClient.FastForward` pattern, `sharding/internal/client_helper.go:93`).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from gethsharding_tpu.crypto.keccak import keccak256
+from gethsharding_tpu.params import Config, DEFAULT_CONFIG, ETHER
+from gethsharding_tpu.smc.state_machine import SMC, SMCRevert
+from gethsharding_tpu.utils.hexbytes import Address20, Hash32
+from gethsharding_tpu.utils.rlp import rlp_encode, int_to_big_endian
+
+
+@dataclass
+class Block:
+    number: int
+    hash: Hash32
+    parent_hash: Hash32
+
+
+@dataclass
+class Receipt:
+    """Minimal tx receipt: status + events emitted during the call."""
+
+    tx_hash: Hash32
+    status: int
+    block_number: int
+    events: List = field(default_factory=list)
+
+
+class SimulatedMainchain:
+    """Deterministic dev chain hosting the SMC state machine."""
+
+    def __init__(self, config: Config = DEFAULT_CONFIG,
+                 genesis_balances: Optional[Dict[Address20, int]] = None):
+        self.config = config
+        genesis = Block(number=0, hash=self._block_hash(0, Hash32()),
+                        parent_hash=Hash32())
+        self.blocks: List[Block] = [genesis]
+        self.balances: Dict[Address20, int] = dict(genesis_balances or {})
+        self.smc = SMC(config=config, blockhash_fn=self.blockhash)
+        self._head_subscribers: List[Callable[[Block], None]] = []
+        self._receipts: Dict[Hash32, Receipt] = {}
+        self._tx_counter = 0
+        self._lock = threading.RLock()
+
+    # -- chain mechanics ---------------------------------------------------
+
+    @staticmethod
+    def _block_hash(number: int, parent_hash: Hash32) -> Hash32:
+        return Hash32(keccak256(rlp_encode([int_to_big_endian(number),
+                                            bytes(parent_hash)])))
+
+    @property
+    def block_number(self) -> int:
+        """Latest sealed block number."""
+        return self.blocks[-1].number
+
+    @property
+    def pending_block_number(self) -> int:
+        return self.block_number + 1
+
+    def current_period(self) -> int:
+        return self.block_number // self.config.period_length
+
+    def blockhash(self, number: int) -> Hash32:
+        """Hash of a sealed block; zero for unknown/future (EVM blockhash)."""
+        if 0 <= number < len(self.blocks):
+            return self.blocks[number].hash
+        return Hash32()
+
+    def block_by_number(self, number: Optional[int] = None) -> Block:
+        if number is None:
+            return self.blocks[-1]
+        return self.blocks[number]
+
+    def commit(self) -> Block:
+        """Seal the pending block and notify head subscribers."""
+        with self._lock:
+            parent = self.blocks[-1]
+            block = Block(
+                number=parent.number + 1,
+                hash=self._block_hash(parent.number + 1, parent.hash),
+                parent_hash=parent.hash,
+            )
+            self.blocks.append(block)
+            subscribers = list(self._head_subscribers)
+        for callback in subscribers:
+            callback(block)
+        return block
+
+    def fast_forward(self, periods: int) -> None:
+        """Mine `periods` full periods of blocks (client_helper.go:93)."""
+        for _ in range(periods * self.config.period_length):
+            self.commit()
+
+    def subscribe_new_head(self, callback: Callable[[Block], None]) -> Callable[[], None]:
+        """Register a head callback; returns an unsubscribe function."""
+        self._head_subscribers.append(callback)
+
+        def unsubscribe():
+            if callback in self._head_subscribers:
+                self._head_subscribers.remove(callback)
+
+        return unsubscribe
+
+    # -- accounts ----------------------------------------------------------
+
+    def fund(self, account: Address20, amount: int = 10_000 * ETHER) -> None:
+        self.balances[account] = self.balances.get(account, 0) + amount
+
+    def balance_of(self, account: Address20) -> int:
+        return self.balances.get(account, 0)
+
+    # -- SMC transaction surface ------------------------------------------
+    # Each transact_* executes in the pending block, records a receipt, and
+    # moves value. Reverts raise SMCRevert and leave no state change.
+
+    def _new_tx_hash(self) -> Hash32:
+        self._tx_counter += 1
+        return Hash32(keccak256(b"tx" + self._tx_counter.to_bytes(8, "big")))
+
+    def _record(self, events_before: int) -> Receipt:
+        receipt = Receipt(
+            tx_hash=self._new_tx_hash(),
+            status=1,
+            block_number=self.pending_block_number,
+            events=self.smc.events[events_before:],
+        )
+        self._receipts[receipt.tx_hash] = receipt
+        return receipt
+
+    def transaction_receipt(self, tx_hash: Hash32) -> Optional[Receipt]:
+        return self._receipts.get(tx_hash)
+
+    def register_notary(self, sender: Address20, value: Optional[int] = None) -> Receipt:
+        with self._lock:
+            deposit = self.config.notary_deposit if value is None else value
+            if self.balances.get(sender, 0) < deposit:
+                raise SMCRevert("insufficient balance for deposit")
+            events_before = len(self.smc.events)
+            self.smc.register_notary(sender, deposit, self.pending_block_number)
+            self.balances[sender] -= deposit
+            return self._record(events_before)
+
+    def deregister_notary(self, sender: Address20) -> Receipt:
+        with self._lock:
+            events_before = len(self.smc.events)
+            self.smc.deregister_notary(sender, self.pending_block_number)
+            return self._record(events_before)
+
+    def release_notary(self, sender: Address20) -> Receipt:
+        with self._lock:
+            events_before = len(self.smc.events)
+            released = self.smc.release_notary(sender, self.pending_block_number)
+            self.balances[sender] = self.balances.get(sender, 0) + released
+            return self._record(events_before)
+
+    def add_header(self, sender: Address20, shard_id: int, period: int,
+                   chunk_root: Hash32, signature: bytes = b"") -> Receipt:
+        with self._lock:
+            events_before = len(self.smc.events)
+            self.smc.add_header(sender, shard_id, period, chunk_root,
+                                signature, self.pending_block_number)
+            return self._record(events_before)
+
+    def submit_vote(self, sender: Address20, shard_id: int, period: int,
+                    index: int, chunk_root: Hash32) -> Receipt:
+        with self._lock:
+            events_before = len(self.smc.events)
+            self.smc.submit_vote(sender, shard_id, period, index, chunk_root,
+                                 self.pending_block_number)
+            return self._record(events_before)
+
+    # -- SMC view surface (latest sealed block, like eth_call) ------------
+
+    def get_notary_in_committee(self, sender: Address20, shard_id: int) -> Address20:
+        return self.smc.get_notary_in_committee_view(
+            sender, shard_id, self.block_number
+        )
+
+    def notary_registry(self, address: Address20):
+        return self.smc.notary_registry.get(address)
+
+    def collation_record(self, shard_id: int, period: int):
+        return self.smc.collation_records.get((shard_id, period))
+
+    def last_submitted_collation(self, shard_id: int) -> int:
+        return self.smc.last_submitted_collation.get(shard_id, 0)
+
+    def last_approved_collation(self, shard_id: int) -> int:
+        return self.smc.last_approved_collation.get(shard_id, 0)

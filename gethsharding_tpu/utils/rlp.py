@@ -127,7 +127,6 @@ def _decode_list(data: bytes, start: int, end: int) -> List[RLPItem]:
         item, pos = _decode_item(data, pos)
         if pos > end:
             raise DecodingError("element extends past end of list")
-    # re-walk is avoided: _decode_item advanced pos correctly; collect inline
         items.append(item)
     return items
 

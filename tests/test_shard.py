@@ -96,3 +96,9 @@ def test_set_canonical_requires_saved_header(shard):
 def test_canonical_missing_raises(shard):
     with pytest.raises(ShardError, match="no canonical collation header"):
         shard.canonical_header_hash(1, 99)
+
+
+def test_check_availability_without_chunk_root(shard):
+    header = CollationHeader(shard_id=1, period=1)
+    with pytest.raises(ShardError, match="no chunk root"):
+        shard.check_availability(header)

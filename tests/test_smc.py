@@ -1,0 +1,359 @@
+"""SMC state machine tests, mirroring the reference contract suite
+(`sharding/contracts/sharding_manager_test.go:233-742`) scenario by scenario
+on the SimulatedMainchain fixture."""
+
+import pytest
+
+from gethsharding_tpu.params import Config, ETHER
+from gethsharding_tpu.smc import SMC, SMCRevert, SimulatedMainchain
+from gethsharding_tpu.utils.hexbytes import Address20, Hash32
+
+
+DEPOSIT = 1000 * ETHER
+
+
+def make_accounts(n):
+    return [Address20(i + 1) for i in range(n)]
+
+
+def helper(n_accounts=1, config=None):
+    chain = SimulatedMainchain(config=config or Config())
+    accounts = make_accounts(n_accounts)
+    for acct in accounts:
+        chain.fund(acct, 2000 * ETHER)
+    return chain, accounts
+
+
+def register_notaries(chain, accounts, start, end):
+    for acct in accounts[start:end]:
+        chain.register_notary(acct)
+        chain.commit()
+
+
+# -- registration (TestNotaryRegister & co) -------------------------------
+
+
+def test_contract_creation():
+    chain, _ = helper()
+    assert chain.smc.notary_pool_length == 0
+    assert chain.smc.shard_count == 100
+
+
+def test_default_config():
+    # mirrors SMC constants (.sol:56-73) — the single source of truth check
+    config = Config()
+    assert config.shard_count == 100
+    assert config.period_length == 5
+    assert config.notary_deposit == 1000 * ETHER
+    assert config.notary_lockup_length == 16128
+    assert config.committee_size == 135
+    assert config.quorum_size == 90
+    assert config.lookahead_length == 4
+    assert config.challenge_period == 25
+
+
+def test_notary_register():
+    chain, accounts = helper(3)
+    register_notaries(chain, accounts, 0, 3)
+    assert chain.smc.notary_pool_length == 3
+    for i, acct in enumerate(accounts):
+        entry = chain.notary_registry(acct)
+        assert entry.deposited is True
+        assert entry.pool_index == i
+    assert chain.smc.balance == 3 * DEPOSIT
+
+
+def test_notary_register_insufficient_ether():
+    chain, accounts = helper(1)
+    with pytest.raises(SMCRevert, match="NOTARY_DEPOSIT"):
+        chain.register_notary(accounts[0], value=100 * ETHER)
+    assert chain.smc.notary_pool_length == 0
+
+
+def test_notary_double_registers():
+    chain, accounts = helper(1)
+    chain.register_notary(accounts[0])
+    chain.commit()
+    with pytest.raises(SMCRevert, match="already deposited"):
+        chain.register_notary(accounts[0])
+    assert chain.smc.notary_pool_length == 1
+
+
+def test_notary_deregister():
+    chain, accounts = helper(1)
+    register_notaries(chain, accounts, 0, 1)
+    chain.fast_forward(1)
+    chain.deregister_notary(accounts[0])
+    chain.commit()
+    assert chain.smc.notary_pool_length == 0
+    entry = chain.notary_registry(accounts[0])
+    assert entry.deregistered_period == chain.current_period()
+
+
+def test_notary_deregister_then_register():
+    # the empty-slot stack quirk: with only one freed slot, stackPop reverts
+    # (`require(emptySlotsStackTop > 1)`, .sol:262), so re-registration
+    # appends a fresh slot instead of reusing index 0
+    chain, accounts = helper(2)
+    register_notaries(chain, accounts, 0, 1)
+    chain.fast_forward(1)
+    chain.deregister_notary(accounts[0])
+    chain.commit()
+    assert chain.smc.notary_pool_length == 0
+    with pytest.raises(SMCRevert, match="stackPop"):
+        chain.register_notary(accounts[1])
+
+
+def test_slot_reuse_with_two_freed_slots():
+    chain, accounts = helper(3)
+    register_notaries(chain, accounts, 0, 2)
+    chain.fast_forward(1)
+    chain.deregister_notary(accounts[0])
+    chain.commit()
+    chain.deregister_notary(accounts[1])
+    chain.commit()
+    # two freed slots: top == 2, pop returns the most recently freed (index 1)
+    chain.register_notary(accounts[2])
+    chain.commit()
+    assert chain.notary_registry(accounts[2]).pool_index == 1
+    assert chain.smc.notary_pool[1] == accounts[2]
+
+
+def test_notary_release():
+    # lockup shrunk via config so the test doesn't mine 80k blocks; the
+    # default 16128-period value is asserted in test_default_config
+    config = Config(notary_lockup_length=4)
+    chain, accounts = helper(1, config)
+    register_notaries(chain, accounts, 0, 1)
+    balance_after_deposit = chain.balance_of(accounts[0])
+    chain.fast_forward(1)
+    chain.deregister_notary(accounts[0])
+    chain.commit()
+    chain.fast_forward(config.notary_lockup_length + 1)
+    chain.release_notary(accounts[0])
+    chain.commit()
+    assert chain.notary_registry(accounts[0]) is None
+    assert chain.balance_of(accounts[0]) == balance_after_deposit + DEPOSIT
+
+
+def test_notary_instant_release():
+    chain, accounts = helper(1)
+    register_notaries(chain, accounts, 0, 1)
+    chain.fast_forward(1)
+    chain.deregister_notary(accounts[0])
+    chain.commit()
+    with pytest.raises(SMCRevert, match="lockup"):
+        chain.release_notary(accounts[0])
+    assert chain.notary_registry(accounts[0]).deposited is True
+
+
+def test_release_without_deregister():
+    chain, accounts = helper(1)
+    register_notaries(chain, accounts, 0, 1)
+    with pytest.raises(SMCRevert, match="not deregistered"):
+        chain.release_notary(accounts[0])
+
+
+# -- committee sampling (TestCommitteeListsAreDifferent & co) --------------
+
+
+def test_committee_lists_are_different():
+    chain, accounts = helper(100)
+    register_notaries(chain, accounts, 0, 100)
+    # sampled committees for shard 0 vs shard 1 must differ somewhere
+    sampled0 = [
+        chain.smc.get_notary_in_committee_view(accounts[i], 0, chain.block_number)
+        for i in range(5)
+    ]
+    sampled1 = [
+        chain.smc.get_notary_in_committee_view(accounts[i], 1, chain.block_number)
+        for i in range(5)
+    ]
+    assert sampled0 != sampled1
+
+
+def test_get_committee_with_non_member():
+    chain, accounts = helper(11)
+    register_notaries(chain, accounts, 0, 10)
+    for _ in range(10):
+        sampled = chain.get_notary_in_committee(accounts[10], 0)
+        assert sampled != accounts[10]
+
+
+def test_get_committee_within_same_period():
+    chain, accounts = helper(1)
+    register_notaries(chain, accounts, 0, 1)
+    sampled = chain.get_notary_in_committee(accounts[0], 0)
+    assert sampled == accounts[0]
+
+
+def test_get_committee_after_deregister():
+    chain, accounts = helper(10)
+    register_notaries(chain, accounts, 0, 10)
+    chain.fast_forward(1)
+    chain.deregister_notary(accounts[0])
+    chain.commit()
+    chain.fast_forward(1)
+    # deregistered notary's slot is zeroed; sampling may hit the hole but
+    # must never return the deregistered address as an active member
+    for i in range(1, 10):
+        sampled = chain.get_notary_in_committee(accounts[i], 0)
+        assert sampled != accounts[0]
+
+
+def test_sampling_is_deterministic():
+    chain, accounts = helper(20)
+    register_notaries(chain, accounts, 0, 20)
+    a = chain.get_notary_in_committee(accounts[3], 7)
+    b = chain.get_notary_in_committee(accounts[3], 7)
+    assert a == b
+
+
+# -- addHeader (TestNormalAddHeader & co) ----------------------------------
+
+
+def test_normal_add_header():
+    chain, accounts = helper(1)
+    chain.fast_forward(1)
+    period = chain.current_period()
+    root = Hash32(b"\x01" * 32)
+    chain.add_header(accounts[0], 0, period, root)
+    chain.commit()
+    record = chain.collation_record(0, period)
+    assert record.chunk_root == root
+    assert record.proposer == accounts[0]
+    assert record.is_elected is False
+    assert chain.last_submitted_collation(0) == period
+
+
+def test_add_two_headers_at_same_period():
+    chain, accounts = helper(2)
+    chain.fast_forward(1)
+    period = chain.current_period()
+    chain.add_header(accounts[0], 0, period, Hash32(b"\x01" * 32))
+    with pytest.raises(SMCRevert, match="already has"):
+        chain.add_header(accounts[1], 0, period, Hash32(b"\x02" * 32))
+
+
+def test_add_headers_at_wrong_period():
+    chain, accounts = helper(1)
+    chain.fast_forward(1)
+    wrong = chain.current_period() + 1
+    with pytest.raises(SMCRevert, match="not current"):
+        chain.add_header(accounts[0], 0, wrong, Hash32(b"\x01" * 32))
+
+
+def test_add_header_shard_range():
+    chain, accounts = helper(1)
+    chain.fast_forward(1)
+    with pytest.raises(SMCRevert, match="out of range"):
+        chain.add_header(accounts[0], 100, chain.current_period(), Hash32())
+
+
+def test_add_header_resets_votes():
+    chain, accounts = helper(1)
+    register_notaries(chain, accounts, 0, 1)
+    chain.fast_forward(1)
+    period = chain.current_period()
+    root = Hash32(b"\x01" * 32)
+    chain.add_header(accounts[0], 0, period, root)
+    chain.commit()
+    chain.submit_vote(accounts[0], 0, period, 0, root)
+    assert chain.smc.get_vote_count(0) == 1
+    chain.fast_forward(1)
+    chain.add_header(accounts[0], 0, chain.current_period(), Hash32(b"\x02" * 32))
+    assert chain.smc.get_vote_count(0) == 0
+
+
+# -- submitVote (TestSubmitVote & co) --------------------------------------
+
+
+def vote_setup(quorum=None):
+    config = Config(quorum_size=quorum) if quorum else Config()
+    chain, accounts = helper(1, config)
+    register_notaries(chain, accounts, 0, 1)
+    chain.fast_forward(1)
+    period = chain.current_period()
+    root = Hash32(b"\x09" * 32)
+    chain.add_header(accounts[0], 0, period, root)
+    chain.commit()
+    return chain, accounts, period, root
+
+
+def test_submit_vote():
+    chain, accounts, period, root = vote_setup()
+    chain.submit_vote(accounts[0], 0, period, 0, root)
+    assert chain.smc.get_vote_count(0) == 1
+    assert chain.smc.has_voted(0, 0) is True
+    # vote word: bit 255 set + count 1 in low byte
+    assert chain.smc.current_vote[0] == (1 << 255) + 1
+
+
+def test_submit_vote_twice():
+    chain, accounts, period, root = vote_setup()
+    chain.submit_vote(accounts[0], 0, period, 0, root)
+    with pytest.raises(SMCRevert, match="already voted"):
+        chain.submit_vote(accounts[0], 0, period, 0, root)
+    assert chain.smc.get_vote_count(0) == 1
+
+
+def test_submit_vote_by_non_eligible_notary():
+    chain, accounts, period, root = vote_setup()
+    outsider = Address20(0xBEEF)
+    chain.fund(outsider, 2000 * ETHER)
+    with pytest.raises(SMCRevert, match="not a deposited notary"):
+        chain.submit_vote(outsider, 0, period, 0, root)
+
+
+def test_submit_vote_without_a_header():
+    chain, accounts = helper(1)
+    register_notaries(chain, accounts, 0, 1)
+    chain.fast_forward(1)
+    period = chain.current_period()
+    with pytest.raises(SMCRevert, match="no collation submitted"):
+        chain.submit_vote(accounts[0], 1, period, 0, Hash32(b"\x09" * 32))
+
+
+def test_submit_vote_with_invalid_args():
+    chain, accounts, period, root = vote_setup()
+    with pytest.raises(SMCRevert, match="out of range"):
+        chain.submit_vote(accounts[0], 100, period, 0, root)
+    with pytest.raises(SMCRevert, match="committee range"):
+        chain.submit_vote(accounts[0], 0, period, 135, root)
+    with pytest.raises(SMCRevert, match="chunk root"):
+        chain.submit_vote(accounts[0], 0, period, 0, Hash32(b"\xaa" * 32))
+    with pytest.raises(SMCRevert, match="not current"):
+        chain.submit_vote(accounts[0], 0, period + 1, 0, root)
+
+
+def test_quorum_marks_elected():
+    # lower quorum to 2 so a single-notary committee can reach it via two
+    # distinct committee indices (sample size 1 => always eligible)
+    chain, accounts, period, root = vote_setup(quorum=2)
+    chain.submit_vote(accounts[0], 0, period, 0, root)
+    assert chain.collation_record(0, period).is_elected is False
+    assert chain.last_approved_collation(0) == 0
+    chain.submit_vote(accounts[0], 0, period, 1, root)
+    assert chain.smc.get_vote_count(0) == 2
+    assert chain.collation_record(0, period).is_elected is True
+    assert chain.last_approved_collation(0) == period
+
+
+def test_vote_word_bitfield_layout():
+    chain, accounts, period, root = vote_setup(quorum=135)
+    for index in (0, 1, 7, 100, 134):
+        chain.submit_vote(accounts[0], 0, period, index, root)
+    votes = chain.smc.current_vote[0]
+    assert votes % 256 == 5  # count in low byte
+    for index in (0, 1, 7, 100, 134):
+        assert (votes >> (255 - index)) & 1 == 1
+    assert chain.smc.has_voted(0, 2) is False
+
+
+def test_events_emitted():
+    chain, accounts, period, root = vote_setup()
+    names = [e.name for e in chain.smc.events]
+    assert "NotaryRegistered" in names
+    assert "HeaderAdded" in names
+    chain.submit_vote(accounts[0], 0, period, 0, root)
+    assert chain.smc.events[-1].name == "VoteSubmitted"

@@ -79,3 +79,11 @@ def test_chunk_root_determinism():
 def test_poc_root_empty_body_uses_salt():
     assert poc_root(b"", b"salt") == chunk_root(b"salt")
     assert poc_root(b"ab", b"s") == chunk_root(b"s" + b"a" + b"s" + b"b")
+
+
+def test_chunk_root_encodes_bytes_as_uint():
+    # Go's Chunks.GetRlp encodes each byte as a uint: 0x00 -> 0x80 (not 0x00).
+    # Regression for the consensus divergence caught in review.
+    assert chunk_root(b"\x00") == derive_sha([rlp_encode(0)])
+    assert chunk_root(b"\x01") == derive_sha([rlp_encode(1)])
+    assert chunk_root(b"\x80") == derive_sha([bytes.fromhex("8180")])

@@ -100,3 +100,20 @@ def test_collation_chunk_root_pipeline():
     # same body -> same root
     c2 = Collation(header=CollationHeader(shard_id=0, period=1), body=body)
     assert c2.calculate_chunk_root() == root
+
+
+def test_strict_decode_rejects_nested_list_fields():
+    from gethsharding_tpu.utils.rlp import DecodingError, rlp_encode
+
+    bad = rlp_encode([[b"\x01"], b"", b"", b"", b"", b"", b"", b"", b""])
+    with pytest.raises(DecodingError, match="expected RLP string"):
+        Transaction.decode_rlp(bad)
+
+
+def test_strict_decode_rejects_wrong_length_hash():
+    from gethsharding_tpu.utils.rlp import DecodingError, rlp_encode
+
+    # 5-byte chunk root must be rejected, not zero-padded
+    bad = rlp_encode([b"\x01", b"\x01\x02\x03\x04\x05", b"\x01", b"", b""])
+    with pytest.raises(DecodingError, match="chunk_root"):
+        CollationHeader.decode_rlp(bad)
