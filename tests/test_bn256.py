@@ -1,0 +1,159 @@
+"""bn256 pairing: curve/tower sanity, bilinearity, PairingCheck, BLS votes.
+
+Kept intentionally small: the pure-Python final exponentiation costs seconds
+per call. The TPU kernels are differential-tested against these primitives.
+"""
+
+import pytest
+
+from gethsharding_tpu.crypto.bn256 import (
+    ATE_LOOP_COUNT,
+    Fp2,
+    G1_GEN,
+    G2_GEN,
+    N,
+    P,
+    U,
+    bls_aggregate_sigs,
+    bls_keygen,
+    bls_sign,
+    bls_verify,
+    bls_verify_aggregate,
+    g1_add,
+    g1_is_on_curve,
+    g1_mul,
+    g1_neg,
+    g2_add,
+    g2_is_on_curve,
+    g2_mul,
+    hash_to_g1,
+    pairing_check,
+)
+
+
+def test_curve_parameters():
+    # BN family relations pin u, p, n together
+    assert P == 36 * U**4 + 36 * U**3 + 24 * U**2 + 6 * U + 1
+    assert N == 36 * U**4 + 36 * U**3 + 18 * U**2 + 6 * U + 1
+    assert ATE_LOOP_COUNT == 6 * U * U
+
+
+def test_generators_on_curve_with_correct_order():
+    # raw (unreduced) scalar muls — g1_mul/g2_mul reduce mod N, which would
+    # make these assertions vacuous
+    from gethsharding_tpu.crypto.bn256 import g1_mul_raw, g2_mul_raw
+
+    assert g1_is_on_curve(G1_GEN)
+    assert g2_is_on_curve(G2_GEN)
+    assert g1_mul_raw(N, G1_GEN) is None
+    assert g2_mul_raw(N, G2_GEN) is None
+
+
+def test_group_arithmetic():
+    a = g1_mul(7, G1_GEN)
+    b = g1_mul(11, G1_GEN)
+    assert g1_add(a, b) == g1_mul(18, G1_GEN)
+    qa = g2_mul(7, G2_GEN)
+    qb = g2_mul(11, G2_GEN)
+    assert g2_add(qa, qb) == g2_mul(18, G2_GEN)
+
+
+def test_fp2_arithmetic():
+    x = Fp2(3, 5)
+    assert (x * x.inv()) == Fp2.one()
+    assert (x + x.neg()).is_zero()
+
+
+def test_pairing_degenerate_identity():
+    # e(P, Q)·e(-P, Q) == 1 — the canonical precompile self-check
+    assert pairing_check([(G1_GEN, G2_GEN), (g1_neg(G1_GEN), G2_GEN)])
+
+
+def test_pairing_bilinearity():
+    # e(aP, bQ)·e(-abP, Q) == 1  <=>  e(aP,bQ) == e(P,Q)^(ab)
+    a, b = 6, 7
+    assert pairing_check(
+        [(g1_mul(a, G1_GEN), g2_mul(b, G2_GEN)),
+         (g1_neg(g1_mul(a * b, G1_GEN)), G2_GEN)]
+    )
+
+
+def test_pairing_nondegenerate():
+    # e(P, Q) != 1 for generators
+    assert not pairing_check([(G1_GEN, G2_GEN)])
+
+
+def test_pairing_infinity_contributes_identity():
+    assert pairing_check([(None, G2_GEN), (G1_GEN, None)])
+
+
+def test_pairing_rejects_off_curve():
+    with pytest.raises(ValueError, match="not on curve"):
+        pairing_check([((1, 3), G2_GEN)])
+
+
+def test_hash_to_g1_on_curve_and_deterministic():
+    h1 = hash_to_g1(b"header hash")
+    h2 = hash_to_g1(b"header hash")
+    assert h1 == h2
+    assert g1_is_on_curve(h1)
+    assert hash_to_g1(b"other") != h1
+
+
+def test_bls_single_vote():
+    sk, pk = bls_keygen(b"notary-0")
+    msg = b"collation header 0x42"
+    sig = bls_sign(msg, sk)
+    assert bls_verify(msg, sig, pk)
+    assert not bls_verify(b"forged header", sig, pk)
+
+
+def test_bls_aggregate_votes():
+    # 4 notaries vote on the same header; one aggregated pair-check verifies
+    msg = b"canonical header"
+    keys = [bls_keygen(bytes([i])) for i in range(4)]
+    sigs = [bls_sign(msg, sk) for sk, _ in keys]
+    agg = bls_aggregate_sigs(sigs)
+    assert bls_verify_aggregate(msg, agg, [pk for _, pk in keys])
+    # dropping a signer's sig breaks the aggregate
+    bad = bls_aggregate_sigs(sigs[:3])
+    assert not bls_verify_aggregate(msg, bad, [pk for _, pk in keys])
+
+
+def test_bls_rejects_infinity_and_empty_committee():
+    # regression: infinity sig/pk or an empty committee must never verify
+    assert not bls_verify(b"m", None, None)
+    assert not bls_verify(b"m", None, G2_GEN)
+    assert not bls_verify(b"m", G1_GEN, None)
+    assert not bls_verify_aggregate(b"m", bls_aggregate_sigs([]), [])
+
+
+def test_pairing_rejects_non_subgroup_g2():
+    # Find a point on the twist curve but outside the order-n subgroup by
+    # scanning x and taking an Fp2 square root of x^3 + b'. The twist has
+    # order n*(2p-n), so almost every curve point is outside the subgroup.
+    from gethsharding_tpu.crypto.bn256 import B2, g2_is_on_curve, g2_mul_raw
+
+    half = pow(2, P - 2, P)
+    for xi in range(1, 200):
+        x = Fp2(xi, 0)
+        rhs = x * x * x + B2
+        a, b = rhs.a, rhs.b
+        norm = (a * a + b * b) % P
+        s = pow(norm, (P + 1) // 4, P)
+        if s * s % P != norm:
+            continue
+        c2 = (a + s) * half % P
+        c = pow(c2, (P + 1) // 4, P)
+        if c * c % P != c2 or c == 0:
+            c2 = (a - s) * half % P
+            c = pow(c2, (P + 1) // 4, P)
+            if c * c % P != c2 or c == 0:
+                continue
+        d = b * half % P * pow(c, P - 2, P) % P
+        cand = (x, Fp2(c, d))
+        if g2_is_on_curve(cand) and g2_mul_raw(N, cand) is not None:
+            with pytest.raises(ValueError, match="subgroup"):
+                pairing_check([(G1_GEN, cand)])
+            return
+    pytest.fail("no non-subgroup twist point found in scan range")

@@ -1,0 +1,105 @@
+"""secp256k1 ECDSA: curve sanity, sign/verify/recover, Ethereum addresses."""
+
+import pytest
+
+from gethsharding_tpu.crypto.keccak import keccak256
+from gethsharding_tpu.crypto.secp256k1 import (
+    G,
+    N,
+    Signature,
+    ecrecover_address,
+    is_on_curve,
+    point_add,
+    point_mul,
+    priv_to_address,
+    pubkey_from_priv,
+    recover,
+    sign,
+    verify,
+)
+
+
+def test_generator_on_curve_and_order():
+    from gethsharding_tpu.crypto.secp256k1 import point_mul_raw
+
+    assert is_on_curve(G)
+    assert point_mul_raw(N, G) is None  # n·G = infinity (unreduced scalar)
+    assert point_mul(N - 1, G) == (G[0], -G[1] % (2**256 - 2**32 - 977))
+
+
+def test_point_arithmetic_consistency():
+    a = point_mul(12345, G)
+    b = point_mul(54321, G)
+    assert point_add(a, b) == point_mul(12345 + 54321, G)
+
+
+def test_known_address_vector():
+    # well-known test vector: priv key 1's address derives from G itself
+    addr = priv_to_address(1)
+    expected = keccak256(
+        G[0].to_bytes(32, "big") + G[1].to_bytes(32, "big")
+    )[12:]
+    assert bytes(addr) == expected
+    # and the canonical hex everyone knows for key=1
+    assert addr.hex() == "7e5f4552091a69125d5dfcb7b8c2659029395bdf"
+
+
+def test_sign_verify_roundtrip():
+    priv = 0xDEADBEEF
+    pub = pubkey_from_priv(priv)
+    digest = keccak256(b"collation header")
+    sig = sign(digest, priv)
+    assert verify(digest, sig, pub)
+    assert not verify(keccak256(b"other"), sig, pub)
+
+
+def test_sign_is_deterministic_low_s():
+    digest = keccak256(b"deterministic")
+    s1 = sign(digest, 7)
+    s2 = sign(digest, 7)
+    assert s1 == s2  # RFC 6979
+    assert s1.s <= N // 2  # low-S
+
+
+def test_recover_matches_signer():
+    priv = 0x12345678ABCDEF
+    digest = keccak256(b"vote")
+    sig = sign(digest, priv)
+    assert recover(digest, sig) == pubkey_from_priv(priv)
+    assert ecrecover_address(digest, sig) == priv_to_address(priv)
+
+
+def test_recover_wrong_v_gives_different_key():
+    priv = 99
+    digest = keccak256(b"msg")
+    sig = sign(digest, priv)
+    flipped = Signature(r=sig.r, s=sig.s, v=sig.v ^ 1)
+    assert recover(digest, flipped) != pubkey_from_priv(priv)
+
+
+def test_high_s_rejected_by_verify():
+    priv = 42
+    digest = keccak256(b"malleable")
+    sig = sign(digest, priv)
+    high = Signature(r=sig.r, s=N - sig.s, v=sig.v ^ 1)
+    # high-S is a valid classic ECDSA signature but must be rejected
+    # (parity with crypto.VerifySignature's malleability rule)
+    assert not verify(digest, high, pubkey_from_priv(priv))
+    # yet recovery with its recid still yields the signer (ecrecover accepts)
+    assert recover(digest, high) == pubkey_from_priv(priv)
+
+
+def test_signature_wire_format_roundtrip():
+    sig = sign(keccak256(b"wire"), 1234)
+    encoded = sig.to_bytes65()
+    assert len(encoded) == 65
+    assert Signature.from_bytes65(encoded) == sig
+
+
+def test_invalid_signatures_rejected():
+    digest = keccak256(b"x")
+    with pytest.raises(ValueError):
+        recover(digest, Signature(r=0, s=1, v=0))
+    with pytest.raises(ValueError):
+        recover(digest, Signature(r=1, s=0, v=0))
+    assert not verify(digest, Signature(r=0, s=1, v=0), pubkey_from_priv(5))
