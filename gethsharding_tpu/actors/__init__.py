@@ -1,0 +1,20 @@
+"""Actor services: notary, proposer, observer, syncer, simulator, txpool.
+
+Parity targets (SURVEY.md §2.1): `sharding/notary`, `sharding/proposer`,
+`sharding/observer`, `sharding/syncer`, `sharding/simulator`,
+`sharding/txpool` — each a Service with Start/Stop lifecycle running its
+loop on a background thread, errors funneled to a channel-equivalent
+(`sharding/utils/service.go` HandleServiceErrors).
+
+Unlike the reference (where the vote path is only exercised from tests),
+the notary's subscribe -> committee-check -> availability-check -> vote ->
+canonical pipeline is fully wired.
+"""
+
+from gethsharding_tpu.actors.base import Service  # noqa: F401
+from gethsharding_tpu.actors.txpool import TXPool  # noqa: F401
+from gethsharding_tpu.actors.proposer import Proposer  # noqa: F401
+from gethsharding_tpu.actors.notary import Notary  # noqa: F401
+from gethsharding_tpu.actors.observer import Observer  # noqa: F401
+from gethsharding_tpu.actors.syncer import Syncer  # noqa: F401
+from gethsharding_tpu.actors.simulator import Simulator  # noqa: F401

@@ -1,0 +1,53 @@
+"""Observer actor: the default, non-staking shard watcher.
+
+Parity: `sharding/observer/service.go` (NewObserver :27) — the reference
+observer only logs lifecycle. Here it also tails new canonical collations
+for its shard (the documented intent of the observer role: "simply observe
+the shard network").
+"""
+
+from __future__ import annotations
+
+from gethsharding_tpu.actors.base import Service
+from gethsharding_tpu.core.shard import Shard, ShardError
+from gethsharding_tpu.mainchain.client import SMCClient
+
+
+class Observer(Service):
+    name = "observer"
+
+    def __init__(self, client: SMCClient, shard: Shard):
+        super().__init__()
+        self.client = client
+        self.shard = shard
+        self.seen_periods = set()
+        self._unsubscribe = None
+
+    def on_start(self) -> None:
+        self.log.info("Starting observer service in shard %d",
+                      self.shard.shard_id)
+        self._unsubscribe = self.client.subscribe_new_head(self._on_head)
+
+    def on_stop(self) -> None:
+        if self._unsubscribe is not None:
+            self._unsubscribe()
+
+    def _on_head(self, block) -> None:
+        period = self.client.current_period()
+        shard_id = self.shard.shard_id
+        if period in self.seen_periods:
+            return
+        if self.client.last_approved_collation(shard_id) == period:
+            self.seen_periods.add(period)
+            try:
+                collation = self.shard.canonical_collation(shard_id, period)
+                self.log.info(
+                    "Observed canonical collation: shard %d period %d txs %d",
+                    shard_id, period, len(collation.transactions),
+                )
+            except ShardError:
+                # header approved on-chain but body not yet synced locally
+                self.log.info(
+                    "Canonical header approved for shard %d period %d "
+                    "(body not local)", shard_id, period,
+                )

@@ -1,0 +1,49 @@
+"""Shard transaction pool service.
+
+Parity: `sharding/txpool/service.go` — the reference emits a fake
+1024-random-byte tx every 5 s into an event feed (`sendTestTransaction
+:47`). This pool keeps that simulation mode (configurable interval) and
+additionally supports real intake via `submit()`, the step the reference
+stubs out.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from gethsharding_tpu.actors.base import Service
+from gethsharding_tpu.core.types import Transaction
+from gethsharding_tpu.p2p.feed import Feed
+
+
+class TXPool(Service):
+    name = "txpool"
+
+    def __init__(self, simulate_interval: Optional[float] = 5.0,
+                 payload_size: int = 1024):
+        super().__init__()
+        self.transactions_feed = Feed()
+        self.simulate_interval = simulate_interval
+        self.payload_size = payload_size
+        self._nonce = 0
+
+    def on_start(self) -> None:
+        if self.simulate_interval is not None:
+            self.spawn(self._send_test_transactions)
+
+    def submit(self, tx: Transaction) -> int:
+        """Real tx intake: push into the feed, return subscriber count."""
+        return self.transactions_feed.send(tx)
+
+    def _make_test_tx(self) -> Transaction:
+        self._nonce += 1
+        return Transaction(
+            nonce=self._nonce,
+            gas_limit=0,
+            payload=os.urandom(self.payload_size),
+        )
+
+    def _send_test_transactions(self) -> None:
+        while not self.wait(self.simulate_interval):
+            self.submit(self._make_test_tx())

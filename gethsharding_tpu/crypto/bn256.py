@@ -407,14 +407,14 @@ def pairing_check(pairs: Sequence[Tuple[G1Point, G2Point]]) -> bool:
     for p, q in pairs:
         if p is None or q is None:
             continue
-        if not (g1_is_on_curve(p) and g2_is_on_curve(q)):
+        if not g1_is_on_curve(p):
             raise ValueError("pairing input not on curve")
-        if g2_mul_raw(N, q) is not None:
+        if not g2_in_subgroup(q):
             # the twist has composite order n·(2p-n); points outside the
             # order-n subgroup break ate-pairing bilinearity. Parity with
             # twistPoint.IsOnCurve's order check (cloudflare twist.go) and
             # the EIP-197 mandate.
-            raise ValueError("G2 point not in the order-n subgroup")
+            raise ValueError("G2 point not on curve or not in the order-n subgroup")
         acc = acc * miller_loop(q, p)
     return final_exponentiation(acc).is_one()
 
@@ -460,7 +460,11 @@ def bls_verify(message: bytes, sig: G1Point, pk: G2Point) -> bool:
         # infinity signature/key would vacuously satisfy the pair check
         # (universal forgery); reject outright
         return False
-    return pairing_check([(sig, G2_GEN), (g1_neg(hash_to_g1(message)), pk)])
+    try:
+        return pairing_check([(sig, G2_GEN), (g1_neg(hash_to_g1(message)), pk)])
+    except ValueError:
+        # malformed network-supplied points are a rejection, not a crash
+        return False
 
 
 def bls_aggregate_sigs(sigs: Sequence[G1Point]) -> G1Point:
@@ -479,7 +483,45 @@ def bls_aggregate_pks(pks: Sequence[G2Point]) -> G2Point:
 
 def bls_verify_aggregate(message: bytes, agg_sig: G1Point,
                          pks: Sequence[G2Point]) -> bool:
-    """All signers signed the same message (the collation header hash)."""
+    """All signers signed the same message (the collation header hash).
+
+    SECURITY: same-message aggregation is sound only against rogue-key
+    attacks when every pk has a verified proof of possession
+    (`bls_verify_possession`) at registration time — an attacker who can
+    register pk' = sk'·G2 - pk_honest without proving knowledge of its
+    secret key can forge the aggregate. The notary registration path
+    enforces PoP; callers using this directly must do the same.
+    """
     if len(pks) == 0:
         return False  # an empty committee proves nothing
     return bls_verify(message, agg_sig, bls_aggregate_pks(pks))
+
+
+# -- proof of possession (rogue-key defense) -------------------------------
+
+_POP_DOMAIN = b"gethsharding-tpu/bls-pop-v1/"
+
+
+def _pk_bytes(pk: G2Point) -> bytes:
+    assert pk is not None
+    x, y = pk
+    return b"".join(
+        c.to_bytes(32, "big") for c in (x.a, x.b, y.a, y.b)
+    )
+
+
+def bls_prove_possession(sk: int, pk: G2Point) -> G1Point:
+    """PoP = sk·H(domain ‖ pk): binds the key to knowledge of its secret."""
+    return g1_mul(sk, hash_to_g1(_POP_DOMAIN + _pk_bytes(pk)))
+
+
+def bls_verify_possession(pk: G2Point, pop: G1Point) -> bool:
+    if pk is None or pop is None:
+        return False
+    try:
+        return pairing_check([
+            (pop, G2_GEN),
+            (g1_neg(hash_to_g1(_POP_DOMAIN + _pk_bytes(pk))), pk),
+        ])
+    except ValueError:
+        return False

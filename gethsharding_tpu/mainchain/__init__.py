@@ -1,0 +1,18 @@
+"""Mainchain bridge: how sharding actors reach the chain hosting the SMC.
+
+Parity target: `sharding/mainchain/` — SMCClient (keystore signing, SMC
+binding, tx waiting) and the narrow role interfaces
+(`sharding/mainchain/interfaces.go:16-68`) that make actors testable
+against fakes. The default backend is the in-process SimulatedMainchain;
+the RPC bridge backend (separate mainchain process) plugs in behind the
+same surface.
+"""
+
+from gethsharding_tpu.mainchain.interfaces import (  # noqa: F401
+    ChainReader,
+    ContractCaller,
+    ContractTransactor,
+    Signer,
+)
+from gethsharding_tpu.mainchain.client import SMCClient  # noqa: F401
+from gethsharding_tpu.mainchain.accounts import AccountManager, Account  # noqa: F401

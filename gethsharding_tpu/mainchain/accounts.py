@@ -1,0 +1,69 @@
+"""Account management: the keystore seam.
+
+Parity target: `accounts/keystore` as used by SMCClient
+(`sharding/mainchain/smc_client.go:218` unlockAccount, :245 Sign). This
+in-memory manager holds secp256k1 keys with unlock semantics; the
+encrypted on-disk keystore (scrypt + AES-CTR JSON files) layers on top in
+`gethsharding_tpu.mainchain.keystore`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from gethsharding_tpu.crypto import secp256k1
+from gethsharding_tpu.crypto.keccak import keccak256
+from gethsharding_tpu.utils.hexbytes import Address20
+
+
+@dataclass
+class Account:
+    address: Address20
+    priv: int
+    unlocked: bool = False
+
+
+class AccountManager:
+    """Holds accounts; signing requires an unlocked account."""
+
+    def __init__(self):
+        self._accounts: Dict[Address20, Account] = {}
+
+    def new_account(self, seed: bytes = b"", unlock: bool = True) -> Account:
+        if seed:
+            priv = int.from_bytes(keccak256(b"key" + seed), "big") % secp256k1.N
+            priv = priv or 1
+        else:
+            import secrets
+
+            priv = secrets.randbelow(secp256k1.N - 1) + 1
+        account = Account(
+            address=secp256k1.priv_to_address(priv), priv=priv, unlocked=unlock
+        )
+        self._accounts[account.address] = account
+        return account
+
+    def import_key(self, priv: int, unlock: bool = True) -> Account:
+        account = Account(
+            address=secp256k1.priv_to_address(priv), priv=priv, unlocked=unlock
+        )
+        self._accounts[account.address] = account
+        return account
+
+    def unlock(self, address: Address20) -> None:
+        self._accounts[address].unlocked = True
+
+    def lock(self, address: Address20) -> None:
+        self._accounts[address].unlocked = False
+
+    def get(self, address: Address20) -> Optional[Account]:
+        return self._accounts.get(address)
+
+    def sign_hash(self, address: Address20, digest: bytes) -> bytes:
+        account = self._accounts.get(address)
+        if account is None:
+            raise KeyError(f"unknown account {address.hex_str}")
+        if not account.unlocked:
+            raise PermissionError(f"account {address.hex_str} is locked")
+        return secp256k1.sign(digest, account.priv).to_bytes65()

@@ -1,0 +1,135 @@
+"""SMCClient: the actor-side handle on the mainchain + SMC.
+
+Parity: `sharding/mainchain/smc_client.go` (NewSMCClient :49, Start :72,
+Sign :245, CreateTXOpts :112, WaitForTransaction :165) and `utils.go`
+(dialRPC, initSMC). Differences by design: the default backend is the
+in-process SimulatedMainchain (no IPC hop), and transactions apply
+synchronously, so `wait_for_transaction` resolves immediately — the
+polling contract is kept for the RPC backend.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from gethsharding_tpu.mainchain.accounts import Account, AccountManager
+from gethsharding_tpu.params import Config, DEFAULT_CONFIG
+from gethsharding_tpu.smc.chain import Receipt, SimulatedMainchain
+from gethsharding_tpu.utils.hexbytes import Address20, Hash32
+
+
+class SMCClient:
+    """Wraps a chain backend + signing account into the actor-facing API.
+
+    Exposes: Signer (sign/account), ChainReader (heads/blocks),
+    ContractCaller and ContractTransactor (SMC surface) — the four role
+    interfaces in `gethsharding_tpu.mainchain.interfaces`.
+    """
+
+    def __init__(self, backend: Optional[SimulatedMainchain] = None,
+                 accounts: Optional[AccountManager] = None,
+                 account: Optional[Account] = None,
+                 deposit_flag: bool = False,
+                 config: Config = DEFAULT_CONFIG):
+        self.backend = backend if backend is not None else SimulatedMainchain(config)
+        self.accounts = accounts or AccountManager()
+        self._account = account or self.accounts.new_account(seed=b"node")
+        self.deposit_flag = deposit_flag
+        self.config = config
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        # parity with SMCClient.Start: dial backend, unlock account, bind SMC
+        self.accounts.unlock(self._account.address)
+
+    def stop(self) -> None:
+        pass
+
+    # -- Signer ------------------------------------------------------------
+
+    def account(self) -> Address20:
+        return self._account.address
+
+    def sign(self, digest: bytes) -> bytes:
+        return self.accounts.sign_hash(self._account.address, digest)
+
+    # -- ChainReader -------------------------------------------------------
+
+    def subscribe_new_head(self, callback):
+        return self.backend.subscribe_new_head(callback)
+
+    def block_by_number(self, number: Optional[int] = None):
+        return self.backend.block_by_number(number)
+
+    @property
+    def block_number(self) -> int:
+        return self.backend.block_number
+
+    def current_period(self) -> int:
+        return self.backend.current_period()
+
+    # -- ContractCaller ----------------------------------------------------
+
+    def get_notary_in_committee(self, shard_id: int,
+                                sender: Optional[Address20] = None) -> Address20:
+        return self.backend.get_notary_in_committee(
+            sender if sender is not None else self._account.address, shard_id
+        )
+
+    def notary_registry(self, address: Optional[Address20] = None):
+        return self.backend.notary_registry(
+            address if address is not None else self._account.address
+        )
+
+    def collation_record(self, shard_id: int, period: int):
+        return self.backend.collation_record(shard_id, period)
+
+    def last_submitted_collation(self, shard_id: int) -> int:
+        return self.backend.last_submitted_collation(shard_id)
+
+    def last_approved_collation(self, shard_id: int) -> int:
+        return self.backend.last_approved_collation(shard_id)
+
+    def has_voted(self, shard_id: int, index: int) -> bool:
+        return self.backend.smc.has_voted(shard_id, index)
+
+    def get_vote_count(self, shard_id: int) -> int:
+        return self.backend.smc.get_vote_count(shard_id)
+
+    def shard_count(self) -> int:
+        return self.backend.smc.shard_count
+
+    # -- ContractTransactor ------------------------------------------------
+
+    def register_notary(self) -> Receipt:
+        return self.backend.register_notary(self._account.address)
+
+    def deregister_notary(self) -> Receipt:
+        return self.backend.deregister_notary(self._account.address)
+
+    def release_notary(self) -> Receipt:
+        return self.backend.release_notary(self._account.address)
+
+    def add_header(self, shard_id: int, period: int, chunk_root: Hash32,
+                   signature: bytes = b"") -> Receipt:
+        return self.backend.add_header(self._account.address, shard_id,
+                                       period, chunk_root, signature)
+
+    def submit_vote(self, shard_id: int, period: int, index: int,
+                    chunk_root: Hash32) -> Receipt:
+        return self.backend.submit_vote(self._account.address, shard_id,
+                                        period, index, chunk_root)
+
+    # -- tx resilience (WaitForTransaction parity) ------------------------
+
+    def wait_for_transaction(self, tx_hash: Hash32,
+                             timeout_s: float = 10.0) -> Receipt:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            receipt = self.backend.transaction_receipt(tx_hash)
+            if receipt is not None:
+                return receipt
+            time.sleep(0.01)
+        raise TimeoutError(f"transaction {tx_hash.hex_str} not mined in time")

@@ -1,0 +1,8 @@
+"""Node runtime: the service container and CLI wiring.
+
+Parity targets: `sharding/node/backend.go` (ShardEthereum service registry)
+adopting the richer `node/node.go` constructor-DI shape as SURVEY.md §7.6
+recommends — one registry for the whole framework.
+"""
+
+from gethsharding_tpu.node.backend import ShardNode  # noqa: F401

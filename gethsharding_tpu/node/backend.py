@@ -1,0 +1,133 @@
+"""ShardNode: the service container for one sharding actor.
+
+Parity: `sharding/node/backend.go` (New :55, Start :98, registerService/
+fetchService :151-174, registerActorService :245) — services register in
+dependency order (shardDB -> p2p -> mainchain client -> txpool -> actor ->
+simulator -> syncer), start in registration order, stop in reverse. The
+registry is keyed by service type with typed fetch, the constructor-DI
+shape of `node/node.go` rather than the reference sharding layer's
+reflection copy.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Type, TypeVar
+
+from gethsharding_tpu.actors.base import Service
+from gethsharding_tpu.actors.notary import Notary
+from gethsharding_tpu.actors.observer import Observer
+from gethsharding_tpu.actors.proposer import Proposer
+from gethsharding_tpu.actors.simulator import Simulator
+from gethsharding_tpu.actors.syncer import Syncer
+from gethsharding_tpu.actors.txpool import TXPool
+from gethsharding_tpu.core.shard import Shard
+from gethsharding_tpu.db.shard_db import ShardDB
+from gethsharding_tpu.mainchain.client import SMCClient
+from gethsharding_tpu.p2p.service import Hub, P2PServer
+from gethsharding_tpu.params import Config, DEFAULT_CONFIG
+from gethsharding_tpu.smc.chain import SimulatedMainchain
+
+S = TypeVar("S")
+
+
+class ShardNode:
+    """One sharding node: an actor plus its support services."""
+
+    ACTORS = ("notary", "proposer", "observer")
+
+    def __init__(self, actor: str = "observer", shard_id: int = 0,
+                 config: Config = DEFAULT_CONFIG,
+                 backend: Optional[SimulatedMainchain] = None,
+                 hub: Optional[Hub] = None,
+                 data_dir: str = "", in_memory_db: bool = True,
+                 deposit: bool = False,
+                 txpool_interval: Optional[float] = 5.0,
+                 simulator_interval: float = 15.0):
+        if actor not in self.ACTORS:
+            raise ValueError(f"unknown actor {actor!r}; pick from {self.ACTORS}")
+        self.actor = actor
+        self.shard_id = shard_id
+        self.config = config
+        self._services: Dict[Type, object] = {}
+        self._order: List[object] = []
+
+        # registration order mirrors backend.go:55-96
+        shard_db = ShardDB(data_dir=data_dir, in_memory=in_memory_db)
+        self._register(shard_db)
+
+        p2p = P2PServer(hub=hub)
+        self._register(p2p)
+
+        client = SMCClient(backend=backend, config=config, deposit_flag=deposit)
+        self._register(client)
+
+        shard = Shard(shard_id=shard_id, shard_db=shard_db.db)
+        self.shard = shard
+
+        if actor == "proposer":
+            txpool = TXPool(simulate_interval=txpool_interval)
+            self._register(txpool)
+            self._register(Proposer(client=client, txpool=txpool,
+                                    shard=shard, config=config))
+        elif actor == "notary":
+            self._register(Notary(client=client, shard=shard, p2p=p2p,
+                                  config=config, deposit_flag=deposit))
+        else:
+            self._register(Observer(client=client, shard=shard))
+
+        if actor != "notary":
+            # non-notary nodes run the simulator (backend.go:303)
+            self._register(Simulator(client=client, p2p=p2p,
+                                     shard_id=shard_id,
+                                     tick_interval=simulator_interval))
+
+        self._register(Syncer(client=client, shard=shard, p2p=p2p))
+
+    # -- registry (backend.go:151-174) ------------------------------------
+
+    def _register(self, service: object) -> None:
+        kind = type(service)
+        if kind in self._services:
+            raise ValueError(f"service {kind.__name__} already registered")
+        self._services[kind] = service
+        self._order.append(service)
+
+    def service(self, kind: Type[S]) -> S:
+        """Typed fetch (fetchService parity)."""
+        if kind not in self._services:
+            raise KeyError(f"unknown service {kind.__name__}")
+        return self._services[kind]  # type: ignore[return-value]
+
+    @property
+    def services(self) -> List[object]:
+        return list(self._order)
+
+    # -- lifecycle (backend.go:98-133) ------------------------------------
+
+    def start(self) -> None:
+        for service in self._order:
+            service.start()
+
+    def stop(self) -> None:
+        for service in reversed(self._order):
+            try:
+                service.stop()
+            except Exception:
+                pass
+
+    # -- conveniences ------------------------------------------------------
+
+    @property
+    def client(self) -> SMCClient:
+        return self.service(SMCClient)
+
+    @property
+    def p2p(self) -> P2PServer:
+        return self.service(P2PServer)
+
+    def errors(self) -> List[str]:
+        out: List[str] = []
+        for service in self._order:
+            if isinstance(service, Service):
+                out.extend(service.errors)
+        return out

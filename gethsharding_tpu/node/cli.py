@@ -1,0 +1,101 @@
+"""`tpu-sharding sharding` — the CLI entry point.
+
+Parity: `cmd/geth/shardingcmd.go` (+ flags `cmd/utils/flags.go:536-549`):
+`sharding --actor {notary,proposer,observer} --shardid N --deposit
+--datadir PATH`. Additional dev-mode flags run an in-process simulated
+mainchain with automatic block production, so a single command demonstrates
+the full period pipeline (the reference needs a separate geth process).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import time
+from typing import List, Optional
+
+from gethsharding_tpu.node.backend import ShardNode
+from gethsharding_tpu.params import Config, ETHER
+from gethsharding_tpu.smc.chain import SimulatedMainchain
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="tpu-sharding",
+        description="TPU-native sharding client",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    sharding = sub.add_parser(
+        "sharding", help="run a sharding actor node"
+    )
+    sharding.add_argument("--actor", default="observer",
+                          choices=("notary", "proposer", "observer"),
+                          help="what role to run (flags.go:542 ActorFlag)")
+    sharding.add_argument("--shardid", type=int, default=0,
+                          help="shard to operate on (flags.go:546)")
+    sharding.add_argument("--deposit", action="store_true",
+                          help="deposit 1000 ETH to join the notary pool "
+                               "(flags.go:537)")
+    sharding.add_argument("--datadir", default="",
+                          help="data directory (in-memory DB if empty)")
+    sharding.add_argument("--periodlength", type=int, default=5)
+    sharding.add_argument("--blocktime", type=float, default=1.0,
+                          help="dev-mode block production interval seconds")
+    sharding.add_argument("--runtime", type=float, default=0.0,
+                          help="seconds to run before exiting (0 = forever)")
+    sharding.add_argument("--txinterval", type=float, default=5.0,
+                          help="simulated txpool emission interval")
+    sharding.add_argument("--verbosity", default="info",
+                          choices=("debug", "info", "warning", "error"))
+    return parser
+
+
+def run_cli(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=getattr(logging, args.verbosity.upper()),
+        format="%(asctime)s %(levelname)-7s %(name)s  %(message)s",
+        datefmt="%H:%M:%S",
+    )
+    if args.command == "sharding":
+        return run_sharding_node(args)
+    return 2
+
+
+def run_sharding_node(args) -> int:
+    config = Config(period_length=args.periodlength)
+    backend = SimulatedMainchain(config=config)
+    node = ShardNode(
+        actor=args.actor,
+        shard_id=args.shardid,
+        config=config,
+        backend=backend,
+        data_dir=args.datadir,
+        in_memory_db=args.datadir == "",
+        deposit=args.deposit,
+        txpool_interval=args.txinterval,
+    )
+    # dev mode: fund the node account so --deposit can stake
+    backend.fund(node.client.account(), 2000 * ETHER)
+
+    log = logging.getLogger("sharding.node")
+    log.info("Starting sharding node: actor=%s shard=%d account=%s",
+             args.actor, args.shardid, node.client.account().hex_str)
+    node.start()
+
+    deadline = time.monotonic() + args.runtime if args.runtime else None
+    try:
+        while deadline is None or time.monotonic() < deadline:
+            time.sleep(args.blocktime)
+            block = backend.commit()
+            if block.number % config.period_length == 0:
+                log.info("period %d sealed (block %d)",
+                         backend.current_period(), block.number)
+    except KeyboardInterrupt:
+        log.info("interrupt received, shutting down")
+    finally:
+        node.stop()
+    for error in node.errors():
+        log.warning("service error: %s", error)
+    return 0

@@ -1,0 +1,16 @@
+"""Shard p2p: typed feed bus + request/response messaging.
+
+Parity target: `sharding/p2p/` (feed map Server, messages) — but where the
+reference's Send/Broadcast are empty TODO stubs (`sharding/p2p/service.go:
+41-50`), this implements the documented intent: typed per-message feeds,
+directed send, and broadcast over an in-process hub that multiple nodes
+(actors) can attach to, mirroring the sharding README's request/response
+data-availability protocol (SURVEY.md §3.4).
+"""
+
+from gethsharding_tpu.p2p.feed import Feed, Subscription  # noqa: F401
+from gethsharding_tpu.p2p.messages import (  # noqa: F401
+    CollationBodyRequest,
+    CollationBodyResponse,
+)
+from gethsharding_tpu.p2p.service import P2PServer, Hub, Peer, Message  # noqa: F401

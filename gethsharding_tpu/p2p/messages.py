@@ -1,0 +1,28 @@
+"""Wire message types for the shard data-availability protocol.
+
+Parity: `sharding/p2p/messages/messages.go` (CollationBodyRequest :11,
+CollationBodyResponse :20).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from gethsharding_tpu.utils.hexbytes import Address20, Hash32
+
+
+@dataclass(frozen=True)
+class CollationBodyRequest:
+    chunk_root: Optional[Hash32]
+    shard_id: int
+    period: int
+    proposer: Optional[Address20]
+    # signature of the reconstructed header by the requester
+    signature: bytes = b""
+
+
+@dataclass(frozen=True)
+class CollationBodyResponse:
+    header_hash: Hash32
+    body: bytes

@@ -1,0 +1,255 @@
+"""Actor services: proposer/notary/syncer/txpool/observer/simulator flows,
+mirroring the reference's service tests plus the fully-wired vote loop."""
+
+import time
+
+import pytest
+
+from gethsharding_tpu.actors import (
+    Notary,
+    Observer,
+    Proposer,
+    Simulator,
+    Syncer,
+    TXPool,
+)
+from gethsharding_tpu.actors.proposer import check_header_added, create_collation
+from gethsharding_tpu.core.shard import Shard, ShardError
+from gethsharding_tpu.core.types import Transaction
+from gethsharding_tpu.crypto import secp256k1
+from gethsharding_tpu.db.kv import MemoryKV
+from gethsharding_tpu.mainchain.client import SMCClient
+from gethsharding_tpu.p2p.service import Hub, P2PServer
+from gethsharding_tpu.params import Config, ETHER
+from gethsharding_tpu.smc.chain import SimulatedMainchain
+
+
+def make_client(backend=None, config=None, seed=b"acct"):
+    config = config or Config()
+    backend = backend or SimulatedMainchain(config=config)
+    client = SMCClient(backend=backend, config=config)
+    client.accounts._accounts.clear()
+    client._account = client.accounts.new_account(seed=seed)
+    backend.fund(client.account(), 5000 * ETHER)
+    client.start()
+    return client
+
+
+def test_txpool_emits_and_accepts():
+    pool = TXPool(simulate_interval=0.01, payload_size=16)
+    sub = pool.transactions_feed.subscribe()
+    pool.start()
+    try:
+        tx = sub.get(timeout=2)
+        assert isinstance(tx, Transaction)
+        assert len(tx.payload) == 16
+    finally:
+        pool.stop()
+    # direct intake works without the simulator thread
+    pool2 = TXPool(simulate_interval=None)
+    sub2 = pool2.transactions_feed.subscribe()
+    pool2.start()
+    pool2.submit(Transaction(nonce=9))
+    assert sub2.get(timeout=1).nonce == 9
+    pool2.stop()
+
+
+def test_create_collation_signs_header():
+    client = make_client()
+    collation = create_collation(client, 1, 0, [Transaction(gas_limit=5)])
+    header = collation.header
+    assert header.proposer_address == client.account()
+    assert header.chunk_root is not None
+    sig = secp256k1.Signature.from_bytes65(header.proposer_signature)
+    # signature covers the unsigned header hash
+    from gethsharding_tpu.core.types import CollationHeader
+
+    unsigned_header = CollationHeader(
+        shard_id=1, chunk_root=header.chunk_root, period=0,
+        proposer_address=client.account(),
+    )
+    assert secp256k1.ecrecover_address(
+        bytes(unsigned_header.hash()), sig
+    ) == client.account()
+
+
+def test_create_collation_rejects_bad_shard():
+    client = make_client()
+    with pytest.raises(ValueError, match="out of range"):
+        create_collation(client, 100, 0, [])
+
+
+def test_proposer_saves_and_adds_header():
+    config = Config()
+    backend = SimulatedMainchain(config=config)
+    client = make_client(backend, config)
+    backend.fast_forward(1)
+    pool = TXPool(simulate_interval=None)
+    shard = Shard(shard_id=0, shard_db=MemoryKV())
+    proposer = Proposer(client=client, txpool=pool, shard=shard,
+                        config=config, poll_interval=0.01)
+    pool.start()
+    proposer.start()
+    try:
+        pool.submit(Transaction(nonce=1, payload=b"hello shard"))
+        deadline = time.time() + 5
+        while proposer.collations_proposed == 0 and time.time() < deadline:
+            time.sleep(0.01)
+        assert proposer.collations_proposed == 1
+        period = client.block_number // config.period_length
+        assert client.last_submitted_collation(0) == period
+        record = client.collation_record(0, period)
+        body = shard.body_by_chunk_root(record.chunk_root)
+        assert b"hello shard" in body
+        assert check_header_added(client, 0, period) is False
+    finally:
+        proposer.stop()
+        pool.stop()
+
+
+def test_notary_joins_pool_and_votes_to_canonical():
+    # single notary, quorum 1: the first vote approves the collation
+    config = Config(quorum_size=1)
+    backend = SimulatedMainchain(config=config)
+    proposer_client = make_client(backend, config, seed=b"proposer")
+    notary_client = make_client(backend, config, seed=b"notary")
+
+    hub = Hub()
+    p2p = P2PServer(hub)
+    shard_db = MemoryKV()
+    shard = Shard(shard_id=3, shard_db=shard_db)
+    notary = Notary(client=notary_client, shard=shard, p2p=p2p,
+                    config=config, deposit_flag=True)
+    notary.start()
+    try:
+        assert notary.is_account_in_notary_pool()
+        backend.fast_forward(1)
+        period = backend.current_period()
+        # proposer adds a header; give the notary the matching body locally
+        collation = create_collation(proposer_client, 3, period,
+                                     [Transaction(nonce=7)])
+        shard.save_collation(collation)
+        proposer_client.backend.add_header(
+            proposer_client.account(), 3, period,
+            collation.header.chunk_root, collation.header.proposer_signature,
+        )
+        backend.commit()  # head triggers the vote loop synchronously
+        assert notary.votes_submitted >= 1
+        assert backend.last_approved_collation(3) == period
+        assert notary.canonical_set == 1
+        canonical = shard.canonical_collation(3, period)
+        assert canonical.header.chunk_root == collation.header.chunk_root
+    finally:
+        notary.stop()
+
+
+def test_notary_not_eligible_without_deposit():
+    config = Config()
+    backend = SimulatedMainchain(config=config)
+    client = make_client(backend, config)
+    shard = Shard(shard_id=0, shard_db=MemoryKV())
+    notary = Notary(client=client, shard=shard, config=config,
+                    deposit_flag=False)
+    notary.start()
+    try:
+        backend.fast_forward(1)
+        assert notary.votes_submitted == 0
+        assert not notary.is_account_in_notary_pool()
+    finally:
+        notary.stop()
+
+
+def test_syncer_roundtrip_over_hub():
+    # node A (has the body) serves node B (needs it) over the hub
+    config = Config()
+    backend = SimulatedMainchain(config=config)
+    client_a = make_client(backend, config, seed=b"a")
+    client_b = make_client(backend, config, seed=b"b")
+    hub = Hub()
+    p2p_a, p2p_b = P2PServer(hub), P2PServer(hub)
+    shard_a = Shard(shard_id=0, shard_db=MemoryKV())
+    shard_b = Shard(shard_id=0, shard_db=MemoryKV())
+
+    collation = create_collation(client_a, 0, 0, [Transaction(nonce=1)])
+    shard_a.save_collation(collation)
+
+    syncer_a = Syncer(client=client_a, shard=shard_a, p2p=p2p_a,
+                      poll_interval=0.01)
+    syncer_b = Syncer(client=client_b, shard=shard_b, p2p=p2p_b,
+                      poll_interval=0.01)
+    p2p_a.start()
+    p2p_b.start()
+    syncer_a.start()
+    syncer_b.start()
+    try:
+        from gethsharding_tpu.p2p.messages import CollationBodyRequest
+
+        p2p_b.broadcast(CollationBodyRequest(
+            chunk_root=collation.header.chunk_root, shard_id=0, period=0,
+            proposer=client_a.account(),
+        ))
+        deadline = time.time() + 5
+        while syncer_b.bodies_stored == 0 and time.time() < deadline:
+            time.sleep(0.01)
+        assert syncer_b.bodies_stored == 1
+        body = shard_b.body_by_chunk_root(collation.header.chunk_root)
+        assert body == collation.body
+    finally:
+        syncer_b.stop()
+        syncer_a.stop()
+        p2p_b.stop()
+        p2p_a.stop()
+
+
+def test_simulator_injects_requests():
+    config = Config()
+    backend = SimulatedMainchain(config=config)
+    client = make_client(backend, config)
+    backend.fast_forward(1)
+    period = backend.current_period()
+    collation = create_collation(client, 2, period, [Transaction(nonce=4)])
+    backend.add_header(client.account(), 2, period,
+                       collation.header.chunk_root, b"")
+    p2p = P2PServer()
+    p2p.start()
+    sub = p2p.subscribe(__import__(
+        "gethsharding_tpu.p2p.messages", fromlist=["CollationBodyRequest"]
+    ).CollationBodyRequest)
+    simulator = Simulator(client=client, p2p=p2p, shard_id=2,
+                          tick_interval=0.02)
+    simulator.start()
+    try:
+        msg = sub.get(timeout=3)
+        assert msg.data.shard_id == 2
+        assert msg.data.chunk_root == collation.header.chunk_root
+    finally:
+        simulator.stop()
+        p2p.stop()
+
+
+def test_observer_sees_canonical():
+    config = Config(quorum_size=1)
+    backend = SimulatedMainchain(config=config)
+    notary_client = make_client(backend, config, seed=b"n")
+    observer_client = make_client(backend, config, seed=b"o")
+    shard_db = MemoryKV()
+    shard = Shard(shard_id=0, shard_db=shard_db)
+    notary = Notary(client=notary_client, shard=shard, config=config,
+                    deposit_flag=True)
+    observer = Observer(client=observer_client, shard=shard)
+    notary.start()
+    observer.start()
+    try:
+        backend.fast_forward(1)
+        period = backend.current_period()
+        collation = create_collation(notary_client, 0, period,
+                                     [Transaction(nonce=2)])
+        shard.save_collation(collation)
+        backend.add_header(notary_client.account(), 0, period,
+                           collation.header.chunk_root,
+                           collation.header.proposer_signature)
+        backend.commit()
+        assert period in observer.seen_periods
+    finally:
+        observer.stop()
+        notary.stop()

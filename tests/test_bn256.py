@@ -157,3 +157,28 @@ def test_pairing_rejects_non_subgroup_g2():
                 pairing_check([(G1_GEN, cand)])
             return
     pytest.fail("no non-subgroup twist point found in scan range")
+
+
+def test_bls_verify_rejects_malformed_points_without_crashing():
+    # network-supplied garbage must be a rejection, not an exception
+    assert not bls_verify(b"m", (1, 3), G2_GEN)  # off-curve G1
+    bad_g2 = (Fp2(1, 2), Fp2(3, 4))
+    assert not bls_verify(b"m", G1_GEN, bad_g2)
+
+
+def test_bls_proof_of_possession():
+    from gethsharding_tpu.crypto.bn256 import (
+        bls_prove_possession,
+        bls_verify_possession,
+        g2_add,
+        g2_neg,
+    )
+
+    sk, pk = bls_keygen(b"honest")
+    pop = bls_prove_possession(sk, pk)
+    assert bls_verify_possession(pk, pop)
+    # rogue key pk' = sk2*G2 - pk has no provable secret: its owner cannot
+    # produce a valid PoP with any sk it knows
+    sk2, pk2 = bls_keygen(b"attacker")
+    rogue = g2_add(pk2, g2_neg(pk))
+    assert not bls_verify_possession(rogue, bls_prove_possession(sk2, rogue))

@@ -1,0 +1,73 @@
+"""ShardNode service container + CLI."""
+
+import pytest
+
+from gethsharding_tpu.actors import Notary, Observer, Proposer, Simulator, Syncer, TXPool
+from gethsharding_tpu.db.shard_db import ShardDB
+from gethsharding_tpu.mainchain.client import SMCClient
+from gethsharding_tpu.node.backend import ShardNode
+from gethsharding_tpu.node.cli import build_parser
+from gethsharding_tpu.p2p.service import Hub, P2PServer
+from gethsharding_tpu.params import Config, ETHER
+from gethsharding_tpu.smc.chain import SimulatedMainchain
+
+
+def test_registry_composition_per_actor():
+    backend = SimulatedMainchain()
+    proposer_node = ShardNode(actor="proposer", backend=backend,
+                              txpool_interval=None)
+    assert isinstance(proposer_node.service(Proposer), Proposer)
+    assert isinstance(proposer_node.service(TXPool), TXPool)
+    assert isinstance(proposer_node.service(Simulator), Simulator)
+    with pytest.raises(KeyError):
+        proposer_node.service(Notary)
+
+    notary_node = ShardNode(actor="notary", backend=backend)
+    assert isinstance(notary_node.service(Notary), Notary)
+    with pytest.raises(KeyError):
+        notary_node.service(Simulator)  # notaries don't run the simulator
+
+    observer_node = ShardNode(actor="observer", backend=backend)
+    assert isinstance(observer_node.service(Observer), Observer)
+    assert isinstance(observer_node.service(Syncer), Syncer)
+
+
+def test_unknown_actor_rejected():
+    with pytest.raises(ValueError, match="unknown actor"):
+        ShardNode(actor="validator")
+
+
+def test_start_stop_lifecycle():
+    backend = SimulatedMainchain()
+    node = ShardNode(actor="observer", backend=backend,
+                     simulator_interval=0.05)
+    node.start()
+    assert node.service(Syncer).running
+    node.stop()
+    assert not node.service(Syncer).running
+    assert node.errors() == []
+
+
+def test_nodes_share_hub_and_backend():
+    config = Config(quorum_size=1)
+    backend = SimulatedMainchain(config=config)
+    hub = Hub()
+    a = ShardNode(actor="proposer", shard_id=0, config=config,
+                  backend=backend, hub=hub, txpool_interval=None)
+    b = ShardNode(actor="notary", shard_id=0, config=config,
+                  backend=backend, hub=hub)
+    assert a.client.backend is b.client.backend
+    assert a.p2p.hub is b.p2p.hub
+
+
+def test_cli_parser_flags():
+    parser = build_parser()
+    args = parser.parse_args(
+        ["sharding", "--actor", "notary", "--shardid", "7", "--deposit",
+         "--runtime", "2"]
+    )
+    assert args.actor == "notary"
+    assert args.shardid == 7
+    assert args.deposit is True
+    with pytest.raises(SystemExit):
+        parser.parse_args(["sharding", "--actor", "miner"])

@@ -1,0 +1,81 @@
+"""Typed feed bus + hub transport."""
+
+from gethsharding_tpu.p2p import (
+    CollationBodyRequest,
+    CollationBodyResponse,
+    Feed,
+    Hub,
+    Message,
+    P2PServer,
+)
+from gethsharding_tpu.utils.hexbytes import Address20, Hash32
+
+
+def test_feed_fanout():
+    feed = Feed()
+    s1, s2 = feed.subscribe(), feed.subscribe()
+    assert feed.send("x") == 2
+    assert s1.get(timeout=1) == "x"
+    assert s2.get(timeout=1) == "x"
+    s1.unsubscribe()
+    assert feed.send("y") == 1
+    assert s2.get(timeout=1) == "y"
+
+
+def test_feed_drop_oldest_when_full():
+    feed = Feed()
+    sub = feed.subscribe(maxsize=2)
+    for i in range(5):
+        feed.send(i)
+    assert sub.get(timeout=1) == 3
+    assert sub.get(timeout=1) == 4
+
+
+def test_hub_directed_send():
+    hub = Hub()
+    a, b = P2PServer(hub), P2PServer(hub)
+    a.start()
+    b.start()
+    sub = b.subscribe(CollationBodyRequest)
+    request = CollationBodyRequest(
+        chunk_root=Hash32(b"\x01" * 32), shard_id=1, period=2,
+        proposer=Address20(b"\x02" * 20),
+    )
+    assert a.send(request, b.self_peer)
+    msg = sub.get(timeout=1)
+    assert isinstance(msg, Message)
+    assert msg.data == request
+    assert msg.peer == a.self_peer
+
+
+def test_hub_broadcast_excludes_sender():
+    hub = Hub()
+    servers = [P2PServer(hub) for _ in range(3)]
+    for s in servers:
+        s.start()
+    subs = [s.subscribe(CollationBodyResponse) for s in servers]
+    response = CollationBodyResponse(header_hash=Hash32(), body=b"zz")
+    assert servers[0].broadcast(response) == 2
+    assert subs[1].get(timeout=1).data == response
+    assert subs[2].get(timeout=1).data == response
+    assert subs[0].try_get() is None
+
+
+def test_loopback_reaches_own_feed():
+    server = P2PServer()
+    server.start()
+    sub = server.subscribe(CollationBodyRequest)
+    request = CollationBodyRequest(chunk_root=None, shard_id=0, period=0,
+                                   proposer=None)
+    server.loopback(request)
+    assert sub.get(timeout=1).data == request
+
+
+def test_detach_stops_delivery():
+    hub = Hub()
+    a, b = P2PServer(hub), P2PServer(hub)
+    a.start()
+    b.start()
+    target = b.self_peer
+    b.stop()
+    assert not a.send("gone", target)
