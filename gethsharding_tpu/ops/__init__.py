@@ -1,0 +1,21 @@
+"""ops — batched TPU kernels (JAX/XLA/Pallas) for the consensus hot loops.
+
+The reference implements its hot crypto natively (SURVEY.md §2.3):
+`crypto/secp256k1` (C), `crypto/bn256/cloudflare` (Go + amd64 asm),
+`crypto/sha3` (Go + amd64 asm). Here each becomes a *batch-first* integer
+kernel designed for the TPU's VPU/MXU:
+
+- `limb`        256-bit modular arithmetic as 12-bit limb planes in int32
+                (no 64-bit anywhere; XLA-friendly static shapes).
+- `keccak_jax`  keccak-f[1600] over uint32 lane pairs, vmapped over messages.
+- `bn256_jax`   Fp2/Fp6/Fp12 tower, G1/G2, optimal-ate Miller loop + final
+                exponentiation; batched PairingCheck and BLS aggregate
+                committee-vote verification (the north-star kernel).
+- `secp256k1_jax` batched ECDSA recover/verify (tx-sender recovery replay).
+- `smc_jax`     the SMC vote/committee/quorum rules as fixed-shape array
+                ops, vmappable over shardID.
+
+Everything is integer-only (consensus data never touches floats) and
+differential-tested against the scalar reference implementations in
+`gethsharding_tpu.crypto` / `gethsharding_tpu.smc`.
+"""

@@ -1,0 +1,269 @@
+"""Batched 256-bit modular arithmetic on TPU: 12-bit limb planes in int32.
+
+This is the foundation under the bn256 pairing and secp256k1 kernels
+(SURVEY.md §7 hard part 1: "big-integer modular arithmetic on TPU — needs
+limb decomposition to run inside MXU/VPU efficiently"). Design:
+
+- A field element is 22 limbs x 12 bits (264 bits) stored little-endian in
+  int32, shape ``(..., 22)``. The leading axes are the batch — every op is
+  batch-first and jit/vmap/shard_map-safe (static shapes, no 64-bit dtypes,
+  no data-dependent control flow).
+- Products of 12-bit limbs are 24 bits; a schoolbook column accumulates at
+  most 22 of them: 22 * (2^12-1)^2 < 2^28.5, safely inside int32. No
+  Montgomery form: reduction folds high limbs through a precomputed
+  ``(2^(12*(22+k)) mod p)`` matrix — a small integer matmul, the natural
+  TPU shape — followed by carry propagation (a `lax.scan`).
+- Elements are kept *lazily* reduced: canonical limbs (< 2^12) but value in
+  [0, 2^264), congruent mod p. `canon` produces the unique value < p for
+  equality/export; everything in between stays lazy.
+
+The reference's equivalents are hand-written Montgomery assembly
+(`crypto/bn256/cloudflare/gfp_amd64.s`: gfpNeg/Add/Sub/Mul) and C field
+code (`crypto/secp256k1/libsecp256k1`); those are scalar-serial designs.
+This one trades per-element latency for batch throughput, which is what the
+135-vote x 100-shard workload (BASELINE.md) actually needs.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+LIMB_BITS = 12
+LIMB_MASK = (1 << LIMB_BITS) - 1
+NLIMBS = 22  # 264 bits >= 256-bit moduli with lazy-reduction headroom
+RADIX = 1 << (LIMB_BITS * NLIMBS)  # 2^264
+
+
+def int_to_limbs(value: int, nlimbs: int = NLIMBS) -> np.ndarray:
+    """Little-endian 12-bit limb decomposition of a non-negative int."""
+    if value < 0:
+        raise ValueError("negative value")
+    limbs = np.zeros(nlimbs, dtype=np.int32)
+    for i in range(nlimbs):
+        limbs[i] = value & LIMB_MASK
+        value >>= LIMB_BITS
+    if value:
+        raise ValueError("value does not fit in limbs")
+    return limbs
+
+
+def limbs_to_int(limbs) -> int:
+    """Inverse of int_to_limbs (host-side; accepts any int dtype array)."""
+    arr = np.asarray(limbs)
+    return sum(int(arr[..., i].item()) << (LIMB_BITS * i) for i in range(arr.shape[-1])) \
+        if arr.ndim == 1 else _limbs_to_int_nd(arr)
+
+
+def _limbs_to_int_nd(arr: np.ndarray):
+    out = np.zeros(arr.shape[:-1], dtype=object)
+    for i in range(arr.shape[-1]):
+        out = out + (arr[..., i].astype(object) << (LIMB_BITS * i))
+    return out
+
+
+def ints_to_limbs(values: Sequence[int], nlimbs: int = NLIMBS) -> np.ndarray:
+    """Batch conversion: (batch,) python ints -> (batch, nlimbs) int32."""
+    return np.stack([int_to_limbs(v, nlimbs) for v in values])
+
+
+def _carry(z: jnp.ndarray) -> jnp.ndarray:
+    """Full carry propagation along the last axis via lax.scan.
+
+    Accepts limbs of either sign with magnitude < 2^31 (arithmetic >> gives
+    floor division, so borrows propagate as negative carries). The caller
+    must guarantee the represented value is non-negative and fits the limb
+    count; the final carry out of the scan is dropped (asserted zero by the
+    differential tests, not at runtime — runtime checks would break jit).
+    """
+    zs = jnp.moveaxis(z, -1, 0)
+
+    def step(c, x):
+        t = x + c
+        return t >> LIMB_BITS, t & LIMB_MASK
+
+    _, out = lax.scan(step, jnp.zeros(z.shape[:-1], jnp.int32), zs)
+    return jnp.moveaxis(out, 0, -1)
+
+
+class ModArith:
+    """Batched arithmetic mod a fixed prime p < 2^255 (constants baked in).
+
+    One instance per modulus; all methods are pure functions of jnp arrays
+    and close over numpy constants, so they trace cleanly under jit, vmap,
+    pjit and shard_map.
+    """
+
+    def __init__(self, p: int):
+        # Lazy-form headroom: values live in [0, 2^264); the fold/carry
+        # termination bound in `normalize` holds for any p < 2^257
+        # (covers the 254-bit bn256 and 256-bit secp256k1 fields).
+        if p.bit_length() > 256:
+            raise ValueError("modulus too large for lazy 264-bit form")
+        self.p = p
+        # Fold matrix: row k holds limbs of 2^(12*(22+k)) mod p. 25 rows
+        # cover the widest intermediate (schoolbook product = 43 columns +
+        # 2 carry-pad limbs -> high part 23 limbs; +2 rounds of refold).
+        self.fold = np.stack(
+            [int_to_limbs(pow(1 << (LIMB_BITS * (NLIMBS + k)), 1, p)) for k in range(25)]
+        )  # (25, 22) int32
+        self.fold_j = jnp.asarray(self.fold)
+        # Additive pad for subtraction: smallest multiple of p >= 2^264,
+        # so (x - y + sub_pad) >= 0 for any lazy x, y. Fits 23 limbs.
+        c = -(-RADIX // p)  # ceil
+        self.sub_pad = jnp.asarray(int_to_limbs(c * p, NLIMBS + 1))
+        # Shifted moduli for canonicalization: p << k >= 2^265 at k_max,
+        # descending conditional subtraction brings any lazy value < p.
+        k_max = 0
+        while (p << k_max) < (RADIX * 2):
+            k_max += 1
+        self.pshift = jnp.asarray(
+            np.stack([int_to_limbs(p << k, NLIMBS + 1) for k in range(k_max, -1, -1)])
+        )  # (k_max+1, 23)
+        self.zero = jnp.zeros(NLIMBS, jnp.int32)
+        self.one = jnp.asarray(int_to_limbs(1))
+
+    # -- normalization ------------------------------------------------------
+
+    def _fold_hi(self, z: jnp.ndarray) -> jnp.ndarray:
+        """Fold limbs >= NLIMBS back under the modulus; result NLIMBS wide."""
+        hi = z[..., NLIMBS:]
+        m = hi.shape[-1]
+        if m == 0:
+            return z
+        folded = jnp.matmul(hi, self.fold_j[:m])  # (..., 22), <= 25*2^24
+        return z[..., :NLIMBS] + folded
+
+    def normalize(self, z: jnp.ndarray) -> jnp.ndarray:
+        """Reduce any accumulator (..., L) with |limb| < 2^29 to lazy form:
+        22 canonical limbs, value in [0, 2^264), same residue mod p."""
+        pad = [(0, 0)] * (z.ndim - 1)
+        # carry with 2 pad limbs (absorbs carries up to 2^(24) x L), fold,
+        # repeat; bounds shrink geometrically (see test_limb differential
+        # coverage across extreme inputs).
+        z = _carry(jnp.pad(z, pad + [(0, 2)]))
+        z = self._fold_hi(z)
+        z = _carry(jnp.pad(z, pad + [(0, 2)]))
+        z = self._fold_hi(z)
+        # Value now < 2^265: one carry limb at most. Two conditional folds
+        # of the top bit terminate: after the first, a re-carry can only be
+        # < p; after the second none is possible.
+        for _ in range(2):
+            z = _carry(jnp.pad(z, pad + [(0, 1)]))
+            z = self._fold_hi(z)
+        return _carry(z)
+
+    # -- ring ops (lazy in, lazy out) --------------------------------------
+
+    def add(self, x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+        return self.normalize(x + y)
+
+    def sub(self, x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+        # x - y + (multiple of p >= 2^264) keeps the value non-negative for
+        # any lazy x, y; per-limb range [-0xfff, 2*0xfff] is carry-safe.
+        diff = jnp.pad(x - y, [(0, 0)] * (x.ndim - 1) + [(0, 1)])
+        return self.normalize(diff + self.sub_pad)
+
+    def neg(self, x: jnp.ndarray) -> jnp.ndarray:
+        return self.sub(jnp.broadcast_to(self.zero, x.shape), x)
+
+    def mul_small(self, x: jnp.ndarray, c: int) -> jnp.ndarray:
+        """Multiply by a small non-negative int (c < 2^16)."""
+        return self.normalize(x * jnp.int32(c))
+
+    def mul(self, x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+        """Schoolbook product -> 43 columns -> fold+carry. Batch-first."""
+        prod = x[..., :, None] * y[..., None, :]  # (..., 22, 22) 24-bit terms
+        # Column sums z[k] = sum_{i+j=k} prod[i,j] via anti-diagonal einsum
+        # against a static one-hot (22,22,43): contracts to an integer
+        # matmul XLA maps well.
+        z = jnp.einsum("...ij,ijk->...k", prod, _DIAG_ONEHOT)
+        return self.normalize(z)
+
+    def sqr(self, x: jnp.ndarray) -> jnp.ndarray:
+        return self.mul(x, x)
+
+    # -- canonical form & predicates ---------------------------------------
+
+    def canon(self, x: jnp.ndarray) -> jnp.ndarray:
+        """Unique representative < p (binary descent conditional subtract)."""
+        z = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, 1)])
+        for k in range(self.pshift.shape[0]):
+            z = _cond_sub(z, self.pshift[k])
+        return z[..., :NLIMBS]
+
+    def is_zero(self, x: jnp.ndarray) -> jnp.ndarray:
+        return jnp.all(self.canon(x) == 0, axis=-1)
+
+    def eq(self, x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+        return jnp.all(self.canon(x) == self.canon(y), axis=-1)
+
+    def select(self, cond: jnp.ndarray, x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+        """Branchless select: cond (...,) bool -> limbs from x else y."""
+        return jnp.where(cond[..., None], x, y)
+
+    # -- exponentiation -----------------------------------------------------
+
+    def pow_static(self, x: jnp.ndarray, e: int) -> jnp.ndarray:
+        """x^e for a *compile-time* exponent, as a lax.scan over its bits
+        (right-to-left square-and-multiply; branchless select per bit)."""
+        if e == 0:
+            return jnp.broadcast_to(self.one, x.shape)
+        bits = jnp.asarray(
+            np.array([(e >> i) & 1 for i in range(e.bit_length())], np.int32)
+        )
+
+        def step(carry, bit):
+            acc, base = carry
+            acc = self.select(bit == 1, self.mul(acc, base), acc)
+            return (acc, self.sqr(base)), None
+
+        acc0 = jnp.broadcast_to(self.one, x.shape)
+        (acc, _), _ = lax.scan(step, (acc0, x), bits)
+        return acc
+
+    def inv(self, x: jnp.ndarray) -> jnp.ndarray:
+        """Modular inverse by Fermat (p prime). inv(0) = 0."""
+        return self.pow_static(x, self.p - 2)
+
+    # -- host conversions ---------------------------------------------------
+
+    def to_ints(self, x) -> np.ndarray:
+        return _limbs_to_int_nd(np.asarray(self.canon(x)))
+
+    def from_int(self, v: int) -> jnp.ndarray:
+        return jnp.asarray(int_to_limbs(v % self.p))
+
+    def from_ints(self, values: Sequence[int]) -> jnp.ndarray:
+        return jnp.asarray(ints_to_limbs([v % self.p for v in values]))
+
+
+def _make_diag_onehot() -> jnp.ndarray:
+    """(22, 22, 43) one-hot E[i, j, i+j] = 1 for the anti-diagonal sum."""
+    e = np.zeros((NLIMBS, NLIMBS, 2 * NLIMBS - 1), np.int32)
+    for i in range(NLIMBS):
+        for j in range(NLIMBS):
+            e[i, j, i + j] = 1
+    return jnp.asarray(e)
+
+
+_DIAG_ONEHOT = _make_diag_onehot()
+
+
+def _cond_sub(z: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """If z >= w (limb arrays, canonical limbs), z - w, else z. Branchless."""
+    diff = jnp.moveaxis(z - w, -1, 0)
+
+    def step(borrow, d):
+        t = d + borrow
+        return t >> LIMB_BITS, t & LIMB_MASK
+
+    borrow, out = lax.scan(step, jnp.zeros(z.shape[:-1], jnp.int32), diff)
+    ge = borrow == 0  # no net borrow -> z >= w
+    return jnp.where(ge[..., None], jnp.moveaxis(out, 0, -1), z)

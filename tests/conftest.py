@@ -14,3 +14,19 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# If a TPU-tunnel PJRT plugin (e.g. the axon sitecustomize hook) registered
+# itself at interpreter start, drop it from the backend factories: tests are
+# CPU-only by design, and a flaky tunnel must not hang backend init.
+try:  # pragma: no cover - environment-dependent
+    import jax
+    import jax._src.xla_bridge as _xb
+
+    # pytest plugins may import jax before this conftest runs, freezing
+    # jax_platforms from the pre-mutation environment — override it too.
+    jax.config.update("jax_platforms", "cpu")
+    for _name in list(getattr(_xb, "_backend_factories", {})):
+        if _name not in ("cpu",):
+            _xb._backend_factories.pop(_name, None)
+except Exception:
+    pass
