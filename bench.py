@@ -1,0 +1,73 @@
+"""Driver benchmark: batched consensus-kernel throughput on real hardware.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: aggregate 256-bit field multiplications/sec through the limb engine
+(ops/limb.py) at the notary workload shape — 100 shards x 135 committee
+members (BASELINE.md configs 2-3). This is the primitive under every
+pairing/signature verification; the headline sig-verifs/sec metric lands
+once ops/bn256_jax.py wires the full pairing on top.
+
+vs_baseline: the reference publishes no measured numbers (BASELINE.md), so
+the ratio is against the driver's north-star target expressed in this
+primitive's units.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from gethsharding_tpu.crypto.bn256 import P as BN_P
+    from gethsharding_tpu.ops.limb import ModArith
+
+    arith = ModArith(BN_P)
+    shards, committee = 100, 135
+    batch = shards * committee  # 13500 field elements in flight
+
+    muls_per_step = 8
+
+    @jax.jit
+    def step(x, y):
+        for _ in range(muls_per_step):
+            x = arith.mul(x, y)
+        return x
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, 1 << 12, (batch, 22), dtype=np.int32))
+    y = jnp.asarray(rng.integers(0, 1 << 12, (batch, 22), dtype=np.int32))
+
+    step(x, y).block_until_ready()  # compile
+
+    iters = 20
+    t0 = time.perf_counter()
+    out = x
+    for _ in range(iters):
+        out = step(out, y)
+    out.block_until_ready()
+    elapsed = time.perf_counter() - t0
+
+    total_muls = batch * muls_per_step * iters
+    rate = total_muls / elapsed
+
+    # North star: >=100k sig-verifs/sec. One BLS aggregate verify is two
+    # pairings; one pairing ~ 1.5e4 field muls (Miller loop + final exp), so
+    # the target in this unit is ~3e9 field muls/sec.
+    baseline_rate = 3.0e9
+    print(json.dumps({
+        "metric": "field_mul_throughput_256bit",
+        "value": round(rate, 1),
+        "unit": "muls/sec",
+        "vs_baseline": round(rate / baseline_rate, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -73,14 +73,12 @@ def ints_to_limbs(values: Sequence[int], nlimbs: int = NLIMBS) -> np.ndarray:
     return np.stack([int_to_limbs(v, nlimbs) for v in values])
 
 
-def _carry(z: jnp.ndarray) -> jnp.ndarray:
-    """Full carry propagation along the last axis via lax.scan.
+def _carry_scan(z: jnp.ndarray):
+    """Carry propagation along the last axis via lax.scan.
 
     Accepts limbs of either sign with magnitude < 2^31 (arithmetic >> gives
-    floor division, so borrows propagate as negative carries). The caller
-    must guarantee the represented value is non-negative and fits the limb
-    count; the final carry out of the scan is dropped (asserted zero by the
-    differential tests, not at runtime — runtime checks would break jit).
+    floor division, so borrows propagate as negative carries). Returns
+    (carry_out, limbs); `_carry` drops the carry, `_cond_sub` tests it.
     """
     zs = jnp.moveaxis(z, -1, 0)
 
@@ -88,8 +86,17 @@ def _carry(z: jnp.ndarray) -> jnp.ndarray:
         t = x + c
         return t >> LIMB_BITS, t & LIMB_MASK
 
-    _, out = lax.scan(step, jnp.zeros(z.shape[:-1], jnp.int32), zs)
-    return jnp.moveaxis(out, 0, -1)
+    # init carry derived from the input so its varying-manual-axes match
+    # under shard_map (a fresh constant would be unvarying -> scan TypeError)
+    carry, out = lax.scan(step, zs[0] * 0, zs)
+    return carry, jnp.moveaxis(out, 0, -1)
+
+
+def _carry(z: jnp.ndarray) -> jnp.ndarray:
+    """Full carry propagation; the final carry out is dropped (asserted zero
+    by the differential tests, not at runtime — runtime checks would break
+    jit). The caller must guarantee the value is non-negative and fits."""
+    return _carry_scan(z)[1]
 
 
 class ModArith:
@@ -110,24 +117,24 @@ class ModArith:
         # Fold matrix: row k holds limbs of 2^(12*(22+k)) mod p. 25 rows
         # cover the widest intermediate (schoolbook product = 43 columns +
         # 2 carry-pad limbs -> high part 23 limbs; +2 rounds of refold).
-        self.fold = np.stack(
+        self.fold_j = np.stack(
             [int_to_limbs(pow(1 << (LIMB_BITS * (NLIMBS + k)), 1, p)) for k in range(25)]
-        )  # (25, 22) int32
-        self.fold_j = jnp.asarray(self.fold)
+        )  # (25, 22) int32; numpy on purpose — jnp.matmul accepts it and
+        # constant-folds under jit without forcing backend init at __init__
         # Additive pad for subtraction: smallest multiple of p >= 2^264,
         # so (x - y + sub_pad) >= 0 for any lazy x, y. Fits 23 limbs.
         c = -(-RADIX // p)  # ceil
-        self.sub_pad = jnp.asarray(int_to_limbs(c * p, NLIMBS + 1))
+        self.sub_pad = int_to_limbs(c * p, NLIMBS + 1)
         # Shifted moduli for canonicalization: p << k >= 2^265 at k_max,
         # descending conditional subtraction brings any lazy value < p.
         k_max = 0
         while (p << k_max) < (RADIX * 2):
             k_max += 1
-        self.pshift = jnp.asarray(
-            np.stack([int_to_limbs(p << k, NLIMBS + 1) for k in range(k_max, -1, -1)])
+        self.pshift = np.stack(
+            [int_to_limbs(p << k, NLIMBS + 1) for k in range(k_max, -1, -1)]
         )  # (k_max+1, 23)
-        self.zero = jnp.zeros(NLIMBS, jnp.int32)
-        self.one = jnp.asarray(int_to_limbs(1))
+        self.zero = np.zeros(NLIMBS, np.int32)
+        self.one = int_to_limbs(1)
 
     # -- normalization ------------------------------------------------------
 
@@ -244,13 +251,17 @@ class ModArith:
         return jnp.asarray(ints_to_limbs([v % self.p for v in values]))
 
 
-def _make_diag_onehot() -> jnp.ndarray:
-    """(22, 22, 43) one-hot E[i, j, i+j] = 1 for the anti-diagonal sum."""
+def _make_diag_onehot() -> np.ndarray:
+    """(22, 22, 43) one-hot E[i, j, i+j] = 1 for the anti-diagonal sum.
+
+    Kept as numpy: jnp.einsum accepts numpy operands and constant-folds it
+    identically under jit, and importing this module must not trigger JAX
+    backend initialization (the TPU-tunnel PJRT plugin can be flaky)."""
     e = np.zeros((NLIMBS, NLIMBS, 2 * NLIMBS - 1), np.int32)
     for i in range(NLIMBS):
         for j in range(NLIMBS):
             e[i, j, i + j] = 1
-    return jnp.asarray(e)
+    return e
 
 
 _DIAG_ONEHOT = _make_diag_onehot()
@@ -258,12 +269,6 @@ _DIAG_ONEHOT = _make_diag_onehot()
 
 def _cond_sub(z: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
     """If z >= w (limb arrays, canonical limbs), z - w, else z. Branchless."""
-    diff = jnp.moveaxis(z - w, -1, 0)
-
-    def step(borrow, d):
-        t = d + borrow
-        return t >> LIMB_BITS, t & LIMB_MASK
-
-    borrow, out = lax.scan(step, jnp.zeros(z.shape[:-1], jnp.int32), diff)
+    borrow, out = _carry_scan(z - w)
     ge = borrow == 0  # no net borrow -> z >= w
-    return jnp.where(ge[..., None], jnp.moveaxis(out, 0, -1), z)
+    return jnp.where(ge[..., None], out, z)

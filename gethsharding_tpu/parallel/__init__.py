@@ -1,0 +1,18 @@
+"""parallel — multi-chip scaling: Mesh construction + shard_map pipelines.
+
+The reference scales by running one OS process per shard actor and letting
+the SMC serialize everything (SURVEY.md §2.2: shard-level data parallelism
+is the only axis). Here the same workload — per-shard vote verification,
+tallying, and quorum — is laid out over a `jax.sharding.Mesh` so that the
+per-shard work rides the VPU/MXU in lockstep and the cross-shard reductions
+ride ICI collectives (`psum` under `shard_map`), per the north star
+(SURVEY.md §5.8).
+
+Tests exercise these paths on a virtual 8-device CPU mesh
+(`tests/conftest.py` sets xla_force_host_platform_device_count), matching
+how the driver dry-runs `__graft_entry__.dryrun_multichip`.
+"""
+
+from gethsharding_tpu.parallel.mesh import make_mesh, shard_axis_sharding
+
+__all__ = ["make_mesh", "shard_axis_sharding"]

@@ -1,0 +1,40 @@
+"""Device mesh construction for the shard axis.
+
+One logical axis — ``"shard"`` — carries the framework's only data-parallel
+dimension (100 independent shard chains, `sharding_manager.sol:56`). Batch
+work whose leading axis is shardID shards cleanly over it; per-period
+cross-shard reductions (vote tallies, quorum counts) become `psum` over the
+axis, which XLA lowers to ICI all-reduces on real TPU topologies.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """A 1-D mesh over the ``"shard"`` axis.
+
+    ``n_devices=None`` uses every visible device; otherwise the first
+    ``n_devices`` (the driver's dryrun passes an explicit count against a
+    virtual CPU platform).
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devices)} visible"
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), axis_names=("shard",))
+
+
+def shard_axis_sharding(mesh: Mesh) -> NamedSharding:
+    """NamedSharding that splits the leading (shardID) axis over the mesh."""
+    return NamedSharding(mesh, PartitionSpec("shard"))

@@ -1,0 +1,25 @@
+"""Driver-contract tests: __graft_entry__.entry / dryrun_multichip.
+
+Mirrors what the driver does: compile-check `entry()` on one device and
+run `dryrun_multichip(8)` on the virtual 8-device CPU mesh (conftest).
+"""
+
+import sys
+from pathlib import Path
+
+import jax
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import __graft_entry__ as graft  # noqa: E402
+
+
+def test_entry_compiles_and_runs():
+    fn, args = graft.entry()
+    out = jax.jit(fn)(*args)
+    out.block_until_ready()
+    assert out.shape[0] == 135
+
+
+def test_dryrun_multichip_8_devices():
+    graft.dryrun_multichip(8)
