@@ -1,0 +1,592 @@
+"""Batched bn256 (alt_bn128) ate pairing on TPU — the north-star kernel.
+
+Re-architecture of the reference's hand-written pairing stack
+(`crypto/bn256/cloudflare`: gfP Montgomery asm `gfp_amd64.s`, Miller loop
+`optate.go`, `PairingCheck` `bn256.go:313`) as batch-first integer array
+programs over the 12-bit-limb field engine (`ops/limb.py`):
+
+- Tower: Fp2 = Fp[i]/(i²+1) as (..., 2, 22) int32; Fp6 = Fp2[v]/(v³-ξ) as
+  (..., 3, 2, 22); Fp12 = Fp6[w]/(w²-v) as (..., 2, 3, 2, 22). ξ = 9+i.
+- Fused tower multiplication: products accumulate in raw schoolbook column
+  space (`ModArith.mul_cols`) and reduce with ONE `normalize` per output
+  component, with `pad_mult` keeping subtracted accumulators non-negative.
+- Miller loop: ate pairing, T = 6u² (trace-1) — the same loop the scalar
+  reference `crypto/bn256.py` uses, so PairingCheck predicates agree by
+  construction. G2 runs in Jacobian coordinates on the twist; line
+  evaluations are inversion-free (each line is scaled by an Fp2 factor,
+  which the final exponentiation kills). Static 127-bit `lax.scan`.
+- Final exponentiation: easy part ((p⁶-1)(p²+1)) via conjugation + one
+  tower inversion, then the standard hard-part addition chain
+  (Devegili–Scott–Dahab) over f^u powers and Frobenius maps — ~3×63
+  square-multiply steps instead of a 3000-bit blind power.
+
+Everything is shape-static, integer-only, and differential-tested against
+the scalar `gethsharding_tpu.crypto.bn256` (tests/test_bn256_jax.py).
+Batch axes are leading axes; `vmap`/`shard_map` compose.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from gethsharding_tpu.crypto import bn256 as ref
+from gethsharding_tpu.ops.limb import ModArith, NLIMBS, ints_to_limbs, int_to_limbs
+
+P = ref.P
+N = ref.N
+U = ref.U
+FP = ModArith(P)
+
+# Column-space bounds: one 22-limb product column < 22·2^24 ≈ 2^28.46; an
+# int32 column accumulator safely holds the sum of FOUR such products plus
+# a canonical pad (< 2^12 per column): 4·2^28.46 + 2^12 < 2^30.5.
+_PAD528 = FP.pad_mult(530)  # covers |subtracted| sums < 2^530
+
+
+def _pad_to(cols: jnp.ndarray, width: int) -> jnp.ndarray:
+    return jnp.pad(cols, [(0, 0)] * (cols.ndim - 1) + [(0, width - cols.shape[-1])])
+
+
+def _red(cols: jnp.ndarray) -> jnp.ndarray:
+    return FP.normalize(cols)
+
+
+def _red_sub(pos_cols: jnp.ndarray, neg_cols: jnp.ndarray) -> jnp.ndarray:
+    """normalize(pos - neg + pad·p), pads aligned to a common width."""
+    width = max(pos_cols.shape[-1], neg_cols.shape[-1], _PAD528.shape[0])
+    z = _pad_to(pos_cols, width) - _pad_to(neg_cols, width)
+    return FP.normalize(z + jnp.asarray(np.pad(_PAD528, (0, width - _PAD528.shape[0]))))
+
+
+# == Fp2: (..., 2, 22), slot 0 = real, slot 1 = i-coefficient =============
+
+
+def fp2_add(x, y):
+    return FP.normalize(x + y)
+
+
+def fp2_sub(x, y):
+    return FP.sub(x, y)
+
+
+def fp2_neg(x):
+    return FP.neg(x)
+
+
+@jax.jit
+def fp2_mul(x, y):
+    """(a+bi)(c+di) = (ac - bd) + (ad + bc)i — fused, 2 normalizes."""
+    a, b = x[..., 0, :], x[..., 1, :]
+    c, d = y[..., 0, :], y[..., 1, :]
+    rr = _red_sub(FP.mul_cols(a, c), FP.mul_cols(b, d))
+    ii = _red(FP.mul_cols(a, d) + FP.mul_cols(b, c))
+    return jnp.stack([rr, ii], axis=-2)
+
+
+@jax.jit
+def fp2_sqr(x):
+    a, b = x[..., 0, :], x[..., 1, :]
+    rr = _red_sub(FP.mul_cols(a, a), FP.mul_cols(b, b))
+    ii = _red(FP.mul_cols(a, b) * 2)
+    return jnp.stack([rr, ii], axis=-2)
+
+
+def fp2_scalar(x, k: int):
+    """Multiply both components by a small non-negative int."""
+    return FP.mul_small(x, k)
+
+
+def fp2_mul_fp(x, s):
+    """Fp2 element times Fp element s (..., 22)."""
+    a, b = x[..., 0, :], x[..., 1, :]
+    return jnp.stack([FP.mul(a, s), FP.mul(b, s)], axis=-2)
+
+
+@jax.jit
+def fp2_mul_xi(x):
+    """×ξ = ×(9+i): (9a - b) + (a + 9b)i."""
+    a, b = x[..., 0, :], x[..., 1, :]
+    rr = FP.sub(FP.mul_small(a, 9), b)
+    ii = FP.normalize(a + FP.mul_small(b, 9))
+    return jnp.stack([rr, ii], axis=-2)
+
+
+def fp2_conj(x):
+    a, b = x[..., 0, :], x[..., 1, :]
+    return jnp.stack([FP.normalize(a), FP.neg(b)], axis=-2)
+
+
+@jax.jit
+def fp2_inv(x):
+    """1/(a+bi) = (a - bi)/(a² + b²); inv(0) = 0."""
+    a, b = x[..., 0, :], x[..., 1, :]
+    norm = _red(FP.mul_cols(a, a) + FP.mul_cols(b, b))
+    ninv = FP.inv(norm)
+    return jnp.stack([FP.mul(a, ninv), FP.neg(FP.mul(b, ninv))], axis=-2)
+
+
+def fp2_is_zero(x):
+    return FP.is_zero(x[..., 0, :]) & FP.is_zero(x[..., 1, :])
+
+
+def fp2_eq(x, y):
+    return FP.eq(x[..., 0, :], y[..., 0, :]) & FP.eq(x[..., 1, :], y[..., 1, :])
+
+
+def _const_fp2(value_a: int, value_b: int) -> np.ndarray:
+    return np.stack([int_to_limbs(value_a % P), int_to_limbs(value_b % P)])
+
+
+FP2_ZERO = np.zeros((2, NLIMBS), np.int32)
+FP2_ONE = _const_fp2(1, 0)
+
+
+# == Fp6: (..., 3, 2, 22) over basis 1, v, v² =============================
+
+
+def fp6_add(x, y):
+    return FP.normalize(x + y)
+
+
+def fp6_sub(x, y):
+    return FP.sub(x, y)
+
+
+def fp6_neg(x):
+    return FP.neg(x)
+
+
+def _c(x, k):
+    return x[..., k, :, :]
+
+
+@jax.jit
+def fp6_mul(x, y):
+    """Schoolbook with v³ = ξ (mirrors scalar Fp6.__mul__)."""
+    a0, a1, a2 = _c(x, 0), _c(x, 1), _c(x, 2)
+    b0, b1, b2 = _c(y, 0), _c(y, 1), _c(y, 2)
+    t0 = fp2_mul(a0, b0)
+    t1 = fp2_add(fp2_mul(a0, b1), fp2_mul(a1, b0))
+    t2 = fp2_add(fp2_add(fp2_mul(a0, b2), fp2_mul(a1, b1)), fp2_mul(a2, b0))
+    t3 = fp2_add(fp2_mul(a1, b2), fp2_mul(a2, b1))  # v³ -> ξ
+    t4 = fp2_mul(a2, b2)  # v⁴ -> ξ·v
+    return jnp.stack(
+        [fp2_add(t0, fp2_mul_xi(t3)), fp2_add(t1, fp2_mul_xi(t4)), t2], axis=-3)
+
+
+def fp6_mul_fp2(x, k):
+    return jnp.stack([fp2_mul(_c(x, j), k) for j in range(3)], axis=-3)
+
+
+def fp6_mul_by_v(x):
+    """(c0, c1, c2) -> (ξ·c2, c0, c1)."""
+    return jnp.stack([fp2_mul_xi(_c(x, 2)), _c(x, 0), _c(x, 1)], axis=-3)
+
+
+@jax.jit
+def fp6_inv(x):
+    """Cubic-extension inversion via the adjoint matrix (scalar parity)."""
+    a, b, c = _c(x, 0), _c(x, 1), _c(x, 2)
+    t0 = fp2_sub(fp2_sqr(a), fp2_mul_xi(fp2_mul(b, c)))
+    t1 = fp2_sub(fp2_mul_xi(fp2_sqr(c)), fp2_mul(a, b))
+    t2 = fp2_sub(fp2_sqr(b), fp2_mul(a, c))
+    denom = fp2_add(fp2_mul(a, t0),
+                    fp2_mul_xi(fp2_add(fp2_mul(c, t1), fp2_mul(b, t2))))
+    dinv = fp2_inv(denom)
+    return jnp.stack(
+        [fp2_mul(t0, dinv), fp2_mul(t1, dinv), fp2_mul(t2, dinv)], axis=-3)
+
+
+FP6_ZERO = np.zeros((3, 2, NLIMBS), np.int32)
+FP6_ONE = np.stack([FP2_ONE, FP2_ZERO, FP2_ZERO])
+
+
+# == Fp12: (..., 2, 3, 2, 22) over basis 1, w with w² = v =================
+
+
+def _h(x, k):
+    return x[..., k, :, :, :]
+
+
+@jax.jit
+def fp12_mul(x, y):
+    t0 = fp6_mul(_h(x, 0), _h(y, 0))
+    t1 = fp6_mul(_h(x, 1), _h(y, 1))
+    lo = fp6_add(t0, fp6_mul_by_v(t1))
+    hi = fp6_add(fp6_mul(_h(x, 0), _h(y, 1)), fp6_mul(_h(x, 1), _h(y, 0)))
+    return jnp.stack([lo, hi], axis=-4)
+
+
+@jax.jit
+def fp12_sqr(x):
+    """Complex squaring: (c0 + c1·w)² via 2 fp6 muls instead of 4.
+
+    lo = (c0+c1)(c0+v·c1) - t - v·t, hi = 2t, with t = c0·c1."""
+    c0, c1 = _h(x, 0), _h(x, 1)
+    t = fp6_mul(c0, c1)
+    vt = fp6_mul_by_v(t)
+    lo = fp6_sub(
+        fp6_sub(fp6_mul(fp6_add(c0, c1), fp6_add(c0, fp6_mul_by_v(c1))), t),
+        vt)
+    hi = FP.mul_small(t, 2)
+    return jnp.stack([lo, hi], axis=-4)
+
+
+@jax.jit
+def fp12_conj(x):
+    """f^(p⁶): (c0, c1) -> (c0, -c1)."""
+    return jnp.stack([FP.normalize(_h(x, 0)), FP.neg(_h(x, 1))], axis=-4)
+
+
+@jax.jit
+def fp12_inv(x):
+    denom = fp6_sub(fp6_mul(_h(x, 0), _h(x, 0)),
+                    fp6_mul_by_v(fp6_mul(_h(x, 1), _h(x, 1))))
+    dinv = fp6_inv(denom)
+    return jnp.stack(
+        [fp6_mul(_h(x, 0), dinv), fp6_neg(fp6_mul(_h(x, 1), dinv))], axis=-4)
+
+
+def fp12_select(cond, x, y):
+    return jnp.where(cond[..., None, None, None, None], x, y)
+
+
+def fp12_is_one(x):
+    one = jnp.asarray(FP12_ONE)
+    flat = FP.canon(x)
+    return jnp.all(flat == FP.canon(jnp.broadcast_to(one, x.shape)),
+                   axis=(-1, -2, -3, -4))
+
+
+FP12_ONE = np.stack([FP6_ONE, FP6_ZERO])
+
+
+# == Frobenius maps =======================================================
+# (a·wᵏ)^(pⁿ) = conjⁿ(a) · γ_{n,k} · wᵏ with γ_{n,k} = ξ^(k(pⁿ-1)/6) ∈ Fp2.
+# Basis order over Fp2: w⁰..w⁵ = c0.d0, c1.d0, c0.d1, c1.d1, c0.d2, c1.d2.
+
+
+def _fp2_pow_host(base: ref.Fp2, e: int) -> ref.Fp2:
+    result, b = ref.Fp2.one(), base
+    while e:
+        if e & 1:
+            result = result * b
+        b = b * b
+        e >>= 1
+    return result
+
+
+def _gamma_table(n: int) -> np.ndarray:
+    """(6, 2, 22) limb constants γ_{n,k} for k = 0..5."""
+    rows = []
+    for k in range(6):
+        g = _fp2_pow_host(ref.XI, k * (P**n - 1) // 6)
+        rows.append(_const_fp2(g.a, g.b))
+    return np.stack(rows)
+
+
+_GAMMA = {n: _gamma_table(n) for n in (1, 2, 3)}
+_WSLOT = [(0, 0), (1, 0), (0, 1), (1, 1), (0, 2), (1, 2)]  # wᵏ -> (h, l)
+
+
+def fp12_frobenius(x, n: int):
+    """f^(pⁿ) for n ∈ {1, 2, 3}."""
+    gam = _GAMMA[n]
+    halves = [[None, None, None], [None, None, None]]
+    for k, (h, l) in enumerate(_WSLOT):
+        coeff = x[..., h, l, :, :]
+        if n % 2 == 1:
+            coeff = fp2_conj(coeff)
+        else:
+            coeff = FP.normalize(coeff)
+        halves[h][l] = fp2_mul(coeff, jnp.asarray(gam[k]))
+    return jnp.stack([jnp.stack(halves[0], axis=-3),
+                      jnp.stack(halves[1], axis=-3)], axis=-4)
+
+
+# == G2 Jacobian steps with line evaluation ================================
+# Twist point T = (X, Y, Z) Jacobian (x = X/Z², y = Y/Z³), each Fp2.
+# Lines are evaluated at P = (px, py) ∈ G1 and scaled by an Fp2 factor
+# (killed by the final exponentiation). Sparse form: ℓ = A + B·w + C·w³
+# with A = c_py·py, B = c_px·px, C = c_const, all Fp2.
+
+
+def _dbl_step(X, Y, Z, px, py):
+    """Tangent step: returns (line (A,B,C), X3, Y3, Z3). Scale = 2YZ³."""
+    A = fp2_sqr(X)
+    B = fp2_sqr(Y)
+    C = fp2_sqr(B)
+    t = fp2_sqr(fp2_add(X, B))
+    D = fp2_scalar(fp2_sub(fp2_sub(t, A), C), 2)  # 4XY²
+    E = fp2_scalar(A, 3)
+    F = fp2_sqr(E)
+    X3 = fp2_sub(F, fp2_scalar(D, 2))
+    Y3 = fp2_sub(fp2_mul(E, fp2_sub(D, X3)), fp2_scalar(C, 8))
+    ZZ = fp2_sqr(Z)
+    Z3 = fp2_scalar(fp2_mul(Y, Z), 2)
+    c_py = fp2_mul(Z3, ZZ)                       # 2YZ³
+    c_px = fp2_neg(fp2_mul(E, ZZ))               # -3X²Z²
+    c_const = fp2_sub(fp2_mul(E, X), fp2_scalar(B, 2))  # 3X³ - 2Y²
+    line = (fp2_mul_fp(c_py, py), fp2_mul_fp(c_px, px), c_const)
+    return line, X3, Y3, Z3
+
+
+def _madd_step(X1, Y1, Z1, x2, y2, px, py):
+    """Chord step vs affine Q = (x2, y2): line scale = Z3 = Z1·H."""
+    Z1Z1 = fp2_sqr(Z1)
+    U2 = fp2_mul(x2, Z1Z1)
+    S2 = fp2_mul(y2, fp2_mul(Z1, Z1Z1))
+    H = fp2_sub(U2, X1)
+    R = fp2_sub(S2, Y1)
+    HH = fp2_sqr(H)
+    V = fp2_mul(X1, HH)
+    HHH = fp2_mul(H, HH)
+    X3 = fp2_sub(fp2_sub(fp2_sqr(R), HHH), fp2_scalar(V, 2))
+    Y3 = fp2_sub(fp2_mul(R, fp2_sub(V, X3)), fp2_mul(Y1, HHH))
+    Z3 = fp2_mul(Z1, H)
+    c_const = fp2_sub(fp2_mul(R, x2), fp2_mul(Z3, y2))
+    line = (fp2_mul_fp(Z3, py), fp2_mul_fp(fp2_neg(R), px), c_const)
+    return line, X3, Y3, Z3
+
+
+@jax.jit
+def fp12_mul_line(f, line):
+    """f · (A + B·w + C·w³), sparse (13 fp2 muls vs 18+ for full mul)."""
+    A, B, C = line
+    f0, f1 = _h(f, 0), _h(f, 1)
+    # f0·ℓ0 and f1·ℓ0 with ℓ0 = (A, 0, 0)
+    f0A = fp6_mul_fp2(f0, A)
+    f1A = fp6_mul_fp2(f1, A)
+    # ℓ1 = (B, C, 0): Fp6-sparse product g·ℓ1
+    def mul_l1(g):
+        g0, g1, g2 = _c(g, 0), _c(g, 1), _c(g, 2)
+        t0 = fp2_add(fp2_mul(g0, B), fp2_mul_xi(fp2_mul(g2, C)))
+        t1 = fp2_add(fp2_mul(g0, C), fp2_mul(g1, B))
+        t2 = fp2_add(fp2_mul(g1, C), fp2_mul(g2, B))
+        return jnp.stack([t0, t1, t2], axis=-3)
+    lo = fp6_add(f0A, fp6_mul_by_v(mul_l1(f1)))
+    hi = fp6_add(mul_l1(f0), f1A)
+    return jnp.stack([lo, hi], axis=-4)
+
+
+# == Miller loop (ate, T = 6u²) ===========================================
+
+ATE_BITS = np.array(
+    [int(b) for b in bin(ref.ATE_LOOP_COUNT)[3:]], np.int32)  # MSB consumed
+
+
+def miller_loop(px, py, qx, qy):
+    """f_{T,Q}(P) batched. px/py (..., 22); qx/qy (..., 2, 22) affine G2.
+
+    Inputs must be valid curve points; infinity handling is the caller's
+    (mask + select, see pairing_check)."""
+    shape = px.shape[:-1]
+    f = jnp.broadcast_to(jnp.asarray(FP12_ONE), shape + (2, 3, 2, NLIMBS))
+    X = jnp.broadcast_to(qx, shape + (2, NLIMBS))
+    Y = jnp.broadcast_to(qy, shape + (2, NLIMBS))
+    Z = jnp.broadcast_to(jnp.asarray(FP2_ONE), shape + (2, NLIMBS))
+    # normalize broadcasts into concrete arrays for scan carry stability
+    f, X, Y, Z = map(FP.normalize, (f, X, Y, Z))
+
+    def step(carry, bit):
+        f, X, Y, Z = carry
+        line, X, Y, Z = _dbl_step(X, Y, Z, px, py)
+        f = fp12_mul_line(fp12_sqr(f), line)
+        line_a, Xa, Ya, Za = _madd_step(X, Y, Z, qx, qy, px, py)
+        fa = fp12_mul_line(f, line_a)
+        take = jnp.broadcast_to(bit == 1, shape)
+        f = fp12_select(take, fa, f)
+        sel = lambda a, b: jnp.where(take[..., None, None], a, b)
+        return (f, sel(Xa, X), sel(Ya, Y), sel(Za, Z)), None
+
+    (f, X, Y, Z), _ = lax.scan(step, (f, X, Y, Z), jnp.asarray(ATE_BITS))
+    return f
+
+
+# == Final exponentiation ==================================================
+
+
+# The hard part runs as a small register machine under ONE lax.scan so XLA
+# compiles each fp12 primitive once (an inline chain of ~25 fp12_muls
+# multiplies compile time by the chain length). Ops: 0 mul, 1 sqr, 2 conj,
+# 3/4/5 frobenius¹/²/³, 6 pow-by-u. Registers: 14 × Fp12.
+# Program = the Devegili–Scott–Dahab chain; register plan in comments.
+_HARD_PROGRAM = np.array([
+    # (op, src_a, src_b, dst) — registers 1..3 (f^u, f^u², f^u³) are filled
+    # by plain _pow_u calls before the scan; XLA dedups their identical
+    # inner scans, and the switch branches stay light.
+    (3, 0, 0, 4),    # r4 = frob1(f)
+    (4, 0, 0, 5),    # r5 = frob2(f)
+    (5, 0, 0, 6),    # r6 = frob3(f)
+    (0, 4, 5, 4),    # r4 = r4·r5
+    (0, 4, 6, 4),    # y0 = r4 = r4·r6
+    (2, 0, 0, 5),    # y1 = r5 = conj(f)
+    (4, 2, 0, 6),    # y2 = r6 = frob2(fu2)
+    (3, 1, 0, 7),    # r7 = frob1(fu)
+    (2, 7, 0, 7),    # y3 = r7 = conj(r7)
+    (3, 2, 0, 8),    # r8 = frob1(fu2)
+    (0, 1, 8, 8),    # r8 = fu·r8
+    (2, 8, 0, 8),    # y4 = r8 = conj(r8)
+    (2, 2, 0, 9),    # y5 = r9 = conj(fu2)
+    (3, 3, 0, 10),   # r10 = frob1(fu3)
+    (0, 3, 10, 10),  # r10 = fu3·r10
+    (2, 10, 0, 10),  # y6 = r10 = conj(r10)
+    (1, 10, 0, 11),  # t0 = r11 = y6²
+    (0, 11, 8, 11),  # t0 = t0·y4
+    (0, 11, 9, 11),  # t0 = t0·y5
+    (0, 7, 9, 12),   # t1 = r12 = y3·y5
+    (0, 12, 11, 12),  # t1 = t1·t0
+    (0, 11, 6, 11),  # t0 = t0·y2
+    (1, 12, 0, 12),  # t1 = t1²
+    (0, 12, 11, 12),  # t1 = t1·t0
+    (1, 12, 0, 12),  # t1 = t1²
+    (0, 12, 5, 13),  # t0' = r13 = t1·y1
+    (0, 12, 4, 12),  # t1 = t1·y0
+    (1, 13, 0, 13),  # t0' = t0'²
+    (0, 13, 12, 13),  # result = r13 = t0'·t1
+], np.int32)
+_N_REGS = 14
+
+_U_BITS = np.array([(U >> i) & 1 for i in range(U.bit_length())], np.int32)
+
+
+def _pow_u(x):
+    """x^u (u = BN parameter, 63 static bits) via square-multiply scan."""
+    def step(carry, bit):
+        acc, base = carry
+        take = jnp.broadcast_to(bit == 1, acc.shape[:-4])
+        acc = fp12_select(take, fp12_mul(acc, base), acc)
+        return (acc, fp12_sqr(base)), None
+
+    acc0 = FP.normalize(jnp.broadcast_to(jnp.asarray(FP12_ONE), x.shape))
+    (acc, _), _ = lax.scan(step, (acc0, x), jnp.asarray(_U_BITS))
+    return acc
+
+
+def final_exponentiation(f):
+    """f^((p¹²-1)/n): easy part then the DSD hard-part addition chain."""
+    # easy: f^(p⁶-1), then ^(p²+1)
+    f = fp12_mul(fp12_conj(f), fp12_inv(f))
+    f = fp12_mul(fp12_frobenius(f, 2), f)
+    # hard part: register machine (see _HARD_PROGRAM)
+    regs = jnp.broadcast_to(
+        jnp.asarray(FP12_ONE), (_N_REGS,) + f.shape).astype(jnp.int32)
+    regs = FP.normalize(regs)
+    regs = regs.at[0].set(f)
+    fu = _pow_u(f)
+    fu2 = _pow_u(fu)
+    regs = regs.at[1].set(fu)
+    regs = regs.at[2].set(fu2)
+    regs = regs.at[3].set(_pow_u(fu2))
+
+    def step(regs, instr):
+        op, a, b, d = instr[0], instr[1], instr[2], instr[3]
+        ra = lax.dynamic_index_in_dim(regs, a, axis=0, keepdims=False)
+        rb = lax.dynamic_index_in_dim(regs, b, axis=0, keepdims=False)
+        out = lax.switch(op, [
+            lambda ra, rb: fp12_mul(ra, rb),
+            lambda ra, rb: fp12_sqr(ra),
+            lambda ra, rb: fp12_conj(ra),
+            lambda ra, rb: fp12_frobenius(ra, 1),
+            lambda ra, rb: fp12_frobenius(ra, 2),
+            lambda ra, rb: fp12_frobenius(ra, 3),
+        ], ra, rb)
+        return lax.dynamic_update_index_in_dim(regs, out, d, axis=0), None
+
+    regs, _ = lax.scan(step, regs, jnp.asarray(_HARD_PROGRAM))
+    return regs[13]
+
+
+# == Pairing check / BLS batch verification ================================
+
+
+def pairing_product(px, py, qx, qy, mask):
+    """∏ over the last batch axis of Miller loops, masked pairs -> 1.
+
+    px/py: (..., K, 22); qx/qy: (..., K, 2, 22); mask: (..., K) bool.
+    Returns the K-product BEFORE final exponentiation.
+    """
+    f = miller_loop(px, py, qx, qy)  # (..., K, 2, 3, 2, 22)
+    one = jnp.broadcast_to(jnp.asarray(FP12_ONE), f.shape)
+    f = fp12_select(mask, f, one)
+    k = f.shape[-5]
+    acc = f[..., 0, :, :, :, :]
+    for j in range(1, k):  # K is small (2 for BLS verify)
+        acc = fp12_mul(acc, f[..., j, :, :, :, :])
+    return acc
+
+
+def pairing_check(px, py, qx, qy, mask):
+    """Batched PairingCheck: ∏ e(Pᵢ, Qᵢ) == 1 per leading-batch element."""
+    return fp12_is_one(final_exponentiation(pairing_product(px, py, qx, qy, mask)))
+
+
+# generator / BLS fixed points as limb constants
+G2_GEN_X = np.stack([int_to_limbs(ref.G2_GEN[0].a), int_to_limbs(ref.G2_GEN[0].b)])
+G2_GEN_Y = np.stack([int_to_limbs(ref.G2_GEN[1].a), int_to_limbs(ref.G2_GEN[1].b)])
+
+
+def bls_verify_aggregate_batch(hx, hy, sx, sy, pkx, pky, valid):
+    """Batched BLS aggregate-vote verification (BASELINE.md config 2/3).
+
+    For each batch element b: e(sig_b, G2_GEN) == e(H_b, aggpk_b), checked
+    as e(sig, G2)·e(-H, pk) == 1.
+    hx/hy, sx/sy: (..., 22) G1 limbs (message hash, aggregate signature);
+    pkx/pky: (..., 2, 22) G2 limbs (aggregate public key);
+    valid: (...,) bool — invalid rows (infinity/malformed, rejected
+    host-side) return False.
+    Returns (...,) bool.
+    """
+    shape = sx.shape[:-1]
+    px = jnp.stack([sx, hx], axis=-2)                      # (..., 2, 22)
+    py = jnp.stack([sy, FP.neg(hy)], axis=-2)              # -H via y negation
+    qx = jnp.stack([jnp.broadcast_to(jnp.asarray(G2_GEN_X), shape + (2, NLIMBS)),
+                    pkx], axis=-3)
+    qy = jnp.stack([jnp.broadcast_to(jnp.asarray(G2_GEN_Y), shape + (2, NLIMBS)),
+                    pky], axis=-3)
+    mask = jnp.broadcast_to(jnp.asarray(True), shape + (2,))
+    return pairing_check(px, py, qx, qy, mask) & valid
+
+
+# == host-side converters ==================================================
+
+
+def g1_to_limbs(points: Sequence[ref.G1Point]):
+    """[(x, y) | None]* -> (xs, ys, valid): (B, 22) int32 ×2 + (B,) bool.
+
+    Infinity/None encodes as (0, 0) with valid=False — callers decide
+    whether that means "skip the pair" (mask) or "reject the row"."""
+    xs, ys, ok = [], [], []
+    for pt in points:
+        if pt is None:
+            xs.append(0), ys.append(0), ok.append(False)
+        else:
+            xs.append(pt[0] % P), ys.append(pt[1] % P), ok.append(True)
+    return (ints_to_limbs(xs), ints_to_limbs(ys), np.asarray(ok))
+
+
+def g2_to_limbs(points: Sequence[ref.G2Point]):
+    """G2 affine points -> (xs, ys, valid): (B, 2, 22) ×2 + (B,) bool."""
+    xs, ys, ok = [], [], []
+    for pt in points:
+        if pt is None:
+            xs.append(np.zeros((2, NLIMBS), np.int32))
+            ys.append(np.zeros((2, NLIMBS), np.int32))
+            ok.append(False)
+        else:
+            x, y = pt
+            xs.append(np.stack([int_to_limbs(x.a), int_to_limbs(x.b)]))
+            ys.append(np.stack([int_to_limbs(y.a), int_to_limbs(y.b)]))
+            ok.append(True)
+    return (np.stack(xs), np.stack(ys), np.asarray(ok))
+
+
+def fp12_to_int_coeffs(x) -> np.ndarray:
+    """Canonical integer coefficients (..., 2, 3, 2) for host comparison."""
+    return FP.to_ints(np.asarray(FP.canon(x)))

@@ -1,0 +1,147 @@
+"""Batched keccak-256 on TPU: keccak-f[1600] over uint32 lane pairs.
+
+Reference parity: `crypto/sha3/keccakf.go` / `keccakf_amd64.s` (scalar,
+one message at a time). Here the permutation is batch-first: a state is
+``(..., 25, 2)`` uint32 — lane ``i`` is ``state[..., i, 0] + state[..., i, 1]
+<< 32`` — and every step (theta/rho/pi/chi/iota) is a vectorized bitwise op
+across all 25 lanes at once, so a batch of B messages runs as B parallel
+sponges on the VPU. No 64-bit dtypes anywhere (TPU int path is 32-bit);
+64-bit rotations decompose into paired 32-bit shifts.
+
+Used by `ops.smc_jax` for batched committee sampling (the SMC's
+``keccak256(blockhash ++ poolIndex ++ shardId)`` over all shards at once)
+and differential-tested against the scalar `crypto/keccak.py`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from gethsharding_tpu.crypto.keccak import RATE_BYTES, ROTATION_OFFSETS, ROUND_CONSTANTS
+
+# Static tables (numpy on purpose: importing this module must not trigger
+# JAX backend init; jnp ops accept numpy operands and constant-fold them).
+_RC = np.array(
+    [[rc & 0xFFFFFFFF, rc >> 32] for rc in ROUND_CONSTANTS], dtype=np.uint32
+)  # (24, 2)
+
+# rho+pi as one static gather: dest lane d = y + 5*((2x + 3y) % 5) takes
+# source lane s = x + 5*y rotated by ROTATION_OFFSETS[s].
+_PI_SRC = np.zeros(25, np.int32)
+_PI_ROT = np.zeros(25, np.int32)
+for _x in range(5):
+    for _y in range(5):
+        _s = _x + 5 * _y
+        _d = _y + 5 * ((2 * _x + 3 * _y) % 5)
+        _PI_SRC[_d] = _s
+        _PI_ROT[_d] = ROTATION_OFFSETS[_s]
+
+# chi: lane (x, y) combines lanes ((x+1)%5, y) and ((x+2)%5, y)
+_CHI_1 = np.array([(x + 1) % 5 + 5 * (i // 5) for i in range(25) for x in [i % 5]],
+                  np.int32)
+_CHI_2 = np.array([(x + 2) % 5 + 5 * (i // 5) for i in range(25) for x in [i % 5]],
+                  np.int32)
+
+_THETA_D_SRC = np.array([(x - 1) % 5 for x in range(5)], np.int32)
+_THETA_D_ROT = np.array([(x + 1) % 5 for x in range(5)], np.int32)
+
+
+def _rotl64(lo: jnp.ndarray, hi: jnp.ndarray, shift: np.ndarray):
+    """Rotate-left of (lo, hi) uint32 pairs by static per-lane shifts.
+
+    ``(v >> 1) >> (31 - s)`` keeps every shift amount in [0, 31] so s = 0 is
+    well-defined (a plain ``>> (32 - s)`` would shift by 32, which XLA does
+    not define for 32-bit operands).
+    """
+    swap = (shift >= 32)
+    s = np.asarray(shift % 32, np.uint32)
+    a = jnp.where(swap, hi, lo)
+    b = jnp.where(swap, lo, hi)
+    new_lo = (a << s) | ((b >> 1) >> (31 - s))
+    new_hi = (b << s) | ((a >> 1) >> (31 - s))
+    return new_lo, new_hi
+
+
+def keccak_f1600(state: jnp.ndarray) -> jnp.ndarray:
+    """Batched keccak-f[1600]: (..., 25, 2) uint32 -> same shape."""
+
+    def round_fn(lanes, rc):
+        lo, hi = lanes[..., 0], lanes[..., 1]  # (..., 25)
+        # theta
+        c_lo = lo[..., 0:5] ^ lo[..., 5:10] ^ lo[..., 10:15] ^ lo[..., 15:20] ^ lo[..., 20:25]
+        c_hi = hi[..., 0:5] ^ hi[..., 5:10] ^ hi[..., 10:15] ^ hi[..., 15:20] ^ hi[..., 20:25]
+        r_lo, r_hi = _rotl64(c_lo[..., _THETA_D_ROT], c_hi[..., _THETA_D_ROT],
+                             np.ones(5, np.int32))
+        d_lo = c_lo[..., _THETA_D_SRC] ^ r_lo
+        d_hi = c_hi[..., _THETA_D_SRC] ^ r_hi
+        lo = lo ^ jnp.tile(d_lo, (1,) * (lo.ndim - 1) + (5,))
+        hi = hi ^ jnp.tile(d_hi, (1,) * (hi.ndim - 1) + (5,))
+        # rho + pi (one gather + static-shift rotate)
+        b_lo, b_hi = _rotl64(lo[..., _PI_SRC], hi[..., _PI_SRC], _PI_ROT)
+        # chi
+        lo = b_lo ^ (~b_lo[..., _CHI_1] & b_lo[..., _CHI_2])
+        hi = b_hi ^ (~b_hi[..., _CHI_1] & b_hi[..., _CHI_2])
+        # iota
+        lo = lo.at[..., 0].set(lo[..., 0] ^ rc[0])
+        hi = hi.at[..., 0].set(hi[..., 0] ^ rc[1])
+        return jnp.stack([lo, hi], axis=-1), None
+
+    out, _ = lax.scan(round_fn, state, jnp.asarray(_RC))
+    return out
+
+
+RATE_LANES = RATE_BYTES // 8  # 17
+
+
+def _bytes_to_lanes(block: jnp.ndarray) -> jnp.ndarray:
+    """(..., 136) uint8 -> (..., 17, 2) uint32, little-endian lanes."""
+    b = block.astype(jnp.uint32).reshape(block.shape[:-1] + (RATE_LANES, 8))
+    lo = b[..., 0] | (b[..., 1] << 8) | (b[..., 2] << 16) | (b[..., 3] << 24)
+    hi = b[..., 4] | (b[..., 5] << 8) | (b[..., 6] << 16) | (b[..., 7] << 24)
+    return jnp.stack([lo, hi], axis=-1)
+
+
+def _lanes_to_bytes(lanes: jnp.ndarray, n_lanes: int) -> jnp.ndarray:
+    """(..., >=n_lanes, 2) uint32 -> (..., n_lanes*8) uint8, little-endian."""
+    parts = []
+    for half in range(2):
+        w = lanes[..., :n_lanes, half]
+        parts.append(jnp.stack(
+            [(w >> (8 * k)) & 0xFF for k in range(4)], axis=-1))
+    out = jnp.concatenate(parts, axis=-1)  # (..., n_lanes, 8)
+    return out.astype(jnp.uint8).reshape(lanes.shape[:-2] + (n_lanes * 8,))
+
+
+def pad_message(length: int) -> int:
+    """Padded length (multiple of the 136-byte rate) for a message length."""
+    return length + (RATE_BYTES - length % RATE_BYTES)
+
+
+def keccak256_fixed(data: jnp.ndarray) -> jnp.ndarray:
+    """Batched keccak-256 over fixed-length messages.
+
+    ``data``: (..., L) uint8 with static L; returns (..., 32) uint8.
+    Ethereum flavour: multi-rate padding with 0x01 domain byte (matches
+    `crypto/keccak.keccak256`, NOT NIST SHA3).
+    """
+    length = data.shape[-1]
+    padded_len = pad_message(length)
+    pad = np.zeros(padded_len - length, np.uint8)
+    pad[0] = 0x01
+    pad[-1] |= 0x80
+    padded = jnp.concatenate(
+        [data, jnp.broadcast_to(pad, data.shape[:-1] + pad.shape)], axis=-1
+    )
+    n_blocks = padded_len // RATE_BYTES
+    state = jnp.zeros(data.shape[:-1] + (25, 2), jnp.uint32)
+    for i in range(n_blocks):  # static unroll; messages here are 1-2 blocks
+        block = padded[..., i * RATE_BYTES : (i + 1) * RATE_BYTES]
+        absorbed = _bytes_to_lanes(block)
+        state = state.at[..., :RATE_LANES, :].set(
+            state[..., :RATE_LANES, :] ^ absorbed
+        )
+        state = keccak_f1600(state)
+    return _lanes_to_bytes(state, 4)

@@ -26,7 +26,7 @@ This one trades per-element latency for batch throughput, which is what the
 
 from __future__ import annotations
 
-from functools import partial
+import os
 from typing import Sequence
 
 import numpy as np
@@ -73,23 +73,73 @@ def ints_to_limbs(values: Sequence[int], nlimbs: int = NLIMBS) -> np.ndarray:
     return np.stack([int_to_limbs(v, nlimbs) for v in values])
 
 
+def _relaxed_round(z: jnp.ndarray):
+    """One vectorized carry round: z_i -> (z_i & mask) + carry(z_{i-1}).
+
+    Width-preserving; returns (top_carry, z'). Shrinks limb magnitude by
+    ~2^LIMB_BITS per round (4 cheap elementwise ops, no sequential loop).
+    """
+    lo = z & LIMB_MASK
+    c = z >> LIMB_BITS  # arithmetic shift: negative carries = borrows
+    shifted = jnp.concatenate(
+        [jnp.zeros_like(c[..., :1]), c[..., :-1]], axis=-1)
+    return c[..., -1], lo + shifted
+
+
+CARRY_IMPL = os.environ.get("GETHSHARDING_TPU_CARRY", "scan")
+if CARRY_IMPL not in ("scan", "assoc"):
+    raise ValueError(
+        f"GETHSHARDING_TPU_CARRY must be 'scan' or 'assoc', got {CARRY_IMPL!r}")
+
+
 def _carry_scan(z: jnp.ndarray):
-    """Carry propagation along the last axis via lax.scan.
+    """Exact carry propagation along the last axis.
 
     Accepts limbs of either sign with magnitude < 2^31 (arithmetic >> gives
     floor division, so borrows propagate as negative carries). Returns
-    (carry_out, limbs); `_carry` drops the carry, `_cond_sub` tests it.
+    (carry_out, limbs): total carry off the top (callers either know it is
+    zero or use its sign as a borrow flag) and canonical limbs.
+
+    Two implementations, selected by $GETHSHARDING_TPU_CARRY:
+    - "scan" (default): sequential lax.scan — compact graph, fastest XLA
+      compile for the big pairing kernels.
+    - "assoc": two relaxed rounds bound limbs to [-1, 2^LIMB_BITS + eps],
+      then the residual per-position carries (each in {-1,0,1}, acting as
+      monotone maps carry_in -> carry_out) compose via
+      `lax.associative_scan` — log-depth flat vector code, no while loops.
     """
-    zs = jnp.moveaxis(z, -1, 0)
+    if CARRY_IMPL == "scan":
+        zs = jnp.moveaxis(z, -1, 0)
 
-    def step(c, x):
-        t = x + c
-        return t >> LIMB_BITS, t & LIMB_MASK
+        def step(c, x):
+            t = x + c
+            return t >> LIMB_BITS, t & LIMB_MASK
 
-    # init carry derived from the input so its varying-manual-axes match
-    # under shard_map (a fresh constant would be unvarying -> scan TypeError)
-    carry, out = lax.scan(step, zs[0] * 0, zs)
-    return carry, jnp.moveaxis(out, 0, -1)
+        # init carry derived from the input so its varying-manual-axes
+        # match under shard_map (a fresh constant would be unvarying)
+        carry, out = lax.scan(step, zs[0] * 0, zs)
+        return carry, jnp.moveaxis(out, 0, -1)
+
+    c1, z = _relaxed_round(z)
+    c2, z = _relaxed_round(z)
+    # z limbs now in [-1, 2^LIMB_BITS + 2^(LIMB_BITS/2)] — well inside the
+    # [-(2^LIMB_BITS - 1), 2^(LIMB_BITS+1) - 2] window where
+    # (z + c) >> LIMB_BITS stays in {-1, 0, 1} for c in {-1, 0, 1}.
+    t = tuple((z + k) >> LIMB_BITS for k in (-1, 0, 1))  # carry-out per carry-in
+
+    def compose(a, b):
+        # prefix composition: apply earlier map `a` first, then `b`
+        return tuple(
+            jnp.where(ac == -1, b[0], jnp.where(ac == 0, b[1], b[2]))
+            for ac in a)
+
+    prefix = lax.associative_scan(compose, t, axis=-1)
+    # carry into position i = (prefix up to i-1) evaluated at 0
+    ev0 = prefix[1]
+    carries = jnp.concatenate(
+        [jnp.zeros_like(ev0[..., :1]), ev0[..., :-1]], axis=-1)
+    out = (z + carries) & LIMB_MASK
+    return c1 + c2 + ev0[..., -1], out
 
 
 def _carry(z: jnp.ndarray) -> jnp.ndarray:
@@ -114,12 +164,12 @@ class ModArith:
         if p.bit_length() > 256:
             raise ValueError("modulus too large for lazy 264-bit form")
         self.p = p
-        # Fold matrix: row k holds limbs of 2^(12*(22+k)) mod p. 25 rows
-        # cover the widest intermediate (schoolbook product = 43 columns +
-        # 2 carry-pad limbs -> high part 23 limbs; +2 rounds of refold).
+        # Fold matrix: row k holds limbs of 2^(12*(22+k)) mod p. 30 rows
+        # cover the widest intermediate (tower-fused accumulators reach 45
+        # columns, + 3 relaxed-round pad limbs -> 26 high limbs).
         self.fold_j = np.stack(
-            [int_to_limbs(pow(1 << (LIMB_BITS * (NLIMBS + k)), 1, p)) for k in range(25)]
-        )  # (25, 22) int32; numpy on purpose — jnp.matmul accepts it and
+            [int_to_limbs(pow(1 << (LIMB_BITS * (NLIMBS + k)), 1, p)) for k in range(30)]
+        )  # (30, 22) int32; numpy on purpose — jnp.matmul accepts it and
         # constant-folds under jit without forcing backend init at __init__
         # Additive pad for subtraction: smallest multiple of p >= 2^264,
         # so (x - y + sub_pad) >= 0 for any lazy x, y. Fits 23 limbs.
@@ -135,6 +185,7 @@ class ModArith:
         )  # (k_max+1, 23)
         self.zero = np.zeros(NLIMBS, np.int32)
         self.one = int_to_limbs(1)
+        self._pad_cache: dict = {}
 
     # -- normalization ------------------------------------------------------
 
@@ -144,26 +195,43 @@ class ModArith:
         m = hi.shape[-1]
         if m == 0:
             return z
+        if m > self.fold_j.shape[0]:  # silent slice-truncation would drop limbs
+            raise ValueError(f"accumulator too wide: {m} high limbs > "
+                             f"{self.fold_j.shape[0]} fold rows")
         folded = jnp.matmul(hi, self.fold_j[:m])  # (..., 22), <= 25*2^24
         return z[..., :NLIMBS] + folded
 
     def normalize(self, z: jnp.ndarray) -> jnp.ndarray:
-        """Reduce any accumulator (..., L) with |limb| < 2^29 to lazy form:
-        22 canonical limbs, value in [0, 2^264), same residue mod p."""
+        """Reduce any accumulator (..., L) with |limb| < 2^30.7 to lazy form:
+        22 canonical limbs, value in [0, 2^264), same residue mod p.
+
+        The first two reduction stages use *relaxed* carry rounds (three
+        vectorized rounds bound limbs to [-1, 2^12] without sequential
+        propagation — a dropped top carry is impossible because each round
+        extends the width by one limb); only the final canonicalization
+        stages need exact carries. This keeps the while-loop count per
+        normalize at 3 instead of 5 — the pairing kernel's compile time is
+        proportional to it.
+        """
         pad = [(0, 0)] * (z.ndim - 1)
-        # carry with 2 pad limbs (absorbs carries up to 2^(24) x L), fold,
-        # repeat; bounds shrink geometrically (see test_limb differential
-        # coverage across extreme inputs).
+
+        def relax3(v):
+            for _ in range(3):
+                top, v = _relaxed_round(jnp.pad(v, pad + [(0, 1)]))
+                # width grew by 1 so the round's own top carry is the new
+                # top limb's whole content; `top` here is always 0
+            return v
+
+        # stage 1: limbs in [-1, 2^12], then fold the high limbs
+        z = self._fold_hi(relax3(z))
+        # stage 2: same again — columns now ~2^24
+        z = self._fold_hi(relax3(z))
+        # stage 3: exact carry; value < 2^264·1.01 + eps ⇒ small top limbs
         z = _carry(jnp.pad(z, pad + [(0, 2)]))
         z = self._fold_hi(z)
-        z = _carry(jnp.pad(z, pad + [(0, 2)]))
+        # stage 4: exact carry; top bit in {0,1}; one conditional fold left
+        z = _carry(jnp.pad(z, pad + [(0, 1)]))
         z = self._fold_hi(z)
-        # Value now < 2^265: one carry limb at most. Two conditional folds
-        # of the top bit terminate: after the first, a re-carry can only be
-        # < p; after the second none is possible.
-        for _ in range(2):
-            z = _carry(jnp.pad(z, pad + [(0, 1)]))
-            z = self._fold_hi(z)
         return _carry(z)
 
     # -- ring ops (lazy in, lazy out) --------------------------------------
@@ -186,12 +254,37 @@ class ModArith:
 
     def mul(self, x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
         """Schoolbook product -> 43 columns -> fold+carry. Batch-first."""
+        return self.normalize(self.mul_cols(x, y))
+
+    def mul_cols(self, x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+        """Raw schoolbook product columns (..., 43), each < 22·2^24.
+
+        Building block for *fused* tower arithmetic (ops/bn256_jax): column
+        accumulators of several products can be added/subtracted (with a
+        `pad_mult` multiple of p keeping the value non-negative) and reduced
+        by a single `normalize`, instead of one normalize per ring op.
+        Callers own the int32 range proof: each column must stay < 2^31.
+        """
         prod = x[..., :, None] * y[..., None, :]  # (..., 22, 22) 24-bit terms
         # Column sums z[k] = sum_{i+j=k} prod[i,j] via anti-diagonal einsum
         # against a static one-hot (22,22,43): contracts to an integer
         # matmul XLA maps well.
-        z = jnp.einsum("...ij,ijk->...k", prod, _DIAG_ONEHOT)
-        return self.normalize(z)
+        return jnp.einsum("...ij,ijk->...k", prod, _DIAG_ONEHOT)
+
+    def pad_mult(self, bits: int) -> np.ndarray:
+        """Limb form of the smallest multiple of p >= 2^bits (cached).
+
+        Added to a column accumulator before subtracting values known to be
+        < 2^bits, so the represented value stays non-negative for
+        `normalize`. Kept canonical-limbed so it adds < 2^12 per column.
+        """
+        cached = self._pad_cache.get(bits)
+        if cached is None:
+            value = -(-(1 << bits) // self.p) * self.p
+            nlimbs = -(-value.bit_length() // LIMB_BITS)
+            cached = int_to_limbs(value, nlimbs)
+            self._pad_cache[bits] = cached
+        return cached
 
     def sqr(self, x: jnp.ndarray) -> jnp.ndarray:
         return self.mul(x, x)

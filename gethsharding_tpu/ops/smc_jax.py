@@ -1,0 +1,205 @@
+"""The SMC vote hot loop as fixed-shape batched array ops.
+
+Split of responsibilities (SURVEY.md §7 step 5): registration/deregistration
+and period bookkeeping are rare control-plane transitions and stay on the
+host (`smc/state_machine.py`); the per-period hot loop — committee sampling,
+vote validation, bitfield casting, quorum — is re-expressed here as
+integer-only, static-shape kernels that `vmap`/`shard_map` over shardID.
+
+Byte-identity contract: given the same pool, registry flags, and attempt
+sequence, `submit_votes_batch` produces exactly the state the scalar
+`SMC.submit_vote` reaches when applying the attempts in order —
+including the packed uint256 vote word (`export_vote_word`), the
+is_elected flip, and acceptance/revert of every individual attempt.
+In-batch ordering is honoured without serializing: the only sequential
+dependence between attempts in one period is the has-voted bitfield, which
+first-occurrence-wins scatter reproduces (`sharding_manager.sol:198-221`).
+
+Sampling parity (.sol:77-100): member = pool[keccak256(blockhash_32 ++
+poolIndex_32 ++ shardId_32) % sampleSize]; an emptied slot contributes the
+zero address.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from gethsharding_tpu.ops.keccak_jax import keccak256_fixed
+
+
+class VoteState(NamedTuple):
+    """Per-shard vote-period state, fixed shapes: S shards, C committee.
+
+    The reference packs `has_voted` and `count` into one uint256
+    (`currentVote`, .sol:32-34); here they are separate planes and
+    `export_vote_word` reproduces the packed form bit-exactly.
+    """
+
+    has_voted: jnp.ndarray      # (S, C) bool — bit 255-index of the word
+    vote_count: jnp.ndarray     # (S,) int32 — low byte of the word
+    last_submitted: jnp.ndarray  # (S,) int32
+    last_approved: jnp.ndarray  # (S,) int32
+    is_elected: jnp.ndarray     # (S,) bool — current period's record flag
+    chunk_root: jnp.ndarray     # (S, 32) uint8 — current record's root
+
+
+class VoteAttempts(NamedTuple):
+    """A batch of submitVote transactions, order-significant. A attempts."""
+
+    shard: jnp.ndarray       # (A,) int32
+    index: jnp.ndarray       # (A,) int32 — claimed committee bitfield slot
+    pool_index: jnp.ndarray  # (A,) int32 — sender's registry poolIndex
+    sender: jnp.ndarray      # (A, 20) uint8
+    chunk_root: jnp.ndarray  # (A, 32) uint8
+    deposited: jnp.ndarray   # (A,) bool — registry[sender].deposited
+    valid: jnp.ndarray       # (A,) bool — caller premask (e.g. sig verified)
+
+
+def _be32(x: jnp.ndarray) -> jnp.ndarray:
+    """int32 (...,) -> (..., 32) uint8 big-endian uint256 (non-negative)."""
+    shifts = np.array([24, 16, 8, 0], np.int32)
+    tail = (x[..., None] >> shifts) & 0xFF
+    out = jnp.zeros(x.shape + (32,), jnp.int32)
+    return out.at[..., 28:].set(tail).astype(jnp.uint8)
+
+
+def sample_committee(blockhash: jnp.ndarray, pool_index: jnp.ndarray,
+                     shard_id: jnp.ndarray, sample_size: jnp.ndarray) -> jnp.ndarray:
+    """Batched getNotaryInCommittee sampling -> pool slot per attempt.
+
+    blockhash (32,) uint8; pool_index/shard_id (A,) int32;
+    sample_size scalar int32 (> 0). Returns (A,) int32 slots.
+    """
+    a = pool_index.shape[0]
+    preimage = jnp.concatenate(
+        [jnp.broadcast_to(blockhash, (a, 32)),
+         _be32(pool_index), _be32(shard_id)], axis=-1)  # (A, 96)
+    digest = keccak256_fixed(preimage)  # (A, 32) uint8, big-endian uint256
+    # uint256 mod sample_size via big-endian Horner: r = r*256 + byte (mod m).
+    # Safe in int32 for m < 2^23 — pool sizes are protocol-bounded (<= 2^15).
+    m = sample_size.astype(jnp.int32)
+
+    def horner(r, b):
+        return (r * 256 + b.astype(jnp.int32)) % m, None
+
+    bytes_first = jnp.moveaxis(digest, -1, 0)  # (32, A)
+    r0 = jnp.zeros(a, jnp.int32) * m  # derived from m: shard_map vma-safe
+    r, _ = lax.scan(horner, r0, bytes_first)
+    return r
+
+
+def submit_votes_batch(state: VoteState, pool_addr: jnp.ndarray,
+                       attempts: VoteAttempts, *, period: jnp.ndarray,
+                       blockhash: jnp.ndarray, sample_size: jnp.ndarray,
+                       committee_size: int, quorum_size: int):
+    """Apply a period's submitVote batch. Returns (new_state, accepted).
+
+    pool_addr: (P, 20) uint8, zero rows for empty slots. period: scalar
+    int32 (the current period; the caller guarantees attempts were made in
+    it, mirroring `period == block.number/PERIOD_LENGTH`, .sol:203).
+    """
+    s_count, c_size = state.has_voted.shape
+    assert c_size == committee_size
+    a = attempts.shard.shape[0]
+    pool_cap = pool_addr.shape[0]
+
+    shard_ok = (attempts.shard >= 0) & (attempts.shard < s_count)
+    shard_ix = jnp.clip(attempts.shard, 0, s_count - 1)
+    index_ok = (attempts.index >= 0) & (attempts.index < committee_size)
+    index_ix = jnp.clip(attempts.index, 0, committee_size - 1)
+
+    # period has a submitted collation + root matches it (.sol:204-210)
+    period_ok = state.last_submitted[shard_ix] == period
+    root_ok = jnp.all(
+        attempts.chunk_root == state.chunk_root[shard_ix], axis=-1)
+
+    # sender is the sampled committee member (.sol:212-214)
+    slot = sample_committee(blockhash, attempts.pool_index, attempts.shard,
+                            sample_size)
+    member = pool_addr[jnp.clip(slot, 0, pool_cap - 1)]
+    member = jnp.where((slot < pool_cap)[:, None], member, 0).astype(jnp.uint8)
+    sampled_ok = jnp.all(member == attempts.sender, axis=-1)
+
+    not_voted = ~state.has_voted[shard_ix, index_ix]
+
+    ok = (attempts.valid & shard_ok & index_ok & period_ok & root_ok
+          & attempts.deposited & not_voted & sampled_ok)
+
+    # first-occurrence-wins within the batch: the only cross-attempt state
+    # inside one period is the has-voted bit per (shard, index) slot.
+    flat = shard_ix * committee_size + index_ix
+    flat = jnp.where(ok, flat, s_count * committee_size)  # invalid -> spill
+    order = jnp.arange(a, dtype=jnp.int32)
+    first = jnp.full((s_count * committee_size + 1,), a, jnp.int32)
+    first = first.at[flat].min(order)
+    accepted = ok & (first[flat] == order)
+
+    has_voted = state.has_voted.at[shard_ix, index_ix].max(accepted)
+    add = jnp.zeros(s_count, jnp.int32).at[shard_ix].add(
+        accepted.astype(jnp.int32))
+    vote_count = (state.vote_count + add) % 256  # low-byte semantics
+    # the scalar SMC only touches lastApproved/isElected inside an accepted
+    # submitVote (.sol:215-218) — a shard with no accepted votes this batch
+    # must keep its prior-period approval state even if its stale count
+    # still clears quorum
+    newly_elected = (add > 0) & (vote_count >= quorum_size)
+    last_approved = jnp.where(newly_elected, period, state.last_approved)
+    is_elected = state.is_elected | newly_elected
+
+    new_state = VoteState(
+        has_voted=has_voted, vote_count=vote_count,
+        last_submitted=state.last_submitted, last_approved=last_approved,
+        is_elected=is_elected, chunk_root=state.chunk_root)
+    return new_state, accepted
+
+
+def add_header_reset(state: VoteState, shard_id: jnp.ndarray,
+                     period: jnp.ndarray, chunk_root: jnp.ndarray) -> VoteState:
+    """addHeader's vote-plane effects for accepted headers (.sol:183-189):
+    record the root, mark the period submitted, clear the vote word.
+
+    shard_id (K,) int32 (distinct shards), period scalar, chunk_root
+    (K, 32) uint8. Acceptance rules (period currency/freshness) stay with
+    the host control plane.
+    """
+    s_count, _ = state.has_voted.shape
+    six = jnp.clip(shard_id, 0, s_count - 1)
+    return VoteState(
+        has_voted=state.has_voted.at[six].set(False),
+        vote_count=state.vote_count.at[six].set(0),
+        last_submitted=state.last_submitted.at[six].set(period),
+        last_approved=state.last_approved,
+        is_elected=state.is_elected.at[six].set(False),
+        chunk_root=state.chunk_root.at[six].set(chunk_root.astype(jnp.uint8)),
+    )
+
+
+def export_vote_word(has_voted: np.ndarray, vote_count: np.ndarray) -> list:
+    """Pack (S, C) bits + (S,) counts into the contract's uint256 words:
+    bit `255 - index` per vote, count in the low byte (.sol:276-285)."""
+    s_count, c_size = has_voted.shape
+    words = []
+    for s in range(s_count):
+        w = 0
+        for i in range(c_size):
+            if has_voted[s, i]:
+                w |= 1 << (255 - i)
+        words.append(w + int(vote_count[s]) % 256)
+    return words
+
+
+def init_vote_state(shard_count: int, committee_size: int) -> VoteState:
+    """All-zero per-shard vote state (numpy; converts lazily in jnp ops)."""
+    return VoteState(
+        has_voted=jnp.zeros((shard_count, committee_size), jnp.bool_),
+        vote_count=jnp.zeros(shard_count, jnp.int32),
+        last_submitted=jnp.zeros(shard_count, jnp.int32),
+        last_approved=jnp.zeros(shard_count, jnp.int32),
+        is_elected=jnp.zeros(shard_count, jnp.bool_),
+        chunk_root=jnp.zeros((shard_count, 32), jnp.uint8),
+    )

@@ -28,5 +28,13 @@ try:  # pragma: no cover - environment-dependent
     for _name in list(getattr(_xb, "_backend_factories", {})):
         if _name not in ("cpu",):
             _xb._backend_factories.pop(_name, None)
+    # Persistent compilation cache: the pairing kernels take minutes to
+    # compile on XLA:CPU; cache hits make repeat test runs near-instant.
+    from pathlib import Path
+
+    jax.config.update("jax_compilation_cache_dir",
+                      str(Path(__file__).resolve().parents[1] / ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 except Exception:
     pass

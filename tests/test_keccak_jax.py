@@ -1,0 +1,62 @@
+"""Differential tests: batched keccak (ops/keccak_jax) vs scalar reference.
+
+The scalar `crypto/keccak.py` is itself pinned by golden vectors
+(tests/test_keccak.py: keccak256(b"") = c5d24601...), so byte-equality here
+transitively pins the TPU kernel to Ethereum's keccak256.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from gethsharding_tpu.crypto.keccak import keccak256, keccak_f1600 as scalar_f1600
+from gethsharding_tpu.ops.keccak_jax import keccak256_fixed, keccak_f1600
+
+
+def _lanes_from_ints(lanes64):
+    lo = [v & 0xFFFFFFFF for v in lanes64]
+    hi = [v >> 32 for v in lanes64]
+    return np.stack([np.array(lo, np.uint32), np.array(hi, np.uint32)], axis=-1)
+
+
+def test_permutation_matches_scalar():
+    rng = np.random.default_rng(7)
+    batch = 5
+    states = [[int(v) for v in rng.integers(0, 1 << 64, 25, dtype=np.uint64)]
+              for _ in range(batch)]
+    packed = jnp.asarray(np.stack([_lanes_from_ints(s) for s in states]))
+    out = np.asarray(jax.jit(keccak_f1600)(packed))
+    for i, s in enumerate(states):
+        expect = scalar_f1600(list(s))
+        got = [int(out[i, j, 0]) | (int(out[i, j, 1]) << 32) for j in range(25)]
+        assert got == expect
+
+
+@pytest.mark.parametrize("length", [0, 1, 31, 32, 96, 135, 136, 137, 200, 272])
+def test_digest_matches_scalar(length):
+    rng = np.random.default_rng(length)
+    batch = 4
+    msgs = [rng.integers(0, 256, length, dtype=np.uint8).tobytes()
+            for _ in range(batch)]
+    data = jnp.asarray(
+        np.stack([np.frombuffer(m, np.uint8) for m in msgs])
+        if length else np.zeros((batch, 0), np.uint8))
+    got = np.asarray(jax.jit(keccak256_fixed)(data))
+    for i, m in enumerate(msgs):
+        assert got[i].tobytes() == keccak256(m)
+
+
+def test_empty_message_golden():
+    out = np.asarray(keccak256_fixed(jnp.zeros((0,), jnp.uint8)))
+    assert out.tobytes().hex() == (
+        "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470"
+    )
+
+
+def test_vmap_over_messages():
+    data = jnp.asarray(
+        np.arange(3 * 96, dtype=np.uint8).reshape(3, 96))
+    direct = np.asarray(keccak256_fixed(data))
+    vmapped = np.asarray(jax.vmap(keccak256_fixed)(data))
+    np.testing.assert_array_equal(direct, vmapped)

@@ -126,3 +126,24 @@ def test_batch_shapes_nd():
     for i in range(2):
         for j in range(3):
             assert int(out[i][j]) == pow(vals[i][j], 2, p)
+
+
+def test_assoc_carry_impl_matches_scan(monkeypatch):
+    """Both carry implementations (scan / assoc) must agree exactly; the
+    assoc path is env-selected and would otherwise go untested."""
+    p = MODULI["bn256_p"]
+    rng = random.Random(11)
+    vals_a = [rng.randrange(p) for _ in range(8)]
+    vals_b = [rng.randrange(p) for _ in range(8)]
+    x = jnp.asarray(limb.ints_to_limbs(vals_a))
+    y = jnp.asarray(limb.ints_to_limbs(vals_b))
+
+    fp = limb.ModArith(p)
+    expect = [a * b % p for a, b in zip(vals_a, vals_b)]
+    got_scan = fp.to_ints(fp.mul(x, y))
+    monkeypatch.setattr(limb, "CARRY_IMPL", "assoc")
+    got_assoc = fp.to_ints(fp.sub(fp.mul(x, y), y))
+    monkeypatch.setattr(limb, "CARRY_IMPL", "scan")
+    assert [int(v) for v in got_scan] == expect
+    assert [int(v) for v in got_assoc] == [(a * b - b) % p
+                                           for a, b in zip(vals_a, vals_b)]

@@ -1,0 +1,252 @@
+"""Differential tests: batched SMC vote kernel (ops/smc_jax) vs the scalar
+state machine (smc/state_machine.py), which is itself contract-test-pinned
+to sharding_manager.sol semantics.
+
+The contract: applying a period's submitVote attempts through
+`submit_votes_batch` must reproduce, byte-identically, the state the scalar
+SMC reaches applying them in order — packed uint256 vote words, per-attempt
+accept/revert, is_elected flips, lastApproved — including first-wins
+resolution of in-batch (shard, index) collisions.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from gethsharding_tpu.crypto.keccak import keccak256
+from gethsharding_tpu.ops.smc_jax import (
+    VoteAttempts, add_header_reset, export_vote_word, init_vote_state,
+    sample_committee, submit_votes_batch,
+)
+from gethsharding_tpu.params import Config
+from gethsharding_tpu.smc.state_machine import SMC, SMCRevert, Notary
+from gethsharding_tpu.utils.hexbytes import Address20, Hash32
+
+CFG = Config(shard_count=6, committee_size=9, quorum_size=3)
+POOL_CAP = 16
+
+
+def _addr(i: int) -> Address20:
+    return Address20(keccak256(b"notary" + bytes([i]))[:20])
+
+
+def _blockhash_fn(n: int) -> Hash32:
+    return Hash32(keccak256(b"block" + n.to_bytes(8, "big")))
+
+
+def _pool_array(smc: SMC) -> np.ndarray:
+    pool = np.zeros((POOL_CAP, 20), np.uint8)
+    for i, a in enumerate(smc.notary_pool):
+        if a is not None:
+            pool[i] = np.frombuffer(bytes(a), np.uint8)
+    return pool
+
+
+def _setup():
+    smc = SMC(CFG, blockhash_fn=_blockhash_fn)
+    notaries = [_addr(i) for i in range(10)]
+    for a in notaries:
+        smc.register_notary(a, CFG.notary_deposit, block_number=0)
+    # one deregistration: slot emptied, registry stays deposited (.sol quirk)
+    smc.deregister_notary(notaries[3], block_number=1)
+    return smc, notaries
+
+
+def test_sample_committee_matches_scalar():
+    smc, notaries = _setup()
+    block_number = 5  # period 1
+    # mirror the sample-size update the scalar performs inside submit_vote
+    smc._update_notary_sample_size(block_number)
+    sample_size = smc.current_period_notary_sample_size
+    bh = np.frombuffer(
+        bytes(_blockhash_fn(1 * CFG.period_length - 1)), np.uint8)
+
+    pool_idx, shards, expect = [], [], []
+    for a in notaries:
+        entry = smc.notary_registry[a]
+        for s in range(CFG.shard_count):
+            pool_idx.append(entry.pool_index)
+            shards.append(s)
+            expect.append(bytes(
+                smc.get_notary_in_committee_view(a, s, block_number)))
+    slots = np.asarray(jax.jit(sample_committee)(
+        jnp.asarray(bh), jnp.asarray(pool_idx, jnp.int32),
+        jnp.asarray(shards, jnp.int32), jnp.int32(sample_size)))
+    pool = _pool_array(smc)
+    for k, slot in enumerate(slots):
+        member = pool[slot] if slot < POOL_CAP else np.zeros(20, np.uint8)
+        assert member.tobytes() == expect[k], k
+
+
+def test_vote_batch_matches_scalar_sequential():
+    smc, notaries = _setup()
+    period, block_number = 1, 5
+    roots = {s: Hash32(keccak256(b"root" + bytes([s])))
+             for s in range(CFG.shard_count)}
+    state = init_vote_state(CFG.shard_count, CFG.committee_size)
+    for s in range(CFG.shard_count - 1):  # last shard: no header this period
+        smc.add_header(notaries[0], s, period, roots[s], b"", block_number)
+    state = add_header_reset(
+        state,
+        jnp.asarray(list(range(CFG.shard_count - 1)), jnp.int32),
+        jnp.int32(period),
+        jnp.asarray(np.stack([
+            np.frombuffer(bytes(roots[s]), np.uint8)
+            for s in range(CFG.shard_count - 1)])))
+
+    smc._update_notary_sample_size(block_number)
+    sample_size = smc.current_period_notary_sample_size
+    bh = np.frombuffer(
+        bytes(_blockhash_fn(period * CFG.period_length - 1)), np.uint8)
+
+    # craft attempts: all eligible (sender, shard) pairs voting at rolling
+    # indices, plus adversarial cases
+    rng = np.random.default_rng(0)
+    attempts = []  # (sender, shard, index, chunk_root, deposited)
+    idx_counter = 0
+    for a in notaries:
+        for s in range(CFG.shard_count):
+            if smc.get_notary_in_committee_view(a, s, block_number) == a:
+                attempts.append((a, s, idx_counter % CFG.committee_size,
+                                 roots[s], True))
+                idx_counter += 1
+    assert attempts, "need at least one eligible vote"
+    sh0 = attempts[0][1]
+    attempts.append((attempts[0][0], sh0, attempts[0][2], roots[sh0], True))  # dup (shard,index)
+    attempts.append((attempts[0][0], sh0, CFG.committee_size, roots[sh0], True))  # index OOR
+    attempts.append((attempts[0][0], sh0, 5, Hash32(b"\xff" * 32), True))  # bad root
+    stranger = _addr(99)
+    attempts.append((stranger, sh0, 6, roots[sh0], False))  # undeposited
+    attempts.append((attempts[0][0], CFG.shard_count - 1, 7,
+                     roots[CFG.shard_count - 1], True))  # no header shard
+    dereg = notaries[3]
+    attempts.append((dereg, sh0, 8, roots[sh0], True))  # deregistered: slot empty
+    rng.shuffle(attempts)
+
+    scalar_ok = []
+    for (a, s, i, root, dep) in attempts:
+        try:
+            smc.submit_vote(a, s, period, i, root, block_number)
+            scalar_ok.append(True)
+        except SMCRevert:
+            scalar_ok.append(False)
+
+    batch = VoteAttempts(
+        shard=jnp.asarray([t[1] for t in attempts], jnp.int32),
+        index=jnp.asarray([t[2] for t in attempts], jnp.int32),
+        pool_index=jnp.asarray(
+            [smc.notary_registry.get(t[0], Notary()).pool_index
+             for t in attempts], jnp.int32),
+        sender=jnp.asarray(np.stack(
+            [np.frombuffer(bytes(t[0]), np.uint8) for t in attempts])),
+        chunk_root=jnp.asarray(np.stack(
+            [np.frombuffer(bytes(t[3]), np.uint8) for t in attempts])),
+        deposited=jnp.asarray([t[4] for t in attempts], jnp.bool_),
+        valid=jnp.ones(len(attempts), jnp.bool_),
+    )
+    new_state, accepted = jax.jit(
+        submit_votes_batch,
+        static_argnames=("committee_size", "quorum_size"))(
+        state, jnp.asarray(_pool_array(smc)), batch,
+        period=jnp.int32(period), blockhash=jnp.asarray(bh),
+        sample_size=jnp.int32(sample_size),
+        committee_size=CFG.committee_size, quorum_size=CFG.quorum_size)
+
+    assert list(np.asarray(accepted)) == scalar_ok
+
+    words = export_vote_word(np.asarray(new_state.has_voted),
+                             np.asarray(new_state.vote_count))
+    for s in range(CFG.shard_count):
+        assert words[s] == smc.current_vote.get(s, 0), f"shard {s}"
+        rec = smc.collation_records.get((s, period))
+        kernel_elected = bool(np.asarray(new_state.is_elected)[s])
+        assert kernel_elected == (rec.is_elected if rec else False), f"shard {s}"
+        assert int(np.asarray(new_state.last_approved)[s]) == \
+            smc.last_approved_collation.get(s, 0), f"shard {s}"
+        assert int(np.asarray(new_state.last_submitted)[s]) == \
+            smc.last_submitted_collation.get(s, 0), f"shard {s}"
+
+
+def test_vmap_over_period_batches():
+    """The kernel vmaps: independent periods in parallel give the same
+    result as one-at-a-time application (shard axis stays inside)."""
+    state = init_vote_state(4, 5)
+    state = add_header_reset(
+        state, jnp.asarray([0, 1, 2, 3], jnp.int32), jnp.int32(1),
+        jnp.zeros((4, 32), jnp.uint8))
+    pool = np.zeros((4, 20), np.uint8)
+    pool[0] = 7
+    bh = np.zeros(32, np.uint8)
+
+    def mk(shards):
+        n = len(shards)
+        return VoteAttempts(
+            shard=jnp.asarray(shards, jnp.int32),
+            index=jnp.asarray(list(range(n)), jnp.int32),
+            pool_index=jnp.zeros(n, jnp.int32),
+            sender=jnp.asarray(np.broadcast_to(pool[0], (n, 20))),
+            chunk_root=jnp.zeros((n, 32), jnp.uint8),
+            deposited=jnp.ones(n, jnp.bool_),
+            valid=jnp.ones(n, jnp.bool_),
+        )
+
+    def run(attempts):
+        return submit_votes_batch(
+            state, jnp.asarray(pool), attempts, period=jnp.int32(1),
+            blockhash=jnp.asarray(bh), sample_size=jnp.int32(1),
+            committee_size=5, quorum_size=3)
+
+    batches = [mk([0, 1, 2]), mk([3, 3, 3])]
+    stacked = VoteAttempts(*[
+        jnp.stack([getattr(batches[0], f), getattr(batches[1], f)])
+        for f in VoteAttempts._fields])
+    vs, va = jax.vmap(run)(stacked)
+    for bi, b in enumerate(batches):
+        s1, a1 = run(b)
+        np.testing.assert_array_equal(np.asarray(va)[bi], np.asarray(a1))
+        np.testing.assert_array_equal(
+            np.asarray(vs.vote_count)[bi], np.asarray(s1.vote_count))
+
+
+def test_no_quorum_carryover_across_periods():
+    """A shard that reached quorum in period 1 and has NO header in period 2
+    must keep last_approved = 1 when a period-2 batch (for other shards)
+    is applied — parity with the scalar rule that lastApproved/isElected
+    only move inside an accepted submitVote (.sol:215-218)."""
+    state = init_vote_state(2, 5)
+    pool = np.zeros((4, 20), np.uint8)
+    pool[0] = 7
+    bh = np.zeros(32, np.uint8)
+
+    def attempts(shards, n0=0):
+        n = len(shards)
+        return VoteAttempts(
+            shard=jnp.asarray(shards, jnp.int32),
+            index=jnp.asarray(list(range(n0, n0 + n)), jnp.int32),
+            pool_index=jnp.zeros(n, jnp.int32),
+            sender=jnp.asarray(np.broadcast_to(pool[0], (n, 20))),
+            chunk_root=jnp.zeros((n, 32), jnp.uint8),
+            deposited=jnp.ones(n, jnp.bool_),
+            valid=jnp.ones(n, jnp.bool_),
+        )
+
+    def submit(state, batch, period):
+        return submit_votes_batch(
+            state, jnp.asarray(pool), batch, period=jnp.int32(period),
+            blockhash=jnp.asarray(bh), sample_size=jnp.int32(1),
+            committee_size=5, quorum_size=2)
+
+    # period 1: header + quorum on shard 0
+    state = add_header_reset(state, jnp.asarray([0], jnp.int32),
+                             jnp.int32(1), jnp.zeros((1, 32), jnp.uint8))
+    state, acc = submit(state, attempts([0, 0]), 1)
+    assert list(np.asarray(acc)) == [True, True]
+    assert int(np.asarray(state.last_approved)[0]) == 1
+
+    # period 2: header only on shard 1; batch votes only shard 1
+    state = add_header_reset(state, jnp.asarray([1], jnp.int32),
+                             jnp.int32(2), jnp.zeros((1, 32), jnp.uint8))
+    state, acc = submit(state, attempts([1], n0=0), 2)
+    assert int(np.asarray(state.last_approved)[0]) == 1, \
+        "stale quorum count must not re-approve shard 0 in period 2"
+    assert int(np.asarray(state.last_approved)[1]) == 0  # 1 vote < quorum 2
