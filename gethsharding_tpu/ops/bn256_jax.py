@@ -5,11 +5,18 @@ Re-architecture of the reference's hand-written pairing stack
 `optate.go`, `PairingCheck` `bn256.go:313`) as batch-first integer array
 programs over the 12-bit-limb field engine (`ops/limb.py`):
 
-- Tower: Fp2 = Fp[i]/(i²+1) as (..., 2, 22) int32; Fp6 = Fp2[v]/(v³-ξ) as
-  (..., 3, 2, 22); Fp12 = Fp6[w]/(w²-v) as (..., 2, 3, 2, 22). ξ = 9+i.
-- Fused tower multiplication: products accumulate in raw schoolbook column
-  space (`ModArith.mul_cols`) and reduce with ONE `normalize` per output
-  component, with `pad_mult` keeping subtracted accumulators non-negative.
+- Fp2 = Fp[i]/(i²+1) as (..., 2, 22) int32.
+- Fp12 in the FLAT w-basis: Fp12 = Fp2[w]/(w⁶ - ξ), ξ = 9+i, stored as
+  (..., 6, 2, 22) — coefficient k of wᵏ is an Fp2 element. The nested
+  2×3 tower (Fp6[w]/(w²-v)) is mathematically identical (w² = v) but the
+  flat basis lets one einsum produce all 24 limb-product planes of a
+  coefficient-pair convolution, and ONE batched normalize reduce all 12
+  output components at once — an order of magnitude fewer graph nodes
+  than per-component tower arithmetic (XLA:CPU segfaulted compiling the
+  tower form of the batched pairing; this form compiles everywhere).
+- Multiplication = length-6 cyclic convolution over the w axis with ξ on
+  wrap-around, accumulated in raw schoolbook column space
+  (`ModArith.mul_cols`) in groups of ≤4 products + pad (int32-safe).
 - Miller loop: ate pairing, T = 6u² (trace-1) — the same loop the scalar
   reference `crypto/bn256.py` uses, so PairingCheck predicates agree by
   construction. G2 runs in Jacobian coordinates on the twist; line
@@ -17,8 +24,8 @@ programs over the 12-bit-limb field engine (`ops/limb.py`):
   which the final exponentiation kills). Static 127-bit `lax.scan`.
 - Final exponentiation: easy part ((p⁶-1)(p²+1)) via conjugation + one
   tower inversion, then the standard hard-part addition chain
-  (Devegili–Scott–Dahab) over f^u powers and Frobenius maps — ~3×63
-  square-multiply steps instead of a 3000-bit blind power.
+  (Devegili–Scott–Dahab) over f^u powers and Frobenius maps, run as a
+  register-machine `lax.scan` so each fp12 primitive compiles once.
 
 Everything is shape-static, integer-only, and differential-tested against
 the scalar `gethsharding_tpu.crypto.bn256` (tests/test_bn256_jax.py).
@@ -36,6 +43,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from gethsharding_tpu.crypto import bn256 as ref
+from gethsharding_tpu.ops import limb as _limb
 from gethsharding_tpu.ops.limb import ModArith, NLIMBS, ints_to_limbs, int_to_limbs
 
 P = ref.P
@@ -43,10 +51,10 @@ N = ref.N
 U = ref.U
 FP = ModArith(P)
 
-# Column-space bounds: one 22-limb product column < 22·2^24 ≈ 2^28.46; an
-# int32 column accumulator safely holds the sum of FOUR such products plus
-# a canonical pad (< 2^12 per column): 4·2^28.46 + 2^12 < 2^30.5.
-_PAD528 = FP.pad_mult(530)  # covers |subtracted| sums < 2^530
+# Column-space bounds: one 22-limb product column < 22·4095² ≈ 2^28.46; an
+# int32 column accumulator safely holds FOUR such products plus a canonical
+# pad (< 2^12 per column): 4·2^28.46 + 2^12 < 2^30.5. Never sum more.
+_PAD530 = FP.pad_mult(530)  # ≥ any sum of two subtracted products
 
 
 def _pad_to(cols: jnp.ndarray, width: int) -> jnp.ndarray:
@@ -59,9 +67,9 @@ def _red(cols: jnp.ndarray) -> jnp.ndarray:
 
 def _red_sub(pos_cols: jnp.ndarray, neg_cols: jnp.ndarray) -> jnp.ndarray:
     """normalize(pos - neg + pad·p), pads aligned to a common width."""
-    width = max(pos_cols.shape[-1], neg_cols.shape[-1], _PAD528.shape[0])
+    width = max(pos_cols.shape[-1], neg_cols.shape[-1], _PAD530.shape[0])
     z = _pad_to(pos_cols, width) - _pad_to(neg_cols, width)
-    return FP.normalize(z + jnp.asarray(np.pad(_PAD528, (0, width - _PAD528.shape[0]))))
+    return FP.normalize(z + jnp.asarray(np.pad(_PAD530, (0, width - _PAD530.shape[0]))))
 
 
 # == Fp2: (..., 2, 22), slot 0 = real, slot 1 = i-coefficient =============
@@ -108,12 +116,16 @@ def fp2_mul_fp(x, s):
     return jnp.stack([FP.mul(a, s), FP.mul(b, s)], axis=-2)
 
 
+_PAD266 = FP.pad_mult(266)  # ≥ one lazy element (for small negated sums)
+
+
 @jax.jit
 def fp2_mul_xi(x):
-    """×ξ = ×(9+i): (9a - b) + (a + 9b)i."""
+    """×ξ = ×(9+i): (9a - b) + (a + 9b)i — 2 normalizes, no products."""
     a, b = x[..., 0, :], x[..., 1, :]
-    rr = FP.sub(FP.mul_small(a, 9), b)
-    ii = FP.normalize(a + FP.mul_small(b, 9))
+    diff = _pad_to(a * 9 - b, _PAD266.shape[0])
+    rr = FP.normalize(diff + jnp.asarray(_PAD266))
+    ii = FP.normalize(a + b * 9)
     return jnp.stack([rr, ii], axis=-2)
 
 
@@ -147,7 +159,105 @@ FP2_ZERO = np.zeros((2, NLIMBS), np.int32)
 FP2_ONE = _const_fp2(1, 0)
 
 
-# == Fp6: (..., 3, 2, 22) over basis 1, v, v² =============================
+# == Fp12 in the w-basis: (..., 6, 2, 22), w⁶ = ξ =========================
+
+FP12_ONE = np.zeros((6, 2, NLIMBS), np.int32)
+FP12_ONE[0, 0, 0] = 1
+
+# static index tables for the cyclic convolution: output k takes, for each
+# i, operand j = (k - i) mod 6 — from y when i + j == k, from ξ·y on wrap
+_CONV_J = np.array([[(k - i) % 6 for i in range(6)] for k in range(6)])
+_CONV_SEL = np.array([[0 if i + (k - i) % 6 == k else 1 for i in range(6)]
+                      for k in range(6)])
+
+# combine tensor per output k: map the 24 limb-product planes (i, a, b) to
+# output component c ∈ {re, im} and accumulation group g = i // 2 (so each
+# group holds 2 pairs = ≤4 products): re += (a0b0) - (a1b1); im += a0b1 + a1b0
+_COMB = np.zeros((6, 2, 2, 2, 3), np.int32)  # (i, a, b, c, g)
+for _i in range(6):
+    _g = _i // 2
+    _COMB[_i, 0, 0, 0, _g] = 1
+    _COMB[_i, 1, 1, 0, _g] = -1
+    _COMB[_i, 0, 1, 1, _g] = 1
+    _COMB[_i, 1, 0, 1, _g] = 1
+
+# per-group pad: real groups subtract ≤2 products (< 2^529) — pad with a
+# multiple of p ≥ 2^530; imag groups are all-positive, no pad needed.
+# Accumulator width = max(product columns, pad limbs).
+_ACC_W = max(2 * NLIMBS - 1, _PAD530.shape[0])
+
+
+def _group_pad(n_groups: int) -> np.ndarray:
+    pad = np.zeros((2, n_groups, _ACC_W), np.int32)
+    pad[0, :, : _PAD530.shape[0]] = _PAD530
+    return pad
+
+
+def _diag_onehot():
+    """The (22, 22, 43) anti-diagonal one-hot — limb.py's product table."""
+    return _limb._DIAG_ONEHOT
+
+
+@jax.jit
+def fp12_mul(x, y):
+    """w-basis product: cyclic convolution with ξ wrap-around.
+
+    Per output k: one einsum builds the 24 limb-product column planes of
+    the 6 contributing (xᵢ, opⱼ) Fp2 pairs, one einsum folds them into
+    (component, group) accumulators; a single batched normalize then
+    reduces all (k, c, g) at once, and a 2-level tree of batched lazy adds
+    merges the 3 groups."""
+    xiy = fp2_mul_xi(y)                      # (..., 6, 2, 22), ξ·y_j
+    w = jnp.stack([y, xiy], axis=-4)         # (..., 2sel, 6, 2, 22)
+    onehot = jnp.asarray(_diag_onehot())
+    comb = jnp.asarray(_COMB)
+    pad = jnp.asarray(_group_pad(3))
+
+    group_cols = []
+    for k in range(6):
+        op = w[..., _CONV_SEL[k], _CONV_J[k], :, :]   # (..., 6, 2, 22)
+        # cols[..., i, a, b, n] = sum_{l+m=n} x[i,a,l]·op[i,b,m]
+        cols = jnp.einsum("...ial,...ibm,lmn->...iabn", x, op, onehot)
+        # fold into (component, group) accumulators, add pads
+        acc = _pad_to(jnp.einsum("...iabn,iabcg->...cgn", cols, comb),
+                      _ACC_W) + pad
+        group_cols.append(acc)
+    acc = jnp.stack(group_cols, axis=-4)     # (..., 6, 2, 3, width)
+    parts = FP.normalize(acc)                # (..., 6, 2, 3, 22)
+    merged = FP.normalize(parts[..., 0, :] + parts[..., 1, :])
+    return FP.normalize(merged + parts[..., 2, :])
+
+
+@jax.jit
+def fp12_sqr(x):
+    return fp12_mul(x, x)
+
+
+@jax.jit
+def fp12_conj(x):
+    """f^(p⁶): negate the odd-w coefficients (w^(p⁶) = -w)."""
+    neg = FP.neg(x)
+    odd = jnp.asarray(
+        np.arange(6).reshape(6, 1, 1) % 2 == 1)
+    return jnp.where(odd, neg, FP.normalize(x))
+
+
+def _h6(x, parity):
+    """Tower slice: even w-coeffs = Fp6 c0, odd = c1 (since w² = v)."""
+    return x[..., parity::2, :, :]
+
+
+def _interleave6(lo, hi):
+    """(..., 3, 2, 22) × 2 -> (..., 6, 2, 22), w-coeff k = (k%2 ? hi : lo)[k//2]."""
+    stacked = jnp.stack([lo, hi], axis=-3)   # (..., 3, 2par, 2, 22)
+    return stacked.reshape(stacked.shape[:-4] + (6,) + stacked.shape[-2:])
+
+
+# -- Fp6 helpers on tower slices (used by inversion only) -----------------
+
+
+def _c(x, k):
+    return x[..., k, :, :]
 
 
 def fp6_add(x, y):
@@ -162,10 +272,6 @@ def fp6_neg(x):
     return FP.neg(x)
 
 
-def _c(x, k):
-    return x[..., k, :, :]
-
-
 @jax.jit
 def fp6_mul(x, y):
     """Schoolbook with v³ = ξ (mirrors scalar Fp6.__mul__)."""
@@ -178,10 +284,6 @@ def fp6_mul(x, y):
     t4 = fp2_mul(a2, b2)  # v⁴ -> ξ·v
     return jnp.stack(
         [fp2_add(t0, fp2_mul_xi(t3)), fp2_add(t1, fp2_mul_xi(t4)), t2], axis=-3)
-
-
-def fp6_mul_fp2(x, k):
-    return jnp.stack([fp2_mul(_c(x, j), k) for j in range(3)], axis=-3)
 
 
 def fp6_mul_by_v(x):
@@ -203,73 +305,28 @@ def fp6_inv(x):
         [fp2_mul(t0, dinv), fp2_mul(t1, dinv), fp2_mul(t2, dinv)], axis=-3)
 
 
-FP6_ZERO = np.zeros((3, 2, NLIMBS), np.int32)
-FP6_ONE = np.stack([FP2_ONE, FP2_ZERO, FP2_ZERO])
-
-
-# == Fp12: (..., 2, 3, 2, 22) over basis 1, w with w² = v =================
-
-
-def _h(x, k):
-    return x[..., k, :, :, :]
-
-
-@jax.jit
-def fp12_mul(x, y):
-    t0 = fp6_mul(_h(x, 0), _h(y, 0))
-    t1 = fp6_mul(_h(x, 1), _h(y, 1))
-    lo = fp6_add(t0, fp6_mul_by_v(t1))
-    hi = fp6_add(fp6_mul(_h(x, 0), _h(y, 1)), fp6_mul(_h(x, 1), _h(y, 0)))
-    return jnp.stack([lo, hi], axis=-4)
-
-
-@jax.jit
-def fp12_sqr(x):
-    """Complex squaring: (c0 + c1·w)² via 2 fp6 muls instead of 4.
-
-    lo = (c0+c1)(c0+v·c1) - t - v·t, hi = 2t, with t = c0·c1."""
-    c0, c1 = _h(x, 0), _h(x, 1)
-    t = fp6_mul(c0, c1)
-    vt = fp6_mul_by_v(t)
-    lo = fp6_sub(
-        fp6_sub(fp6_mul(fp6_add(c0, c1), fp6_add(c0, fp6_mul_by_v(c1))), t),
-        vt)
-    hi = FP.mul_small(t, 2)
-    return jnp.stack([lo, hi], axis=-4)
-
-
-@jax.jit
-def fp12_conj(x):
-    """f^(p⁶): (c0, c1) -> (c0, -c1)."""
-    return jnp.stack([FP.normalize(_h(x, 0)), FP.neg(_h(x, 1))], axis=-4)
-
-
 @jax.jit
 def fp12_inv(x):
-    denom = fp6_sub(fp6_mul(_h(x, 0), _h(x, 0)),
-                    fp6_mul_by_v(fp6_mul(_h(x, 1), _h(x, 1))))
+    """(c0 + c1 w)⁻¹ via the quadratic norm over the Fp6 tower slices."""
+    c0, c1 = _h6(x, 0), _h6(x, 1)
+    denom = fp6_sub(fp6_mul(c0, c0), fp6_mul_by_v(fp6_mul(c1, c1)))
     dinv = fp6_inv(denom)
-    return jnp.stack(
-        [fp6_mul(_h(x, 0), dinv), fp6_neg(fp6_mul(_h(x, 1), dinv))], axis=-4)
+    return _interleave6(fp6_mul(c0, dinv), fp6_neg(fp6_mul(c1, dinv)))
 
 
 def fp12_select(cond, x, y):
-    return jnp.where(cond[..., None, None, None, None], x, y)
+    return jnp.where(cond[..., None, None, None], x, y)
 
 
 def fp12_is_one(x):
     one = jnp.asarray(FP12_ONE)
-    flat = FP.canon(x)
-    return jnp.all(flat == FP.canon(jnp.broadcast_to(one, x.shape)),
-                   axis=(-1, -2, -3, -4))
-
-
-FP12_ONE = np.stack([FP6_ONE, FP6_ZERO])
+    return jnp.all(
+        FP.canon(x) == FP.canon(jnp.broadcast_to(one, x.shape)),
+        axis=(-1, -2, -3))
 
 
 # == Frobenius maps =======================================================
 # (a·wᵏ)^(pⁿ) = conjⁿ(a) · γ_{n,k} · wᵏ with γ_{n,k} = ξ^(k(pⁿ-1)/6) ∈ Fp2.
-# Basis order over Fp2: w⁰..w⁵ = c0.d0, c1.d0, c0.d1, c1.d1, c0.d2, c1.d2.
 
 
 def _fp2_pow_host(base: ref.Fp2, e: int) -> ref.Fp2:
@@ -292,22 +349,12 @@ def _gamma_table(n: int) -> np.ndarray:
 
 
 _GAMMA = {n: _gamma_table(n) for n in (1, 2, 3)}
-_WSLOT = [(0, 0), (1, 0), (0, 1), (1, 1), (0, 2), (1, 2)]  # wᵏ -> (h, l)
 
 
 def fp12_frobenius(x, n: int):
-    """f^(pⁿ) for n ∈ {1, 2, 3}."""
-    gam = _GAMMA[n]
-    halves = [[None, None, None], [None, None, None]]
-    for k, (h, l) in enumerate(_WSLOT):
-        coeff = x[..., h, l, :, :]
-        if n % 2 == 1:
-            coeff = fp2_conj(coeff)
-        else:
-            coeff = FP.normalize(coeff)
-        halves[h][l] = fp2_mul(coeff, jnp.asarray(gam[k]))
-    return jnp.stack([jnp.stack(halves[0], axis=-3),
-                      jnp.stack(halves[1], axis=-3)], axis=-4)
+    """f^(pⁿ) for n ∈ {1, 2, 3} — batched over all six w-coefficients."""
+    coeff = fp2_conj(x) if n % 2 == 1 else FP.normalize(x)
+    return fp2_mul(coeff, jnp.asarray(_GAMMA[n]))
 
 
 # == G2 Jacobian steps with line evaluation ================================
@@ -355,24 +402,43 @@ def _madd_step(X1, Y1, Z1, x2, y2, px, py):
     return line, X3, Y3, Z3
 
 
+# sparse line-mul tables: ℓ = A·w⁰ + B·w¹ + C·w³; output k takes
+# A·f_k, B·f_{k-1} (ξ·f_{k+5} on wrap), C·f_{k-3} (ξ·f_{k+3} on wrap)
+_LINE_POS = np.array([0, 1, 3])  # w-degrees of A, B, C
+_LINE_J = np.array([[(k - d) % 6 for d in _LINE_POS] for k in range(6)])
+_LINE_SEL = np.array([[0 if k - d >= 0 else 1 for d in _LINE_POS]
+                      for k in range(6)])
+# combine: (t∈3 line terms, a, b, c, g): group 0 = terms A,B; group 1 = C
+_LCOMB = np.zeros((3, 2, 2, 2, 2), np.int32)
+for _t in range(3):
+    _g = 0 if _t < 2 else 1
+    _LCOMB[_t, 0, 0, 0, _g] = 1
+    _LCOMB[_t, 1, 1, 0, _g] = -1
+    _LCOMB[_t, 0, 1, 1, _g] = 1
+    _LCOMB[_t, 1, 0, 1, _g] = 1
+
+
 @jax.jit
 def fp12_mul_line(f, line):
-    """f · (A + B·w + C·w³), sparse (13 fp2 muls vs 18+ for full mul)."""
+    """f · (A + B·w + C·w³) — sparse convolution, same fusion scheme."""
     A, B, C = line
-    f0, f1 = _h(f, 0), _h(f, 1)
-    # f0·ℓ0 and f1·ℓ0 with ℓ0 = (A, 0, 0)
-    f0A = fp6_mul_fp2(f0, A)
-    f1A = fp6_mul_fp2(f1, A)
-    # ℓ1 = (B, C, 0): Fp6-sparse product g·ℓ1
-    def mul_l1(g):
-        g0, g1, g2 = _c(g, 0), _c(g, 1), _c(g, 2)
-        t0 = fp2_add(fp2_mul(g0, B), fp2_mul_xi(fp2_mul(g2, C)))
-        t1 = fp2_add(fp2_mul(g0, C), fp2_mul(g1, B))
-        t2 = fp2_add(fp2_mul(g1, C), fp2_mul(g2, B))
-        return jnp.stack([t0, t1, t2], axis=-3)
-    lo = fp6_add(f0A, fp6_mul_by_v(mul_l1(f1)))
-    hi = fp6_add(mul_l1(f0), f1A)
-    return jnp.stack([lo, hi], axis=-4)
+    lstack = jnp.stack([A, B, C], axis=-3)   # (..., 3, 2, 22)
+    xif = fp2_mul_xi(f)
+    w = jnp.stack([f, xif], axis=-4)         # (..., 2sel, 6, 2, 22)
+    onehot = jnp.asarray(_diag_onehot())
+    comb = jnp.asarray(_LCOMB)
+    pad = jnp.asarray(_group_pad(2))
+
+    group_cols = []
+    for k in range(6):
+        op = w[..., _LINE_SEL[k], _LINE_J[k], :, :]   # (..., 3, 2, 22)
+        cols = jnp.einsum("...tal,...tbm,lmn->...tabn", lstack, op, onehot)
+        acc = _pad_to(jnp.einsum("...tabn,tabcg->...cgn", cols, comb),
+                      _ACC_W) + pad
+        group_cols.append(acc)
+    acc = jnp.stack(group_cols, axis=-4)     # (..., 6, 2, 2, width)
+    parts = FP.normalize(acc)
+    return FP.normalize(parts[..., 0, :] + parts[..., 1, :])
 
 
 # == Miller loop (ate, T = 6u²) ===========================================
@@ -387,10 +453,14 @@ def miller_loop(px, py, qx, qy):
     Inputs must be valid curve points; infinity handling is the caller's
     (mask + select, see pairing_check)."""
     shape = px.shape[:-1]
-    f = jnp.broadcast_to(jnp.asarray(FP12_ONE), shape + (2, 3, 2, NLIMBS))
+    # zero derived from a varying input so constant-built scan carries
+    # inherit the varying manual axes under shard_map
+    vzero = (px[..., :1] * 0)[..., None]  # (..., 1, 1)
+    f = jnp.broadcast_to(jnp.asarray(FP12_ONE),
+                         shape + (6, 2, NLIMBS)) + vzero[..., None]
     X = jnp.broadcast_to(qx, shape + (2, NLIMBS))
     Y = jnp.broadcast_to(qy, shape + (2, NLIMBS))
-    Z = jnp.broadcast_to(jnp.asarray(FP2_ONE), shape + (2, NLIMBS))
+    Z = jnp.broadcast_to(jnp.asarray(FP2_ONE), shape + (2, NLIMBS)) + vzero
     # normalize broadcasts into concrete arrays for scan carry stability
     f, X, Y, Z = map(FP.normalize, (f, X, Y, Z))
 
@@ -411,11 +481,10 @@ def miller_loop(px, py, qx, qy):
 
 # == Final exponentiation ==================================================
 
-
 # The hard part runs as a small register machine under ONE lax.scan so XLA
 # compiles each fp12 primitive once (an inline chain of ~25 fp12_muls
 # multiplies compile time by the chain length). Ops: 0 mul, 1 sqr, 2 conj,
-# 3/4/5 frobenius¹/²/³, 6 pow-by-u. Registers: 14 × Fp12.
+# 3/4/5 frobenius¹/²/³. Registers: 14 × Fp12.
 # Program = the Devegili–Scott–Dahab chain; register plan in comments.
 _HARD_PROGRAM = np.array([
     # (op, src_a, src_b, dst) — registers 1..3 (f^u, f^u², f^u³) are filled
@@ -460,11 +529,12 @@ def _pow_u(x):
     """x^u (u = BN parameter, 63 static bits) via square-multiply scan."""
     def step(carry, bit):
         acc, base = carry
-        take = jnp.broadcast_to(bit == 1, acc.shape[:-4])
+        take = jnp.broadcast_to(bit == 1, acc.shape[:-3])
         acc = fp12_select(take, fp12_mul(acc, base), acc)
         return (acc, fp12_sqr(base)), None
 
-    acc0 = FP.normalize(jnp.broadcast_to(jnp.asarray(FP12_ONE), x.shape))
+    acc0 = FP.normalize(
+        jnp.broadcast_to(jnp.asarray(FP12_ONE), x.shape) + x * 0)
     (acc, _), _ = lax.scan(step, (acc0, x), jnp.asarray(_U_BITS))
     return acc
 
@@ -476,7 +546,7 @@ def final_exponentiation(f):
     f = fp12_mul(fp12_frobenius(f, 2), f)
     # hard part: register machine (see _HARD_PROGRAM)
     regs = jnp.broadcast_to(
-        jnp.asarray(FP12_ONE), (_N_REGS,) + f.shape).astype(jnp.int32)
+        jnp.asarray(FP12_ONE), (_N_REGS,) + f.shape).astype(jnp.int32) + f * 0
     regs = FP.normalize(regs)
     regs = regs.at[0].set(f)
     fu = _pow_u(f)
@@ -512,13 +582,13 @@ def pairing_product(px, py, qx, qy, mask):
     px/py: (..., K, 22); qx/qy: (..., K, 2, 22); mask: (..., K) bool.
     Returns the K-product BEFORE final exponentiation.
     """
-    f = miller_loop(px, py, qx, qy)  # (..., K, 2, 3, 2, 22)
+    f = miller_loop(px, py, qx, qy)  # (..., K, 6, 2, 22)
     one = jnp.broadcast_to(jnp.asarray(FP12_ONE), f.shape)
     f = fp12_select(mask, f, one)
-    k = f.shape[-5]
-    acc = f[..., 0, :, :, :, :]
+    k = f.shape[-4]
+    acc = f[..., 0, :, :, :]
     for j in range(1, k):  # K is small (2 for BLS verify)
-        acc = fp12_mul(acc, f[..., j, :, :, :, :])
+        acc = fp12_mul(acc, f[..., j, :, :, :])
     return acc
 
 
@@ -587,6 +657,21 @@ def g2_to_limbs(points: Sequence[ref.G2Point]):
     return (np.stack(xs), np.stack(ys), np.asarray(ok))
 
 
+# tower-order interop: w-coeff k ↔ tower slot (h, l) with k = 2l + h
+_WSLOT = [(0, 0), (1, 0), (0, 1), (1, 1), (0, 2), (1, 2)]  # wᵏ -> (h, l)
+
+
+def fp12_from_tower(arr: np.ndarray) -> np.ndarray:
+    """(..., 2, 3, 2, 22) tower layout -> (..., 6, 2, 22) w-basis."""
+    return np.stack([arr[..., h, l, :, :] for (h, l) in _WSLOT], axis=-3)
+
+
 def fp12_to_int_coeffs(x) -> np.ndarray:
-    """Canonical integer coefficients (..., 2, 3, 2) for host comparison."""
-    return FP.to_ints(np.asarray(FP.canon(x)))
+    """Canonical integer coefficients (..., 2, 3, 2) in TOWER order
+    (c0/c1 × v-power × Fp2 component) for host comparison with the scalar
+    reference classes."""
+    w = FP.to_ints(np.asarray(FP.canon(x)))  # (..., 6, 2) object ints
+    out = np.zeros(w.shape[:-2] + (2, 3, 2), object)
+    for k, (h, l) in enumerate(_WSLOT):
+        out[..., h, l, :] = w[..., k, :]
+    return out

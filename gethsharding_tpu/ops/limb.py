@@ -324,7 +324,8 @@ class ModArith:
             acc = self.select(bit == 1, self.mul(acc, base), acc)
             return (acc, self.sqr(base)), None
 
-        acc0 = jnp.broadcast_to(self.one, x.shape)
+        # + x*0: init inherits x's varying manual axes under shard_map
+        acc0 = jnp.broadcast_to(self.one, x.shape) + x * 0
         (acc, _), _ = lax.scan(step, (acc0, x), bits)
         return acc
 

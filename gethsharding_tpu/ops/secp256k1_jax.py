@@ -1,0 +1,241 @@
+"""Batched secp256k1 ECDSA public-key recovery on TPU.
+
+Parity target: the reference's libsecp256k1 C library behind
+`secp256k1.RecoverPubkey` (`crypto/secp256k1/secp256.go:105`) — the
+per-transaction sender-recovery hot loop of collation replay
+(`core/types/transaction_signing.go`, SURVEY.md §2.3 row 1). That design
+is scalar-serial with precomputed tables; this one is batch-first: B
+recoveries advance together through one 256-step Shamir double-and-add
+ladder, every step branchless (selects, no data-dependent control flow),
+on the 12-bit-limb engine (`ops/limb.py`).
+
+Recovery math: given (e, r, s, recid) with R = lift_x(r, recid):
+  Q = r⁻¹·(s·R - e·G)
+computed as the joint ladder u1·G + u2·R with u1 = -e·r⁻¹ mod n,
+u2 = s·r⁻¹ mod n. Point arithmetic is Jacobian over a = 0, b = 7 with
+complete-ized formulas: the generic chord addition is patched by selects
+for the P = ±Q and infinity cases (infinity is Z = 0, matching the
+exceptional-case handling the C library does with branches).
+
+Differential-tested against the scalar `crypto/secp256k1.py`
+(tests/test_secp256k1_jax.py), which is itself round-trip tested against
+RFC6979 signing.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from gethsharding_tpu.crypto import secp256k1 as ref
+from gethsharding_tpu.ops.limb import (
+    ModArith, NLIMBS, _carry_scan, ints_to_limbs, int_to_limbs,
+)
+
+P = ref.P
+N = ref.N
+FQ = ModArith(P)   # base field
+FN = ModArith(N)   # scalar field
+
+_G = (int_to_limbs(ref.GX), int_to_limbs(ref.GY))
+_B7 = int_to_limbs(7)
+
+
+# == Jacobian point ops (branchless) ======================================
+# A point is (X, Y, Z) limb arrays; infinity is Z = 0 (canonical: X=1,Y=1).
+
+
+def _pt_double(X, Y, Z):
+    """dbl-2009-l for a = 0. Infinity (Z=0) stays infinity (Z3=0)."""
+    A = FQ.mul(X, X)
+    Bv = FQ.mul(Y, Y)
+    C = FQ.mul(Bv, Bv)
+    t = FQ.mul(FQ.add(X, Bv), FQ.add(X, Bv))
+    D = FQ.mul_small(FQ.sub(FQ.sub(t, A), C), 2)   # 4XY²
+    E = FQ.mul_small(A, 3)
+    F = FQ.mul(E, E)
+    X3 = FQ.sub(F, FQ.mul_small(D, 2))
+    Y3 = FQ.sub(FQ.mul(E, FQ.sub(D, X3)), FQ.mul_small(C, 8))
+    Z3 = FQ.mul_small(FQ.mul(Y, Z), 2)
+    return X3, Y3, Z3
+
+
+def _pt_add(X1, Y1, Z1, X2, Y2, Z2):
+    """Complete-ized Jacobian addition via selects.
+
+    Handles: P2 = inf -> P1; P1 = inf -> P2; P1 = P2 -> double;
+    P1 = -P2 -> inf; generic chord otherwise."""
+    Z1Z1 = FQ.mul(Z1, Z1)
+    Z2Z2 = FQ.mul(Z2, Z2)
+    U1 = FQ.mul(X1, Z2Z2)
+    U2 = FQ.mul(X2, Z1Z1)
+    S1 = FQ.mul(Y1, FQ.mul(Z2, Z2Z2))
+    S2 = FQ.mul(Y2, FQ.mul(Z1, Z1Z1))
+    H = FQ.sub(U2, U1)
+    R = FQ.sub(S2, S1)
+
+    HH = FQ.mul(H, H)
+    HHH = FQ.mul(H, HH)
+    V = FQ.mul(U1, HH)
+    X3 = FQ.sub(FQ.sub(FQ.mul(R, R), HHH), FQ.mul_small(V, 2))
+    Y3 = FQ.sub(FQ.mul(R, FQ.sub(V, X3)), FQ.mul(S1, HHH))
+    Z3 = FQ.mul(FQ.mul(Z1, Z2), H)
+
+    inf1 = FQ.is_zero(Z1)
+    inf2 = FQ.is_zero(Z2)
+    h_zero = FQ.is_zero(H)
+    r_zero = FQ.is_zero(R)
+    same_point = h_zero & r_zero & ~inf1 & ~inf2      # -> double
+    opposite = h_zero & ~r_zero & ~inf1 & ~inf2       # -> infinity
+
+    dX, dY, dZ = _pt_double(X1, Y1, Z1)
+
+    def pick(a, b, cond):
+        return FQ.select(cond, a, b)
+
+    X3 = pick(dX, X3, same_point)
+    Y3 = pick(dY, Y3, same_point)
+    Z3 = pick(dZ, Z3, same_point)
+    zero = jnp.zeros_like(Z3)
+    Z3 = jnp.where(opposite[..., None], zero, Z3)
+    # infinity operands
+    X3 = pick(X2, pick(X1, X3, inf2), inf1)
+    Y3 = pick(Y2, pick(Y1, Y3, inf2), inf1)
+    Z3 = pick(Z2, pick(Z1, Z3, inf2), inf1)
+    return X3, Y3, Z3
+
+
+def _to_affine(X, Y, Z):
+    zinv = FQ.inv(Z)
+    zinv2 = FQ.mul(zinv, zinv)
+    x = FQ.mul(X, zinv2)
+    y = FQ.mul(Y, FQ.mul(zinv, zinv2))
+    return x, y
+
+
+# == scalar bit decomposition (data-dependent, on-device) =================
+
+
+def _scalar_bits(k):
+    """(..., 22) limbs (canonical) -> (..., 256) bits, LSB first."""
+    shifts = np.arange(12, dtype=np.int32)
+    bits = (k[..., :, None] >> shifts) & 1          # (..., 22, 12)
+    flat = bits.reshape(bits.shape[:-2] + (NLIMBS * 12,))
+    return flat[..., :256]
+
+
+# == batched recovery ======================================================
+
+
+@jax.jit
+def ecrecover_batch(e, r, s, recid, valid):
+    """Batched pubkey recovery.
+
+    e, r, s: (..., 22) int32 limbs (msg-hash int, signature r, s);
+    recid: (...,) int32 in {0, 1} (y parity of R); valid: (...,) bool.
+    Returns (qx, qy, ok): affine pubkey limbs + per-element success
+    (False for r/s out of [1, n-1], r with no curve point, or infinity
+    result — matching the C library's failure returns).
+    """
+    # R = lift_x(r): y² = r³ + 7; y = (r³+7)^((p+1)/4) (p ≡ 3 mod 4)
+    rx = FQ.normalize(r)
+    y_sq = FQ.add(FQ.mul(FQ.mul(rx, rx), rx), jnp.asarray(_B7))
+    ry = FQ.pow_static(y_sq, (P + 1) // 4)
+    on_curve = FQ.eq(FQ.mul(ry, ry), y_sq)
+    # choose parity: canon(ry) low bit vs recid
+    ry_c = FQ.canon(ry)
+    parity = (ry_c[..., 0] & 1).astype(jnp.int32)
+    want = recid.astype(jnp.int32) & 1
+    ry = FQ.select(parity == want, ry, FQ.neg(ry))
+
+    # scalars: rinv = r⁻¹ mod n; u1 = -e·rinv; u2 = s·rinv
+    rn = FN.normalize(r)
+    rinv = FN.inv(rn)
+    u1 = FN.mul(FN.neg(FN.normalize(e)), rinv)
+    u2 = FN.mul(FN.normalize(s), rinv)
+    b1 = _scalar_bits(FN.canon(u1))
+    b2 = _scalar_bits(FN.canon(u2))
+
+    # precompute G + R (per batch element; G broadcast)
+    shape = r.shape[:-1]
+    gx = jnp.broadcast_to(jnp.asarray(_G[0]), shape + (NLIMBS,)) + rx * 0
+    gy = jnp.broadcast_to(jnp.asarray(_G[1]), shape + (NLIMBS,)) + rx * 0
+    one = jnp.broadcast_to(jnp.asarray(FQ.one), shape + (NLIMBS,)) + rx * 0
+    grx, gry, grz = _pt_add(gx, gy, one, rx, ry, one)
+
+    # Shamir ladder, MSB -> LSB: acc = 2acc + {0, G, R, G+R}
+    accX = jnp.zeros_like(gx)
+    accY = jnp.zeros_like(gy)
+    accZ = jnp.zeros_like(gx)  # Z = 0: infinity
+    accX = accX + one  # canonical infinity (1, 1, 0)
+    accY = accY + one
+
+    bits = jnp.stack([b1, b2], axis=-1)  # (..., 256, 2)
+    bits_rev = jnp.moveaxis(bits[..., ::-1, :], -2, 0)  # (256, ..., 2)
+
+    def step(carry, bit):
+        X, Y, Z = carry
+        X, Y, Z = _pt_double(X, Y, Z)
+        t1, t2 = bit[..., 0] == 1, bit[..., 1] == 1
+        # select the addend: none / G / R / G+R
+        aX = FQ.select(t1 & t2, grx, FQ.select(t1, gx, rx))
+        aY = FQ.select(t1 & t2, gry, FQ.select(t1, gy, ry))
+        aZ = FQ.select(t1 & t2, grz,
+                       jnp.broadcast_to(one, grz.shape))
+        Xn, Yn, Zn = _pt_add(X, Y, Z, aX, aY, aZ)
+        any_add = t1 | t2
+        X = FQ.select(any_add, Xn, X)
+        Y = FQ.select(any_add, Yn, Y)
+        Z = FQ.select(any_add, Zn, Z)
+        return (X, Y, Z), None
+
+    (X, Y, Z), _ = lax.scan(step, (accX, accY, accZ), bits_rev)
+    qx, qy = _to_affine(X, Y, Z)
+
+    # validity: r, s in [1, n-1]; recid in {0,1} (the rare r+n overflow
+    # case, recid 2/3, is a host-side fallback — `ref.recover` handles it);
+    # R on curve; result not infinity
+    r_ok = ~FN.is_zero(rn) & _lt_n(r)
+    s_ok = ~FN.is_zero(FN.normalize(s)) & _lt_n(s)
+    ok = (valid & on_curve & r_ok & s_ok & (recid >= 0) & (recid < 2)
+          & ~FQ.is_zero(Z))
+    return qx, qy, ok
+
+
+def _lt_n(x):
+    """Raw integer value of canonical limbs < n? (r/s arrive as canonical
+    256-bit wire integers, so the comparison is on the raw value, NOT a
+    field-reduced one). The borrow sign of exact carry propagation is the
+    comparison — same primitive `_cond_sub` uses in limb.py."""
+    borrow, _ = _carry_scan(x - jnp.asarray(int_to_limbs(N)))
+    return borrow < 0  # net borrow <=> x < n
+
+
+# == host-side converters ==================================================
+
+
+def hashes_to_limbs(hashes: Sequence[bytes]) -> np.ndarray:
+    return ints_to_limbs([int.from_bytes(h, "big") for h in hashes])
+
+
+def sigs_to_limbs(sigs: Sequence[ref.Signature]):
+    """[Signature] -> (e-placeholder-free) (r, s, recid) arrays."""
+    r = ints_to_limbs([sig.r for sig in sigs])
+    s = ints_to_limbs([sig.s for sig in sigs])
+    v = np.asarray([sig.v for sig in sigs], np.int32)
+    return r, s, v
+
+
+def limbs_to_pubkeys(qx, qy, ok):
+    """Device outputs -> [(x, y) | None] host points."""
+    xs = FQ.to_ints(np.asarray(qx))
+    ys = FQ.to_ints(np.asarray(qy))
+    out = []
+    for i in range(len(np.asarray(ok))):
+        out.append((int(xs[i]), int(ys[i])) if bool(np.asarray(ok)[i]) else None)
+    return out

@@ -19,15 +19,14 @@ from gethsharding_tpu.crypto import bn256 as ref
 from gethsharding_tpu.ops import bn256_jax as k
 from gethsharding_tpu.ops.limb import ints_to_limbs
 
-# The full Miller-loop/final-exponentiation kernels compile for minutes on
-# XLA:CPU, and the batched pairing_check graph currently SEGFAULTS the
-# XLA:CPU compiler (observed on jax 0.9 — a compile-resource crash, not a
-# correctness issue; single-pair shapes compile and pass). Until the
-# smaller-graph kernel rework lands, the end-to-end pairing tests are
-# opt-in: set GETHSHARDING_RUN_SLOW=1 to run them.
+# The full Miller-loop/final-exponentiation kernels take ~20-90 s each to
+# compile on XLA:CPU (near-instant on repeat runs via the persistent cache
+# in conftest.py). They run by default — the suite must exercise the
+# north-star kernel end to end — but GETHSHARDING_SKIP_SLOW=1 skips them
+# for quick local loops.
 slow = pytest.mark.skipif(
-    os.environ.get("GETHSHARDING_RUN_SLOW") != "1",
-    reason="set GETHSHARDING_RUN_SLOW=1 to run the full pairing kernels",
+    os.environ.get("GETHSHARDING_SKIP_SLOW") == "1",
+    reason="GETHSHARDING_SKIP_SLOW=1",
 )
 
 
@@ -41,12 +40,13 @@ def _rand_fp12(rng) -> ref.Fp12:
 
 
 def _fp12_to_arr(x: ref.Fp12) -> np.ndarray:
-    out = np.zeros((2, 3, 2, 22), np.int32)
+    """Scalar Fp12 -> the kernel's w-basis (6, 2, 22) layout."""
+    tower = np.zeros((2, 3, 2, 22), np.int32)
     for h, c6 in enumerate((x.c0, x.c1)):
         for l, c2 in enumerate((c6.c0, c6.c1, c6.c2)):
-            out[h, l, 0] = ints_to_limbs([c2.a])[0]
-            out[h, l, 1] = ints_to_limbs([c2.b])[0]
-    return out
+            tower[h, l, 0] = ints_to_limbs([c2.a])[0]
+            tower[h, l, 1] = ints_to_limbs([c2.b])[0]
+    return k.fp12_from_tower(tower)
 
 
 def _arr_to_coeffs(arr) -> np.ndarray:

@@ -18,7 +18,8 @@ def test_entry_compiles_and_runs():
     fn, args = graft.entry()
     out = jax.jit(fn)(*args)
     out.block_until_ready()
-    assert out.shape[0] == 135
+    assert out.shape == (4,)
+    assert bool(out.all()), "flagship BLS verification must accept"
 
 
 def test_dryrun_multichip_8_devices():
