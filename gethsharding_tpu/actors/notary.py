@@ -27,6 +27,7 @@ from gethsharding_tpu.mainchain.client import SMCClient
 from gethsharding_tpu.p2p.messages import CollationBodyRequest
 from gethsharding_tpu.p2p.service import P2PServer
 from gethsharding_tpu.params import Config, DEFAULT_CONFIG
+from gethsharding_tpu.sigbackend import SigBackend, get_backend
 from gethsharding_tpu.smc.state_machine import SMCRevert
 
 
@@ -37,7 +38,8 @@ class Notary(Service):
                  p2p: Optional[P2PServer] = None,
                  config: Config = DEFAULT_CONFIG,
                  deposit_flag: bool = False,
-                 all_shards: bool = True):
+                 all_shards: bool = True,
+                 sig_backend: Optional[SigBackend] = None):
         super().__init__()
         self.client = client
         self.shard = shard
@@ -46,8 +48,10 @@ class Notary(Service):
         self.deposit_flag = deposit_flag
         # notaries watch every shard (the reference scans 0..shardCount)
         self.all_shards = all_shards
+        self.sig_backend = sig_backend or get_backend("python")
         self.votes_submitted = 0
         self.canonical_set = 0
+        self.signatures_rejected = 0
         self._unsubscribe = None
 
     # -- lifecycle ---------------------------------------------------------
@@ -144,6 +148,20 @@ class Notary(Service):
         if self.client.has_voted(shard_id, registry.pool_index):
             return False
 
+        # proposer-signature check through the sig backend (the reference's
+        # native-crypto seam; batch-verified on TPU with sigbackend 'jax').
+        # An unsigned record (empty sig) is accepted for parity with the
+        # reference flow, where header signatures are not yet enforced
+        # on-chain — but a PRESENT signature must recover to the proposer.
+        if record.signature:
+            if not self.verify_proposer_signatures(
+                    [(shard_id, period, record)])[0]:
+                self.signatures_rejected += 1
+                self.record_error(
+                    f"proposer signature invalid: shard {shard_id} "
+                    f"period {period}")
+                return False
+
         # data-availability check against the local shardDB; fetch the body
         # over shardp2p when missing (the reference's syncer round-trip)
         if not self._check_availability(shard_id, period, record):
@@ -165,6 +183,31 @@ class Notary(Service):
         if self.client.last_approved_collation(shard_id) == period:
             self._set_canonical(shard_id, period, record)
         return True
+
+    def verify_proposer_signatures(self, records) -> list:
+        """Batch-verify proposer signatures over collation-header records.
+
+        `records`: [(shard_id, period, record)]. The signed digest is the
+        header hash with an EMPTY signature field (the proposer signs
+        before add_sig — proposer.py create_collation). One backend
+        dispatch covers the whole batch: with sigbackend 'jax' this is the
+        vmapped recovery ladder over every shard's record at once.
+        """
+        digests, sigs = [], []
+        for shard_id, period, record in records:
+            unsigned = CollationHeader(
+                shard_id=shard_id,
+                chunk_root=record.chunk_root,
+                period=period,
+                proposer_address=record.proposer,
+            )
+            digests.append(bytes(unsigned.hash()))
+            sigs.append(record.signature)
+        recovered = self.sig_backend.ecrecover_addresses(digests, sigs)
+        return [
+            got is not None and got == rec[2].proposer
+            for got, rec in zip(recovered, records)
+        ]
 
     def _check_availability(self, shard_id: int, period: int, record) -> bool:
         header = self._reconstruct_header(shard_id, period, record)

@@ -25,6 +25,7 @@ from gethsharding_tpu.db.shard_db import ShardDB
 from gethsharding_tpu.mainchain.client import SMCClient
 from gethsharding_tpu.p2p.service import Hub, P2PServer
 from gethsharding_tpu.params import Config, DEFAULT_CONFIG
+from gethsharding_tpu.sigbackend import get_backend
 from gethsharding_tpu.smc.chain import SimulatedMainchain
 
 S = TypeVar("S")
@@ -42,7 +43,8 @@ class ShardNode:
                  data_dir: str = "", in_memory_db: bool = True,
                  deposit: bool = False,
                  txpool_interval: Optional[float] = 5.0,
-                 simulator_interval: float = 15.0):
+                 simulator_interval: float = 15.0,
+                 sig_backend: str = "python"):
         if actor not in self.ACTORS:
             raise ValueError(f"unknown actor {actor!r}; pick from {self.ACTORS}")
         self.actor = actor
@@ -71,7 +73,8 @@ class ShardNode:
                                     shard=shard, config=config))
         elif actor == "notary":
             self._register(Notary(client=client, shard=shard, p2p=p2p,
-                                  config=config, deposit_flag=deposit))
+                                  config=config, deposit_flag=deposit,
+                                  sig_backend=get_backend(sig_backend)))
         else:
             self._register(Observer(client=client, shard=shard))
 

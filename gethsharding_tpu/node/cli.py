@@ -46,6 +46,11 @@ def build_parser() -> argparse.ArgumentParser:
                           help="seconds to run before exiting (0 = forever)")
     sharding.add_argument("--txinterval", type=float, default=5.0,
                           help="simulated txpool emission interval")
+    sharding.add_argument("--sigbackend", default="python",
+                          choices=("python", "jax"),
+                          help="signature verification backend: scalar host "
+                               "crypto or batched TPU kernels (the "
+                               "reference's native-crypto build seam)")
     sharding.add_argument("--verbosity", default="info",
                           choices=("debug", "info", "warning", "error"))
     return parser
@@ -75,6 +80,7 @@ def run_sharding_node(args) -> int:
         in_memory_db=args.datadir == "",
         deposit=args.deposit,
         txpool_interval=args.txinterval,
+        sig_backend=args.sigbackend,
     )
     # dev mode: fund the node account so --deposit can stake
     backend.fund(node.client.account(), 2000 * ETHER)

@@ -1,0 +1,117 @@
+"""The per-period cross-shard pipeline: verify → tally → approve.
+
+This is the framework's "training step": for every shard in a period,
+verify the aggregate BLS committee vote on the shard's collation header
+(batched pairing kernel), tally accepted votes, apply the quorum rule, and
+all-reduce the period totals — laid out so the shard axis shards over a
+`jax.sharding.Mesh` (BASELINE.md configs 3 and 5; SURVEY.md §2.2 row 1:
+shard-level data parallelism is the reference's only scaling axis, here it
+is the mesh axis and the tallies ride ICI collectives).
+
+Two dispatch modes, same math:
+- single-device: one jitted batch over all shards;
+- mesh: `shard_map` with each device owning a contiguous shard slab and
+  `psum` for the cross-shard reductions.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as PS
+
+from gethsharding_tpu.crypto import bn256 as bls
+from gethsharding_tpu.ops import bn256_jax as bn
+from gethsharding_tpu.params import Config, DEFAULT_CONFIG
+from gethsharding_tpu.parallel.mesh import shard_axis_sharding
+
+
+class PeriodInputs(NamedTuple):
+    """Device arrays for one period across S shards (leading axis = shard)."""
+
+    hx: jnp.ndarray    # (S, 22) G1 hash-to-curve of each header
+    hy: jnp.ndarray
+    sx: jnp.ndarray    # (S, 22) aggregate committee signature
+    sy: jnp.ndarray
+    pkx: jnp.ndarray   # (S, 2, 22) aggregate committee public key
+    pky: jnp.ndarray
+    vote_count: jnp.ndarray  # (S,) int32 — votes aggregated per shard
+    has_header: jnp.ndarray  # (S,) bool — shard has a submission this period
+
+
+class PeriodOutputs(NamedTuple):
+    verified: jnp.ndarray       # (S,) bool — aggregate signature valid
+    approved: jnp.ndarray       # (S,) bool — verified & quorum reached
+    total_votes: jnp.ndarray    # () int32 — Σ counted votes (all shards)
+    total_approved: jnp.ndarray  # () int32 — Σ approved shards
+
+
+def _step(inp: PeriodInputs, quorum: int, axis: Optional[str]):
+    ok = bn.bls_verify_aggregate_batch(
+        inp.hx, inp.hy, inp.sx, inp.sy, inp.pkx, inp.pky, inp.has_header)
+    counted = jnp.where(ok, inp.vote_count, 0)
+    approved = ok & (counted >= quorum)
+    total_votes = jnp.sum(counted)
+    total_approved = jnp.sum(approved.astype(jnp.int32))
+    if axis is not None:
+        total_votes = jax.lax.psum(total_votes, axis_name=axis)
+        total_approved = jax.lax.psum(total_approved, axis_name=axis)
+    return PeriodOutputs(ok, approved, total_votes, total_approved)
+
+
+class PeriodPipeline:
+    """Compiled per-period verifier, optionally sharded over a mesh.
+
+    The mesh path requires the shard count to divide evenly over the
+    ``"shard"`` mesh axis (pad with has_header=False rows otherwise).
+    """
+
+    def __init__(self, config: Config = DEFAULT_CONFIG,
+                 mesh: Optional[Mesh] = None):
+        self.config = config
+        self.mesh = mesh
+        quorum = config.quorum_size
+        if mesh is None:
+            self._fn = jax.jit(lambda inp: _step(inp, quorum, None))
+        else:
+            self._fn = jax.jit(shard_map(
+                lambda inp: _step(inp, quorum, "shard"),
+                mesh=mesh,
+                in_specs=(PeriodInputs(*([PS("shard")] * 8)),),
+                out_specs=PeriodOutputs(
+                    PS("shard"), PS("shard"), PS(), PS()),
+            ))
+
+    def run(self, inputs: PeriodInputs) -> PeriodOutputs:
+        if self.mesh is not None:
+            sharding = shard_axis_sharding(self.mesh)
+            inputs = PeriodInputs(
+                *(jax.device_put(a, sharding) for a in inputs))
+        return self._fn(inputs)
+
+    # -- host-side assembly -------------------------------------------------
+
+    def build_inputs(self, headers: Sequence[Optional[bytes]],
+                     agg_sigs: Sequence[Optional[bls.G1Point]],
+                     agg_pks: Sequence[Optional[bls.G2Point]],
+                     vote_counts: Sequence[int]) -> PeriodInputs:
+        """Host records -> device arrays. `headers[i] is None` marks a
+        shard with no submission this period (row masked out)."""
+        hashes = [bls.hash_to_g1(h) if h is not None else None
+                  for h in headers]
+        hx, hy, hok = bn.g1_to_limbs(hashes)
+        sx, sy, sok = bn.g1_to_limbs(list(agg_sigs))
+        pkx, pky, pok = bn.g2_to_limbs(list(agg_pks))
+        has_header = hok & sok & pok
+        return PeriodInputs(
+            hx=jnp.asarray(hx), hy=jnp.asarray(hy),
+            sx=jnp.asarray(sx), sy=jnp.asarray(sy),
+            pkx=jnp.asarray(pkx), pky=jnp.asarray(pky),
+            vote_count=jnp.asarray(np.asarray(vote_counts, np.int32)),
+            has_header=jnp.asarray(has_header),
+        )

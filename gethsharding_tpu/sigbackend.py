@@ -1,0 +1,156 @@
+"""Signature backends: the `--sigbackend={python,jax}` seam.
+
+The reference routes all signature work through native code chosen at
+build time (cgo libsecp256k1, bn256 assembly — SURVEY.md §2.3). Here the
+same seam is a runtime-selected backend object:
+
+- ``python``: the scalar host implementations (`crypto/secp256k1`,
+  `crypto/bn256`) — always available, no accelerator required. The
+  byte-exact baseline.
+- ``jax``: the batched TPU kernels (`ops/secp256k1_jax`,
+  `ops/bn256_jax`) — batch-first; one dispatch verifies a whole period's
+  worth of signatures. Imports JAX lazily so CPU-only control-plane
+  processes never initialize an accelerator backend.
+
+Both backends implement the same API and are differential-tested against
+each other (tests/test_sigbackend.py). Actors take a backend instance;
+the CLI exposes ``--sigbackend``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from gethsharding_tpu.crypto import bn256 as bls
+from gethsharding_tpu.crypto import secp256k1 as ecdsa
+from gethsharding_tpu.utils.hexbytes import Address20
+
+
+class SigBackend:
+    """Batch signature operations used by the consensus hot loops."""
+
+    name = "abstract"
+
+    def ecrecover_addresses(self, digests: Sequence[bytes],
+                            sigs65: Sequence[bytes]) -> List[Optional[Address20]]:
+        """Recover the signer address per (32-byte digest, 65-byte [R||S||V])
+        pair; None where the signature is invalid."""
+        raise NotImplementedError
+
+    def bls_verify_aggregates(
+            self,
+            messages: Sequence[bytes],
+            agg_sigs: Sequence[bls.G1Point],
+            agg_pks: Sequence[bls.G2Point]) -> List[bool]:
+        """Verify one aggregate committee vote per message."""
+        raise NotImplementedError
+
+
+class PythonSigBackend(SigBackend):
+    """Scalar host crypto — parity baseline."""
+
+    name = "python"
+
+    def ecrecover_addresses(self, digests, sigs65):
+        out: List[Optional[Address20]] = []
+        for digest, sig in zip(digests, sigs65):
+            try:
+                signature = ecdsa.Signature.from_bytes65(bytes(sig))
+                out.append(ecdsa.ecrecover_address(bytes(digest), signature))
+            except (ValueError, AssertionError):
+                out.append(None)
+        return out
+
+    def bls_verify_aggregates(self, messages, agg_sigs, agg_pks):
+        return [
+            bls.bls_verify(bytes(m), s, pk)
+            for m, s, pk in zip(messages, agg_sigs, agg_pks)
+        ]
+
+
+class JaxSigBackend(SigBackend):
+    """Batched accelerator kernels; one dispatch per batch."""
+
+    name = "jax"
+
+    def __init__(self):
+        import jax  # lazy: only sig-verifying processes touch the backend
+        import jax.numpy as jnp
+
+        from gethsharding_tpu.ops import bn256_jax, secp256k1_jax
+
+        self._jax = jax
+        self._jnp = jnp
+        self._bn = bn256_jax
+        self._sec = secp256k1_jax
+        self._recover = jax.jit(secp256k1_jax.ecrecover_batch)
+        self._bls = jax.jit(bn256_jax.bls_verify_aggregate_batch)
+
+    def ecrecover_addresses(self, digests, sigs65):
+        import numpy as np
+
+        jnp = self._jnp
+        n = len(digests)
+        if n == 0:
+            return []
+        sigs, valid, host_rows = [], [], []
+        for i, sig in enumerate(sigs65):
+            sig = bytes(sig)
+            if len(sig) == 65 and sig[64] in (0, 1):
+                sigs.append(ecdsa.Signature.from_bytes65(sig))
+                valid.append(True)
+            else:
+                if len(sig) == 65 and sig[64] in (2, 3):
+                    # rare r+n overflow recids: scalar host fallback keeps
+                    # exact RecoverPubkey parity
+                    host_rows.append(i)
+                sigs.append(ecdsa.Signature(r=1, s=1, v=0))  # placeholder
+                valid.append(False)
+        e = self._sec.hashes_to_limbs([bytes(d) for d in digests])
+        r, s, v = self._sec.sigs_to_limbs(sigs)
+        qx, qy, ok = self._recover(
+            jnp.asarray(e), jnp.asarray(r), jnp.asarray(s), jnp.asarray(v),
+            jnp.asarray(np.asarray(valid)))
+        pubs = self._sec.limbs_to_pubkeys(qx, qy, ok)
+        out = [ecdsa.pubkey_to_address(p) if p is not None else None
+               for p in pubs]
+        for i in host_rows:
+            try:
+                out[i] = ecdsa.ecrecover_address(
+                    bytes(digests[i]),
+                    ecdsa.Signature.from_bytes65(bytes(sigs65[i])))
+            except (ValueError, AssertionError):
+                out[i] = None
+        return out
+
+    def bls_verify_aggregates(self, messages, agg_sigs, agg_pks):
+        import numpy as np
+
+        jnp = self._jnp
+        if len(messages) == 0:
+            return []
+        hashes = [bls.hash_to_g1(bytes(m)) for m in messages]
+        hx, hy, hok = self._bn.g1_to_limbs(hashes)
+        sx, sy, sok = self._bn.g1_to_limbs(list(agg_sigs))
+        pkx, pky, pok = self._bn.g2_to_limbs(list(agg_pks))
+        # infinity signature/key is an outright rejection (scalar parity)
+        valid = hok & sok & pok
+        out = self._bls(
+            jnp.asarray(hx), jnp.asarray(hy), jnp.asarray(sx),
+            jnp.asarray(sy), jnp.asarray(pkx), jnp.asarray(pky),
+            jnp.asarray(valid))
+        return [bool(b) for b in np.asarray(out)]
+
+
+_BACKENDS = {"python": PythonSigBackend, "jax": JaxSigBackend}
+_cache: dict = {}
+
+
+def get_backend(name: str = "python") -> SigBackend:
+    """Backend registry: 'python' (scalar host) or 'jax' (batched TPU)."""
+    if name not in _BACKENDS:
+        raise ValueError(
+            f"unknown sigbackend {name!r}; choose from {sorted(_BACKENDS)}")
+    if name not in _cache:
+        _cache[name] = _BACKENDS[name]()
+    return _cache[name]

@@ -1,0 +1,67 @@
+"""Period pipeline: single-device vs 8-device-mesh parity, quorum rules,
+masked shards — the cross-shard "training step" (BASELINE.md config 3)."""
+
+import numpy as np
+import pytest
+
+from gethsharding_tpu.crypto import bn256 as bls
+from gethsharding_tpu.params import Config
+from gethsharding_tpu.parallel import make_mesh
+from gethsharding_tpu.parallel.period import PeriodInputs, PeriodPipeline
+
+CFG = Config(shard_count=8, committee_size=5, quorum_size=3)
+
+
+def _period_case():
+    """8 shards: 0-5 signed headers (varying vote counts), 6 tampered,
+    7 no submission."""
+    headers, sigs, pks, counts = [], [], [], []
+    keys = [bls.bls_keygen(bytes([i])) for i in range(3)]
+    agg_pk = bls.bls_aggregate_pks([pk for _, pk in keys])
+    for s in range(6):
+        header = b"hdr" + bytes([s])
+        agg = bls.bls_aggregate_sigs(
+            [bls.bls_sign(header, sk) for sk, _ in keys])
+        headers.append(header)
+        sigs.append(agg)
+        pks.append(agg_pk)
+        counts.append(5 if s % 2 == 0 else 2)  # alternate quorum/no-quorum
+    # shard 6: tampered aggregate signature
+    header6 = b"hdr6"
+    agg6 = bls.g1_add(
+        bls.bls_aggregate_sigs([bls.bls_sign(header6, sk) for sk, _ in keys]),
+        bls.G1_GEN)
+    headers.append(header6), sigs.append(agg6), pks.append(agg_pk)
+    counts.append(5)
+    # shard 7: no submission
+    headers.append(None), sigs.append(None), pks.append(None)
+    counts.append(0)
+    return headers, sigs, pks, counts
+
+
+EXPECT_VERIFIED = [True] * 6 + [False, False]
+EXPECT_APPROVED = [True, False, True, False, True, False, False, False]
+
+
+def test_single_device_period():
+    pipe = PeriodPipeline(config=CFG)
+    out = pipe.run(pipe.build_inputs(*_period_case()))
+    assert list(np.asarray(out.verified)) == EXPECT_VERIFIED
+    assert list(np.asarray(out.approved)) == EXPECT_APPROVED
+    assert int(out.total_votes) == 5 + 2 + 5 + 2 + 5 + 2
+    assert int(out.total_approved) == 3
+
+
+def test_mesh_matches_single_device():
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+    single = PeriodPipeline(config=CFG)
+    meshed = PeriodPipeline(config=CFG, mesh=make_mesh(8))
+    inputs = single.build_inputs(*_period_case())
+    a = single.run(inputs)
+    b = meshed.run(inputs)
+    np.testing.assert_array_equal(np.asarray(a.verified), np.asarray(b.verified))
+    np.testing.assert_array_equal(np.asarray(a.approved), np.asarray(b.approved))
+    assert int(a.total_votes) == int(b.total_votes)
+    assert int(a.total_approved) == int(b.total_approved)
