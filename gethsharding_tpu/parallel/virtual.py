@@ -1,0 +1,72 @@
+"""Virtual-device forcing: validate multi-chip layouts without real chips.
+
+The driver environment exposes exactly one real TPU chip; multi-chip
+shardings are validated on XLA's host-platform virtual CPU devices
+(``--xla_force_host_platform_device_count``), per the environment contract
+in SURVEY.md §7.5. This is the single shared implementation used by both
+``tests/conftest.py`` and ``__graft_entry__.dryrun_multichip`` so the two
+cannot drift.
+
+Forcing must happen before the first XLA client is created in the process:
+XLA parses the flag once, and the environment's TPU-tunnel PJRT plugin
+patches backend lookup to dial the tunnel even when ``JAX_PLATFORMS=cpu``
+is set — dropping every non-cpu backend factory is the load-bearing step.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from pathlib import Path
+from typing import Optional
+
+_COUNT_RE = re.compile(r"--xla_force_host_platform_device_count=(\d+)")
+
+
+def requested_virtual_cpu_count() -> int:
+    """Virtual CPU device count currently requested via XLA_FLAGS (0 if none)."""
+    m = _COUNT_RE.search(os.environ.get("XLA_FLAGS", ""))
+    return int(m.group(1)) if m else 0
+
+
+def force_virtual_cpu_devices(n: int,
+                              cache_dir: Optional[str] = None) -> None:
+    """Force >= ``n`` visible JAX devices via the virtual CPU host platform.
+
+    Idempotent; safe to call again in a process where it already ran (e.g.
+    under pytest where conftest ran it at collection time). Must run before
+    the first backend init to have any effect on the device count.
+
+    Also points JAX's persistent compilation cache at the repo-local
+    ``.jax_cache`` (the pairing kernels take minutes to compile cold on
+    XLA:CPU; cache hits make repeat runs take seconds).
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if requested_virtual_cpu_count() < n:
+        flags = _COUNT_RE.sub("", flags)
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    if cache_dir is None:
+        cache_dir = str(Path(__file__).resolve().parents[2] / ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:  # pragma: no cover - config name drift across jax
+        pass
+
+    try:
+        import jax._src.xla_bridge as xb
+
+        for name in list(getattr(xb, "_backend_factories", {})):
+            if name != "cpu":
+                xb._backend_factories.pop(name, None)
+    except Exception:  # pragma: no cover - jax-internal layout drift
+        pass

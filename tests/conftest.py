@@ -2,39 +2,16 @@
 
 Multi-chip sharding paths (`gethsharding_tpu.parallel`) are exercised on a
 virtual 8-device CPU mesh (XLA host-platform device count), mirroring how the
-driver dry-runs `__graft_entry__.dryrun_multichip`. Must run before any jax
-import, hence environment mutation at conftest import time.
+driver dry-runs `__graft_entry__.dryrun_multichip`. The forcing logic lives
+in `gethsharding_tpu.parallel.virtual` (shared with the dryrun entry) and
+must run before any backend init, hence at conftest import time.
 """
 
-import os
+import sys
+from pathlib import Path
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
-# If a TPU-tunnel PJRT plugin (e.g. the axon sitecustomize hook) registered
-# itself at interpreter start, drop it from the backend factories: tests are
-# CPU-only by design, and a flaky tunnel must not hang backend init.
-try:  # pragma: no cover - environment-dependent
-    import jax
-    import jax._src.xla_bridge as _xb
+from gethsharding_tpu.parallel.virtual import force_virtual_cpu_devices
 
-    # pytest plugins may import jax before this conftest runs, freezing
-    # jax_platforms from the pre-mutation environment — override it too.
-    jax.config.update("jax_platforms", "cpu")
-    for _name in list(getattr(_xb, "_backend_factories", {})):
-        if _name not in ("cpu",):
-            _xb._backend_factories.pop(_name, None)
-    # Persistent compilation cache: the pairing kernels take minutes to
-    # compile on XLA:CPU; cache hits make repeat test runs near-instant.
-    from pathlib import Path
-
-    jax.config.update("jax_compilation_cache_dir",
-                      str(Path(__file__).resolve().parents[1] / ".jax_cache"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-except Exception:
-    pass
+force_virtual_cpu_devices(8)
