@@ -329,21 +329,11 @@ def fp12_is_one(x):
 # (a·wᵏ)^(pⁿ) = conjⁿ(a) · γ_{n,k} · wᵏ with γ_{n,k} = ξ^(k(pⁿ-1)/6) ∈ Fp2.
 
 
-def _fp2_pow_host(base: ref.Fp2, e: int) -> ref.Fp2:
-    result, b = ref.Fp2.one(), base
-    while e:
-        if e & 1:
-            result = result * b
-        b = b * b
-        e >>= 1
-    return result
-
-
 def _gamma_table(n: int) -> np.ndarray:
     """(6, 2, 22) limb constants γ_{n,k} for k = 0..5."""
     rows = []
     for k in range(6):
-        g = _fp2_pow_host(ref.XI, k * (P**n - 1) // 6)
+        g = ref._fp2_pow(ref.XI, k * (P**n - 1) // 6)
         rows.append(_const_fp2(g.a, g.b))
     return np.stack(rows)
 
@@ -523,6 +513,7 @@ _HARD_PROGRAM = np.array([
 _N_REGS = 14
 
 _U_BITS = np.array([(U >> i) & 1 for i in range(U.bit_length())], np.int32)
+_U_NAF = np.asarray(ref._naf(U), np.int32)  # little-endian digits of u
 
 
 def _pow_u(x):
@@ -539,21 +530,19 @@ def _pow_u(x):
     return acc
 
 
-def final_exponentiation(f):
-    """f^((p¹²-1)/n): easy part then the DSD hard-part addition chain."""
-    # easy: f^(p⁶-1), then ^(p²+1)
-    f = fp12_mul(fp12_conj(f), fp12_inv(f))
-    f = fp12_mul(fp12_frobenius(f, 2), f)
-    # hard part: register machine (see _HARD_PROGRAM)
+def _run_hard_part(f, pow_u_fn, inv_fn):
+    """The DSD hard-part register machine (see _HARD_PROGRAM), shared by
+    the value path (inverse = cyclotomic conjugate) and the fraction path
+    (inverse = component swap)."""
     regs = jnp.broadcast_to(
         jnp.asarray(FP12_ONE), (_N_REGS,) + f.shape).astype(jnp.int32) + f * 0
     regs = FP.normalize(regs)
     regs = regs.at[0].set(f)
-    fu = _pow_u(f)
-    fu2 = _pow_u(fu)
+    fu = pow_u_fn(f)
+    fu2 = pow_u_fn(fu)
     regs = regs.at[1].set(fu)
     regs = regs.at[2].set(fu2)
-    regs = regs.at[3].set(_pow_u(fu2))
+    regs = regs.at[3].set(pow_u_fn(fu2))
 
     def step(regs, instr):
         op, a, b, d = instr[0], instr[1], instr[2], instr[3]
@@ -562,7 +551,7 @@ def final_exponentiation(f):
         out = lax.switch(op, [
             lambda ra, rb: fp12_mul(ra, rb),
             lambda ra, rb: fp12_sqr(ra),
-            lambda ra, rb: fp12_conj(ra),
+            lambda ra, rb: inv_fn(ra),
             lambda ra, rb: fp12_frobenius(ra, 1),
             lambda ra, rb: fp12_frobenius(ra, 2),
             lambda ra, rb: fp12_frobenius(ra, 3),
@@ -571,6 +560,61 @@ def final_exponentiation(f):
 
     regs, _ = lax.scan(step, regs, jnp.asarray(_HARD_PROGRAM))
     return regs[13]
+
+
+def final_exponentiation(f):
+    """f^((p¹²-1)/n): easy part then the DSD hard-part addition chain."""
+    # easy: f^(p⁶-1), then ^(p²+1)
+    f = fp12_mul(fp12_conj(f), fp12_inv(f))
+    f = fp12_mul(fp12_frobenius(f, 2), f)
+    return _run_hard_part(f, _pow_u, fp12_conj)
+
+
+# == Inversion-free pairing check ==========================================
+# The boolean check is_one(f^((p¹²-1)/n)) never needs a field inversion:
+# f^(p⁶-1) = conj(f)/f is carried as a STACKED FRACTION (leading axis 2 =
+# numerator/denominator). Every hard-part op is a group homomorphism
+# (mul/sqr/frobenius apply componentwise, batched over the fraction axis),
+# and the DSD chain's "conjugate = cyclotomic inverse" becomes a free
+# component swap — valid on fractions of arbitrary elements, since for the
+# represented (cyclotomic) quotient swap(N,D) represents exactly (N/D)⁻¹.
+# The final is_one collapses to canon(N) == canon(D). This removes the
+# ~254-squaring Fermat inversion from the hot path, the single deepest
+# sequential chain in the r1 kernel.
+
+
+def _pow_u_fraction(x):
+    """x^u on a fraction-stacked element (leading axis 2 = num/den).
+
+    NAF digits of u (static): digit 0 costs one squaring; ±1 digits one
+    extra mul, with -1 multiplying by the SWAPPED fraction (free inverse).
+    """
+    xswap = x[::-1]
+
+    def step(acc, d):
+        acc = fp12_sqr(acc)
+        acc = lax.switch(d + 1, [
+            lambda a: fp12_mul(a, xswap),
+            lambda a: a,
+            lambda a: fp12_mul(a, x),
+        ], acc)
+        return acc, None
+
+    digits = np.asarray(list(reversed(_U_NAF[:-1])), np.int32)
+    acc, _ = lax.scan(step, x, jnp.asarray(digits))  # top digit: acc = x
+    return acc
+
+
+def fp12_eq(x, y):
+    return jnp.all(FP.canon(x) == FP.canon(y), axis=(-1, -2, -3))
+
+
+def pairing_is_one(f):
+    """is_one(final_exponentiation(f)) without any field inversion."""
+    nd = jnp.stack([fp12_conj(f), FP.normalize(f)])  # conj(f)/f = f^(p⁶-1)
+    nd = fp12_mul(fp12_frobenius(nd, 2), nd)         # ^(p²+1)
+    nd = _run_hard_part(nd, _pow_u_fraction, lambda ra: ra[::-1])
+    return fp12_eq(nd[0], nd[1])
 
 
 # == Pairing check / BLS batch verification ================================
@@ -593,8 +637,161 @@ def pairing_product(px, py, qx, qy, mask):
 
 
 def pairing_check(px, py, qx, qy, mask):
-    """Batched PairingCheck: ∏ e(Pᵢ, Qᵢ) == 1 per leading-batch element."""
-    return fp12_is_one(final_exponentiation(pairing_product(px, py, qx, qy, mask)))
+    """Batched PairingCheck: ∏ e(Pᵢ, Qᵢ) == 1 per leading-batch element.
+
+    Boolean parity with `bn256.PairingCheck` (cloudflare/bn256.go:313);
+    fraction axis is prepended INSIDE pairing_is_one, so any leading batch
+    shape composes.
+    """
+    return pairing_is_one(pairing_product(px, py, qx, qy, mask))
+
+
+# == Optimal-ate Miller loop with a shared accumulator =====================
+# The BLS hot loop checks e(sig, G2_GEN)·e(-H, pk) == 1. Three structural
+# wins over running `miller_loop` per pair (scalar twin:
+# `crypto/bn256.py miller_loop_optimal`; reference analog: the optimal-ate
+# loop of `crypto/bn256/cloudflare/optate.go`):
+# - loop count 6u+2 (66-digit NAF, weight 22) instead of 6u² (127 bits):
+#   88 program steps vs 127, plus two Frobenius adjustment lines;
+# - ONE shared f accumulator: per doubling step a single fp12_sqr serves
+#   both pairs (the product ∏fᵢ is accumulated in-loop);
+# - the generator pairing's line COEFFICIENTS are precomputed on the host
+#   as numpy constants (the G2 walk doesn't depend on runtime data), so
+#   pair 0 contributes two fp2-by-scalar products per step instead of a
+#   full Jacobian double/add chain.
+
+def _host_jac_dbl(X, Y, Z):
+    """Host twin of _dbl_step on ref.Fp2 (same formulas, same scales)."""
+    A = X * X
+    B = Y * Y
+    C = B * B
+    t = (X + B) * (X + B)
+    D = (t - A - C).scalar(2)
+    E = A.scalar(3)
+    F = E * E
+    X3 = F - D.scalar(2)
+    Y3 = E * (D - X3) - C.scalar(8)
+    ZZ = Z * Z
+    Z3 = (Y * Z).scalar(2)
+    line = (Z3 * ZZ, (E * ZZ).neg(), E * X - B.scalar(2))
+    return line, X3, Y3, Z3
+
+
+def _host_jac_madd(X1, Y1, Z1, x2, y2):
+    """Host twin of _madd_step on ref.Fp2."""
+    Z1Z1 = Z1 * Z1
+    U2 = x2 * Z1Z1
+    S2 = y2 * Z1 * Z1Z1
+    H = U2 - X1
+    R = S2 - Y1
+    HH = H * H
+    V = X1 * HH
+    HHH = H * HH
+    X3 = R * R - HHH - V.scalar(2)
+    Y3 = R * (V - X3) - Y1 * HHH
+    Z3 = Z1 * H
+    line = (Z3, R.neg(), R * x2 - Z3 * y2)
+    return line, X3, Y3, Z3
+
+
+def _build_opt_program():
+    """(ops, gen_lines): the static optimal-ate schedule and the
+    precomputed G2-generator line coefficients along it.
+
+    ops (L,) int32: 0 = DBL, 1 = ADD(+Q), 2 = ADD(-Q), 3 = ADD(πQ),
+    4 = ADD(-π²Q). gen_lines (L, 3, 2, 22): (c_py, c_px, c_const) per step.
+    """
+    ops = []
+    for d in reversed(ref.OPT_ATE_NAF[:-1]):
+        ops.append(0)
+        if d == 1:
+            ops.append(1)
+        elif d == -1:
+            ops.append(2)
+    ops += [3, 4]
+
+    q = ref.G2_GEN
+    cands = [q, ref.g2_neg(q), ref.g2_frobenius(q),
+             ref.g2_neg(ref.g2_frobenius2(q))]
+    (X, Y), Z = q, ref.Fp2.one()
+    lines = []
+    for op in ops:
+        if op == 0:
+            line, X, Y, Z = _host_jac_dbl(X, Y, Z)
+        else:
+            x2, y2 = cands[op - 1]
+            line, X, Y, Z = _host_jac_madd(X, Y, Z, x2, y2)
+        lines.append(np.stack([_const_fp2(c.a, c.b) for c in line]))
+    return np.asarray(ops, np.int32), np.stack(lines)
+
+
+_OPT_OPS, _GEN_LINES = _build_opt_program()
+_TWF_X = _const_fp2(ref.TWIST_FROB_X.a, ref.TWIST_FROB_X.b)
+_TWF_Y = _const_fp2(ref.TWIST_FROB_Y.a, ref.TWIST_FROB_Y.b)
+_TWF2_X = _const_fp2(ref.TWIST_FROB2_X.a, ref.TWIST_FROB2_X.b)
+_TWF2_Y = _const_fp2(ref.TWIST_FROB2_Y.a, ref.TWIST_FROB2_Y.b)
+
+
+def _bls_miller_opt(sx, sy, hx, hy, pkx, pky):
+    """Shared-accumulator optimal-ate Miller product for the BLS check.
+
+    Pair 0: (sig, G2_GEN) via precomputed static lines evaluated at sig.
+    Pair 1: (-H, pk) via a dynamic Jacobian walk on the twist.
+    Returns f = miller(sig, G2)·miller(-H, pk) before final exponentiation.
+    """
+    shape = sx.shape[:-1]
+    hy_neg = FP.neg(hy)
+
+    # dynamic add candidates: [+Q, -Q, πQ, -π²Q] for Q = pk
+    q1x = fp2_mul(fp2_conj(pkx), jnp.asarray(_TWF_X))
+    q1y = fp2_mul(fp2_conj(pky), jnp.asarray(_TWF_Y))
+    q2x = fp2_mul(pkx, jnp.asarray(_TWF2_X))
+    q2ny = FP.neg(fp2_mul(pky, jnp.asarray(_TWF2_Y)))
+    cand_x = jnp.stack([pkx, pkx, q1x, q2x])       # (4, ..., 2, 22)
+    cand_y = jnp.stack([pky, FP.neg(pky), q1y, q2ny])
+
+    vzero = (sx[..., :1] * 0)[..., None]           # (..., 1, 1)
+    f = FP.normalize(jnp.broadcast_to(jnp.asarray(FP12_ONE),
+                                      shape + (6, 2, NLIMBS)) + vzero[..., None])
+    X = FP.normalize(jnp.broadcast_to(pkx, shape + (2, NLIMBS)))
+    Y = FP.normalize(jnp.broadcast_to(pky, shape + (2, NLIMBS)))
+    Z = FP.normalize(jnp.broadcast_to(jnp.asarray(FP2_ONE),
+                                      shape + (2, NLIMBS)) + vzero)
+
+    def gen_line(line_c):
+        """Static generator line evaluated at P0 = (sx, sy)."""
+        A = fp2_mul_fp(line_c[0], sy)
+        B = fp2_mul_fp(line_c[1], sx)
+        C = jnp.broadcast_to(FP.normalize(line_c[2]), shape + (2, NLIMBS))
+        return A, B, C
+
+    def dbl_branch(f, X, Y, Z, line_c, op):
+        line1, X, Y, Z = _dbl_step(X, Y, Z, hx, hy_neg)
+        f = fp12_sqr(f)
+        f = fp12_mul_line(f, gen_line(line_c))
+        f = fp12_mul_line(f, line1)
+        return f, X, Y, Z
+
+    def add_branch(f, X, Y, Z, line_c, op):
+        idx = op - 1
+        x2 = lax.dynamic_index_in_dim(cand_x, idx, axis=0, keepdims=False)
+        y2 = lax.dynamic_index_in_dim(cand_y, idx, axis=0, keepdims=False)
+        line1, X, Y, Z = _madd_step(X, Y, Z, x2, y2, hx, hy_neg)
+        f = fp12_mul_line(f, gen_line(line_c))
+        f = fp12_mul_line(f, line1)
+        return f, X, Y, Z
+
+    def step(carry, xs):
+        op, line_c = xs
+        f, X, Y, Z = carry
+        f, X, Y, Z = lax.cond(op == 0, dbl_branch, add_branch,
+                              f, X, Y, Z, line_c, op)
+        return (f, X, Y, Z), None
+
+    (f, X, Y, Z), _ = lax.scan(
+        step, (f, X, Y, Z),
+        (jnp.asarray(_OPT_OPS), jnp.asarray(_GEN_LINES)))
+    return f
 
 
 # generator / BLS fixed points as limb constants
@@ -606,22 +803,16 @@ def bls_verify_aggregate_batch(hx, hy, sx, sy, pkx, pky, valid):
     """Batched BLS aggregate-vote verification (BASELINE.md config 2/3).
 
     For each batch element b: e(sig_b, G2_GEN) == e(H_b, aggpk_b), checked
-    as e(sig, G2)·e(-H, pk) == 1.
+    as e(sig, G2)·e(-H, pk) == 1 via the shared-accumulator optimal-ate
+    Miller loop and the inversion-free final check.
     hx/hy, sx/sy: (..., 22) G1 limbs (message hash, aggregate signature);
     pkx/pky: (..., 2, 22) G2 limbs (aggregate public key);
     valid: (...,) bool — invalid rows (infinity/malformed, rejected
     host-side) return False.
     Returns (...,) bool.
     """
-    shape = sx.shape[:-1]
-    px = jnp.stack([sx, hx], axis=-2)                      # (..., 2, 22)
-    py = jnp.stack([sy, FP.neg(hy)], axis=-2)              # -H via y negation
-    qx = jnp.stack([jnp.broadcast_to(jnp.asarray(G2_GEN_X), shape + (2, NLIMBS)),
-                    pkx], axis=-3)
-    qy = jnp.stack([jnp.broadcast_to(jnp.asarray(G2_GEN_Y), shape + (2, NLIMBS)),
-                    pky], axis=-3)
-    mask = jnp.broadcast_to(jnp.asarray(True), shape + (2,))
-    return pairing_check(px, py, qx, qy, mask) & valid
+    f = _bls_miller_opt(sx, sy, hx, hy, pkx, pky)
+    return pairing_is_one(f) & valid
 
 
 # == host-side converters ==================================================

@@ -29,6 +29,30 @@ def requested_virtual_cpu_count() -> int:
     return int(m.group(1)) if m else 0
 
 
+def build_virtual_env(n: int, base_env=None) -> dict:
+    """A copy of ``base_env`` (default: os.environ) with the virtual CPU
+    platform forced for a CHILD process: JAX_PLATFORMS=cpu and the
+    host-platform device-count flag rewritten to ``n``."""
+    env = dict(os.environ if base_env is None else base_env)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = _COUNT_RE.sub("", env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n}"
+    ).strip()
+    return env
+
+
+def backend_initialized() -> bool:
+    """True if any XLA backend client already exists in this process (at
+    which point the device-count flag can no longer take effect)."""
+    try:
+        import jax._src.xla_bridge as xb
+
+        return bool(getattr(xb, "_backends", {}))
+    except Exception:  # pragma: no cover - jax-internal layout drift
+        return False
+
+
 def force_virtual_cpu_devices(n: int,
                               cache_dir: Optional[str] = None) -> None:
     """Force >= ``n`` visible JAX devices via the virtual CPU host platform.

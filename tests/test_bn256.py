@@ -182,3 +182,26 @@ def test_bls_proof_of_possession():
     sk2, pk2 = bls_keygen(b"attacker")
     rogue = g2_add(pk2, g2_neg(pk))
     assert not bls_verify_possession(rogue, bls_prove_possession(sk2, rogue))
+
+
+def test_optimal_ate_check_parity():
+    """pairing_check_optimal (6u+2 loop + frobenius lines, the batched
+    kernel's scalar twin) agrees with the plain-ate pairing_check."""
+    from gethsharding_tpu.crypto.bn256 import (
+        G1_GEN,
+        G2_GEN,
+        g1_mul,
+        g1_neg,
+        g2_mul,
+        pairing_check,
+        pairing_check_optimal,
+    )
+
+    a = 987654321
+    accept = [(g1_mul(a, G1_GEN), G2_GEN), (g1_neg(G1_GEN), g2_mul(a, G2_GEN))]
+    reject = [(g1_mul(a + 1, G1_GEN), G2_GEN),
+              (g1_neg(G1_GEN), g2_mul(a, G2_GEN))]
+    assert pairing_check_optimal(accept) is pairing_check(accept) is True
+    assert pairing_check_optimal(reject) is pairing_check(reject) is False
+    # infinity pairs contribute identity in both variants
+    assert pairing_check_optimal([(None, G2_GEN), (G1_GEN, None)]) is True
