@@ -5,9 +5,17 @@ Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
 The workload is BASELINE.md config 3: one period of the 100-shard
 sharding protocol — for every shard, verify the aggregate BLS committee
 vote (135 signatures aggregated into one G1 point) on its collation
-header via the batched bn256 pairing kernel (ops/bn256_jax):
-100 aggregate checks = 200 Miller loops + 100 final exponentiations,
-all as one jitted batch on the accelerator.
+header via the batched optimal-ate pairing kernel (ops/bn256_jax):
+one shared-accumulator Miller product + inversion-free final check per
+shard, all as one jitted batch on the accelerator.
+
+The kernel has two build-time knobs whose best setting depends on whether
+the backend is latency- or throughput-bound (env vars read at import:
+GETHSHARDING_TPU_LIMB_FORM = wide|exact, GETHSHARDING_TPU_CARRY =
+scan|assoc). The benchmark AUTOTUNES: it re-executes itself in a
+subprocess per configuration, measures each, and reports the fastest.
+Results are cached in .bench_autotune.json keyed by backend so repeat
+runs skip the sweep.
 
 Metric: aggregate notary-signature verifications/sec = shards × committee
 / wall time. North star (BASELINE.md): ≥100k/sec on TPU v4-8 —
@@ -18,14 +26,29 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
+SHARDS, COMMITTEE = 100, 135
 
-def main() -> None:
+# ordered by prior: exact/scan won the CPU sweep (throughput-bound), the
+# wide/assoc pair minimizes sequential depth (latency-bound TPU); if the
+# sweep budget runs out, the best of the configs measured so far wins
+CONFIGS = [
+    {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "scan"},
+    {"GETHSHARDING_TPU_LIMB_FORM": "wide", "GETHSHARDING_TPU_CARRY": "assoc"},
+    {"GETHSHARDING_TPU_LIMB_FORM": "wide", "GETHSHARDING_TPU_CARRY": "scan"},
+    {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "assoc"},
+]
+
+SWEEP_BUDGET_S = float(os.environ.get("GETHSHARDING_BENCH_BUDGET_S", "1200"))
+
+
+def _enable_compile_cache() -> None:
     import jax
-    import jax.numpy as jnp
 
     try:  # persistent compile cache: first run pays ~1 min, repeats don't
         jax.config.update(
@@ -37,10 +60,24 @@ def main() -> None:
     except Exception:
         pass
 
+
+def measure_single() -> dict:
+    """Measure the workload under the CURRENT env config; return stats."""
+    if os.environ.get("GETHSHARDING_BENCH_CPU") == "1":
+        # hermetic/offline runs: force the CPU backend before any init
+        # (the TPU-tunnel plugin otherwise dials hardware that may be
+        # absent); the driver's real-hardware runs never set this.
+        from gethsharding_tpu.parallel.virtual import force_virtual_cpu_devices
+
+        force_virtual_cpu_devices(1)
+
+    import jax
+    import jax.numpy as jnp
+
+    _enable_compile_cache()
+
     from gethsharding_tpu.crypto import bn256 as ref
     from gethsharding_tpu.ops import bn256_jax as k
-
-    shards, committee = 100, 135
 
     # one real signed header, replicated across shards (throughput is
     # data-independent; correctness is pinned by tests/test_bn256_jax.py)
@@ -51,11 +88,11 @@ def main() -> None:
     agg_pk = ref.bls_aggregate_pks([pk for _, pk in keys])
     h = ref.hash_to_g1(header)
 
-    hx, hy, _ = k.g1_to_limbs([h] * shards)
-    sx, sy, _ = k.g1_to_limbs([agg_sig] * shards)
-    pkx, pky, _ = k.g2_to_limbs([agg_pk] * shards)
+    hx, hy, _ = k.g1_to_limbs([h] * SHARDS)
+    sx, sy, _ = k.g1_to_limbs([agg_sig] * SHARDS)
+    pkx, pky, _ = k.g2_to_limbs([agg_pk] * SHARDS)
     args = [jnp.asarray(a) for a in (hx, hy, sx, sy, pkx, pky)]
-    args.append(jnp.ones(shards, bool))
+    args.append(jnp.ones(SHARDS, bool))
 
     fn = jax.jit(k.bls_verify_aggregate_batch)
     out = fn(*args)
@@ -68,11 +105,98 @@ def main() -> None:
     out.block_until_ready()
     elapsed = (time.perf_counter() - t0) / iters
 
-    sig_rate = shards * committee / elapsed
+    return {
+        "platform": jax.devices()[0].platform,
+        "elapsed": elapsed,
+        "sig_rate": SHARDS * COMMITTEE / elapsed,
+    }
+
+
+def _run_config(cfg: dict) -> dict | None:
+    """Measure one config in a subprocess; None on failure/timeout."""
+    env = dict(os.environ)
+    env.update(cfg)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--single"],
+            env=env, capture_output=True, text=True, timeout=560,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                stats = json.loads(line)
+                if "sig_rate" in stats:
+                    return stats
+            except json.JSONDecodeError:
+                continue
+    except (subprocess.TimeoutExpired, OSError):
+        pass
+    return None
+
+
+def _cache_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        ".bench_autotune.json")
+
+
+def main() -> None:
+    if "--single" in sys.argv:
+        print(json.dumps(measure_single()))
+        return
+
+    best_cfg, best = None, None
+    cache_key = None
+    try:
+        cached = json.load(open(_cache_path()))
+        cache_key = cached.get("platform")
+        if all(k in cached for k in ("config", "platform")):
+            best_cfg = cached["config"]
+    except Exception:
+        pass
+
+    if best_cfg is not None:
+        # verify the cached winner still runs, then use it directly
+        stats = _run_config(best_cfg)
+        if stats is not None and stats.get("platform") == cache_key:
+            best = stats
+        else:
+            best_cfg = None
+
+    if best_cfg is None:
+        results = []
+        sweep_start = time.monotonic()
+        for i, cfg in enumerate(CONFIGS):
+            if results and time.monotonic() - sweep_start > SWEEP_BUDGET_S:
+                print(f"# sweep budget exhausted after {i} configs",
+                      file=sys.stderr)
+                break
+            stats = _run_config(cfg)
+            if stats is not None:
+                results.append((cfg, stats))
+                print(f"# config {cfg} -> "
+                      f"{stats['sig_rate']:.1f} sigs/sec "
+                      f"[{stats['platform']}]", file=sys.stderr)
+        if not results:
+            # subprocess sweep impossible (e.g. no fork) — measure inline
+            best_cfg, best = {}, measure_single()
+        else:
+            best_cfg, best = max(results, key=lambda r: r[1]["sig_rate"])
+            try:
+                json.dump({"config": best_cfg,
+                           "platform": best["platform"]},
+                          open(_cache_path(), "w"))
+            except OSError:
+                pass
+
+    sig_rate = best["sig_rate"]
+    form = best_cfg.get("GETHSHARDING_TPU_LIMB_FORM", "wide")
+    carry = best_cfg.get("GETHSHARDING_TPU_CARRY", "scan")
     print(json.dumps({
         "metric": "notary_sig_verifications_per_sec",
         "value": round(sig_rate, 1),
-        "unit": "sigs/sec (100 shards x 135-vote BLS aggregate, bn256 pairing)",
+        "unit": (f"sigs/sec (100 shards x 135-vote BLS aggregate, "
+                 f"opt-ate bn256, {form}/{carry}, "
+                 f"{best['platform']})"),
         "vs_baseline": round(sig_rate / 100_000.0, 4),
     }))
 

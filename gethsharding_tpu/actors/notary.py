@@ -18,17 +18,19 @@ for all shards in a period are verified as one batch (see
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import List, Optional, Tuple
 
+from gethsharding_tpu import metrics
 from gethsharding_tpu.actors.base import Service
 from gethsharding_tpu.core.shard import Shard, ShardError
 from gethsharding_tpu.core.types import CollationHeader
+from gethsharding_tpu.crypto import bn256 as bls
 from gethsharding_tpu.mainchain.client import SMCClient
 from gethsharding_tpu.p2p.messages import CollationBodyRequest
 from gethsharding_tpu.p2p.service import P2PServer
 from gethsharding_tpu.params import Config, DEFAULT_CONFIG
 from gethsharding_tpu.sigbackend import SigBackend, get_backend
-from gethsharding_tpu.smc.state_machine import SMCRevert
+from gethsharding_tpu.smc.state_machine import SMCRevert, vote_digest
 
 
 class Notary(Service):
@@ -52,7 +54,19 @@ class Notary(Service):
         self.votes_submitted = 0
         self.canonical_set = 0
         self.signatures_rejected = 0
+        self.audits_run = 0
+        self.audit_mismatches = 0
+        self.aggregate_sigs_verified = 0
+        self._last_audited_period = 0
         self._unsubscribe = None
+        # the two BASELINE metrics (SURVEY.md §7.8): aggregate notary
+        # signature verifications/sec and collation validate latency
+        self.m_sigs_verified = metrics.counter(
+            "notary/aggregate_sig_verifications")
+        self.m_validate_latency = metrics.timer("notary/validate_latency")
+        self.m_audit_latency = metrics.timer("notary/period_audit_latency")
+        self.m_votes = metrics.counter("notary/votes_submitted")
+        self.m_audit_mismatch = metrics.counter("notary/audit_mismatches")
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -110,6 +124,11 @@ class Notary(Service):
         if not self.is_account_in_notary_pool():
             return
         period = self.client.current_period()
+        # audit the previous period's aggregate votes once, in one batched
+        # device dispatch (the re-architected hot loop; see audit_period)
+        if period > 0 and self._last_audited_period < period:
+            self.audit_period(period - 1)
+            self._last_audited_period = period
         # a vote submitted now executes in the PENDING block; if that block
         # already belongs to the next period the SMC will revert with
         # "period is not current" — skip and wait for the new period's head
@@ -118,23 +137,47 @@ class Notary(Service):
             return
         shard_ids = (range(self.client.shard_count())
                      if self.all_shards else [self.shard.shard_id])
-        for shard_id in shard_ids:
-            self.check_shard(shard_id, period)
 
-    def check_shard(self, shard_id: int, period: int) -> None:
-        # committee sampling: eligible iff sample(our poolIndex) == us
-        sampled = self.client.get_notary_in_committee(shard_id)
+        # phase 1: collect every eligible (shard, record) pair this period
         me = self.client.account()
-        if sampled != me:
+        candidates: List[Tuple[int, int, object]] = []
+        for shard_id in shard_ids:
+            if self.client.get_notary_in_committee(shard_id) != me:
+                continue
+            record = self.client.collation_record(shard_id, period)
+            if (record is None
+                    or self.client.last_submitted_collation(shard_id) != period):
+                continue
+            candidates.append((shard_id, period, record))
+        if not candidates:
             return
-        record = self.client.collation_record(shard_id, period)
-        if record is None or self.client.last_submitted_collation(shard_id) != period:
-            return
-        self.submit_vote(shard_id, period, record)
+
+        # phase 2: ONE batched proposer-signature verification across all
+        # candidate shards (with sigbackend 'jax' this is a single vmapped
+        # recovery-ladder dispatch, replacing the per-shard batch-of-1)
+        signed = [c for c in candidates if c[2].signature]
+        sig_ok = {}
+        if signed:
+            for (shard_id, _, _), good in zip(
+                    signed, self.verify_proposer_signatures(signed)):
+                sig_ok[shard_id] = good
+
+        # phase 3: availability checks + signed vote submission per shard
+        for shard_id, p, record in candidates:
+            if record.signature and not sig_ok.get(shard_id, False):
+                self.signatures_rejected += 1
+                self.record_error(
+                    f"proposer signature invalid: shard {shard_id} "
+                    f"period {p}")
+                continue
+            with self.m_validate_latency.time():
+                self.submit_vote(shard_id, p, record,
+                                 proposer_sig_checked=True)
 
     # -- voting (notary.go:413 submitVote) ---------------------------------
 
-    def submit_vote(self, shard_id: int, period: int, record) -> bool:
+    def submit_vote(self, shard_id: int, period: int, record,
+                    proposer_sig_checked: bool = False) -> bool:
         registry = self.client.notary_registry()
         if registry is None or not registry.deposited:
             self.record_error("cannot vote: not a deposited notary")
@@ -149,11 +192,13 @@ class Notary(Service):
             return False
 
         # proposer-signature check through the sig backend (the reference's
-        # native-crypto seam; batch-verified on TPU with sigbackend 'jax').
-        # An unsigned record (empty sig) is accepted for parity with the
-        # reference flow, where header signatures are not yet enforced
-        # on-chain — but a PRESENT signature must recover to the proposer.
-        if record.signature:
+        # native-crypto seam). The period flow pre-verifies ALL candidate
+        # records in one batch (notarize_collations phase 2); this single
+        # check covers direct callers. An unsigned record (empty sig) is
+        # accepted for parity with the reference flow, where header
+        # signatures are not yet enforced on-chain — but a PRESENT
+        # signature must recover to the proposer.
+        if record.signature and not proposer_sig_checked:
             if not self.verify_proposer_signatures(
                     [(shard_id, period, record)])[0]:
                 self.signatures_rejected += 1
@@ -171,18 +216,118 @@ class Notary(Service):
             )
             return False
 
+        # the vote carries our aggregatable BLS signature over
+        # (shard, period, chunkRoot) — the artifact the period audit
+        # batch-verifies (smc/state_machine.py vote_digest)
+        digest = vote_digest(shard_id, period, record.chunk_root)
         try:
             self.client.submit_vote(shard_id, period, registry.pool_index,
-                                    record.chunk_root)
+                                    record.chunk_root,
+                                    bls_sig=self.client.bls_sign(digest))
         except SMCRevert as exc:
             self.record_error(f"vote reverted: {exc}")
             return False
         self.votes_submitted += 1
+        self.m_votes.inc()
 
         # on quorum, persist the canonical header (notary.go:165)
         if self.client.last_approved_collation(shard_id) == period:
             self._set_canonical(shard_id, period, record)
         return True
+
+    # -- the batched period audit (the re-architected hot loop) ------------
+
+    def audit_period(self, period: int) -> Optional[bool]:
+        """Verify a whole period's committee votes in ONE device dispatch.
+
+        For every shard with a collation record in `period`, aggregate the
+        accepted votes' BLS signatures and the voters' registered pubkeys,
+        then verify all shards' aggregates in a single sig-backend call
+        (with sigbackend 'jax': one batched optimal-ate pairing dispatch —
+        BASELINE.md config 3, the loop `sharding/notary/notary.go:62`
+        re-architected). The quorum outcome recomputed from the verified
+        votes must be byte-identical with the SMC's `is_elected` flags;
+        a mismatch (forged/invalid stored signature, tally drift) is
+        counted and reported. Additionally replays the period's accepted
+        vote transactions through the fixed-shape batch kernel
+        (`ops/smc_jax.submit_votes_batch`) via the chain's vote log and
+        checks state parity with the scalar machine.
+
+        Returns True (all consistent), False (mismatch), or None (nothing
+        auditable this period).
+        """
+        shards, msgs, sigs, pks = [], [], [], []
+        signed_counts, total_counts, expected = [], [], []
+        for shard_id in range(self.client.shard_count()):
+            record = self.client.collation_record(shard_id, period)
+            if record is None or not record.vote_sigs:
+                continue
+            # resolve voter pubkeys by the attribution recorded AT VOTE
+            # TIME (pool slots can be freed/reused before the audit runs;
+            # registry entries persist until release)
+            member_pks = []
+            for vote in record.vote_sigs.values():
+                entry = self.client.notary_registry_of(vote.signer)
+                if entry is None or entry.bls_pubkey is None:
+                    member_pks = None  # released voter: not resolvable
+                    break
+                member_pks.append(entry.bls_pubkey)
+            if member_pks is None:
+                continue
+            shards.append(shard_id)
+            msgs.append(vote_digest(shard_id, period, record.chunk_root))
+            sigs.append(bls.bls_aggregate_sigs(
+                [v.sig for v in record.vote_sigs.values()]))
+            pks.append(bls.bls_aggregate_pks(member_pks))
+            signed_counts.append(len(record.vote_sigs))
+            total_counts.append(record.vote_count)
+            expected.append(bool(record.is_elected))
+        if not shards:
+            return None
+
+        with self.m_audit_latency.time():
+            ok = self.sig_backend.bls_verify_aggregates(msgs, sigs, pks)
+        self.audits_run += 1
+        verified = sum(n for n, good in zip(signed_counts, ok) if good)
+        self.aggregate_sigs_verified += verified
+        self.m_sigs_verified.inc(verified)
+
+        consistent = True
+        quorum = self.config.quorum_size
+        for shard_id, good, n_signed, n_total, elected in zip(
+                shards, ok, signed_counts, total_counts, expected):
+            # two independent checks: (1) the signed aggregate must verify
+            # (a failure means a stored signature is forged/corrupt);
+            # (2) the SMC's election flag must match the quorum rule over
+            # the persistent accepted-vote count. n_signed can lag n_total
+            # when key-less (legacy-registered) notaries voted — their
+            # votes count for quorum but cannot be signature-audited.
+            mismatch = None
+            if not good:
+                mismatch = (f"invalid aggregate signature "
+                            f"({n_signed}/{n_total} votes signed)")
+            elif (n_total >= quorum) != elected:
+                mismatch = (f"tally drift: votes={n_total} quorum={quorum} "
+                            f"smc_elected={elected}")
+            if mismatch is not None:
+                consistent = False
+                self.audit_mismatches += 1
+                self.m_audit_mismatch.inc()
+                self.record_error(
+                    f"period {period} audit mismatch on shard {shard_id}: "
+                    f"{mismatch}")
+
+        # the replay check runs the jax batch kernel; skip it for pure-host
+        # control planes (sigbackend 'python') to keep them accelerator-free
+        replay = (self.client.verify_period_batch(period)
+                  if self.sig_backend.name == "jax" else None)
+        if replay is False:
+            consistent = False
+            self.audit_mismatches += 1
+            self.record_error(
+                f"period {period} batch-replay mismatch: "
+                f"submit_votes_batch disagrees with the scalar SMC")
+        return consistent
 
     def verify_proposer_signatures(self, records) -> list:
         """Batch-verify proposer signatures over collation-header records.

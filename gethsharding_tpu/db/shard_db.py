@@ -33,11 +33,16 @@ class ShardDB:
     def stop(self) -> None:
         if self._db is not None:
             self._db.close()
+            if not self.in_memory:
+                self._db = None  # restart reopens the file
 
     # -- accessors ---------------------------------------------------------
 
     @property
     def db(self) -> KVStore:
         if self._db is None:
-            raise RuntimeError("ShardDB not started")
+            # open-on-first-access: construction-time wiring (ShardNode
+            # hands the store to Shard before services start) must not
+            # depend on lifecycle order
+            self.start()
         return self._db

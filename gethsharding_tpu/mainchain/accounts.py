@@ -9,10 +9,10 @@ encrypted on-disk keystore (scrypt + AES-CTR JSON files) layers on top in
 
 from __future__ import annotations
 
-from dataclasses import dataclass
-from typing import Dict, Optional
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
 
-from gethsharding_tpu.crypto import secp256k1
+from gethsharding_tpu.crypto import bn256, secp256k1
 from gethsharding_tpu.crypto.keccak import keccak256
 from gethsharding_tpu.utils.hexbytes import Address20
 
@@ -22,6 +22,20 @@ class Account:
     address: Address20
     priv: int
     unlocked: bool = False
+    # BLS vote keypair, derived deterministically from the secp256k1 key
+    # (one identity, two signature schemes: ECDSA for transactions, BLS for
+    # aggregatable committee votes — BASELINE.md configs 2-3)
+    _bls: Optional[Tuple[int, bn256.G2Point]] = field(
+        default=None, repr=False, compare=False)
+
+    def bls_keypair(self) -> Tuple[int, bn256.G2Point]:
+        if self._bls is None:
+            self._bls = bn256.bls_keygen(self.priv.to_bytes(32, "big"))
+        return self._bls
+
+    @property
+    def bls_pubkey(self) -> bn256.G2Point:
+        return self.bls_keypair()[1]
 
 
 class AccountManager:
@@ -61,9 +75,26 @@ class AccountManager:
         return self._accounts.get(address)
 
     def sign_hash(self, address: Address20, digest: bytes) -> bytes:
+        account = self._require_unlocked(address)
+        return secp256k1.sign(digest, account.priv).to_bytes65()
+
+    def bls_sign(self, address: Address20, message: bytes) -> bn256.G1Point:
+        """BLS-sign a vote message with the account's derived vote key."""
+        account = self._require_unlocked(address)
+        sk, _ = account.bls_keypair()
+        return bn256.bls_sign(message, sk)
+
+    def bls_proof_of_possession(self, address: Address20) -> bn256.G1Point:
+        """PoP binding the vote pubkey to its secret key (rogue-key defense;
+        verified in batch by the notary audit pipeline, not per-tx)."""
+        account = self._require_unlocked(address)
+        sk, pk = account.bls_keypair()
+        return bn256.bls_prove_possession(sk, pk)
+
+    def _require_unlocked(self, address: Address20) -> Account:
         account = self._accounts.get(address)
         if account is None:
             raise KeyError(f"unknown account {address.hex_str}")
         if not account.unlocked:
             raise PermissionError(f"account {address.hex_str} is locked")
-        return secp256k1.sign(digest, account.priv).to_bytes65()
+        return account

@@ -55,6 +55,10 @@ class SMCClient:
     def sign(self, digest: bytes) -> bytes:
         return self.accounts.sign_hash(self._account.address, digest)
 
+    def bls_sign(self, message: bytes):
+        """Sign a vote message with the account's BLS vote key."""
+        return self.accounts.bls_sign(self._account.address, message)
+
     # -- ChainReader -------------------------------------------------------
 
     def subscribe_new_head(self, callback):
@@ -93,18 +97,25 @@ class SMCClient:
         return self.backend.last_approved_collation(shard_id)
 
     def has_voted(self, shard_id: int, index: int) -> bool:
-        return self.backend.smc.has_voted(shard_id, index)
+        return self.backend.has_voted(shard_id, index)
 
     def get_vote_count(self, shard_id: int) -> int:
-        return self.backend.smc.get_vote_count(shard_id)
+        return self.backend.get_vote_count(shard_id)
 
     def shard_count(self) -> int:
-        return self.backend.smc.shard_count
+        return self.backend.shard_count()
 
     # -- ContractTransactor ------------------------------------------------
 
     def register_notary(self) -> Receipt:
-        return self.backend.register_notary(self._account.address)
+        # the vote pubkey + proof of possession register with the deposit;
+        # validators batch-verify PoPs (rogue-key defense) in the audit
+        return self.backend.register_notary(
+            self._account.address,
+            bls_pubkey=self._account.bls_pubkey,
+            bls_pop=self.accounts.bls_proof_of_possession(
+                self._account.address),
+        )
 
     def deregister_notary(self) -> Receipt:
         return self.backend.deregister_notary(self._account.address)
@@ -118,9 +129,21 @@ class SMCClient:
                                        period, chunk_root, signature)
 
     def submit_vote(self, shard_id: int, period: int, index: int,
-                    chunk_root: Hash32) -> Receipt:
+                    chunk_root: Hash32, bls_sig=None) -> Receipt:
         return self.backend.submit_vote(self._account.address, shard_id,
-                                        period, index, chunk_root)
+                                        period, index, chunk_root,
+                                        bls_sig=bls_sig)
+
+    def notary_by_pool_index(self, index: int) -> Optional[Address20]:
+        return self.backend.notary_by_pool_index(index)
+
+    def notary_registry_of(self, address: Address20):
+        return self.backend.notary_registry(address)
+
+    def verify_period_batch(self, period: int) -> Optional[bool]:
+        """Chain-side batched vote-replay audit (None if unsupported)."""
+        fn = getattr(self.backend, "verify_period_batch", None)
+        return fn(period) if fn is not None else None
 
     # -- tx resilience (WaitForTransaction parity) ------------------------
 

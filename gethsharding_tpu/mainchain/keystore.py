@@ -1,0 +1,184 @@
+"""Encrypted on-disk keystore: scrypt + AES-128-CTR JSON key files.
+
+Parity target: `accounts/keystore` (geth Web3 Secret Storage version 3 —
+`keystore.go:79`, `passphrase.go` EncryptKey/DecryptKey) as consumed by the
+sharding client's unlock flow (`sharding/mainchain/smc_client.go:218`).
+Files written here use the same JSON schema, KDF, cipher, and keccak-based
+MAC as geth's, so keys round-trip between the two implementations. The
+default scrypt cost is geth's "standard" profile (n=262144, r=8, p=1);
+tests use light parameters for speed.
+
+Identity persistence: `Keystore.load_or_create` gives a node a stable
+address across restarts from `<datadir>/keystore` + a password (the
+`--datadir`/`--password` flow in `node/cli.py`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import secrets
+import time
+from dataclasses import dataclass
+from hashlib import scrypt
+from pathlib import Path
+from typing import List, Optional
+
+from gethsharding_tpu.crypto import secp256k1
+from gethsharding_tpu.crypto.keccak import keccak256
+from gethsharding_tpu.utils.hexbytes import Address20
+
+STANDARD_SCRYPT_N = 262144
+STANDARD_SCRYPT_P = 1
+LIGHT_SCRYPT_N = 4096
+LIGHT_SCRYPT_P = 6
+SCRYPT_R = 8
+SCRYPT_DKLEN = 32
+
+
+class KeystoreError(Exception):
+    pass
+
+
+def _aes128_ctr(key16: bytes, iv16: bytes, data: bytes) -> bytes:
+    from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+    cipher = Cipher(algorithms.AES(key16), modes.CTR(iv16))
+    enc = cipher.encryptor()
+    return enc.update(data) + enc.finalize()
+
+
+def encrypt_key(priv: int, password: str, *, scrypt_n: int = STANDARD_SCRYPT_N,
+                scrypt_p: int = STANDARD_SCRYPT_P) -> dict:
+    """Private key -> Web3 Secret Storage v3 JSON object."""
+    salt = secrets.token_bytes(32)
+    derived = scrypt(password.encode(), salt=salt, n=scrypt_n, r=SCRYPT_R,
+                     p=scrypt_p, dklen=SCRYPT_DKLEN, maxmem=2**31 - 1)
+    iv = secrets.token_bytes(16)
+    ciphertext = _aes128_ctr(derived[:16], iv, priv.to_bytes(32, "big"))
+    mac = keccak256(derived[16:32] + ciphertext)
+    address = secp256k1.priv_to_address(priv)
+    return {
+        "address": address.hex_str[2:],
+        "crypto": {
+            "cipher": "aes-128-ctr",
+            "ciphertext": ciphertext.hex(),
+            "cipherparams": {"iv": iv.hex()},
+            "kdf": "scrypt",
+            "kdfparams": {
+                "dklen": SCRYPT_DKLEN,
+                "n": scrypt_n,
+                "p": scrypt_p,
+                "r": SCRYPT_R,
+                "salt": salt.hex(),
+            },
+            "mac": mac.hex(),
+        },
+        "id": "-".join(secrets.token_hex(n) for n in (4, 2, 2, 2, 6)),
+        "version": 3,
+    }
+
+
+def decrypt_key(obj: dict, password: str) -> int:
+    """Web3 Secret Storage JSON -> private key int. Raises KeystoreError on
+    a wrong password (MAC mismatch) or unsupported parameters."""
+    if obj.get("version") != 3:
+        raise KeystoreError(f"unsupported keystore version {obj.get('version')}")
+    crypto = obj["crypto"]
+    if crypto.get("cipher") != "aes-128-ctr":
+        raise KeystoreError(f"unsupported cipher {crypto.get('cipher')}")
+    ciphertext = bytes.fromhex(crypto["ciphertext"])
+    iv = bytes.fromhex(crypto["cipherparams"]["iv"])
+    kdf = crypto.get("kdf")
+    params = crypto["kdfparams"]
+    if kdf == "scrypt":
+        derived = scrypt(password.encode(), salt=bytes.fromhex(params["salt"]),
+                         n=params["n"], r=params["r"], p=params["p"],
+                         dklen=params["dklen"], maxmem=2**31 - 1)
+    elif kdf == "pbkdf2":
+        import hashlib
+
+        if params.get("prf") != "hmac-sha256":
+            raise KeystoreError(f"unsupported prf {params.get('prf')}")
+        derived = hashlib.pbkdf2_hmac(
+            "sha256", password.encode(), bytes.fromhex(params["salt"]),
+            params["c"], params["dklen"])
+    else:
+        raise KeystoreError(f"unsupported kdf {kdf}")
+    mac = keccak256(derived[16:32] + ciphertext)
+    if mac.hex() != crypto["mac"]:
+        raise KeystoreError("could not decrypt key with given password")
+    priv = int.from_bytes(_aes128_ctr(derived[:16], iv, ciphertext), "big")
+    if not 1 <= priv < secp256k1.N:
+        raise KeystoreError("decrypted key is out of range")
+    return priv
+
+
+@dataclass
+class StoredAccount:
+    address: Address20
+    path: Path
+
+
+class Keystore:
+    """Directory of V3 key files (the `<datadir>/keystore` convention)."""
+
+    def __init__(self, directory: os.PathLike | str, *,
+                 scrypt_n: int = STANDARD_SCRYPT_N,
+                 scrypt_p: int = STANDARD_SCRYPT_P):
+        self.directory = Path(directory)
+        self.scrypt_n = scrypt_n
+        self.scrypt_p = scrypt_p
+
+    def accounts(self) -> List[StoredAccount]:
+        """Stored accounts, sorted by file name (creation order for files
+        written by `store`, mirroring geth's URL ordering)."""
+        out = []
+        if not self.directory.is_dir():
+            return out
+        for path in sorted(self.directory.iterdir()):
+            if not path.is_file():
+                continue
+            try:
+                obj = json.loads(path.read_text())
+                addr = Address20(bytes.fromhex(obj["address"]))
+            except (ValueError, KeyError, json.JSONDecodeError):
+                continue
+            out.append(StoredAccount(address=addr, path=path))
+        return out
+
+    def store(self, priv: int, password: str) -> StoredAccount:
+        """Encrypt and write a key file (UTC--<timestamp>--<address>)."""
+        obj = encrypt_key(priv, password, scrypt_n=self.scrypt_n,
+                          scrypt_p=self.scrypt_p)
+        self.directory.mkdir(parents=True, exist_ok=True)
+        stamp = time.strftime("%Y-%m-%dT%H-%M-%S", time.gmtime())
+        path = self.directory / f"UTC--{stamp}--{obj['address']}"
+        tmp = path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(obj, indent=2))
+        os.replace(tmp, path)  # atomic: no torn key files on crash
+        try:
+            os.chmod(path, 0o600)
+        except OSError:
+            pass
+        return StoredAccount(
+            address=Address20(bytes.fromhex(obj["address"])), path=path)
+
+    def unlock(self, address: Address20, password: str) -> int:
+        """Decrypt the key file for `address`; KeystoreError if absent or
+        the password is wrong."""
+        for stored in self.accounts():
+            if stored.address == address:
+                return decrypt_key(json.loads(stored.path.read_text()),
+                                   password)
+        raise KeystoreError(f"no key file for {address.hex_str}")
+
+    def load_or_create(self, password: str) -> int:
+        """The node-identity flow: decrypt the first stored key, or create
+        one if the keystore is empty. A restarted node keeps its address."""
+        stored = self.accounts()
+        if stored:
+            return self.unlock(stored[0].address, password)
+        priv = secrets.randbelow(secp256k1.N - 1) + 1
+        self.store(priv, password)
+        return priv

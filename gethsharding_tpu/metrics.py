@@ -1,0 +1,204 @@
+"""Metrics: counters / gauges / timers behind a registry.
+
+Parity: the `metrics/` go-metrics fork (registry `metrics.go:22-39`,
+process collectors :42, expvar/influx exporters) scoped to what the
+sharding framework actually needs natively (SURVEY.md §7.8): the two
+BASELINE metrics — aggregate signature verifications/sec and collation
+validate latency percentiles — plus per-actor operation counters.
+
+Like the reference's `metrics.Enabled` gate, collection is cheap enough
+to leave on; the `--metrics` CLI flag controls *reporting*. Timers keep a
+bounded sample reservoir for percentile snapshots (the go-metrics
+ExpDecaySample analog, simplified to a ring buffer — recent-window
+percentiles, which is what a validate-latency dashboard wants).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class Counter:
+    """Monotonic event count with a creation-time rate."""
+
+    def __init__(self) -> None:
+        self._value = 0
+        self._t0 = time.monotonic()
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+    def rate(self) -> float:
+        """Events/sec since creation."""
+        elapsed = time.monotonic() - self._t0
+        return self._value / elapsed if elapsed > 0 else 0.0
+
+    def snapshot(self) -> dict:
+        return {"type": "counter", "count": self._value,
+                "rate_per_s": round(self.rate(), 3)}
+
+
+class Gauge:
+    """Last-written value."""
+
+    def __init__(self) -> None:
+        self._value: float = 0.0
+
+    def set(self, value: float) -> None:
+        self._value = value
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def snapshot(self) -> dict:
+        return {"type": "gauge", "value": self._value}
+
+
+class Timer:
+    """Duration observations with percentile snapshots over a recent
+    window (ring buffer of the last `reservoir` observations)."""
+
+    def __init__(self, reservoir: int = 1024) -> None:
+        self._samples: List[float] = []
+        self._reservoir = reservoir
+        self._count = 0
+        self._total = 0.0
+        self._next = 0
+        self._lock = threading.Lock()
+
+    def observe(self, seconds: float) -> None:
+        with self._lock:
+            self._count += 1
+            self._total += seconds
+            if len(self._samples) < self._reservoir:
+                self._samples.append(seconds)
+            else:  # ring overwrite: recent-window percentiles
+                self._samples[self._next] = seconds
+                self._next = (self._next + 1) % self._reservoir
+
+    def time(self) -> "_TimerContext":
+        return _TimerContext(self)
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def percentile(self, q: float) -> float:
+        with self._lock:
+            if not self._samples:
+                return 0.0
+            ordered = sorted(self._samples)
+        idx = min(int(q * len(ordered)), len(ordered) - 1)
+        return ordered[idx]
+
+    def mean(self) -> float:
+        return self._total / self._count if self._count else 0.0
+
+    def snapshot(self) -> dict:
+        return {
+            "type": "timer", "count": self._count,
+            "mean_s": round(self.mean(), 6),
+            "p50_s": round(self.percentile(0.50), 6),
+            "p95_s": round(self.percentile(0.95), 6),
+            "p99_s": round(self.percentile(0.99), 6),
+        }
+
+
+class _TimerContext:
+    def __init__(self, timer: Timer) -> None:
+        self._timer = timer
+
+    def __enter__(self) -> "_TimerContext":
+        self._start = time.monotonic()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._timer.observe(time.monotonic() - self._start)
+
+
+class Registry:
+    """Named metric registry (metrics.Registry parity)."""
+
+    def __init__(self) -> None:
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _get_or_register(self, name: str, factory):
+        with self._lock:
+            metric = self._metrics.get(name)
+            if metric is None:
+                metric = factory()
+                self._metrics[name] = metric
+            return metric
+
+    def counter(self, name: str) -> Counter:
+        return self._get_or_register(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get_or_register(name, Gauge)
+
+    def timer(self, name: str) -> Timer:
+        return self._get_or_register(name, Timer)
+
+    def get(self, name: str) -> Optional[object]:
+        return self._metrics.get(name)
+
+    def snapshot(self) -> Dict[str, dict]:
+        with self._lock:
+            items = list(self._metrics.items())
+        return {name: metric.snapshot() for name, metric in sorted(items)}
+
+
+# the default registry (metrics.DefaultRegistry parity)
+DEFAULT_REGISTRY = Registry()
+
+
+def counter(name: str) -> Counter:
+    return DEFAULT_REGISTRY.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return DEFAULT_REGISTRY.gauge(name)
+
+
+def timer(name: str) -> Timer:
+    return DEFAULT_REGISTRY.timer(name)
+
+
+class PeriodicReporter:
+    """Logs a registry snapshot every `interval` seconds (the
+    `CollectProcessMetrics` + exp exporter analog, to the log stream)."""
+
+    def __init__(self, registry: Registry = DEFAULT_REGISTRY,
+                 interval: float = 10.0, logger=None) -> None:
+        import logging
+
+        self.registry = registry
+        self.interval = interval
+        self.log = logger or logging.getLogger("metrics")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="metrics-reporter")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            for name, snap in self.registry.snapshot().items():
+                self.log.info("%s %s", name, snap)

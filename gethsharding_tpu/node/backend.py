@@ -44,7 +44,8 @@ class ShardNode:
                  deposit: bool = False,
                  txpool_interval: Optional[float] = 5.0,
                  simulator_interval: float = 15.0,
-                 sig_backend: str = "python"):
+                 sig_backend: str = "python",
+                 password: Optional[str] = None):
         if actor not in self.ACTORS:
             raise ValueError(f"unknown actor {actor!r}; pick from {self.ACTORS}")
         self.actor = actor
@@ -60,7 +61,22 @@ class ShardNode:
         p2p = P2PServer(hub=hub)
         self._register(p2p)
 
-        client = SMCClient(backend=backend, config=config, deposit_flag=deposit)
+        # node identity: with a datadir + password, load-or-create an
+        # encrypted key file so the address survives restarts
+        # (accounts/keystore parity; smc_client.go:218 unlock flow)
+        account = None
+        accounts_mgr = None
+        if data_dir and password is not None:
+            from gethsharding_tpu.mainchain.accounts import AccountManager
+            from gethsharding_tpu.mainchain.keystore import Keystore
+
+            keystore = Keystore(f"{data_dir}/keystore")
+            accounts_mgr = AccountManager()
+            account = accounts_mgr.import_key(
+                keystore.load_or_create(password))
+
+        client = SMCClient(backend=backend, config=config, deposit_flag=deposit,
+                           accounts=accounts_mgr, account=account)
         self._register(client)
 
         shard = Shard(shard_id=shard_id, shard_db=shard_db.db)

@@ -39,6 +39,11 @@ def build_parser() -> argparse.ArgumentParser:
                                "(flags.go:537)")
     sharding.add_argument("--datadir", default="",
                           help="data directory (in-memory DB if empty)")
+    sharding.add_argument("--password", default=None,
+                          help="password file or literal for the encrypted "
+                               "keystore under <datadir>/keystore "
+                               "(flags.go PasswordFileFlag); with --datadir "
+                               "the node address survives restarts")
     sharding.add_argument("--periodlength", type=int, default=5)
     sharding.add_argument("--blocktime", type=float, default=1.0,
                           help="dev-mode block production interval seconds")
@@ -53,6 +58,14 @@ def build_parser() -> argparse.ArgumentParser:
                                "reference's native-crypto build seam)")
     sharding.add_argument("--verbosity", default="info",
                           choices=("debug", "info", "warning", "error"))
+    sharding.add_argument("--metrics", action="store_true",
+                          help="report the metrics registry periodically "
+                               "and dump it at exit (metrics.go:22 gate)")
+    sharding.add_argument("--metrics-interval", type=float, default=10.0)
+    sharding.add_argument("--profile", default="",
+                          help="write a JAX profiler trace to this directory "
+                               "while running (the --pprof/--trace analog, "
+                               "internal/debug/flags.go:40-90)")
     return parser
 
 
@@ -71,6 +84,13 @@ def run_cli(argv: Optional[List[str]] = None) -> int:
 def run_sharding_node(args) -> int:
     config = Config(period_length=args.periodlength)
     backend = SimulatedMainchain(config=config)
+    password = args.password
+    if password is not None:
+        try:  # geth convention: --password usually names a file
+            with open(password) as fh:
+                password = fh.read().strip()
+        except OSError:
+            pass  # treat as a literal password
     node = ShardNode(
         actor=args.actor,
         shard_id=args.shardid,
@@ -81,6 +101,7 @@ def run_sharding_node(args) -> int:
         deposit=args.deposit,
         txpool_interval=args.txinterval,
         sig_backend=args.sigbackend,
+        password=password,
     )
     # dev mode: fund the node account so --deposit can stake
     backend.fund(node.client.account(), 2000 * ETHER)
@@ -88,6 +109,23 @@ def run_sharding_node(args) -> int:
     log = logging.getLogger("sharding.node")
     log.info("Starting sharding node: actor=%s shard=%d account=%s",
              args.actor, args.shardid, node.client.account().hex_str)
+
+    reporter = None
+    if args.metrics:
+        from gethsharding_tpu.metrics import DEFAULT_REGISTRY, PeriodicReporter
+
+        reporter = PeriodicReporter(interval=args.metrics_interval)
+        reporter.start()
+    profiling = False
+    if args.profile:
+        try:
+            import jax
+
+            jax.profiler.start_trace(args.profile)
+            profiling = True
+        except Exception as exc:
+            log.warning("JAX profiler unavailable: %s", exc)
+
     node.start()
 
     deadline = time.monotonic() + args.runtime if args.runtime else None
@@ -102,6 +140,23 @@ def run_sharding_node(args) -> int:
         log.info("interrupt received, shutting down")
     finally:
         node.stop()
+        if profiling:
+            import jax
+
+            jax.profiler.stop_trace()
+        if reporter is not None:
+            reporter.stop()
+    if args.metrics:
+        from gethsharding_tpu.metrics import DEFAULT_REGISTRY
+
+        for name, snap in DEFAULT_REGISTRY.snapshot().items():
+            log.info("metric %s %s", name, snap)
     for error in node.errors():
         log.warning("service error: %s", error)
     return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(run_cli())

@@ -51,10 +51,13 @@ N = ref.N
 U = ref.U
 FP = ModArith(P)
 
-# Column-space bounds: one 22-limb product column < 22·4095² ≈ 2^28.46; an
+# Column-space bounds: one 25-limb product column < 25·4095² ≈ 2^28.64; an
 # int32 column accumulator safely holds FOUR such products plus a canonical
-# pad (< 2^12 per column): 4·2^28.46 + 2^12 < 2^30.5. Never sum more.
-_PAD530 = FP.pad_mult(530)  # ≥ any sum of two subtracted products
+# pad (< 2^12 per column): 4·2^28.64 + 2^12 < 2^30.7. Never sum more.
+# Subtraction pads scale with the lazy VALUE bound (< 2^LAZY_BITS): a
+# product of two lazy values is < 2^(2·273), so a sum of two subtracted
+# products needs a multiple of p ≥ 2^547.
+_PAD530 = FP.pad_mult(2 * _limb.LAZY_BITS + 1)  # ≥ two subtracted products
 
 
 def _pad_to(cols: jnp.ndarray, width: int) -> jnp.ndarray:
@@ -116,15 +119,17 @@ def fp2_mul_fp(x, s):
     return jnp.stack([FP.mul(a, s), FP.mul(b, s)], axis=-2)
 
 
-_PAD266 = FP.pad_mult(266)  # ≥ one lazy element (for small negated sums)
+_PAD266 = FP.pad_mult(_limb.LAZY_BITS)  # ≥ one lazy element (negated sums)
 
 
 @jax.jit
 def fp2_mul_xi(x):
     """×ξ = ×(9+i): (9a - b) + (a + 9b)i — 2 normalizes, no products."""
     a, b = x[..., 0, :], x[..., 1, :]
-    diff = _pad_to(a * 9 - b, _PAD266.shape[0])
-    rr = FP.normalize(diff + jnp.asarray(_PAD266))
+    width = max(a.shape[-1], _PAD266.shape[0])
+    diff = _pad_to(a * 9 - b, width)
+    rr = FP.normalize(diff + jnp.asarray(np.pad(
+        _PAD266, (0, width - _PAD266.shape[0]))))
     ii = FP.normalize(a + b * 9)
     return jnp.stack([rr, ii], axis=-2)
 

@@ -4,18 +4,23 @@ This is the foundation under the bn256 pairing and secp256k1 kernels
 (SURVEY.md §7 hard part 1: "big-integer modular arithmetic on TPU — needs
 limb decomposition to run inside MXU/VPU efficiently"). Design:
 
-- A field element is 22 limbs x 12 bits (264 bits) stored little-endian in
-  int32, shape ``(..., 22)``. The leading axes are the batch — every op is
+- A field element is 25 limbs x 12 bits stored little-endian in int32,
+  shape ``(..., 25)``. The leading axes are the batch — every op is
   batch-first and jit/vmap/shard_map-safe (static shapes, no 64-bit dtypes,
   no data-dependent control flow).
 - Products of 12-bit limbs are 24 bits; a schoolbook column accumulates at
-  most 22 of them: 22 * (2^12-1)^2 < 2^28.5, safely inside int32. No
-  Montgomery form: reduction folds high limbs through a precomputed
+  most 25 of them, and fused callers sum up to FOUR such products:
+  4 * 25 * (2^12-1)^2 < 2^30.7, safely inside int32. No Montgomery form:
+  reduction folds limbs >= FOLD_BASE(=22) through a precomputed
   ``(2^(12*(22+k)) mod p)`` matrix — a small integer matmul, the natural
-  TPU shape — followed by carry propagation (a `lax.scan`).
-- Elements are kept *lazily* reduced: canonical limbs (< 2^12) but value in
-  [0, 2^264), congruent mod p. `canon` produces the unique value < p for
-  equality/export; everything in between stays lazy.
+  TPU shape — followed by ONE exact carry propagation.
+- Elements are kept *lazily* reduced: canonical limbs (< 2^12), width 25,
+  value in [0, 2^LAZY_BITS), congruent mod p. The width is 3 limbs wider
+  than the fold base ON PURPOSE: it lets `normalize` finish with a single
+  exact carry (the serialized lax.scan that dominates kernel latency on
+  TPU) instead of the three an exact 22-limb form needs — the overflow
+  above 2^264 simply stays in the top limbs until the next fold. `canon`
+  produces the unique value < p for equality/export.
 
 The reference's equivalents are hand-written Montgomery assembly
 (`crypto/bn256/cloudflare/gfp_amd64.s`: gfpNeg/Add/Sub/Mul) and C field
@@ -37,8 +42,26 @@ from jax import lax
 
 LIMB_BITS = 12
 LIMB_MASK = (1 << LIMB_BITS) - 1
-NLIMBS = 22  # 264 bits >= 256-bit moduli with lazy-reduction headroom
-RADIX = 1 << (LIMB_BITS * NLIMBS)  # 2^264
+
+# Two lazy representations, selected by $GETHSHARDING_TPU_LIMB_FORM:
+# - "wide" (default): 25-limb operands, value < 2^273, ONE exact carry per
+#   normalize — minimizes sequential depth (TPU latency).
+# - "exact": 22-limb operands, value < 2^264, three exact carries per
+#   normalize — minimizes schoolbook width (+29% fewer product FLOPs),
+#   better when throughput-bound. bench.py autotunes over both.
+LIMB_FORM = os.environ.get("GETHSHARDING_TPU_LIMB_FORM", "wide")
+if LIMB_FORM == "wide":
+    NLIMBS = 25    # operand width: 300 bits of capacity
+    LAZY_BITS = 273  # lazy-form value bound (see normalize)
+elif LIMB_FORM == "exact":
+    NLIMBS = 22
+    LAZY_BITS = 264
+else:
+    raise ValueError(
+        f"GETHSHARDING_TPU_LIMB_FORM must be 'wide' or 'exact', got {LIMB_FORM!r}")
+FOLD_BASE = 22     # limbs >= FOLD_BASE fold back under the modulus
+FOLD_ROWS = 33     # max high limbs a single fold can absorb
+RADIX = 1 << (LIMB_BITS * NLIMBS)
 
 
 def int_to_limbs(value: int, nlimbs: int = NLIMBS) -> np.ndarray:
@@ -158,31 +181,42 @@ class ModArith:
     """
 
     def __init__(self, p: int):
-        # Lazy-form headroom: values live in [0, 2^264); the fold/carry
-        # termination bound in `normalize` holds for any p < 2^257
-        # (covers the 254-bit bn256 and 256-bit secp256k1 fields).
+        # Lazy-form headroom: values live in [0, 2^LAZY_BITS); the bound
+        # derivation in `normalize` holds for any p < 2^257 (covers the
+        # 254-bit bn256 and 256-bit secp256k1 fields).
         if p.bit_length() > 256:
-            raise ValueError("modulus too large for lazy 264-bit form")
+            raise ValueError("modulus too large for the lazy limb form")
         self.p = p
-        # Fold matrix: row k holds limbs of 2^(12*(22+k)) mod p. 30 rows
-        # cover the widest intermediate (tower-fused accumulators reach 45
-        # columns, + 3 relaxed-round pad limbs -> 26 high limbs).
+        # Fold matrix: row k holds limbs of 2^(12*(FOLD_BASE+k)) mod p.
+        # FOLD_ROWS rows cover the widest intermediate (fused accumulators
+        # reach 49 columns, + 3 relaxed-round pad limbs -> 30 high limbs).
         self.fold_j = np.stack(
-            [int_to_limbs(pow(1 << (LIMB_BITS * (NLIMBS + k)), 1, p)) for k in range(30)]
-        )  # (30, 22) int32; numpy on purpose — jnp.matmul accepts it and
-        # constant-folds under jit without forcing backend init at __init__
-        # Additive pad for subtraction: smallest multiple of p >= 2^264,
-        # so (x - y + sub_pad) >= 0 for any lazy x, y. Fits 23 limbs.
-        c = -(-RADIX // p)  # ceil
-        self.sub_pad = int_to_limbs(c * p, NLIMBS + 1)
-        # Shifted moduli for canonicalization: p << k >= 2^265 at k_max,
-        # descending conditional subtraction brings any lazy value < p.
+            [int_to_limbs(pow(1 << (LIMB_BITS * (FOLD_BASE + k)), 1, p),
+                          FOLD_BASE)
+             for k in range(FOLD_ROWS)]
+        )  # (FOLD_ROWS, 22) int32; numpy on purpose — jnp.matmul accepts
+        # it and constant-folds under jit without backend init at __init__
+        # Additive pad for subtraction: smallest multiple of p >= RADIX, so
+        # (x - y + sub_pad) > 0 for ANY canonical-limb operand (the lazy
+        # invariant is tighter, but accepting the full limb capacity makes
+        # the API contract unconditional at negligible cost).
+        cover_bits = LIMB_BITS * NLIMBS
+        c = -(-(1 << cover_bits) // p)  # ceil
+        self.sub_pad = int_to_limbs(c * p, -(-(cover_bits + 1) // LIMB_BITS))
+        # Lift added before each fold: a multiple of p large enough that
+        # the folded value stays non-negative even when relaxed-round
+        # borrows leave -1 limbs below FOLD_BASE (lo value >= -2^253) or
+        # fold rows act on -1 high limbs (>= -FOLD_ROWS*2^12*p > -2^260).
+        self.lift = int_to_limbs(-(-(1 << 261) // p) * p, FOLD_BASE)
+        # Shifted moduli for canonicalization: p << k >= RADIX at k_max;
+        # descending conditional subtraction brings any canonical-limb
+        # value < p.
         k_max = 0
-        while (p << k_max) < (RADIX * 2):
+        while (p << k_max) < (1 << cover_bits):
             k_max += 1
         self.pshift = np.stack(
             [int_to_limbs(p << k, NLIMBS + 1) for k in range(k_max, -1, -1)]
-        )  # (k_max+1, 23)
+        )  # (k_max+1, 26)
         self.zero = np.zeros(NLIMBS, np.int32)
         self.one = int_to_limbs(1)
         self._pad_cache: dict = {}
@@ -190,28 +224,34 @@ class ModArith:
     # -- normalization ------------------------------------------------------
 
     def _fold_hi(self, z: jnp.ndarray) -> jnp.ndarray:
-        """Fold limbs >= NLIMBS back under the modulus; result NLIMBS wide."""
-        hi = z[..., NLIMBS:]
+        """Fold limbs >= FOLD_BASE back under the modulus; FOLD_BASE wide."""
+        hi = z[..., FOLD_BASE:]
         m = hi.shape[-1]
         if m == 0:
             return z
         if m > self.fold_j.shape[0]:  # silent slice-truncation would drop limbs
             raise ValueError(f"accumulator too wide: {m} high limbs > "
                              f"{self.fold_j.shape[0]} fold rows")
-        folded = jnp.matmul(hi, self.fold_j[:m])  # (..., 22), <= 25*2^24
-        return z[..., :NLIMBS] + folded
+        folded = jnp.matmul(hi, self.fold_j[:m])  # (..., 22), <= 33*2^24
+        return z[..., :FOLD_BASE] + folded
 
     def normalize(self, z: jnp.ndarray) -> jnp.ndarray:
-        """Reduce any accumulator (..., L) with |limb| < 2^30.7 to lazy form:
-        22 canonical limbs, value in [0, 2^264), same residue mod p.
+        """Reduce any accumulator (..., L) with |limb| < 2^30.7 to lazy
+        form: NLIMBS canonical limbs, value in [0, 2^LAZY_BITS), same
+        residue mod p — with ONE exact carry.
 
-        The first two reduction stages use *relaxed* carry rounds (three
-        vectorized rounds bound limbs to [-1, 2^12] without sequential
-        propagation — a dropped top carry is impossible because each round
-        extends the width by one limb); only the final canonicalization
-        stages need exact carries. This keeps the while-loop count per
-        normalize at 3 instead of 5 — the pairing kernel's compile time is
-        proportional to it.
+        Stages: three *relaxed* carry rounds (vectorized, no sequential
+        propagation; a dropped top carry is impossible because each round
+        extends the width by one limb) bound limbs to [-1, 2^12 + eps];
+        one fold brings the width to FOLD_BASE while adding `lift` (a
+        multiple of p) so the value stays non-negative despite borrow
+        limbs; the single exact carry then canonicalizes into the 3 spare
+        top limbs. Value bound: lo < 2^264, fold <= FOLD_ROWS*2^12*p,
+        lift < 2^262 — total < 2^273 = 2^LAZY_BITS, so the carry off the
+        top limb is provably zero. The exact carry is THE serialized
+        lax.scan dominating kernel latency on TPU; one per normalize
+        (instead of three for an exact-width form) is the point of the
+        25-limb lazy representation.
         """
         pad = [(0, 0)] * (z.ndim - 1)
 
@@ -222,14 +262,16 @@ class ModArith:
                 # top limb's whole content; `top` here is always 0
             return v
 
-        # stage 1: limbs in [-1, 2^12], then fold the high limbs
+        if LIMB_FORM == "wide":
+            z = self._fold_hi(relax3(z)) + self.lift
+            return _carry(jnp.pad(z, pad + [(0, NLIMBS - FOLD_BASE)]))
+
+        # "exact" form: the legacy 3-carry ladder producing value < 2^264
+        # in exactly 22 canonical limbs.
         z = self._fold_hi(relax3(z))
-        # stage 2: same again — columns now ~2^24
         z = self._fold_hi(relax3(z))
-        # stage 3: exact carry; value < 2^264·1.01 + eps ⇒ small top limbs
         z = _carry(jnp.pad(z, pad + [(0, 2)]))
         z = self._fold_hi(z)
-        # stage 4: exact carry; top bit in {0,1}; one conditional fold left
         z = _carry(jnp.pad(z, pad + [(0, 1)]))
         z = self._fold_hi(z)
         return _carry(z)
@@ -240,10 +282,12 @@ class ModArith:
         return self.normalize(x + y)
 
     def sub(self, x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
-        # x - y + (multiple of p >= 2^264) keeps the value non-negative for
-        # any lazy x, y; per-limb range [-0xfff, 2*0xfff] is carry-safe.
-        diff = jnp.pad(x - y, [(0, 0)] * (x.ndim - 1) + [(0, 1)])
-        return self.normalize(diff + self.sub_pad)
+        # x - y + (multiple of p >= 2^LAZY_BITS) keeps the value positive
+        # for any lazy x, y; per-limb range [-0xfff, 2*0xfff] is carry-safe.
+        w = max(x.shape[-1], self.sub_pad.shape[0])
+        diff = jnp.pad(x - y, [(0, 0)] * (x.ndim - 1) + [(0, w - x.shape[-1])])
+        return self.normalize(diff + np.pad(self.sub_pad,
+                                            (0, w - self.sub_pad.shape[0])))
 
     def neg(self, x: jnp.ndarray) -> jnp.ndarray:
         return self.sub(jnp.broadcast_to(self.zero, x.shape), x)
@@ -253,11 +297,11 @@ class ModArith:
         return self.normalize(x * jnp.int32(c))
 
     def mul(self, x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
-        """Schoolbook product -> 43 columns -> fold+carry. Batch-first."""
+        """Schoolbook product -> 49 columns -> fold+carry. Batch-first."""
         return self.normalize(self.mul_cols(x, y))
 
     def mul_cols(self, x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
-        """Raw schoolbook product columns (..., 43), each < 22·2^24.
+        """Raw schoolbook product columns (..., 49), each < 25·2^24.
 
         Building block for *fused* tower arithmetic (ops/bn256_jax): column
         accumulators of several products can be added/subtracted (with a
@@ -265,9 +309,9 @@ class ModArith:
         by a single `normalize`, instead of one normalize per ring op.
         Callers own the int32 range proof: each column must stay < 2^31.
         """
-        prod = x[..., :, None] * y[..., None, :]  # (..., 22, 22) 24-bit terms
+        prod = x[..., :, None] * y[..., None, :]  # (..., 25, 25) 24-bit terms
         # Column sums z[k] = sum_{i+j=k} prod[i,j] via anti-diagonal einsum
-        # against a static one-hot (22,22,43): contracts to an integer
+        # against a static one-hot (25,25,49): contracts to an integer
         # matmul XLA maps well.
         return jnp.einsum("...ij,ijk->...k", prod, _DIAG_ONEHOT)
 
@@ -346,7 +390,7 @@ class ModArith:
 
 
 def _make_diag_onehot() -> np.ndarray:
-    """(22, 22, 43) one-hot E[i, j, i+j] = 1 for the anti-diagonal sum.
+    """(25, 25, 49) one-hot E[i, j, i+j] = 1 for the anti-diagonal sum.
 
     Kept as numpy: jnp.einsum accepts numpy operands and constant-folds it
     identically under jit, and importing this module must not trigger JAX

@@ -1,0 +1,71 @@
+"""Standalone mainchain process: `python -m gethsharding_tpu.rpc.chain_server`.
+
+The dev-mode equivalent of the geth process the reference's actors dial
+(`sharding/mainchain/utils.go:17` — one mainchain node, N actor
+processes). Hosts a SimulatedMainchain behind an RPCServer; block
+production is either timed (--blocktime) or driven remotely via the
+shard_commit / shard_fastForward dev methods.
+
+Prints one JSON line {"host": ..., "port": ...} on stdout once listening,
+so a parent process (test harness, orchestrator) can dial it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+import time
+
+from gethsharding_tpu.params import Config
+from gethsharding_tpu.rpc.server import RPCServer
+from gethsharding_tpu.smc.chain import SimulatedMainchain
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="chain-server")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--periodlength", type=int, default=5)
+    parser.add_argument("--quorum", type=int, default=None,
+                        help="override QUORUM_SIZE (dev/test chains)")
+    parser.add_argument("--shardcount", type=int, default=None)
+    parser.add_argument("--blocktime", type=float, default=0.0,
+                        help="auto block production interval (0 = manual "
+                             "via shard_commit / shard_fastForward)")
+    parser.add_argument("--runtime", type=float, default=0.0,
+                        help="seconds before exit (0 = forever)")
+    parser.add_argument("--verbosity", default="warning")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=getattr(logging, args.verbosity.upper()))
+    overrides = {"period_length": args.periodlength}
+    if args.quorum is not None:
+        overrides["quorum_size"] = args.quorum
+    if args.shardcount is not None:
+        overrides["shard_count"] = args.shardcount
+    config = Config(**overrides)
+    backend = SimulatedMainchain(config=config)
+    server = RPCServer(backend, host=args.host, port=args.port)
+    server.start()
+    print(json.dumps({"host": server.address[0], "port": server.address[1]}),
+          flush=True)
+
+    deadline = time.monotonic() + args.runtime if args.runtime else None
+    try:
+        while deadline is None or time.monotonic() < deadline:
+            if args.blocktime > 0:
+                time.sleep(args.blocktime)
+                backend.commit()
+            else:
+                time.sleep(0.2)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,292 @@
+"""RPC client + RemoteMainchain: dial a chain process and act on it.
+
+Parity: `ethclient` + `sharding/mainchain/utils.go:17-22` (dialRPC).
+`RemoteMainchain` implements the same backend surface as
+`SimulatedMainchain` (duck-typed), so `SMCClient(backend=RemoteMainchain
+.dial(...))` turns any sharding actor into a genuinely separate OS
+process from the chain — the reference's process topology (N actor
+processes <-> one mainchain node over RPC).
+
+A background reader thread routes responses by id and dispatches
+`shard_subscription` notifications to head subscribers (the
+`SubscribeNewHead` flow, `sharding/notary/notary.go:33-38`).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import queue
+import socket
+import threading
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+from gethsharding_tpu.rpc import codec
+from gethsharding_tpu.smc.state_machine import SMCRevert
+from gethsharding_tpu.utils.hexbytes import Address20, Hash32
+
+log = logging.getLogger("rpc.client")
+
+
+class RPCError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(f"rpc error {code}: {message}")
+        self.code = code
+        self.message = message
+
+
+@dataclass
+class RemoteBlock:
+    number: int
+    hash: Hash32
+    parent_hash: Hash32
+
+
+def _dec_block(obj: dict) -> RemoteBlock:
+    return RemoteBlock(number=obj["number"],
+                       hash=Hash32(codec.dec_bytes(obj["hash"])),
+                       parent_hash=Hash32(codec.dec_bytes(obj["parentHash"])))
+
+
+@dataclass
+class RemoteReceipt:
+    tx_hash: Hash32
+    status: int
+    block_number: int
+
+
+class RPCClient:
+    """Newline-delimited JSON-RPC 2.0 over a stream socket."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 timeout: float = 30.0):
+        self._sock = socket.create_connection((host, port), timeout=10.0)
+        self._sock.settimeout(None)
+        self._file = self._sock.makefile("rwb")
+        self._ids = itertools.count(1)
+        self._pending: dict = {}
+        self._pending_lock = threading.Lock()
+        self._write_lock = threading.Lock()
+        self._head_subscribers: List[Callable] = []
+        self._timeout = timeout
+        self._closed = False
+        # notifications are dispatched OFF the reader thread: subscriber
+        # callbacks (e.g. the notary head loop) issue further RPC calls,
+        # which would deadlock if the reader were blocked inside them
+        self._notifications: "queue.Queue" = queue.Queue()
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True,
+            name="rpc-client-dispatch")
+        self._dispatcher.start()
+        self._reader = threading.Thread(target=self._read_loop, daemon=True,
+                                        name="rpc-client-reader")
+        self._reader.start()
+
+    def close(self) -> None:
+        self._closed = True
+        self._notifications.put(None)
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+    # -- request/response --------------------------------------------------
+
+    def call(self, method: str, *params):
+        rid = next(self._ids)
+        event = threading.Event()
+        slot: dict = {"event": event}
+        with self._pending_lock:
+            self._pending[rid] = slot
+        payload = (json.dumps({"jsonrpc": "2.0", "id": rid, "method": method,
+                               "params": list(params)}) + "\n").encode()
+        with self._write_lock:
+            self._file.write(payload)
+            self._file.flush()
+        if not event.wait(self._timeout):
+            with self._pending_lock:
+                self._pending.pop(rid, None)
+            raise TimeoutError(f"rpc call {method} timed out")
+        if "error" in slot:
+            err = slot["error"]
+            if err.get("data") == "SMCRevert":
+                raise SMCRevert(err.get("message", ""))
+            raise RPCError(err.get("code", -1), err.get("message", ""))
+        return slot.get("result")
+
+    def subscribe_heads(self, callback: Callable) -> Callable[[], None]:
+        self._head_subscribers.append(callback)
+        self.call("shard_subscribe", "newHeads")
+
+        def unsubscribe() -> None:
+            if callback in self._head_subscribers:
+                self._head_subscribers.remove(callback)
+
+        return unsubscribe
+
+    def _read_loop(self) -> None:
+        try:
+            for raw in self._file:
+                try:
+                    msg = json.loads(raw)
+                except json.JSONDecodeError:
+                    continue
+                if msg.get("method") == "shard_subscription":
+                    self._notifications.put(
+                        _dec_block(msg["params"]["result"]))
+                    continue
+                rid = msg.get("id")
+                with self._pending_lock:
+                    slot = self._pending.pop(rid, None)
+                if slot is not None:
+                    if "error" in msg:
+                        slot["error"] = msg["error"]
+                    else:
+                        slot["result"] = msg.get("result")
+                    slot["event"].set()
+        except (OSError, ValueError):
+            pass
+        finally:
+            if not self._closed:
+                log.warning("rpc connection lost")
+            self._notifications.put(None)
+            # unblock all waiters
+            with self._pending_lock:
+                pending = list(self._pending.values())
+                self._pending.clear()
+            for slot in pending:
+                slot["error"] = {"code": -32000, "message": "connection lost"}
+                slot["event"].set()
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            block = self._notifications.get()
+            if block is None:
+                return
+            for callback in list(self._head_subscribers):
+                try:
+                    callback(block)
+                except Exception:  # noqa: BLE001 - subscriber owns it
+                    log.exception("head subscriber failed")
+
+
+class RemoteMainchain:
+    """Client-side mainchain backend over RPC (SimulatedMainchain's duck
+    type, minus in-process-only internals)."""
+
+    def __init__(self, rpc: RPCClient):
+        self.rpc = rpc
+
+    @classmethod
+    def dial(cls, host: str, port: int, timeout: float = 30.0
+             ) -> "RemoteMainchain":
+        return cls(RPCClient(host, port, timeout=timeout))
+
+    def close(self) -> None:
+        self.rpc.close()
+
+    # chain reader
+    @property
+    def block_number(self) -> int:
+        return self.rpc.call("shard_blockNumber")
+
+    def current_period(self) -> int:
+        return self.rpc.call("shard_currentPeriod")
+
+    def block_by_number(self, number: Optional[int] = None) -> RemoteBlock:
+        return _dec_block(self.rpc.call("shard_blockByNumber", number))
+
+    def subscribe_new_head(self, callback) -> Callable[[], None]:
+        return self.rpc.subscribe_heads(callback)
+
+    # SMC views
+    def get_notary_in_committee(self, sender: Address20, shard_id: int):
+        return Address20(codec.dec_bytes(self.rpc.call(
+            "shard_getNotaryInCommittee", codec.enc_bytes(sender), shard_id)))
+
+    def notary_registry(self, address: Address20):
+        return codec.dec_registry(self.rpc.call(
+            "shard_notaryRegistry", codec.enc_bytes(address)))
+
+    def collation_record(self, shard_id: int, period: int):
+        return codec.dec_record(self.rpc.call(
+            "shard_collationRecord", shard_id, period))
+
+    def last_submitted_collation(self, shard_id: int) -> int:
+        return self.rpc.call("shard_lastSubmittedCollation", shard_id)
+
+    def last_approved_collation(self, shard_id: int) -> int:
+        return self.rpc.call("shard_lastApprovedCollation", shard_id)
+
+    def notary_by_pool_index(self, index: int) -> Optional[Address20]:
+        addr = self.rpc.call("shard_notaryByPoolIndex", index)
+        return None if addr is None else Address20(codec.dec_bytes(addr))
+
+    def has_voted(self, shard_id: int, index: int) -> bool:
+        return self.rpc.call("shard_hasVoted", shard_id, index)
+
+    def get_vote_count(self, shard_id: int) -> int:
+        return self.rpc.call("shard_getVoteCount", shard_id)
+
+    def shard_count(self) -> int:
+        return self.rpc.call("shard_shardCount")
+
+    def balance_of(self, account: Address20) -> int:
+        return self.rpc.call("shard_balanceOf", codec.enc_bytes(account))
+
+    def transaction_receipt(self, tx_hash: Hash32):
+        obj = self.rpc.call("shard_transactionReceipt",
+                            codec.enc_bytes(tx_hash))
+        return None if obj is None else RemoteReceipt(
+            tx_hash=Hash32(codec.dec_bytes(obj["txHash"])),
+            status=obj["status"], block_number=obj["blockNumber"])
+
+    def verify_period_batch(self, period: int):
+        return self.rpc.call("shard_verifyPeriodBatch", period)
+
+    # transactions
+    def register_notary(self, sender: Address20, value=None,
+                        bls_pubkey=None, bls_pop=None) -> RemoteReceipt:
+        return self._receipt(self.rpc.call(
+            "shard_registerNotary", codec.enc_bytes(sender),
+            codec.enc_g2(bls_pubkey), codec.enc_g1(bls_pop)))
+
+    def deregister_notary(self, sender: Address20) -> RemoteReceipt:
+        return self._receipt(self.rpc.call(
+            "shard_deregisterNotary", codec.enc_bytes(sender)))
+
+    def release_notary(self, sender: Address20) -> RemoteReceipt:
+        return self._receipt(self.rpc.call(
+            "shard_releaseNotary", codec.enc_bytes(sender)))
+
+    def add_header(self, sender: Address20, shard_id: int, period: int,
+                   chunk_root: Hash32, signature: bytes = b"") -> RemoteReceipt:
+        return self._receipt(self.rpc.call(
+            "shard_addHeader", codec.enc_bytes(sender), shard_id, period,
+            codec.enc_bytes(chunk_root), codec.enc_bytes(signature)))
+
+    def submit_vote(self, sender: Address20, shard_id: int, period: int,
+                    index: int, chunk_root: Hash32,
+                    bls_sig=None) -> RemoteReceipt:
+        return self._receipt(self.rpc.call(
+            "shard_submitVote", codec.enc_bytes(sender), shard_id, period,
+            index, codec.enc_bytes(chunk_root), codec.enc_g1(bls_sig)))
+
+    # dev-mode chain control
+    def fund(self, account: Address20, amount: int) -> None:
+        self.rpc.call("shard_fund", codec.enc_bytes(account), amount)
+
+    def commit(self) -> RemoteBlock:
+        return _dec_block(self.rpc.call("shard_commit"))
+
+    def fast_forward(self, periods: int) -> int:
+        return self.rpc.call("shard_fastForward", periods)
+
+    @staticmethod
+    def _receipt(obj: dict) -> RemoteReceipt:
+        return RemoteReceipt(tx_hash=Hash32(codec.dec_bytes(obj["txHash"])),
+                             status=obj["status"],
+                             block_number=obj["blockNumber"])

@@ -1,0 +1,115 @@
+"""Wire encoding for the mainchain RPC surface.
+
+JSON-RPC 2.0 payload values: addresses/hashes/byte strings as 0x-hex,
+bn256 curve points as hex-int coordinate arrays (G1 = [x, y], G2 =
+[[xa, xb], [ya, yb]], null = infinity/absent), registry entries and
+collation records as plain objects. Deliberately schema-first and
+version-tagged so a non-Python peer can implement the same surface.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from gethsharding_tpu.crypto import bn256
+from gethsharding_tpu.utils.hexbytes import Address20, Hash32
+
+
+def enc_bytes(b: bytes) -> str:
+    return "0x" + bytes(b).hex()
+
+
+def dec_bytes(s: str) -> bytes:
+    return bytes.fromhex(s[2:] if s.startswith("0x") else s)
+
+
+def enc_g1(p: Optional[bn256.G1Point]) -> Optional[list]:
+    return None if p is None else [hex(p[0]), hex(p[1])]
+
+
+def dec_g1(v) -> Optional[bn256.G1Point]:
+    return None if v is None else (int(v[0], 16), int(v[1], 16))
+
+
+def enc_g2(p: Optional[bn256.G2Point]) -> Optional[list]:
+    if p is None:
+        return None
+    x, y = p
+    return [[hex(x.a), hex(x.b)], [hex(y.a), hex(y.b)]]
+
+
+def dec_g2(v) -> Optional[bn256.G2Point]:
+    if v is None:
+        return None
+    (xa, xb), (ya, yb) = v
+    return (bn256.Fp2(int(xa, 16), int(xb, 16)),
+            bn256.Fp2(int(ya, 16), int(yb, 16)))
+
+
+def enc_registry(entry) -> Optional[dict]:
+    if entry is None:
+        return None
+    return {
+        "deregisteredPeriod": entry.deregistered_period,
+        "poolIndex": entry.pool_index,
+        "balance": entry.balance,
+        "deposited": entry.deposited,
+        "blsPubkey": enc_g2(entry.bls_pubkey),
+        "blsPop": enc_g1(entry.bls_pop),
+    }
+
+
+def dec_registry(obj: Optional[dict]):
+    if obj is None:
+        return None
+    from gethsharding_tpu.smc.state_machine import Notary
+
+    return Notary(
+        deregistered_period=obj["deregisteredPeriod"],
+        pool_index=obj["poolIndex"],
+        balance=obj["balance"],
+        deposited=obj["deposited"],
+        bls_pubkey=dec_g2(obj["blsPubkey"]),
+        bls_pop=dec_g1(obj["blsPop"]),
+    )
+
+
+def enc_record(record) -> Optional[dict]:
+    if record is None:
+        return None
+    return {
+        "chunkRoot": enc_bytes(record.chunk_root),
+        "proposer": enc_bytes(record.proposer),
+        "isElected": record.is_elected,
+        "signature": enc_bytes(record.signature),
+        "voteSigs": {str(i): [enc_g1(v.sig), enc_bytes(v.signer)]
+                     for i, v in record.vote_sigs.items()},
+        "voteCount": record.vote_count,
+    }
+
+
+def dec_record(obj: Optional[dict]):
+    if obj is None:
+        return None
+    from gethsharding_tpu.smc.state_machine import CollationRecord, VoteSig
+
+    return CollationRecord(
+        chunk_root=Hash32(dec_bytes(obj["chunkRoot"])),
+        proposer=Address20(dec_bytes(obj["proposer"])),
+        is_elected=obj["isElected"],
+        signature=dec_bytes(obj["signature"]),
+        vote_sigs={int(i): VoteSig(sig=dec_g1(v[0]),
+                                   signer=Address20(dec_bytes(v[1])))
+                   for i, v in obj["voteSigs"].items()},
+        vote_count=obj["voteCount"],
+    )
+
+
+def enc_block(block) -> dict:
+    return {"number": block.number, "hash": enc_bytes(block.hash),
+            "parentHash": enc_bytes(block.parent_hash)}
+
+
+def enc_receipt(receipt) -> dict:
+    return {"txHash": enc_bytes(receipt.tx_hash), "status": receipt.status,
+            "blockNumber": receipt.block_number}

@@ -1,0 +1,245 @@
+"""RPCServer: expose a SimulatedMainchain over JSON-RPC 2.0.
+
+Parity: `rpc/server.go:46` + the IPC codec (`rpc/ipc.go`,
+`rpc/json.go`) — newline-delimited JSON-RPC 2.0 frames over a stream
+socket, one goroutine-equivalent thread per connection, `shard_subscribe`
+push notifications for new heads (the `eth_subscribe` pattern the notary's
+head loop depends on, `sharding/notary/notary.go:33-38`).
+
+SMC reverts map to JSON-RPC error code 3 (geth's revert error code) with
+the revert reason in `message`; the client re-raises them as `SMCRevert`
+so actor-side control flow is identical in- and cross-process.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import socketserver
+import threading
+from typing import Optional
+
+from gethsharding_tpu.rpc import codec
+from gethsharding_tpu.smc.chain import SimulatedMainchain
+from gethsharding_tpu.smc.state_machine import SMCRevert
+from gethsharding_tpu.utils.hexbytes import Address20, Hash32
+
+log = logging.getLogger("rpc.server")
+
+REVERT_CODE = 3
+METHOD_NOT_FOUND = -32601
+INVALID_REQUEST = -32600
+INTERNAL_ERROR = -32603
+
+
+class RPCServer:
+    """Threaded JSON-RPC server over TCP (host, port) — port 0 picks a
+    free one (`server.address` reports the bound endpoint)."""
+
+    def __init__(self, backend: SimulatedMainchain,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.backend = backend
+        self._subscribers: dict = {}  # wfile -> lock
+        self._sub_lock = threading.Lock()
+        server = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self) -> None:
+                server._handle_connection(self)
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._tcp = Server((host, port), Handler)
+        self.address = self._tcp.server_address  # (host, bound_port)
+        self._thread: Optional[threading.Thread] = None
+        self._unsubscribe = backend.subscribe_new_head(self._on_head)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._tcp.serve_forever, daemon=True, name="rpc-server")
+        self._thread.start()
+        log.info("RPC listening on %s:%d", *self.address)
+
+    def stop(self) -> None:
+        if self._unsubscribe is not None:
+            self._unsubscribe()
+        self._tcp.shutdown()
+        self._tcp.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    # -- head push (eth_subscribe newHeads parity) -------------------------
+
+    def _on_head(self, block) -> None:
+        note = (json.dumps({
+            "jsonrpc": "2.0",
+            "method": "shard_subscription",
+            "params": {"subscription": "newHeads",
+                       "result": codec.enc_block(block)},
+        }) + "\n").encode()
+        with self._sub_lock:
+            targets = list(self._subscribers.items())
+        for wfile, lock in targets:
+            try:
+                with lock:
+                    wfile.write(note)
+                    wfile.flush()
+            except OSError:
+                with self._sub_lock:
+                    self._subscribers.pop(wfile, None)
+
+    # -- connection loop ---------------------------------------------------
+
+    def _handle_connection(self, handler) -> None:
+        write_lock = threading.Lock()
+        try:
+            for raw in handler.rfile:
+                raw = raw.strip()
+                if not raw:
+                    continue
+                response = self._dispatch(raw, handler, write_lock)
+                if response is not None:
+                    with write_lock:
+                        handler.wfile.write(
+                            (json.dumps(response) + "\n").encode())
+                        handler.wfile.flush()
+        except (OSError, ValueError):
+            pass
+        finally:
+            with self._sub_lock:
+                self._subscribers.pop(handler.wfile, None)
+
+    def _dispatch(self, raw: bytes, handler, write_lock) -> Optional[dict]:
+        try:
+            req = json.loads(raw)
+        except json.JSONDecodeError:
+            return {"jsonrpc": "2.0", "id": None,
+                    "error": {"code": INVALID_REQUEST, "message": "bad json"}}
+        rid = req.get("id")
+        method = req.get("method", "")
+        params = req.get("params", [])
+        try:
+            if method == "shard_subscribe":
+                with self._sub_lock:
+                    self._subscribers[handler.wfile] = write_lock
+                result = "newHeads"
+            else:
+                fn = getattr(self, "rpc_" + method.replace("shard_", "", 1),
+                             None)
+                if fn is None:
+                    return {"jsonrpc": "2.0", "id": rid,
+                            "error": {"code": METHOD_NOT_FOUND,
+                                      "message": f"unknown method {method}"}}
+                result = fn(*params)
+        except SMCRevert as exc:
+            return {"jsonrpc": "2.0", "id": rid,
+                    "error": {"code": REVERT_CODE, "message": str(exc),
+                              "data": "SMCRevert"}}
+        except Exception as exc:  # noqa: BLE001 - RPC boundary
+            log.exception("rpc %s failed", method)
+            return {"jsonrpc": "2.0", "id": rid,
+                    "error": {"code": INTERNAL_ERROR, "message": str(exc)}}
+        if rid is None:
+            return None  # notification
+        return {"jsonrpc": "2.0", "id": rid, "result": result}
+
+    # -- method surface (shard_* namespace) --------------------------------
+    # views
+
+    def rpc_blockNumber(self):
+        return self.backend.block_number
+
+    def rpc_currentPeriod(self):
+        return self.backend.current_period()
+
+    def rpc_blockByNumber(self, number=None):
+        return codec.enc_block(self.backend.block_by_number(number))
+
+    def rpc_shardCount(self):
+        return self.backend.smc.shard_count
+
+    def rpc_getNotaryInCommittee(self, sender, shard_id):
+        return codec.enc_bytes(self.backend.get_notary_in_committee(
+            Address20(codec.dec_bytes(sender)), shard_id))
+
+    def rpc_notaryRegistry(self, address):
+        return codec.enc_registry(self.backend.notary_registry(
+            Address20(codec.dec_bytes(address))))
+
+    def rpc_collationRecord(self, shard_id, period):
+        return codec.enc_record(self.backend.collation_record(shard_id, period))
+
+    def rpc_lastSubmittedCollation(self, shard_id):
+        return self.backend.last_submitted_collation(shard_id)
+
+    def rpc_lastApprovedCollation(self, shard_id):
+        return self.backend.last_approved_collation(shard_id)
+
+    def rpc_notaryByPoolIndex(self, index):
+        addr = self.backend.notary_by_pool_index(index)
+        return None if addr is None else codec.enc_bytes(addr)
+
+    def rpc_hasVoted(self, shard_id, index):
+        return self.backend.smc.has_voted(shard_id, index)
+
+    def rpc_getVoteCount(self, shard_id):
+        return self.backend.smc.get_vote_count(shard_id)
+
+    def rpc_balanceOf(self, address):
+        return self.backend.balance_of(Address20(codec.dec_bytes(address)))
+
+    def rpc_transactionReceipt(self, tx_hash):
+        receipt = self.backend.transaction_receipt(
+            Hash32(codec.dec_bytes(tx_hash)))
+        return None if receipt is None else codec.enc_receipt(receipt)
+
+    def rpc_verifyPeriodBatch(self, period):
+        return self.backend.verify_period_batch(period)
+
+    # transactions
+
+    def rpc_registerNotary(self, sender, bls_pubkey=None, bls_pop=None):
+        return codec.enc_receipt(self.backend.register_notary(
+            Address20(codec.dec_bytes(sender)),
+            bls_pubkey=codec.dec_g2(bls_pubkey),
+            bls_pop=codec.dec_g1(bls_pop)))
+
+    def rpc_deregisterNotary(self, sender):
+        return codec.enc_receipt(self.backend.deregister_notary(
+            Address20(codec.dec_bytes(sender))))
+
+    def rpc_releaseNotary(self, sender):
+        return codec.enc_receipt(self.backend.release_notary(
+            Address20(codec.dec_bytes(sender))))
+
+    def rpc_addHeader(self, sender, shard_id, period, chunk_root, signature):
+        return codec.enc_receipt(self.backend.add_header(
+            Address20(codec.dec_bytes(sender)), shard_id, period,
+            Hash32(codec.dec_bytes(chunk_root)),
+            codec.dec_bytes(signature)))
+
+    def rpc_submitVote(self, sender, shard_id, period, index, chunk_root,
+                       bls_sig=None):
+        return codec.enc_receipt(self.backend.submit_vote(
+            Address20(codec.dec_bytes(sender)), shard_id, period, index,
+            Hash32(codec.dec_bytes(chunk_root)),
+            bls_sig=codec.dec_g1(bls_sig)))
+
+    # dev-mode chain control (the SimulatedBackend Commit/FastForward
+    # surface, exposed so a test/driver process can steer the chain)
+
+    def rpc_fund(self, address, amount):
+        self.backend.fund(Address20(codec.dec_bytes(address)), amount)
+        return True
+
+    def rpc_commit(self):
+        return codec.enc_block(self.backend.commit())
+
+    def rpc_fastForward(self, periods):
+        self.backend.fast_forward(periods)
+        return self.backend.block_number

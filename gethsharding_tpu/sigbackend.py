@@ -86,6 +86,15 @@ class JaxSigBackend(SigBackend):
         self._recover = jax.jit(secp256k1_jax.ecrecover_batch)
         self._bls = jax.jit(bn256_jax.bls_verify_aggregate_batch)
 
+    @staticmethod
+    def _bucket(n: int) -> int:
+        """Pad batches to power-of-two buckets so the live node compiles a
+        handful of kernel shapes instead of one per distinct batch size."""
+        size = 1
+        while size < n:
+            size *= 2
+        return size
+
     def ecrecover_addresses(self, digests, sigs65):
         import numpy as np
 
@@ -106,12 +115,16 @@ class JaxSigBackend(SigBackend):
                     host_rows.append(i)
                 sigs.append(ecdsa.Signature(r=1, s=1, v=0))  # placeholder
                 valid.append(False)
-        e = self._sec.hashes_to_limbs([bytes(d) for d in digests])
+        pad = self._bucket(n) - n
+        sigs.extend([ecdsa.Signature(r=1, s=1, v=0)] * pad)
+        valid.extend([False] * pad)
+        e = self._sec.hashes_to_limbs(
+            [bytes(d) for d in digests] + [b"\x00" * 32] * pad)
         r, s, v = self._sec.sigs_to_limbs(sigs)
         qx, qy, ok = self._recover(
             jnp.asarray(e), jnp.asarray(r), jnp.asarray(s), jnp.asarray(v),
             jnp.asarray(np.asarray(valid)))
-        pubs = self._sec.limbs_to_pubkeys(qx, qy, ok)
+        pubs = self._sec.limbs_to_pubkeys(qx, qy, ok)[:n]
         out = [ecdsa.pubkey_to_address(p) if p is not None else None
                for p in pubs]
         for i in host_rows:
@@ -127,19 +140,21 @@ class JaxSigBackend(SigBackend):
         import numpy as np
 
         jnp = self._jnp
-        if len(messages) == 0:
+        n = len(messages)
+        if n == 0:
             return []
-        hashes = [bls.hash_to_g1(bytes(m)) for m in messages]
+        pad = self._bucket(n) - n
+        hashes = [bls.hash_to_g1(bytes(m)) for m in messages] + [None] * pad
         hx, hy, hok = self._bn.g1_to_limbs(hashes)
-        sx, sy, sok = self._bn.g1_to_limbs(list(agg_sigs))
-        pkx, pky, pok = self._bn.g2_to_limbs(list(agg_pks))
+        sx, sy, sok = self._bn.g1_to_limbs(list(agg_sigs) + [None] * pad)
+        pkx, pky, pok = self._bn.g2_to_limbs(list(agg_pks) + [None] * pad)
         # infinity signature/key is an outright rejection (scalar parity)
         valid = hok & sok & pok
         out = self._bls(
             jnp.asarray(hx), jnp.asarray(hy), jnp.asarray(sx),
             jnp.asarray(sy), jnp.asarray(pkx), jnp.asarray(pky),
             jnp.asarray(valid))
-        return [bool(b) for b in np.asarray(out)]
+        return [bool(b) for b in np.asarray(out)[:n]]
 
 
 _BACKENDS = {"python": PythonSigBackend, "jax": JaxSigBackend}

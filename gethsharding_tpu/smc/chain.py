@@ -57,6 +57,10 @@ class SimulatedMainchain:
         self._receipts: Dict[Hash32, Receipt] = {}
         self._tx_counter = 0
         self._lock = threading.RLock()
+        # per-period vote log for the batched replay audit
+        # (ops/smc_jax.submit_votes_batch vs the scalar machine): accepted
+        # attempts + the sampling context snapshot + end-of-period state
+        self._vote_audit: Dict[int, dict] = {}
 
     # -- chain mechanics ---------------------------------------------------
 
@@ -98,6 +102,13 @@ class SimulatedMainchain:
                 parent_hash=parent.hash,
             )
             self.blocks.append(block)
+            # a period ends when the pending block number crosses into the
+            # next period: snapshot its end-of-period vote state for the
+            # batched replay audit before any next-period tx can clear it
+            old_pending = block.number
+            plen = self.config.period_length
+            if (old_pending + 1) // plen > old_pending // plen:
+                self._finalize_vote_audit(old_pending // plen)
             subscribers = list(self._head_subscribers)
         for callback in subscribers:
             callback(block)
@@ -147,20 +158,24 @@ class SimulatedMainchain:
     def transaction_receipt(self, tx_hash: Hash32) -> Optional[Receipt]:
         return self._receipts.get(tx_hash)
 
-    def register_notary(self, sender: Address20, value: Optional[int] = None) -> Receipt:
+    def register_notary(self, sender: Address20, value: Optional[int] = None,
+                        bls_pubkey=None, bls_pop=None) -> Receipt:
         with self._lock:
             deposit = self.config.notary_deposit if value is None else value
             if self.balances.get(sender, 0) < deposit:
                 raise SMCRevert("insufficient balance for deposit")
             events_before = len(self.smc.events)
-            self.smc.register_notary(sender, deposit, self.pending_block_number)
+            self.smc.register_notary(sender, deposit, self.pending_block_number,
+                                     bls_pubkey=bls_pubkey, bls_pop=bls_pop)
             self.balances[sender] -= deposit
+            self._mark_pool_churn()
             return self._record(events_before)
 
     def deregister_notary(self, sender: Address20) -> Receipt:
         with self._lock:
             events_before = len(self.smc.events)
             self.smc.deregister_notary(sender, self.pending_block_number)
+            self._mark_pool_churn()
             return self._record(events_before)
 
     def release_notary(self, sender: Address20) -> Receipt:
@@ -179,11 +194,16 @@ class SimulatedMainchain:
             return self._record(events_before)
 
     def submit_vote(self, sender: Address20, shard_id: int, period: int,
-                    index: int, chunk_root: Hash32) -> Receipt:
+                    index: int, chunk_root: Hash32, bls_sig=None) -> Receipt:
         with self._lock:
             events_before = len(self.smc.events)
+            pre_last_approved = (
+                dict(self.smc.last_approved_collation)
+                if period not in self._vote_audit else None)
             self.smc.submit_vote(sender, shard_id, period, index, chunk_root,
-                                 self.pending_block_number)
+                                 self.pending_block_number, bls_sig=bls_sig)
+            self._log_vote(period, sender, shard_id, index, chunk_root,
+                           pre_last_approved)
             return self._record(events_before)
 
     # -- SMC view surface (latest sealed block, like eth_call) ------------
@@ -204,3 +224,160 @@ class SimulatedMainchain:
 
     def last_approved_collation(self, shard_id: int) -> int:
         return self.smc.last_approved_collation.get(shard_id, 0)
+
+    def notary_by_pool_index(self, index: int) -> Optional[Address20]:
+        """Pool slot -> notary address (None for empty/out-of-range slots)."""
+        pool = self.smc.notary_pool
+        return pool[index] if 0 <= index < len(pool) else None
+
+    def has_voted(self, shard_id: int, index: int) -> bool:
+        return self.smc.has_voted(shard_id, index)
+
+    def get_vote_count(self, shard_id: int) -> int:
+        return self.smc.get_vote_count(shard_id)
+
+    def shard_count(self) -> int:
+        return self.smc.shard_count
+
+    # -- batched vote-replay audit ----------------------------------------
+    # The chain logs every ACCEPTED submitVote together with a snapshot of
+    # the sampling context (pool, sample size, period blockhash) taken at
+    # the period's first vote, and the end-of-period vote state at the
+    # period boundary. `verify_period_batch` replays the log through the
+    # fixed-shape kernel `ops/smc_jax.submit_votes_batch` and checks the
+    # result is byte-identical with what the scalar machine computed —
+    # in-node failure detection for the batch path (SURVEY.md §5.3).
+
+    def _mark_pool_churn(self) -> None:
+        pending_period = self.pending_block_number // self.config.period_length
+        entry = self._vote_audit.get(pending_period)
+        if entry is not None:
+            # pool mutated after the snapshot: sampling context no longer
+            # reproducible for this period; skip its replay check
+            entry["churned"] = True
+
+    def _log_vote(self, period: int, sender: Address20, shard_id: int,
+                  index: int, chunk_root: Hash32, pre_last_approved) -> None:
+        entry = self._vote_audit.get(period)
+        if entry is None:
+            entry = {
+                "attempts": [],
+                "churned": False,
+                # post-update value: SMC.submit_vote just ran
+                # _update_notary_sample_size for this period
+                "sample_size": self.smc.current_period_notary_sample_size,
+                "pool": [bytes(a) if a is not None else None
+                         for a in self.smc.notary_pool],
+                "blockhash": bytes(self.blockhash(
+                    period * self.config.period_length - 1)),
+                "pre_last_approved": pre_last_approved or {},
+                "final": None,
+            }
+            self._vote_audit[period] = entry
+        reg = self.smc.notary_registry[sender]
+        entry["attempts"].append({
+            "shard": shard_id,
+            "index": index,
+            "pool_index": reg.pool_index,
+            "sender": bytes(sender),
+            "chunk_root": bytes(chunk_root),
+        })
+
+    def _finalize_vote_audit(self, period: int) -> None:
+        entry = self._vote_audit.get(period)
+        if entry is not None and entry["final"] is None:
+            shards = {a["shard"] for a in entry["attempts"]}
+            entry["final"] = {
+                "words": {s: self.smc.current_vote.get(s, 0) for s in shards},
+                "elected": {
+                    s: bool(self.smc.collation_records[(s, period)].is_elected)
+                    for s in shards
+                    if (s, period) in self.smc.collation_records},
+                "last_approved": {
+                    s: self.smc.last_approved_collation.get(s, 0)
+                    for s in shards},
+            }
+        # bound memory: keep a few recent periods only
+        for p in [p for p in self._vote_audit if p < period - 8]:
+            del self._vote_audit[p]
+
+    def verify_period_batch(self, period: int) -> Optional[bool]:
+        """Replay `period`'s accepted votes through the batch kernel and
+        compare with the scalar outcome. True = byte-identical, False =
+        divergence, None = not auditable (no votes, pool churn mid-period,
+        or period not yet finalized)."""
+        with self._lock:
+            entry = self._vote_audit.get(period)
+            if (entry is None or entry["churned"] or not entry["attempts"]
+                    or entry["final"] is None):
+                return None
+            attempts = list(entry["attempts"])
+            records = {
+                s: self.smc.collation_records.get((s, period))
+                for s in range(self.smc.shard_count)
+            }
+            snapshot = dict(entry)
+
+        import numpy as np
+        import jax.numpy as jnp
+
+        from gethsharding_tpu.ops import smc_jax
+
+        s_count = self.smc.shard_count
+        committee = self.config.committee_size
+        last_sub = np.zeros(s_count, np.int32)
+        roots = np.zeros((s_count, 32), np.uint8)
+        last_appr = np.zeros(s_count, np.int32)
+        for s in range(s_count):
+            last_appr[s] = snapshot["pre_last_approved"].get(s, 0)
+            rec = records[s]
+            if rec is not None:
+                last_sub[s] = period
+                roots[s] = np.frombuffer(bytes(rec.chunk_root), np.uint8)
+        state = smc_jax.init_vote_state(s_count, committee)._replace(
+            last_submitted=jnp.asarray(last_sub),
+            chunk_root=jnp.asarray(roots),
+            last_approved=jnp.asarray(last_appr),
+        )
+        pool = snapshot["pool"]
+        pool_addr = np.zeros((max(len(pool), 1), 20), np.uint8)
+        for i, addr in enumerate(pool):
+            if addr is not None:
+                pool_addr[i] = np.frombuffer(addr, np.uint8)
+        n_att = len(attempts)
+        att = smc_jax.VoteAttempts(
+            shard=jnp.asarray([a["shard"] for a in attempts], jnp.int32),
+            index=jnp.asarray([a["index"] for a in attempts], jnp.int32),
+            pool_index=jnp.asarray([a["pool_index"] for a in attempts],
+                                   jnp.int32),
+            sender=jnp.asarray(np.stack(
+                [np.frombuffer(a["sender"], np.uint8) for a in attempts])),
+            chunk_root=jnp.asarray(np.stack(
+                [np.frombuffer(a["chunk_root"], np.uint8) for a in attempts])),
+            deposited=jnp.ones(n_att, bool),
+            valid=jnp.ones(n_att, bool),
+        )
+        new_state, accepted = smc_jax.submit_votes_batch(
+            state, jnp.asarray(pool_addr), att,
+            period=jnp.int32(period),
+            blockhash=jnp.asarray(
+                np.frombuffer(snapshot["blockhash"], np.uint8)),
+            sample_size=jnp.int32(snapshot["sample_size"]),
+            committee_size=committee,
+            quorum_size=self.config.quorum_size,
+        )
+        if not bool(np.asarray(accepted).all()):
+            return False  # a scalar-accepted vote was rejected by the batch
+        words = smc_jax.export_vote_word(
+            np.asarray(new_state.has_voted), np.asarray(new_state.vote_count))
+        final = snapshot["final"]
+        elected = np.asarray(new_state.is_elected)
+        approved = np.asarray(new_state.last_approved)
+        for s in sorted({a["shard"] for a in attempts}):
+            if words[s] != final["words"].get(s, 0):
+                return False
+            if bool(elected[s]) != final["elected"].get(s, False):
+                return False
+            if int(approved[s]) != final["last_approved"].get(s, 0):
+                return False
+        return True

@@ -27,6 +27,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
+from gethsharding_tpu.crypto import bn256
 from gethsharding_tpu.crypto.keccak import keccak256
 from gethsharding_tpu.params import Config, DEFAULT_CONFIG
 from gethsharding_tpu.utils.hexbytes import Address20, Hash32
@@ -38,24 +39,64 @@ class SMCRevert(Exception):
     """Equivalent of a failed Solidity `require` — the tx has no effect."""
 
 
+def vote_digest(shard_id: int, period: int, chunk_root: Hash32) -> bytes:
+    """The message a notary BLS-signs when voting: domain-separated
+    (shard, period, chunkRoot) tuple. Same-message aggregation per shard —
+    every committee member of a shard signs the identical digest, so the
+    period pipeline verifies ONE aggregate pair per shard.
+
+    (TPU-native extension over `sharding_manager.sol:198-221`, where vote
+    authenticity rides only on the tx sender; here votes additionally
+    carry an aggregatable signature so validators can batch-verify whole
+    periods in one device dispatch — the north-star hot loop.)
+    """
+    return keccak256(
+        b"gethsharding-vote-v1/"
+        + shard_id.to_bytes(32, "big")
+        + period.to_bytes(32, "big")
+        + bytes(chunk_root)
+    )
+
+
 @dataclass
 class Notary:
-    """Per-notary registry entry (.sol:11-16)."""
+    """Per-notary registry entry (.sol:11-16), extended with the BLS vote
+    pubkey registered alongside the deposit (PoP retained for batch
+    verification by validators — rogue-key defense)."""
 
     deregistered_period: int = 0
     pool_index: int = 0
     balance: int = 0
     deposited: bool = False
+    bls_pubkey: Optional[bn256.G2Point] = None
+    bls_pop: Optional[bn256.G1Point] = None
+
+
+@dataclass
+class VoteSig:
+    """An accepted vote's BLS signature with signer attribution, recorded
+    at vote time so the period audit resolves the voter's registered
+    pubkey WITHOUT consulting the live pool (pool slots can be freed and
+    reused between the vote and the audit)."""
+
+    sig: bn256.G1Point
+    signer: Address20
 
 
 @dataclass
 class CollationRecord:
-    """Per-(shard, period) collation header record (.sol:18-23)."""
+    """Per-(shard, period) collation header record (.sol:18-23), extended
+    with the accepted votes' BLS signatures keyed by committee bitfield
+    index — the persistent artifact the batched period audit verifies —
+    and a persistent accepted-vote counter (the packed word's low byte is
+    transient: addHeader clears it next period, .sol:187)."""
 
     chunk_root: Hash32 = field(default_factory=Hash32)
     proposer: Address20 = field(default_factory=Address20)
     is_elected: bool = False
     signature: bytes = b""
+    vote_sigs: Dict[int, VoteSig] = field(default_factory=dict)
+    vote_count: int = 0
 
 
 @dataclass
@@ -199,13 +240,20 @@ class SMC:
     # -- transactions ------------------------------------------------------
 
     def register_notary(self, sender: Address20, value: int,
-                        block_number: int) -> None:
-        """registerNotary (.sol:103-133)."""
+                        block_number: int,
+                        bls_pubkey: Optional[bn256.G2Point] = None,
+                        bls_pop: Optional[bn256.G1Point] = None) -> None:
+        """registerNotary (.sol:103-133). `bls_pubkey`/`bls_pop` register
+        the notary's aggregatable vote key; when a pubkey is supplied a PoP
+        must accompany it (its pairing check is deferred to the batched
+        validator audit, keeping registration scalar-crypto-free)."""
         entry = self.notary_registry.get(sender)
         if entry is not None and entry.deposited:
             raise SMCRevert("notary already deposited")
         if value != self.config.notary_deposit:
             raise SMCRevert("deposit must be exactly NOTARY_DEPOSIT")
+        if bls_pubkey is not None and bls_pop is None:
+            raise SMCRevert("BLS pubkey requires a proof of possession")
 
         self._update_notary_sample_size(block_number)
 
@@ -218,7 +266,8 @@ class SMC:
         self.notary_pool_length += 1
 
         self.notary_registry[sender] = Notary(
-            deregistered_period=0, pool_index=index, balance=value, deposited=True
+            deregistered_period=0, pool_index=index, balance=value,
+            deposited=True, bls_pubkey=bls_pubkey, bls_pop=bls_pop,
         )
         self.balance += value
 
@@ -303,8 +352,15 @@ class SMC:
         )
 
     def submit_vote(self, sender: Address20, shard_id: int, period: int,
-                    index: int, chunk_root: Hash32, block_number: int) -> None:
-        """submitVote (.sol:198-221)."""
+                    index: int, chunk_root: Hash32, block_number: int,
+                    bls_sig: Optional[bn256.G1Point] = None) -> None:
+        """submitVote (.sol:198-221), extended: a notary registered with a
+        BLS pubkey must attach its signature over
+        `vote_digest(shard, period, chunkRoot)`. Authenticity within a tx
+        still rides on the sender (reference parity); the stored signature
+        is the artifact the batched period audit verifies in one device
+        dispatch — an invalid one is detected there (and in a slashing
+        design would forfeit the deposit)."""
         if not (0 <= shard_id < self.shard_count):
             raise SMCRevert("shard id out of range")
         if period != self._period(block_number):
@@ -319,12 +375,26 @@ class SMC:
         entry = self.notary_registry.get(sender)
         if entry is None or not entry.deposited:
             raise SMCRevert("sender is not a deposited notary")
+        if entry.bls_pubkey is not None:
+            if bls_sig is None:
+                raise SMCRevert("vote must carry a BLS signature")
+            # the reference contract leaves _index unbound to the sender
+            # (.sol:198-221 checks only range + hasVoted); for SIGNED votes
+            # the index is the attribution key, so it must be the sender's
+            # own pool slot — otherwise a voter could burn another slot's
+            # bit and poison the audit's signer resolution
+            if index != entry.pool_index:
+                raise SMCRevert(
+                    "signed vote index must be the sender's pool index")
         if self.has_voted(shard_id, index):
             raise SMCRevert("notary already voted at this index")
         if self.get_notary_in_committee(sender, shard_id, block_number) != sender:
             raise SMCRevert("sender is not the sampled committee member")
 
         self._cast_vote(shard_id, index)
+        record.vote_count += 1
+        if bls_sig is not None:
+            record.vote_sigs[index] = VoteSig(sig=bls_sig, signer=sender)
         vote_count = self.get_vote_count(shard_id)
         if vote_count >= self.config.quorum_size:
             self.last_approved_collation[shard_id] = period

@@ -10,8 +10,30 @@ must run before any backend init, hence at conftest import time.
 import sys
 from pathlib import Path
 
+import pytest
+
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
 from gethsharding_tpu.parallel.virtual import force_virtual_cpu_devices
 
 force_virtual_cpu_devices(8)
+
+# Test tiers: everything in these modules compiles the heavyweight batched
+# kernels (pairing Miller loops, 256-step recovery ladders) — minutes of
+# XLA:CPU compile when the persistent cache is cold. They are auto-marked
+# `slow`; the fast tier (`pytest -m "not slow"`) stays green in <60s cold.
+_SLOW_MODULES = {
+    "test_bn256_jax",
+    "test_secp256k1_jax",
+    "test_sigbackend",
+    "test_graft_entry",
+    "test_period_pipeline",
+    "test_end_to_end",
+    "test_limb",  # the Fermat-inversion pow chains dominate its compiles
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.module.__name__ in _SLOW_MODULES:
+            item.add_marker(pytest.mark.slow)

@@ -17,7 +17,7 @@ import pytest
 
 from gethsharding_tpu.crypto import bn256 as ref
 from gethsharding_tpu.ops import bn256_jax as k
-from gethsharding_tpu.ops.limb import ints_to_limbs
+from gethsharding_tpu.ops.limb import NLIMBS, ints_to_limbs
 
 # The full Miller-loop/final-exponentiation kernels take ~20-90 s each to
 # compile on XLA:CPU (near-instant on repeat runs via the persistent cache
@@ -41,7 +41,7 @@ def _rand_fp12(rng) -> ref.Fp12:
 
 def _fp12_to_arr(x: ref.Fp12) -> np.ndarray:
     """Scalar Fp12 -> the kernel's w-basis (6, 2, 22) layout."""
-    tower = np.zeros((2, 3, 2, 22), np.int32)
+    tower = np.zeros((2, 3, 2, NLIMBS), np.int32)
     for h, c6 in enumerate((x.c0, x.c1)):
         for l, c2 in enumerate((c6.c0, c6.c1, c6.c2)):
             tower[h, l, 0] = ints_to_limbs([c2.a])[0]

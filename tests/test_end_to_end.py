@@ -129,3 +129,74 @@ def test_multi_shard_lockstep_two_periods():
         notary_node.stop()
         for node in proposers:
             node.stop()
+
+
+def test_period_audit_one_batched_dispatch():
+    """The re-architected hot loop, in the RUNNING node: a multi-shard
+    period's committee votes (real BLS signatures produced by the voting
+    path) are verified by the notary in ONE sig-backend dispatch at the
+    next period boundary, the quorum outcome matches the SMC byte-for-byte,
+    and the chain's vote log replays cleanly through
+    ops/smc_jax.submit_votes_batch."""
+    from gethsharding_tpu.crypto import bn256 as bls
+
+    n_shards = 3
+    config = Config(quorum_size=1)
+    backend = SimulatedMainchain(config=config)
+    hub = Hub()
+    proposers = [
+        ShardNode(actor="proposer", shard_id=s, config=config,
+                  backend=backend, hub=hub, txpool_interval=None)
+        for s in range(n_shards)
+    ]
+    notary_node = ShardNode(actor="notary", shard_id=0, config=config,
+                            backend=backend, hub=hub, deposit=True,
+                            sig_backend="jax")
+    backend.fund(notary_node.client.account(), 2000 * ETHER)
+    for node in proposers:
+        node.start()
+    notary_node.start()
+    try:
+        notary = notary_node.service(Notary)
+        backend.fast_forward(1)
+        period = backend.current_period()
+        for s, node in enumerate(proposers):
+            node.service(TXPool).submit(
+                Transaction(nonce=period, payload=bytes([s])))
+        assert wait_until(
+            lambda: all(backend.last_submitted_collation(s) == period
+                        for s in range(n_shards)))
+        for _ in range(config.period_length - 1):
+            backend.commit()
+            if all(backend.last_approved_collation(s) == period
+                   for s in range(n_shards)):
+                break
+            time.sleep(0.05)
+        assert all(backend.last_approved_collation(s) == period
+                   for s in range(n_shards)), notary_node.errors()
+        # every vote carried a real BLS signature
+        for s in range(n_shards):
+            assert backend.collation_record(s, period).vote_sigs
+
+        # crossing into the next period triggers the in-node audit:
+        # one batched pairing dispatch over all shards + the vote-log
+        # replay through the fixed-shape SMC kernel
+        backend.fast_forward(1)
+        assert wait_until(lambda: notary.audits_run >= 1, timeout=120.0), \
+            notary_node.errors()
+        assert notary.audit_mismatches == 0
+        assert notary.aggregate_sigs_verified >= n_shards
+        assert backend.verify_period_batch(period) is True
+
+        # a forged stored signature must be caught by the audit
+        record = backend.collation_record(0, period)
+        idx = next(iter(record.vote_sigs))
+        vote = record.vote_sigs[idx]
+        record.vote_sigs[idx] = type(vote)(
+            sig=bls.g1_add(vote.sig, bls.G1_GEN), signer=vote.signer)
+        assert notary.audit_period(period) is False
+        assert notary.audit_mismatches >= 1
+    finally:
+        notary_node.stop()
+        for node in proposers:
+            node.stop()

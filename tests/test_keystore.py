@@ -1,0 +1,121 @@
+"""Encrypted keystore tests: V3 round-trip, wrong password, identity
+persistence across restarts (parity: accounts/keystore passphrase_test.go
+patterns; light scrypt params for speed)."""
+
+import json
+
+import pytest
+
+from gethsharding_tpu.crypto import secp256k1
+from gethsharding_tpu.mainchain.keystore import (
+    LIGHT_SCRYPT_N,
+    LIGHT_SCRYPT_P,
+    Keystore,
+    KeystoreError,
+    decrypt_key,
+    encrypt_key,
+)
+
+
+def light_store(tmp_path):
+    return Keystore(tmp_path / "keystore", scrypt_n=LIGHT_SCRYPT_N,
+                    scrypt_p=LIGHT_SCRYPT_P)
+
+
+def test_encrypt_decrypt_round_trip():
+    priv = 0xDEADBEEF1234
+    obj = encrypt_key(priv, "pass-phrase", scrypt_n=LIGHT_SCRYPT_N,
+                      scrypt_p=LIGHT_SCRYPT_P)
+    assert obj["version"] == 3
+    assert obj["crypto"]["cipher"] == "aes-128-ctr"
+    assert obj["address"] == secp256k1.priv_to_address(priv).hex_str[2:]
+    assert decrypt_key(obj, "pass-phrase") == priv
+
+
+def test_wrong_password_rejected_by_mac():
+    obj = encrypt_key(7, "right", scrypt_n=LIGHT_SCRYPT_N,
+                      scrypt_p=LIGHT_SCRYPT_P)
+    with pytest.raises(KeystoreError, match="could not decrypt"):
+        decrypt_key(obj, "wrong")
+
+
+def test_pbkdf2_kdf_supported():
+    import hashlib
+    import secrets as s
+
+    # construct a pbkdf2 V3 file by hand (geth's alternate KDF)
+    priv = 0x1234
+    password, salt, iv = "pw", s.token_bytes(32), s.token_bytes(16)
+    derived = hashlib.pbkdf2_hmac("sha256", password.encode(), salt, 1024, 32)
+    from gethsharding_tpu.crypto.keccak import keccak256
+    from gethsharding_tpu.mainchain.keystore import _aes128_ctr
+
+    ciphertext = _aes128_ctr(derived[:16], iv, priv.to_bytes(32, "big"))
+    obj = {
+        "address": secp256k1.priv_to_address(priv).hex_str[2:],
+        "crypto": {
+            "cipher": "aes-128-ctr",
+            "ciphertext": ciphertext.hex(),
+            "cipherparams": {"iv": iv.hex()},
+            "kdf": "pbkdf2",
+            "kdfparams": {"dklen": 32, "c": 1024, "prf": "hmac-sha256",
+                          "salt": salt.hex()},
+            "mac": keccak256(derived[16:32] + ciphertext).hex(),
+        },
+        "id": "x", "version": 3,
+    }
+    assert decrypt_key(obj, password) == priv
+
+
+def test_store_unlock_and_accounts_listing(tmp_path):
+    ks = light_store(tmp_path)
+    stored = ks.store(42, "hunter2")
+    assert ks.accounts()[0].address == stored.address
+    assert ks.unlock(stored.address, "hunter2") == 42
+    with pytest.raises(KeystoreError):
+        ks.unlock(stored.address, "wrong")
+    # file content is valid V3 JSON with restrictive permissions
+    obj = json.loads(stored.path.read_text())
+    assert obj["version"] == 3
+
+
+def test_identity_survives_restart(tmp_path):
+    ks = light_store(tmp_path)
+    priv1 = ks.load_or_create("node-password")
+    # "restart": a fresh Keystore over the same directory
+    ks2 = light_store(tmp_path)
+    priv2 = ks2.load_or_create("node-password")
+    assert priv1 == priv2
+    assert (secp256k1.priv_to_address(priv1)
+            == secp256k1.priv_to_address(priv2))
+
+
+def test_corrupt_files_skipped(tmp_path):
+    ks = light_store(tmp_path)
+    ks.store(9, "pw")
+    (tmp_path / "keystore" / "garbage").write_text("not json")
+    assert len(ks.accounts()) == 1
+
+
+def test_node_identity_persists_across_restart(tmp_path, monkeypatch):
+    """A ShardNode with --datadir/--password keeps its address (and thus its
+    notary registration) across a restart."""
+    import gethsharding_tpu.mainchain.keystore as ksmod
+    from gethsharding_tpu.node.backend import ShardNode
+    from gethsharding_tpu.smc.chain import SimulatedMainchain
+
+    # light scrypt for test speed
+    monkeypatch.setattr(ksmod, "STANDARD_SCRYPT_N", LIGHT_SCRYPT_N)
+    monkeypatch.setattr(ksmod, "STANDARD_SCRYPT_P", LIGHT_SCRYPT_P)
+
+    backend = SimulatedMainchain()
+    node = ShardNode(actor="observer", backend=backend,
+                     data_dir=str(tmp_path), password="pw")
+    addr1 = node.client.account()
+    node2 = ShardNode(actor="observer", backend=backend,
+                      data_dir=str(tmp_path), password="pw")
+    assert node2.client.account() == addr1
+
+    with pytest.raises(KeystoreError):
+        ShardNode(actor="observer", backend=backend,
+                  data_dir=str(tmp_path), password="wrong")

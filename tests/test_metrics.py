@@ -1,0 +1,91 @@
+"""Metrics registry tests (parity: metrics/metrics.go registry semantics,
+scoped to the native counters/gauges/timers the framework instruments)."""
+
+import time
+
+from gethsharding_tpu.metrics import (
+    Counter,
+    Gauge,
+    PeriodicReporter,
+    Registry,
+    Timer,
+)
+
+
+def test_counter_and_rate():
+    c = Counter()
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    assert c.rate() > 0
+    snap = c.snapshot()
+    assert snap["type"] == "counter" and snap["count"] == 5
+
+
+def test_gauge():
+    g = Gauge()
+    g.set(3.5)
+    assert g.value == 3.5
+
+
+def test_timer_percentiles_and_context():
+    t = Timer()
+    for ms in (1, 2, 3, 4, 100):
+        t.observe(ms / 1000)
+    assert t.count == 5
+    assert 0.001 <= t.percentile(0.5) <= 0.004
+    assert t.percentile(0.99) == 0.1
+    with t.time():
+        time.sleep(0.01)
+    assert t.count == 6
+
+
+def test_timer_ring_buffer_recent_window():
+    t = Timer(reservoir=4)
+    for v in (1.0, 1.0, 1.0, 1.0, 0.001, 0.001, 0.001, 0.001):
+        t.observe(v)
+    # old 1.0s samples were overwritten by the recent window
+    assert t.percentile(0.99) == 0.001
+    assert t.count == 8
+
+
+def test_registry_get_or_register_and_snapshot():
+    r = Registry()
+    c1 = r.counter("a/ops")
+    c2 = r.counter("a/ops")
+    assert c1 is c2
+    r.timer("a/latency").observe(0.5)
+    snap = r.snapshot()
+    assert set(snap) == {"a/ops", "a/latency"}
+    assert snap["a/latency"]["p50_s"] == 0.5
+
+
+def test_periodic_reporter_logs(caplog):
+    import logging
+
+    r = Registry()
+    r.counter("x").inc()
+    reporter = PeriodicReporter(registry=r, interval=0.05,
+                                logger=logging.getLogger("test-metrics"))
+    with caplog.at_level(logging.INFO, logger="test-metrics"):
+        reporter.start()
+        time.sleep(0.2)
+        reporter.stop()
+    assert any("x" in rec.message for rec in caplog.records)
+
+
+def test_notary_instruments_baseline_metrics():
+    """The notary registers the two BASELINE metrics on the default
+    registry (sig-verifs counter + validate-latency timer)."""
+    from gethsharding_tpu.metrics import DEFAULT_REGISTRY
+    from gethsharding_tpu.actors.notary import Notary
+    from gethsharding_tpu.core.shard import Shard
+    from gethsharding_tpu.db.kv import MemoryKV
+    from gethsharding_tpu.mainchain.client import SMCClient
+
+    client = SMCClient()
+    notary = Notary(client=client,
+                    shard=Shard(shard_id=0, shard_db=MemoryKV()))
+    assert DEFAULT_REGISTRY.get("notary/aggregate_sig_verifications") is not None
+    assert DEFAULT_REGISTRY.get("notary/validate_latency") is not None
+    assert notary.m_votes is DEFAULT_REGISTRY.get("notary/votes_submitted")

@@ -1,0 +1,144 @@
+"""RPC boundary tests: in-process server/client round-trips, revert
+propagation, head subscriptions — and the flagship cross-process test:
+the full proposer -> notary period pipeline with the chain in a SEPARATE
+OS PROCESS reached only over the wire (the reference's topology,
+`sharding/mainchain/utils.go:17-22`)."""
+
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from gethsharding_tpu.actors import Notary, Proposer, TXPool
+from gethsharding_tpu.core.types import Transaction
+from gethsharding_tpu.node.backend import ShardNode
+from gethsharding_tpu.p2p.service import Hub
+from gethsharding_tpu.params import Config, ETHER
+from gethsharding_tpu.rpc import RemoteMainchain, RPCServer
+from gethsharding_tpu.smc.chain import SimulatedMainchain
+from gethsharding_tpu.smc.state_machine import SMCRevert
+from gethsharding_tpu.utils.hexbytes import Address20
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def wait_until(predicate, timeout=10.0, step=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(step)
+    return predicate()
+
+
+@pytest.fixture()
+def rpc_pair():
+    backend = SimulatedMainchain(config=Config(quorum_size=1))
+    server = RPCServer(backend)
+    server.start()
+    remote = RemoteMainchain.dial(*server.address)
+    yield backend, remote
+    remote.close()
+    server.stop()
+
+
+def test_views_round_trip(rpc_pair):
+    backend, remote = rpc_pair
+    assert remote.block_number == 0
+    assert remote.shard_count() == backend.smc.shard_count
+    backend.commit()
+    assert remote.block_number == 1
+    block = remote.block_by_number(1)
+    assert bytes(block.hash) == bytes(backend.blocks[1].hash)
+    assert remote.collation_record(0, 1) is None
+
+
+def test_transactions_and_revert(rpc_pair):
+    backend, remote = rpc_pair
+    addr = Address20(b"\x11" * 20)
+    remote.fund(addr, 2000 * ETHER)
+    assert remote.balance_of(addr) == 2000 * ETHER
+    receipt = remote.register_notary(addr)
+    assert receipt.status == 1
+    entry = remote.notary_registry(addr)
+    assert entry.deposited and entry.pool_index == 0
+    # second deposit reverts — and arrives as SMCRevert, not a generic error
+    with pytest.raises(SMCRevert, match="already deposited"):
+        remote.register_notary(addr)
+    assert remote.transaction_receipt(receipt.tx_hash).status == 1
+
+
+def test_head_subscription_pushes(rpc_pair):
+    backend, remote = rpc_pair
+    seen = []
+    remote.subscribe_new_head(lambda b: seen.append(b.number))
+    backend.commit()
+    backend.commit()
+    assert wait_until(lambda: len(seen) >= 2)
+    assert seen[:2] == [1, 2]
+
+
+def test_full_period_pipeline_cross_process(tmp_path):
+    """test_end_to_end's period pipeline with the mainchain in its own OS
+    process: proposer + notary live here, the chain and SMC live in the
+    child, every interaction crosses the JSON-RPC wire."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "gethsharding_tpu.rpc.chain_server",
+         "--periodlength", "5", "--quorum", "1", "--runtime", "120"],
+        cwd=REPO_ROOT, stdout=subprocess.PIPE, text=True,
+    )
+    try:
+        endpoint = json.loads(proc.stdout.readline())
+        config = Config(quorum_size=1)
+        chain_ctl = RemoteMainchain.dial(endpoint["host"], endpoint["port"])
+        hub = Hub()
+        shard_id = 2
+
+        proposer_node = ShardNode(
+            actor="proposer", shard_id=shard_id, config=config,
+            backend=RemoteMainchain.dial(endpoint["host"], endpoint["port"]),
+            hub=hub, txpool_interval=None)
+        notary_node = ShardNode(
+            actor="notary", shard_id=shard_id, config=config,
+            backend=RemoteMainchain.dial(endpoint["host"], endpoint["port"]),
+            hub=hub, deposit=True)
+        chain_ctl.fund(notary_node.client.account(), 2000 * ETHER)
+
+        proposer_node.start()
+        notary_node.start()
+        try:
+            notary = notary_node.service(Notary)
+            assert notary.is_account_in_notary_pool()
+
+            chain_ctl.fast_forward(1)
+            period = chain_ctl.current_period()
+            proposer_node.service(TXPool).submit(
+                Transaction(nonce=1, payload=b"cross-process tx"))
+            assert wait_until(
+                lambda: proposer_node.service(Proposer).collations_proposed >= 1
+            ), notary_node.errors() + proposer_node.errors()
+            assert chain_ctl.last_submitted_collation(shard_id) == period
+
+            approved = False
+            for _ in range(config.period_length - 1):
+                chain_ctl.commit()
+                if wait_until(
+                        lambda: chain_ctl.last_approved_collation(shard_id)
+                        == period, timeout=3.0):
+                    approved = True
+                    break
+            assert approved, notary_node.errors() + proposer_node.errors()
+            record = chain_ctl.collation_record(shard_id, period)
+            assert record.is_elected is True
+            assert record.vote_sigs  # the BLS-signed vote crossed the wire
+            assert wait_until(lambda: notary.canonical_set >= 1, timeout=5.0)
+        finally:
+            notary_node.stop()
+            proposer_node.stop()
+            chain_ctl.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
