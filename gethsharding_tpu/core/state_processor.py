@@ -1,0 +1,156 @@
+"""Phase-1 shard state replay: the deterministic transition engine.
+
+Parity: `core/state_processor.go:56-88` (Process / ApplyTransaction) and
+`core/state_transition.go:131,183` (preCheck -> buyGas -> intrinsic gas ->
+value transfer), scoped to phase-1 semantics — nonce/balance/intrinsic-gas
+accounting with sender recovery (`core/types/transaction_signing.go`), no
+EVM execution (the sharding phase-1 contract: "no state execution on
+shards", sharding/README.md). Contract creation (to=None) is out of
+phase-1 scope and rejected.
+
+Check order mirrors geth's TransitionDb exactly so acceptance statuses are
+bit-compatible: (1) sender recovery, (2) nonce equality, (3) buy gas
+(balance >= gas_limit*gas_price), (4) intrinsic gas <= gas_limit,
+(5) value transfer (post-buy balance >= value). Any failure rejects the
+whole transaction with no state change (phase-1 has no partial execution,
+so a failed tx burns nothing).
+
+The state commitment (`ShardState.root`) is keccak256 over the accounts
+in ascending address order, each row addr(20) || nonce_be(8) ||
+balance_be(32) — a flat, fixed-shape commitment the batched device kernel
+(`ops/replay_jax.py`) reproduces byte-identically; the MPT-rooted variant
+of `core/trie.py` remains available for header chunk roots.
+
+This scalar engine is the differential-testing twin of the vmapped device
+replay (BASELINE.md config 4).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from gethsharding_tpu.core.types import Transaction
+from gethsharding_tpu.crypto import secp256k1
+from gethsharding_tpu.crypto.keccak import keccak256
+from gethsharding_tpu.utils.hexbytes import Address20, Hash32
+
+# gas cost model (params/protocol_params.go, geth 1.8 / homestead)
+GAS_TX = 21000
+GAS_TXDATA_ZERO = 4
+GAS_TXDATA_NONZERO = 68
+
+MAX_U256 = (1 << 256) - 1
+
+
+def intrinsic_gas(payload: bytes) -> int:
+    """TxGas + per-byte data gas (state_transition.go IntrinsicGas)."""
+    nonzero = sum(1 for b in payload if b)
+    return (GAS_TX + GAS_TXDATA_NONZERO * nonzero
+            + GAS_TXDATA_ZERO * (len(payload) - nonzero))
+
+
+def recover_sender(tx: Transaction) -> Optional[Address20]:
+    """Homestead sender recovery: v = 27 + parity over sig_hash."""
+    if tx.v not in (27, 28):
+        return None
+    try:
+        sig = secp256k1.Signature(r=tx.r, s=tx.s, v=tx.v - 27)
+        return secp256k1.ecrecover_address(bytes(tx.sig_hash()), sig)
+    except (ValueError, AssertionError):
+        return None
+
+
+def sign_transaction(tx: Transaction, priv: int) -> Transaction:
+    """Sign in place of the keystore path (homestead v = 27 + parity)."""
+    sig = secp256k1.sign(bytes(tx.sig_hash()), priv)
+    return Transaction(
+        nonce=tx.nonce, gas_price=tx.gas_price, gas_limit=tx.gas_limit,
+        to=tx.to, value=tx.value, payload=tx.payload,
+        v=27 + sig.v, r=sig.r, s=sig.s,
+    )
+
+
+@dataclass
+class AccountState:
+    nonce: int = 0
+    balance: int = 0
+
+
+@dataclass
+class Receipt:
+    status: int              # 1 = applied, 0 = rejected (no state change)
+    gas_used: int
+    sender: Optional[Address20]
+
+
+class ShardState:
+    """Flat account states with a canonical keccak commitment."""
+
+    def __init__(self, accounts: Optional[Dict[Address20, AccountState]] = None):
+        self.accounts: Dict[Address20, AccountState] = dict(accounts or {})
+
+    def get(self, address: Address20) -> AccountState:
+        account = self.accounts.get(address)
+        if account is None:
+            account = AccountState()
+            self.accounts[address] = account
+        return account
+
+    def root(self) -> Hash32:
+        blob = b"".join(
+            bytes(addr) + acct.nonce.to_bytes(8, "big")
+            + acct.balance.to_bytes(32, "big")
+            for addr, acct in sorted(self.accounts.items(),
+                                     key=lambda kv: bytes(kv[0]))
+        )
+        return Hash32(keccak256(blob))
+
+
+def apply_transaction(state: ShardState, tx: Transaction,
+                      coinbase: Address20) -> Receipt:
+    """One phase-1 state transition (ApplyTransaction parity, see module
+    docstring for the check order)."""
+    sender_addr = recover_sender(tx)
+    if sender_addr is None or tx.to is None:
+        return Receipt(status=0, gas_used=0, sender=sender_addr)
+    sender = state.get(sender_addr)
+    if tx.nonce != sender.nonce:
+        return Receipt(status=0, gas_used=0, sender=sender_addr)
+    gas_cost = tx.gas_limit * tx.gas_price
+    if sender.balance < gas_cost:
+        return Receipt(status=0, gas_used=0, sender=sender_addr)
+    gas = intrinsic_gas(tx.payload)
+    if gas > tx.gas_limit:
+        return Receipt(status=0, gas_used=0, sender=sender_addr)
+    if sender.balance - gas_cost < tx.value:
+        return Receipt(status=0, gas_used=0, sender=sender_addr)
+
+    # apply: nonce bump, fee to the coinbase (unused gas refunds net out:
+    # phase-1 uses exactly the intrinsic gas), value transfer
+    sender.nonce += 1
+    fee = gas * tx.gas_price
+    sender.balance -= fee + tx.value
+    state.get(tx.to).balance = (state.get(tx.to).balance + tx.value) & MAX_U256
+    state.get(coinbase).balance = (state.get(coinbase).balance + fee) & MAX_U256
+    return Receipt(status=1, gas_used=gas, sender=sender_addr)
+
+
+def process(state: ShardState, txs: Sequence[Transaction],
+            coinbase: Address20) -> List[Receipt]:
+    """Replay a collation's transactions in order (Process parity)."""
+    return [apply_transaction(state, tx, coinbase) for tx in txs]
+
+
+def touched_addresses(txs: Sequence[Transaction],
+                      coinbase: Address20) -> List[Address20]:
+    """Every address a replay can touch, deduplicated, sorted — the fixed
+    account table the device kernel operates over."""
+    seen = {bytes(coinbase): coinbase}
+    for tx in txs:
+        sender = recover_sender(tx)
+        if sender is not None:
+            seen.setdefault(bytes(sender), sender)
+        if tx.to is not None:
+            seen.setdefault(bytes(tx.to), tx.to)
+    return [seen[k] for k in sorted(seen)]

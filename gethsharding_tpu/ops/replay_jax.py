@@ -1,0 +1,343 @@
+"""Batched collation replay: per-shard state transitions on device.
+
+BASELINE.md config 4 — "proposer-path collation tx replay" — as a
+fixed-shape array program `vmap`'d over shardID (the re-architecture of
+`core/state_processor.go:56-88` + `core/state_transition.go:131,183`):
+
+- sender recovery for EVERY transaction of EVERY shard runs as one
+  batched `ecrecover_batch` dispatch (the per-tx ecrecover of
+  `core/types/transaction_signing.go`, SURVEY.md §2.3 row 1), followed by
+  an on-device keccak for pubkey -> address;
+- each shard then applies its transactions IN ORDER under a `lax.scan`
+  (nonce equality, buy-gas, intrinsic-gas, value-transfer checks — the
+  exact TransitionDb order of the scalar twin `core/state_processor.py`),
+  with balances as 32x8-bit limb planes in int32 (exact uint256
+  add/sub/compare/scale without 64-bit dtypes);
+- the final account table is committed with an on-device keccak,
+  byte-identical with `ShardState.root`.
+
+Shapes: S shards x T txs x A accounts (host-padded; masked rows are
+no-ops). Leading axes batch; `vmap`/`shard_map` compose — the shard axis
+is the mesh axis for the multi-chip stress config (BASELINE config 5).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from gethsharding_tpu.core import state_processor as ref
+from gethsharding_tpu.core.types import Transaction
+from gethsharding_tpu.ops import secp256k1_jax
+from gethsharding_tpu.ops.keccak_jax import keccak256_fixed
+from gethsharding_tpu.ops.limb import LIMB_BITS, NLIMBS
+from gethsharding_tpu.utils.hexbytes import Address20
+
+# == uint256 as 32 little-endian 8-bit limbs in int32 ======================
+
+
+def _carry8(z: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Exact signed carry propagation over 8-bit limbs; returns
+    (top_carry, canonical_limbs). Arithmetic >> handles borrows."""
+    zs = jnp.moveaxis(z, -1, 0)
+
+    def step(c, x):
+        t = x + c
+        return t >> 8, t & 0xFF
+
+    carry, out = lax.scan(step, zs[0] * 0, zs)
+    return carry, jnp.moveaxis(out, 0, -1)
+
+
+def u256_ge(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """x >= y on canonical limb arrays (borrow sign of the difference)."""
+    borrow, _ = _carry8(x - y)
+    return borrow >= 0
+
+
+def u256_mul_u32(x: jnp.ndarray, k: jnp.ndarray
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """x * k for non-negative int32 k -> (low 32 limbs, overflowed_256).
+
+    Split k into 16-bit halves so per-limb products stay below 2^25."""
+    k_lo = (k & 0xFFFF)[..., None]
+    k_hi = ((k >> 16) & 0x7FFF)[..., None]
+    pad = [(0, 0)] * (x.ndim - 1)
+    lo = jnp.pad(x * k_lo, pad + [(0, 3)])
+    hi = jnp.pad(x * k_hi, pad + [(2, 1)])  # << 16 = two limbs up
+    carry, limbs = _carry8(lo + hi)
+    overflow = (carry != 0) | jnp.any(limbs[..., 32:] != 0, axis=-1)
+    return limbs[..., :32], overflow
+
+
+# == 12-bit field limbs -> bytes (for on-device address derivation) ========
+
+_BIT = np.arange(256)
+_BIT_LIMB = _BIT // LIMB_BITS
+_BIT_OFF = _BIT % LIMB_BITS
+
+
+def limbs12_to_bytes_be(x: jnp.ndarray) -> jnp.ndarray:
+    """(..., NLIMBS) canonical 12-bit limbs -> (..., 32) uint8 big-endian."""
+    bits = (x[..., _BIT_LIMB] >> _BIT_OFF) & 1          # (..., 256) LSB-first
+    by = bits.reshape(bits.shape[:-1] + (32, 8))        # LE byte order
+    weights = np.asarray(1 << np.arange(8), np.int32)
+    le = jnp.sum(by * weights, axis=-1)                 # (..., 32) LE
+    return jnp.flip(le, axis=-1).astype(jnp.uint8)
+
+
+def pubkeys_to_addresses(qx: jnp.ndarray, qy: jnp.ndarray) -> jnp.ndarray:
+    """Recovered pubkey limbs -> (..., 20) uint8 address, keccak on device
+    (crypto.PubkeyToAddress parity: keccak256(X || Y)[12:]).
+
+    The recovery outputs are LAZY field limbs (value only congruent mod
+    p); canonicalize before serializing."""
+    pub = jnp.concatenate(
+        [limbs12_to_bytes_be(secp256k1_jax.FQ.canon(qx)),
+         limbs12_to_bytes_be(secp256k1_jax.FQ.canon(qy))], axis=-1)
+    return keccak256_fixed(pub)[..., 12:]
+
+
+# == replay inputs =========================================================
+
+
+class ReplayInputs(NamedTuple):
+    """Host-marshalled device arrays; leading axis S = shards."""
+
+    # account table (host-sorted ascending by address; fixed rows)
+    addrs: jnp.ndarray        # (S, A, 20) uint8
+    nonces: jnp.ndarray       # (S, A) int32
+    balances: jnp.ndarray     # (S, A, 32) int32, 8-bit limbs little-endian
+    table_len: jnp.ndarray    # (S,) int32 — real rows (rest padding)
+    coinbase_ix: jnp.ndarray  # (S,) int32 — coinbase row index
+    # transactions, in order
+    tx_e: jnp.ndarray         # (S, T, NLIMBS) sig-hash field limbs
+    tx_r: jnp.ndarray         # (S, T, NLIMBS)
+    tx_s: jnp.ndarray         # (S, T, NLIMBS)
+    tx_recid: jnp.ndarray     # (S, T) int32
+    tx_nonce: jnp.ndarray     # (S, T) int32
+    tx_gas_limit: jnp.ndarray  # (S, T) int32
+    tx_intrinsic: jnp.ndarray  # (S, T) int32 — host-counted data bytes
+    tx_price: jnp.ndarray     # (S, T, 32) 8-bit limbs
+    tx_value: jnp.ndarray     # (S, T, 32)
+    tx_to: jnp.ndarray        # (S, T, 20) uint8
+    tx_valid: jnp.ndarray     # (S, T) bool — well-formed + recoverable form
+
+
+class ReplayOutputs(NamedTuple):
+    statuses: jnp.ndarray     # (S, T) bool
+    gas_used: jnp.ndarray     # (S, T) int32
+    nonces: jnp.ndarray       # (S, A) int32 — final table
+    balances: jnp.ndarray     # (S, A, 32) int32
+    roots: jnp.ndarray        # (S, 32) uint8 — state commitments
+
+
+def _shard_replay(addrs, nonces, balances, coinbase_ix, senders, sender_ok,
+                  tx_nonce, tx_gas_limit, tx_intrinsic, tx_price, tx_value,
+                  tx_to, tx_valid):
+    """Sequential in-order replay for ONE shard (vmapped over S)."""
+
+    def tx_step(carry, xs):
+        nonces, balances = carry
+        (s_addr, s_ok, nonce, gas_limit, intrinsic, price, value, to,
+         valid) = xs
+
+        s_match = jnp.all(addrs == s_addr, axis=-1)
+        t_match = jnp.all(addrs == to, axis=-1)
+        s_ix = jnp.argmax(s_match)
+        t_ix = jnp.argmax(t_match)
+
+        ok = valid & s_ok & jnp.any(s_match) & jnp.any(t_match)
+        ok &= nonces[s_ix] == nonce
+        gas_cost, over = u256_mul_u32(price, gas_limit)
+        # an overflowing cost exceeds any 256-bit balance by definition
+        ok &= ~over & u256_ge(balances[s_ix], gas_cost)
+        ok &= intrinsic <= gas_limit
+        _, post_buy = _carry8(balances[s_ix] - gas_cost)
+        ok &= u256_ge(post_buy, value)
+        fee, _ = u256_mul_u32(price, intrinsic)  # <= gas_cost when ok
+
+        # deltas applied together; same-row cases (self-transfer, sender
+        # is coinbase) net out exactly like sequential scalar updates
+        okl = ok.astype(jnp.int32)
+        _, debit = _carry8(fee + value)
+        delta = jnp.zeros_like(balances)
+        delta = delta.at[s_ix].add(-debit * okl)
+        delta = delta.at[t_ix].add(value * okl)
+        delta = delta.at[coinbase_ix].add(fee * okl)
+        # credits wrap mod 2^256 (scalar masks with MAX_U256): the carry
+        # off limb 31 is dropped
+        _, balances = _carry8(balances + delta)
+        nonces = nonces.at[s_ix].add(okl)
+        return (nonces, balances), (ok, intrinsic * okl)
+
+    (nonces, balances), (statuses, gas_used) = lax.scan(
+        tx_step, (nonces, balances),
+        (senders, sender_ok, tx_nonce, tx_gas_limit, tx_intrinsic,
+         tx_price, tx_value, tx_to, tx_valid))
+    return nonces, balances, statuses, gas_used
+
+
+def _state_root(addrs, nonces, balances, table_len):
+    """keccak256 over rows addr(20) || nonce_be(8) || balance_be(32) for
+    the real table rows; padding rows are zeroed so equal tables hash
+    equal regardless of the padded width... which would break parity with
+    the scalar root over exactly `table_len` rows — so the row count is
+    mixed into the tail instead (see build_replay_inputs: tables are
+    padded to a SHARED width with zero rows, and the scalar twin pads the
+    same way via `root_with_padding`)."""
+    a = addrs.shape[-2]
+    shifts = np.asarray([56, 48, 40, 32, 24, 16, 8, 0], np.int64)
+    nonce_be = ((nonces.astype(jnp.int64)[..., None] >> shifts) & 0xFF
+                ).astype(jnp.uint8)
+    bal_be = jnp.flip(balances, axis=-1).astype(jnp.uint8)
+    rows = jnp.concatenate([addrs, nonce_be, bal_be], axis=-1)  # (A, 60)
+    blob = rows.reshape(rows.shape[:-2] + (a * 60,))
+    return keccak256_fixed(blob)
+
+
+@jax.jit
+def replay_batch(inp: ReplayInputs) -> ReplayOutputs:
+    """The full config-4 pipeline: one recovery dispatch for all S*T
+    transactions, then the per-shard ordered transition scan vmapped over
+    the shard axis, then on-device state commitments."""
+    s, t = inp.tx_recid.shape
+    flat = lambda x: x.reshape((s * t,) + x.shape[2:])
+    qx, qy, rec_ok = secp256k1_jax.ecrecover_batch(
+        flat(inp.tx_e), flat(inp.tx_r), flat(inp.tx_s), flat(inp.tx_recid),
+        flat(inp.tx_valid))
+    senders = pubkeys_to_addresses(qx, qy).reshape(s, t, 20)
+    sender_ok = rec_ok.reshape(s, t)
+
+    nonces, balances, statuses, gas_used = jax.vmap(_shard_replay)(
+        inp.addrs, inp.nonces, inp.balances, inp.coinbase_ix, senders,
+        sender_ok, inp.tx_nonce, inp.tx_gas_limit, inp.tx_intrinsic,
+        inp.tx_price, inp.tx_value, inp.tx_to, inp.tx_valid)
+    roots = _state_root(inp.addrs, nonces, balances, inp.table_len)
+    return ReplayOutputs(statuses=statuses, gas_used=gas_used,
+                         nonces=nonces, balances=balances, roots=roots)
+
+
+# == host marshalling ======================================================
+
+
+def _u256_limbs(value: int) -> np.ndarray:
+    return np.asarray([(value >> (8 * i)) & 0xFF for i in range(32)],
+                      np.int32)
+
+
+def build_replay_inputs(
+        shard_txs: Sequence[Sequence[Transaction]],
+        genesis: Sequence[Dict[Address20, ref.AccountState]],
+        coinbases: Sequence[Address20],
+        pad_txs: Optional[int] = None,
+        pad_accounts: Optional[int] = None) -> ReplayInputs:
+    """Transactions + per-shard genesis accounts -> fixed-shape arrays.
+
+    The account table per shard = genesis ∪ touched addresses, ascending;
+    uneven shards are padded (zero account rows, invalid tx rows)."""
+    s = len(shard_txs)
+    tables: List[List[Address20]] = []
+    for txs, gen, coinbase in zip(shard_txs, genesis, coinbases):
+        addrs = {bytes(a): a for a in gen}
+        for a in ref.touched_addresses(txs, coinbase):
+            addrs.setdefault(bytes(a), a)
+        tables.append([addrs[k] for k in sorted(addrs)])
+
+    a_max = max(max((len(t) for t in tables), default=1), 1)
+    t_max = max(max((len(t) for t in shard_txs), default=1), 1)
+    if pad_accounts is not None:
+        a_max = max(a_max, pad_accounts)
+    if pad_txs is not None:
+        t_max = max(t_max, pad_txs)
+
+    z = np.zeros
+    addrs = z((s, a_max, 20), np.uint8)
+    nonces = z((s, a_max), np.int32)
+    balances = z((s, a_max, 32), np.int32)
+    table_len = z(s, np.int32)
+    coinbase_ix = z(s, np.int32)
+    tx_e = z((s, t_max, NLIMBS), np.int32)
+    tx_r = z((s, t_max, NLIMBS), np.int32)
+    tx_s = z((s, t_max, NLIMBS), np.int32)
+    tx_recid = z((s, t_max), np.int32)
+    tx_nonce = z((s, t_max), np.int32)
+    tx_gas_limit = z((s, t_max), np.int32)
+    tx_intrinsic = z((s, t_max), np.int32)
+    tx_price = z((s, t_max, 32), np.int32)
+    tx_value = z((s, t_max, 32), np.int32)
+    tx_to = z((s, t_max, 20), np.uint8)
+    tx_valid = z((s, t_max), bool)
+
+    for i, (txs, gen, coinbase) in enumerate(zip(shard_txs, genesis,
+                                                 coinbases)):
+        table = tables[i]
+        table_len[i] = len(table)
+        for row, addr in enumerate(table):
+            addrs[i, row] = np.frombuffer(bytes(addr), np.uint8)
+            acct = gen.get(addr)
+            if acct is not None:
+                nonces[i, row] = acct.nonce
+                balances[i, row] = _u256_limbs(acct.balance)
+            if addr == coinbase:
+                coinbase_ix[i] = row
+        digests, rs, ss, recs, valids = [], [], [], [], []
+        for j, tx in enumerate(txs):
+            well_formed = (tx.v in (27, 28) and tx.to is not None
+                           and 0 <= tx.nonce < 2 ** 31
+                           and 0 <= tx.gas_limit < 2 ** 31
+                           and 0 <= tx.gas_price < 2 ** 256
+                           and 0 <= tx.value < 2 ** 256)
+            digests.append(bytes(tx.sig_hash()))
+            rs.append(tx.r % (1 << 256))
+            ss.append(tx.s % (1 << 256))
+            recs.append((tx.v - 27) & 1)
+            valids.append(well_formed)
+            if not well_formed:
+                continue
+            tx_nonce[i, j] = tx.nonce
+            tx_gas_limit[i, j] = tx.gas_limit
+            tx_intrinsic[i, j] = ref.intrinsic_gas(tx.payload)
+            tx_price[i, j] = _u256_limbs(tx.gas_price)
+            tx_value[i, j] = _u256_limbs(tx.value)
+            tx_to[i, j] = np.frombuffer(bytes(tx.to), np.uint8)
+        if txs:
+            tx_e[i, :len(txs)] = secp256k1_jax.hashes_to_limbs(digests)
+            from gethsharding_tpu.ops.limb import ints_to_limbs
+
+            tx_r[i, :len(txs)] = ints_to_limbs(rs)
+            tx_s[i, :len(txs)] = ints_to_limbs(ss)
+            tx_recid[i, :len(txs)] = recs
+            tx_valid[i, :len(txs)] = valids
+
+    as_j = jnp.asarray
+    return ReplayInputs(
+        addrs=as_j(addrs), nonces=as_j(nonces), balances=as_j(balances),
+        table_len=as_j(table_len), coinbase_ix=as_j(coinbase_ix),
+        tx_e=as_j(tx_e), tx_r=as_j(tx_r), tx_s=as_j(tx_s),
+        tx_recid=as_j(tx_recid), tx_nonce=as_j(tx_nonce),
+        tx_gas_limit=as_j(tx_gas_limit), tx_intrinsic=as_j(tx_intrinsic),
+        tx_price=as_j(tx_price), tx_value=as_j(tx_value), tx_to=as_j(tx_to),
+        tx_valid=as_j(tx_valid),
+    )
+
+
+def scalar_root_with_padding(state: ref.ShardState, a_total: int):
+    """The scalar twin of the device commitment: the device hashes the
+    FULL padded table (zero rows included), so the scalar root must pad to
+    the same width for comparison."""
+    from gethsharding_tpu.crypto.keccak import keccak256
+    from gethsharding_tpu.utils.hexbytes import Hash32
+
+    rows = sorted(state.accounts.items(), key=lambda kv: bytes(kv[0]))
+    blob = b"".join(
+        bytes(addr) + acct.nonce.to_bytes(8, "big")
+        + acct.balance.to_bytes(32, "big")
+        for addr, acct in rows)
+    blob += b"\x00" * 60 * (a_total - len(rows))
+    return Hash32(keccak256(blob))

@@ -30,6 +30,7 @@ _SLOW_MODULES = {
     "test_period_pipeline",
     "test_end_to_end",
     "test_limb",  # the Fermat-inversion pow chains dominate its compiles
+    "test_replay",
 }
 
 
