@@ -16,12 +16,24 @@ from gethsharding_tpu.utils.rlp import rlp_encode, int_to_big_endian
 
 
 def derive_sha(items: Sequence[bytes]) -> bytes:
-    """Root hash over rlp(index) -> item (items are already RLP-encoded)."""
+    """Root hash over rlp(index) -> item (items are already RLP-encoded).
+
+    Large lists go through the native bulk MPT builder (`native/mpt.c` —
+    the scalability answer to per-byte chunk roots over 1 MiB bodies);
+    the Python trie is the fallback and differential twin."""
     if not items:
         return EMPTY_ROOT
+    keys = [rlp_encode(int_to_big_endian(index))
+            for index in range(len(items))]
+    if len(items) >= 64:
+        from gethsharding_tpu import native
+
+        root = native.mpt_root(keys, list(items))
+        if root is not None:
+            return root
     trie = Trie()
-    for index, item in enumerate(items):
-        trie.update(rlp_encode(int_to_big_endian(index)), item)
+    for key, item in zip(keys, items):
+        trie.update(key, item)
     return trie.root_hash()
 
 

@@ -78,7 +78,23 @@ RATE_BYTES = 136  # 1088-bit rate for 256-bit output
 
 
 def keccak256(data: bytes) -> bytes:
-    """keccak256 digest (Ethereum flavour: 0x01 domain padding)."""
+    """keccak256 digest (Ethereum flavour: 0x01 domain padding).
+
+    Dispatches to the native C library when available (the reference's
+    keccak is assembly, `crypto/sha3/keccakf_amd64.s`; here it is
+    `native/keccak.c` behind ctypes) with this pure-Python implementation
+    as the always-available fallback and differential twin
+    (`keccak256_py`)."""
+    from gethsharding_tpu import native
+
+    digest = native.keccak256(data)
+    if digest is not None:
+        return digest
+    return keccak256_py(data)
+
+
+def keccak256_py(data: bytes) -> bytes:
+    """Pure-Python keccak256 (the portable reference path)."""
     # multi-rate padding: append 0x01, zero-fill, set MSB of final byte
     padded = bytearray(data)
     pad_len = RATE_BYTES - (len(padded) % RATE_BYTES)

@@ -1,0 +1,161 @@
+"""Native runtime components: build-on-first-use C library via ctypes.
+
+The reference keeps its host-side hot loops in native code (keccak
+assembly `crypto/sha3/keccakf_amd64.s`, C libsecp256k1); this module is
+the framework's equivalent seam: `native/*.c` compiled once into a shared
+library (cached beside the sources, rebuilt when they change) and bound
+with ctypes — no pybind11/build-system dependency. Everything has a pure
+Python fallback; set GETHSHARDING_NO_NATIVE=1 to force it (differential
+tests run both).
+
+Exports (None when unavailable):
+- keccak256(data) -> 32 bytes            (Ethereum keccak)
+- keccak256_batch(np.uint8 (n, L)) -> (n, 32)
+- mpt_root(keys, values) -> 32 bytes     (bulk sorted MPT build; small
+  keys/values only — the DeriveSha shape. Values are the logical value
+  bytes; the builder RLP-string-wraps them inside nodes.)
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+log = logging.getLogger("native")
+
+_SOURCES = ["keccak.c", "mpt.c"]
+_KEY_CAP = 16
+_VAL_CAP = 64
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _native_dir() -> Path:
+    return Path(__file__).resolve().parents[1] / "native"
+
+
+def _build(lib_path: Path, sources: List[Path]) -> bool:
+    cc = os.environ.get("CC", "cc")
+    lib_path.parent.mkdir(parents=True, exist_ok=True)
+    cmd = [cc, "-O3", "-shared", "-fPIC", "-o", str(lib_path)]
+    cmd += [str(s) for s in sources]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as exc:
+        log.warning("native build failed to run: %s", exc)
+        return False
+    if proc.returncode != 0:
+        log.warning("native build failed:\n%s", proc.stderr)
+        return False
+    return True
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("GETHSHARDING_NO_NATIVE") == "1":
+            return None
+        src_dir = _native_dir()
+        sources = [src_dir / s for s in _SOURCES]
+        if not all(s.is_file() for s in sources):
+            return None
+        lib_path = src_dir / "build" / "libgethsharding.so"
+        newest = max(s.stat().st_mtime for s in sources)
+        if not lib_path.is_file() or lib_path.stat().st_mtime < newest:
+            if not _build(lib_path, sources):
+                return None
+        try:
+            lib = ctypes.CDLL(str(lib_path))
+        except OSError as exc:
+            log.warning("native load failed: %s", exc)
+            return None
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.gs_keccak256.argtypes = [u8p, ctypes.c_uint64, u8p]
+        lib.gs_keccak256.restype = None
+        lib.gs_keccak256_batch.argtypes = [
+            u8p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64, u8p]
+        lib.gs_keccak256_batch.restype = None
+        lib.gs_mpt_root.argtypes = [
+            u8p, ctypes.c_uint64, u8p, u8p, ctypes.c_uint64, u8p,
+            ctypes.c_uint64, u8p]
+        lib.gs_mpt_root.restype = ctypes.c_int
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def keccak256(data: bytes) -> Optional[bytes]:
+    lib = _load()
+    if lib is None:
+        return None
+    out = (ctypes.c_uint8 * 32)()
+    buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data) if data else \
+        (ctypes.c_uint8 * 1)()
+    lib.gs_keccak256(buf, len(data), out)
+    return bytes(out)
+
+
+def keccak256_batch(messages) -> Optional["np.ndarray"]:
+    """(n, L) uint8 array -> (n, 32) uint8 digests."""
+    import numpy as np
+
+    lib = _load()
+    if lib is None:
+        return None
+    arr = np.ascontiguousarray(messages, np.uint8)
+    n, length = arr.shape
+    out = np.empty((n, 32), np.uint8)
+    lib.gs_keccak256_batch(
+        arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n, length,
+        length, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    return out
+
+
+def mpt_root(keys: Sequence[bytes], values: Sequence[bytes]
+             ) -> Optional[bytes]:
+    """Bulk MPT root over (key, value) pairs; None when the native lib is
+    unavailable or a key/value exceeds the builder caps."""
+    import numpy as np
+
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(keys)
+    if n != len(values):
+        raise ValueError("keys/values length mismatch")
+    if any(len(k) > _KEY_CAP for k in keys) or \
+            any(len(v) > _VAL_CAP for v in values):
+        return None
+    karr = np.zeros((max(n, 1), _KEY_CAP), np.uint8)
+    klen = np.zeros(max(n, 1), np.uint8)
+    varr = np.zeros((max(n, 1), _VAL_CAP), np.uint8)
+    vlen = np.zeros(max(n, 1), np.uint8)
+    for i, (k, v) in enumerate(zip(keys, values)):
+        karr[i, :len(k)] = np.frombuffer(k, np.uint8)
+        klen[i] = len(k)
+        varr[i, :len(v)] = np.frombuffer(v, np.uint8)
+        vlen[i] = len(v)
+    out = (ctypes.c_uint8 * 32)()
+    u8 = ctypes.POINTER(ctypes.c_uint8)
+    rc = lib.gs_mpt_root(
+        karr.ctypes.data_as(u8), _KEY_CAP, klen.ctypes.data_as(u8),
+        varr.ctypes.data_as(u8), _VAL_CAP, vlen.ctypes.data_as(u8),
+        n, out)
+    if rc != 0:
+        log.warning("gs_mpt_root failed rc=%d; falling back", rc)
+        return None
+    return bytes(out)

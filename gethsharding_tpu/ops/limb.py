@@ -92,8 +92,37 @@ def _limbs_to_int_nd(arr: np.ndarray):
 
 
 def ints_to_limbs(values: Sequence[int], nlimbs: int = NLIMBS) -> np.ndarray:
-    """Batch conversion: (batch,) python ints -> (batch, nlimbs) int32."""
-    return np.stack([int_to_limbs(v, nlimbs) for v in values])
+    """Batch conversion: (batch,) python ints -> (batch, nlimbs) int32.
+
+    Vectorized: one to_bytes per int (C speed), then a numpy bit-plane
+    extraction — this sits on the host marshalling critical path
+    (hashes/signatures -> limbs for every batch dispatch)."""
+    n = len(values)
+    if n == 0:
+        return np.zeros((0, nlimbs), np.int32)
+    nbytes = -(-nlimbs * LIMB_BITS // 8)
+    cap = 1 << (nlimbs * LIMB_BITS)
+    try:
+        raw = b"".join(v.to_bytes(nbytes, "little") for v in values)
+    except OverflowError as exc:
+        raise ValueError(f"value out of range for {nlimbs} limbs") from exc
+    if nbytes * 8 != nlimbs * LIMB_BITS:
+        # capacity is not byte-aligned: the spare top nibble must be zero
+        for v in values:
+            if v >= cap:
+                raise ValueError("value does not fit in limbs")
+    arr = np.frombuffer(raw, np.uint8).reshape(n, nbytes).astype(np.int32)
+    # limb i spans bits [12i, 12i+12): even limbs = byte 3i/2 + low nibble
+    # of the next byte; odd limbs = high nibble + the following byte
+    idx = np.arange(nlimbs)
+    b0 = (idx * LIMB_BITS) // 8
+    odd = (idx % 2).astype(bool)
+    b1 = np.minimum(b0 + 1, nbytes - 1)
+    lo = arr[:, b0]
+    hi = arr[:, b1]
+    even_limbs = lo | ((hi & 0x0F) << 8)
+    odd_limbs = (lo >> 4) | (hi << 4)
+    return np.where(odd, odd_limbs, even_limbs).astype(np.int32)
 
 
 def _relaxed_round(z: jnp.ndarray):

@@ -183,18 +183,17 @@ def _shard_replay(addrs, nonces, balances, coinbase_ix, senders, sender_ok,
     return nonces, balances, statuses, gas_used
 
 
-def _state_root(addrs, nonces, balances, table_len):
-    """keccak256 over rows addr(20) || nonce_be(8) || balance_be(32) for
-    the real table rows; padding rows are zeroed so equal tables hash
-    equal regardless of the padded width... which would break parity with
-    the scalar root over exactly `table_len` rows — so the row count is
-    mixed into the tail instead (see build_replay_inputs: tables are
-    padded to a SHARED width with zero rows, and the scalar twin pads the
-    same way via `root_with_padding`)."""
+def _state_root(addrs, nonces, balances):
+    """keccak256 over rows addr(20) || nonce_be(8) || balance_be(32),
+    INCLUDING zero padding rows (tables are host-padded to a shared
+    width); the scalar twin pads identically via
+    `scalar_root_with_padding`."""
     a = addrs.shape[-2]
-    shifts = np.asarray([56, 48, 40, 32, 24, 16, 8, 0], np.int64)
-    nonce_be = ((nonces.astype(jnp.int64)[..., None] >> shifts) & 0xFF
-                ).astype(jnp.uint8)
+    # nonce is int32 (< 2^31): high 4 of the 8 big-endian bytes are zero
+    shifts = np.asarray([24, 16, 8, 0], np.int32)
+    lo4 = ((nonces[..., None] >> shifts) & 0xFF).astype(jnp.uint8)
+    nonce_be = jnp.concatenate(
+        [jnp.zeros(lo4.shape[:-1] + (4,), jnp.uint8), lo4], axis=-1)
     bal_be = jnp.flip(balances, axis=-1).astype(jnp.uint8)
     rows = jnp.concatenate([addrs, nonce_be, bal_be], axis=-1)  # (A, 60)
     blob = rows.reshape(rows.shape[:-2] + (a * 60,))
@@ -218,7 +217,7 @@ def replay_batch(inp: ReplayInputs) -> ReplayOutputs:
         inp.addrs, inp.nonces, inp.balances, inp.coinbase_ix, senders,
         sender_ok, inp.tx_nonce, inp.tx_gas_limit, inp.tx_intrinsic,
         inp.tx_price, inp.tx_value, inp.tx_to, inp.tx_valid)
-    roots = _state_root(inp.addrs, nonces, balances, inp.table_len)
+    roots = _state_root(inp.addrs, nonces, balances)
     return ReplayOutputs(statuses=statuses, gas_used=gas_used,
                          nonces=nonces, balances=balances, roots=roots)
 

@@ -88,7 +88,9 @@ def sample_committee(blockhash: jnp.ndarray, pool_index: jnp.ndarray,
         return (r * 256 + b.astype(jnp.int32)) % m, None
 
     bytes_first = jnp.moveaxis(digest, -1, 0)  # (32, A)
-    r0 = jnp.zeros(a, jnp.int32) * m  # derived from m: shard_map vma-safe
+    # init derived from every varying operand so the carry's manual axes
+    # match the scan body's output under shard_map
+    r0 = pool_index * 0 + shard_id * 0 + jnp.zeros(a, jnp.int32) * m
     r, _ = lax.scan(horner, r0, bytes_first)
     return r
 
@@ -96,12 +98,16 @@ def sample_committee(blockhash: jnp.ndarray, pool_index: jnp.ndarray,
 def submit_votes_batch(state: VoteState, pool_addr: jnp.ndarray,
                        attempts: VoteAttempts, *, period: jnp.ndarray,
                        blockhash: jnp.ndarray, sample_size: jnp.ndarray,
-                       committee_size: int, quorum_size: int):
+                       committee_size: int, quorum_size: int,
+                       sample_shard: jnp.ndarray = None):
     """Apply a period's submitVote batch. Returns (new_state, accepted).
 
     pool_addr: (P, 20) uint8, zero rows for empty slots. period: scalar
     int32 (the current period; the caller guarantees attempts were made in
     it, mirroring `period == block.number/PERIOD_LENGTH`, .sol:203).
+    `sample_shard` (A,) overrides the shard ids used for committee
+    sampling: under shard_map the state is indexed by LOCAL slab ids while
+    the keccak sampling must see GLOBAL shard ids.
     """
     s_count, c_size = state.has_voted.shape
     assert c_size == committee_size
@@ -119,8 +125,10 @@ def submit_votes_batch(state: VoteState, pool_addr: jnp.ndarray,
         attempts.chunk_root == state.chunk_root[shard_ix], axis=-1)
 
     # sender is the sampled committee member (.sol:212-214)
-    slot = sample_committee(blockhash, attempts.pool_index, attempts.shard,
-                            sample_size)
+    slot = sample_committee(
+        blockhash, attempts.pool_index,
+        attempts.shard if sample_shard is None else sample_shard,
+        sample_size)
     member = pool_addr[jnp.clip(slot, 0, pool_cap - 1)]
     member = jnp.where((slot < pool_cap)[:, None], member, 0).astype(jnp.uint8)
     sampled_ok = jnp.all(member == attempts.sender, axis=-1)
@@ -176,6 +184,27 @@ def add_header_reset(state: VoteState, shard_id: jnp.ndarray,
         last_approved=state.last_approved,
         is_elected=state.is_elected.at[six].set(False),
         chunk_root=state.chunk_root.at[six].set(chunk_root.astype(jnp.uint8)),
+    )
+
+
+def add_header_reset_masked(state: VoteState, mask: jnp.ndarray,
+                            period: jnp.ndarray,
+                            chunk_root: jnp.ndarray) -> VoteState:
+    """Fixed-shape variant of `add_header_reset`: every shard row carries a
+    bool `mask` (True = a header was accepted this period) instead of a
+    dynamic index list — the shape shard_map wants (mask shards over the
+    mesh, no gather/scatter across devices).
+
+    mask (S,), chunk_root (S, 32) uint8."""
+    m1 = mask[:, None]
+    return VoteState(
+        has_voted=jnp.where(m1, False, state.has_voted),
+        vote_count=jnp.where(mask, 0, state.vote_count),
+        last_submitted=jnp.where(mask, period, state.last_submitted),
+        last_approved=state.last_approved,
+        is_elected=jnp.where(mask, False, state.is_elected),
+        chunk_root=jnp.where(m1, chunk_root.astype(jnp.uint8),
+                             state.chunk_root),
     )
 
 

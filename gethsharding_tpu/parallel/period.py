@@ -67,8 +67,10 @@ def _step(inp: PeriodInputs, quorum: int, axis: Optional[str]):
 class PeriodPipeline:
     """Compiled per-period verifier, optionally sharded over a mesh.
 
-    The mesh path requires the shard count to divide evenly over the
-    ``"shard"`` mesh axis (pad with has_header=False rows otherwise).
+    Uneven shard counts are handled transparently: `run` pads the batch
+    with masked (has_header=False) rows up to the next multiple of the
+    mesh axis size and slices the per-shard outputs back — masked rows
+    contribute nothing to the `psum` tallies.
     """
 
     def __init__(self, config: Config = DEFAULT_CONFIG,
@@ -88,11 +90,29 @@ class PeriodPipeline:
             ))
 
     def run(self, inputs: PeriodInputs) -> PeriodOutputs:
-        if self.mesh is not None:
-            sharding = shard_axis_sharding(self.mesh)
-            inputs = PeriodInputs(
-                *(jax.device_put(a, sharding) for a in inputs))
-        return self._fn(inputs)
+        n = int(inputs.hx.shape[0])
+        if self.mesh is None:
+            return self._fn(inputs)
+        n_dev = self.mesh.devices.size
+        padded = -(-n // n_dev) * n_dev
+        if padded != n:
+            pad = padded - n
+
+            def pad_rows(a):
+                widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+                return jnp.pad(a, widths)  # zeros: has_header rows False
+
+            inputs = PeriodInputs(*(pad_rows(a) for a in inputs))
+        sharding = shard_axis_sharding(self.mesh)
+        inputs = PeriodInputs(
+            *(jax.device_put(a, sharding) for a in inputs))
+        out = self._fn(inputs)
+        if padded != n:
+            out = PeriodOutputs(
+                verified=out.verified[:n], approved=out.approved[:n],
+                total_votes=out.total_votes,
+                total_approved=out.total_approved)
+        return out
 
     # -- host-side assembly -------------------------------------------------
 

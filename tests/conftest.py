@@ -31,6 +31,7 @@ _SLOW_MODULES = {
     "test_end_to_end",
     "test_limb",  # the Fermat-inversion pow chains dominate its compiles
     "test_replay",
+    "test_stress",
 }
 
 

@@ -1,0 +1,182 @@
+"""Fault-injection doubles at the mainchain interface seams + log/error
+assertions — the reference's faultyReader/faultyCaller pattern
+(`sharding/syncer/service_test.go:66`, `simulator/service_test.go:115`)
+with `LogHandler.VerifyLogMsg`-style assertions
+(`sharding/internal/log_helper.go:12,41`) mapped onto the Service error
+funnel and the logging records."""
+
+import logging
+import time
+
+import pytest
+
+from gethsharding_tpu.actors import Notary, Proposer, Simulator, Syncer, TXPool
+from gethsharding_tpu.core.shard import Shard
+from gethsharding_tpu.core.types import Transaction
+from gethsharding_tpu.db.kv import MemoryKV
+from gethsharding_tpu.mainchain.client import SMCClient
+from gethsharding_tpu.p2p.messages import (
+    CollationBodyRequest,
+    CollationBodyResponse,
+)
+from gethsharding_tpu.p2p.service import Hub, P2PServer
+from gethsharding_tpu.params import Config, ETHER
+from gethsharding_tpu.smc.chain import SimulatedMainchain
+from gethsharding_tpu.utils.hexbytes import Address20, Hash32
+
+
+def wait_until(predicate, timeout=5.0, step=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(step)
+    return predicate()
+
+
+class FaultyClient(SMCClient):
+    """Role-interface double that fails selected operations — the
+    faultyReader/faultyCaller/faultySigner seams."""
+
+    def __init__(self, *args, fail=(), **kwargs):
+        super().__init__(*args, **kwargs)
+        self.fail = set(fail)
+
+    def _maybe(self, op):
+        if op in self.fail:
+            raise RuntimeError(f"injected {op} fault")
+
+    def sign(self, digest):
+        self._maybe("sign")
+        return super().sign(digest)
+
+    def collation_record(self, shard_id, period):
+        self._maybe("collation_record")
+        return super().collation_record(shard_id, period)
+
+    def block_by_number(self, number=None):
+        self._maybe("block_by_number")
+        return super().block_by_number(number)
+
+    def get_notary_in_committee(self, shard_id, sender=None):
+        self._maybe("get_notary_in_committee")
+        return super().get_notary_in_committee(shard_id, sender)
+
+
+def shard_fixture():
+    return Shard(shard_id=0, shard_db=MemoryKV())
+
+
+def test_syncer_faulty_signer_records_and_logs(caplog):
+    """A failing keystore Sign on the response path must surface as a
+    recorded service error AND a log line (not a crash, not silence)."""
+    backend = SimulatedMainchain()
+    client = FaultyClient(backend=backend, fail={"sign"})
+    hub = Hub()
+    p2p = P2PServer(hub=hub)
+    p2p.start()
+    requester = P2PServer(hub=hub)
+    requester.start()
+    syncer = Syncer(client=client, shard=shard_fixture(), p2p=p2p)
+    with caplog.at_level(logging.ERROR):
+        syncer.start()
+        try:
+            requester.broadcast(CollationBodyRequest(
+                chunk_root=Hash32(b"\x01" * 32), shard_id=0, period=1,
+                proposer=Address20(b"\x02" * 20)))
+            assert wait_until(lambda: len(syncer.errors) >= 1), syncer.errors
+        finally:
+            syncer.stop()
+            p2p.stop()
+    assert any("could not construct response" in e for e in syncer.errors)
+    assert any("could not construct response" in rec.message
+               for rec in caplog.records)
+    assert syncer.responses_sent == 0
+
+
+def test_syncer_empty_response_body_records_error():
+    """An empty synced body is rejected by the shard store (ShardError)
+    and funnelled to the error channel — the faultyCollationFetcher-class
+    failure on the response side."""
+    backend = SimulatedMainchain()
+    client = SMCClient(backend=backend)
+    hub = Hub()
+    p2p = P2PServer(hub=hub)
+    p2p.start()
+    requester = P2PServer(hub=hub)
+    requester.start()
+    syncer = Syncer(client=client, shard=shard_fixture(), p2p=p2p)
+    syncer.start()
+    try:
+        requester.broadcast(CollationBodyResponse(
+            header_hash=Hash32(b"\x03" * 32), body=b""))
+        assert wait_until(lambda: len(syncer.errors) >= 1)
+    finally:
+        syncer.stop()
+        p2p.stop()
+    assert any("could not store synced body" in e for e in syncer.errors)
+    assert syncer.bodies_stored == 0
+
+
+def test_notary_faulty_committee_caller_records_head_error():
+    """checkSMCForNotary failures funnel into the error channel, and the
+    head loop keeps running (log-and-continue, HandleServiceErrors
+    parity)."""
+    config = Config(quorum_size=1)
+    backend = SimulatedMainchain(config=config)
+    client = FaultyClient(backend=backend, config=config,
+                          fail={"get_notary_in_committee"})
+    backend.fund(client.account(), 2000 * ETHER)
+    notary = Notary(client=client, shard=shard_fixture(), config=config,
+                    deposit_flag=True)
+    notary.start()
+    try:
+        backend.fast_forward(1)
+        assert wait_until(lambda: len(notary.errors) >= 1)
+        first_errors = len(notary.errors)
+        backend.commit()  # the loop survives and keeps reporting
+        assert wait_until(lambda: len(notary.errors) > first_errors)
+    finally:
+        notary.stop()
+    assert any("notarize failed at head" in e for e in notary.errors)
+
+
+def test_proposer_faulty_signer_records_error():
+    config = Config(quorum_size=1)
+    backend = SimulatedMainchain(config=config)
+    client = FaultyClient(backend=backend, config=config,
+                          fail={"sign"})
+    txpool = TXPool(simulate_interval=None)
+    proposer = Proposer(client=client, txpool=txpool, shard=shard_fixture(),
+                        config=config)
+    txpool.start()
+    proposer.start()
+    try:
+        backend.fast_forward(1)
+        txpool.submit(Transaction(nonce=1, payload=b"x"))
+        assert wait_until(lambda: len(proposer.errors) >= 1)
+    finally:
+        proposer.stop()
+        txpool.stop()
+    assert any("create collation failed" in e for e in proposer.errors)
+    assert proposer.collations_proposed == 0
+
+
+def test_simulator_faulty_record_fetcher_records_error():
+    config = Config(quorum_size=1)
+    backend = SimulatedMainchain(config=config)
+    client = FaultyClient(backend=backend, config=config,
+                          fail={"collation_record"})
+    backend.fast_forward(1)
+    hub = Hub()
+    p2p = P2PServer(hub=hub)
+    p2p.start()
+    simulator = Simulator(client=client, p2p=p2p, shard_id=0,
+                          tick_interval=0.05)
+    simulator.start()
+    try:
+        assert wait_until(lambda: len(simulator.errors) >= 1)
+    finally:
+        simulator.stop()
+        p2p.stop()
+    assert any("simulator tick failed" in e for e in simulator.errors)

@@ -1,0 +1,106 @@
+"""Native runtime component tests: the C keccak + bulk MPT builder vs
+their pure-Python twins (differential, randomized, plus the 1 MiB body
+the scalability fix exists for)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from gethsharding_tpu import native
+from gethsharding_tpu.core.derive_sha import chunk_root, derive_sha
+from gethsharding_tpu.core.trie import Trie
+from gethsharding_tpu.crypto.keccak import keccak256, keccak256_py
+from gethsharding_tpu.utils.rlp import int_to_big_endian, rlp_encode
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native library unavailable")
+
+
+def test_keccak_native_matches_python():
+    rng = np.random.default_rng(5)
+    for length in (0, 1, 55, 56, 135, 136, 137, 272, 1000):
+        data = bytes(rng.integers(0, 255, length, dtype=np.uint8))
+        assert native.keccak256(data) == keccak256_py(data), length
+
+
+def test_keccak_batch():
+    rng = np.random.default_rng(6)
+    msgs = rng.integers(0, 255, (64, 96), dtype=np.uint8)
+    out = native.keccak256_batch(msgs)
+    for i in range(64):
+        assert bytes(out[i]) == keccak256_py(bytes(msgs[i]))
+
+
+def _python_trie_root(pairs):
+    trie = Trie()
+    for k, v in pairs:
+        trie.update(k, v)
+    return trie.root_hash()
+
+
+def test_mpt_root_matches_python_trie_randomized():
+    rng = np.random.default_rng(7)
+    for trial in range(8):
+        n = int(rng.integers(1, 600))
+        pairs = {}
+        for _ in range(n):
+            klen = int(rng.integers(1, 9))
+            key = bytes(rng.integers(0, 255, klen, dtype=np.uint8))
+            val = bytes(rng.integers(0, 255, int(rng.integers(1, 65)),
+                                     dtype=np.uint8))
+            pairs[key] = val
+        items = list(pairs.items())
+        got = native.mpt_root([k for k, _ in items], [v for _, v in items])
+        assert got == _python_trie_root(items), f"trial {trial}"
+
+
+def test_mpt_root_long_string_values():
+    """Values of 56-64 bytes need RLP's long-string form inside nodes."""
+    for vlen in (55, 56, 60, 64):
+        pairs = [(bytes([i]), bytes([i]) * vlen) for i in range(5)]
+        got = native.mpt_root([k for k, _ in pairs], [v for _, v in pairs])
+        assert got == _python_trie_root(pairs), vlen
+
+
+def test_mpt_root_duplicate_keys_last_wins():
+    keys = [b"\x01", b"\x02", b"\x01"]
+    vals = [b"a", b"b", b"c"]
+    got = native.mpt_root(keys, vals)
+    assert got == _python_trie_root([(b"\x01", b"c"), (b"\x02", b"b")])
+
+
+def test_mpt_root_empty_and_single():
+    from gethsharding_tpu.core.trie import EMPTY_ROOT
+
+    assert native.mpt_root([], []) == EMPTY_ROOT
+    assert native.mpt_root([b"\x80"], [b"\x05"]) == _python_trie_root(
+        [(b"\x80", b"\x05")])
+
+
+def test_derive_sha_native_matches_python_across_sizes():
+    # crosses every rlp(uint) key-shape boundary (1/2/3-byte keys)
+    for n in (1, 2, 64, 127, 128, 129, 255, 256, 300):
+        items = [rlp_encode(bytes([i % 256])) for i in range(n)]
+        keys = [rlp_encode(int_to_big_endian(i)) for i in range(n)]
+        assert native.mpt_root(keys, items) == _python_trie_root(
+            list(zip(keys, items))), n
+
+
+def test_chunk_root_one_mebibyte_body():
+    """The protocol's collation size cap (collation.go:45) is now
+    computable in seconds instead of minutes."""
+    body = bytes(range(256)) * (2 ** 20 // 256)
+    t0 = time.monotonic()
+    root = chunk_root(body)
+    elapsed = time.monotonic() - t0
+    assert len(root) == 32
+    assert elapsed < 30, f"1 MiB chunk root took {elapsed:.1f}s"
+    # spot-check against the python path on a prefix (full python would
+    # take minutes — exactly the trap this fixes)
+    prefix = body[:2048]
+    import os
+
+    items = [rlp_encode(int(b)) for b in prefix]
+    keys = [rlp_encode(int_to_big_endian(i)) for i in range(len(prefix))]
+    assert chunk_root(prefix) == _python_trie_root(list(zip(keys, items)))

@@ -1,0 +1,59 @@
+"""Config-5 stress pipeline tests: the fused addHeader + vote + BLS
+verify + replay step, mesh-sharded over the virtual 8-device CPU mesh
+with distinct per-shard data and UNEVEN shard counts (padding rows),
+bit-identical with the single-device run."""
+
+import numpy as np
+import pytest
+
+from gethsharding_tpu.parallel.mesh import make_mesh
+from gethsharding_tpu.parallel.stress import (
+    StressPipeline,
+    build_stress_inputs,
+)
+from gethsharding_tpu.params import Config
+
+COMMITTEE = 7  # small pool keeps host-side workload generation fast
+
+
+@pytest.fixture(scope="module")
+def workload():
+    # 19 shards over 8 devices: uneven (pads to 24)
+    return build_stress_inputs(19, votes_per_shard=3, txs_per_shard=2,
+                               committee_size=COMMITTEE)
+
+
+def _run(mesh, workload):
+    inputs, pool_addr, blockhash, sample_size, _ = workload
+    config = Config(committee_size=COMMITTEE, quorum_size=2)
+    pipeline = StressPipeline(config=config, mesh=mesh)
+    return pipeline.run(inputs, pool_addr, blockhash, period=1,
+                        sample_size=sample_size)
+
+
+def test_single_device_stress_step(workload):
+    out = _run(None, workload)
+    accepted = np.asarray(out.accepted)
+    # the builder constructs attempts the committee sampling must accept
+    assert accepted.all(), accepted
+    assert np.asarray(out.agg_ok).all()
+    assert np.asarray(out.tx_status).all()
+    assert int(out.total_votes) == accepted.size
+    # votes_per_shard (3) >= quorum (2): every shard elects
+    assert np.asarray(out.is_elected).all()
+    assert int(out.total_elected) == accepted.shape[0]
+
+
+def test_mesh_matches_single_device_with_padding(workload):
+    single = _run(None, workload)
+    mesh = make_mesh(8)
+    sharded = _run(mesh, workload)
+    for name in ("accepted", "vote_count", "is_elected", "agg_ok",
+                 "tx_status", "roots"):
+        a = np.asarray(getattr(single, name))
+        b = np.asarray(getattr(sharded, name))
+        assert a.shape == b.shape, name
+        assert (a == b).all(), name
+    assert int(single.total_votes) == int(sharded.total_votes)
+    assert int(single.total_elected) == int(sharded.total_elected)
+    assert int(single.total_txs) == int(sharded.total_txs)
