@@ -67,6 +67,7 @@ class Notary(Service):
         self.m_audit_latency = metrics.timer("notary/period_audit_latency")
         self.m_votes = metrics.counter("notary/votes_submitted")
         self.m_audit_mismatch = metrics.counter("notary/audit_mismatches")
+        self.m_windback_checks = metrics.counter("notary/windback_checks")
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -216,6 +217,12 @@ class Notary(Service):
             )
             return False
 
+        # enforced windback (sharding/README.md): the previous W periods'
+        # collations on this shard chain must also be available before we
+        # extend it with a vote
+        if not self._check_windback(shard_id, period):
+            return False
+
         # the vote carries our aggregatable BLS signature over
         # (shard, period, chunkRoot) — the artifact the period audit
         # batch-verifies (smc/state_machine.py vote_digest)
@@ -353,6 +360,26 @@ class Notary(Service):
             got is not None and got == rec[2].proposer
             for got, rec in zip(recovered, records)
         ]
+
+    def _check_windback(self, shard_id: int, period: int) -> bool:
+        """Enforced windback: verify availability of the last
+        `config.windback_depth` periods' collations on this shard chain
+        (fetching missing bodies over shardp2p), refusing to vote while
+        any of them is unavailable."""
+        depth = self.config.windback_depth
+        if depth <= 0:
+            return True
+        for prior in range(max(1, period - depth), period):
+            record = self.client.collation_record(shard_id, prior)
+            if record is None:
+                continue  # no collation that period: nothing to hold
+            self.m_windback_checks.inc()
+            if not self._check_availability(shard_id, prior, record):
+                self.record_error(
+                    f"windback: collation body unavailable for shard "
+                    f"{shard_id} period {prior}; refusing to vote")
+                return False
+        return True
 
     def _check_availability(self, shard_id: int, period: int, record) -> bool:
         header = self._reconstruct_header(shard_id, period, record)

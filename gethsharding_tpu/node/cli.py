@@ -45,6 +45,10 @@ def build_parser() -> argparse.ArgumentParser:
                                "(flags.go PasswordFileFlag); with --datadir "
                                "the node address survives restarts")
     sharding.add_argument("--periodlength", type=int, default=5)
+    sharding.add_argument("--windback", type=int, default=0,
+                          help="enforced windback depth: periods of prior "
+                               "collation bodies a notary must hold before "
+                               "voting (sharding/README.md)")
     sharding.add_argument("--blocktime", type=float, default=1.0,
                           help="dev-mode block production interval seconds")
     sharding.add_argument("--runtime", type=float, default=0.0,
@@ -82,7 +86,8 @@ def run_cli(argv: Optional[List[str]] = None) -> int:
 
 
 def run_sharding_node(args) -> int:
-    config = Config(period_length=args.periodlength)
+    config = Config(period_length=args.periodlength,
+                    windback_depth=args.windback)
     backend = SimulatedMainchain(config=config)
     password = args.password
     if password is not None:

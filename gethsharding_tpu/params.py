@@ -27,6 +27,11 @@ class Config:
     lookahead_length: int = 4  # periods of committee lookahead
     challenge_period: int = 25  # proof-of-custody challenge window
     collation_size_limit: int = 1 << 20  # bytes
+    # Enforced windback (sharding/README.md "Enforced Windback"): how many
+    # prior periods' collation bodies a notary must hold/fetch before it
+    # may vote to extend a shard chain. 0 disables (the reference ships
+    # the requirement as documented intent only; --windback on the CLI).
+    windback_depth: int = 0
 
 
 DEFAULT_CONFIG = Config()

@@ -253,3 +253,48 @@ def test_observer_sees_canonical():
     finally:
         observer.stop()
         notary.stop()
+
+
+def test_windback_blocks_vote_until_prior_body_available():
+    """Enforced windback (sharding/README.md): with windback_depth set, a
+    notary refuses to vote while a prior period's collation body is
+    unavailable, and votes once it can be fetched over shardp2p."""
+    import time as _time
+
+    from gethsharding_tpu.actors.proposer import create_collation
+    from gethsharding_tpu.params import Config
+
+    config = Config(quorum_size=1, windback_depth=3)
+    backend = SimulatedMainchain(config=config)
+    client = SMCClient(backend=backend, config=config)
+    backend.fund(client.account(), 2000 * ETHER)
+    shard = Shard(shard_id=0, shard_db=MemoryKV())
+    notary = Notary(client=client, shard=shard, config=config,
+                    deposit_flag=True, all_shards=False)
+    notary.start()
+    try:
+        # period 1: a collation whose body the notary never receives
+        backend.fast_forward(1)
+        old = create_collation(client, 0, 1, [Transaction(nonce=1,
+                                                          payload=b"old")])
+        client.add_header(0, 1, old.header.chunk_root,
+                          old.header.proposer_signature)
+        # period 2: a collation the notary has locally
+        backend.fast_forward(1)
+        fresh = create_collation(client, 0, 2, [Transaction(nonce=2,
+                                                            payload=b"new")])
+        shard.save_collation(fresh)
+        client.add_header(0, 2, fresh.header.chunk_root,
+                          fresh.header.proposer_signature)
+        record = backend.collation_record(0, 2)
+
+        assert notary.submit_vote(0, 2, record) is False
+        assert any("windback" in e for e in notary.errors)
+        assert notary.votes_submitted == 0
+
+        # once the prior body is stored (synced), the vote goes through
+        shard.save_collation(old)
+        assert notary.submit_vote(0, 2, record) is True
+        assert backend.last_approved_collation(0) == 2
+    finally:
+        notary.stop()
