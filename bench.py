@@ -40,12 +40,18 @@ import numpy as np
 SHARDS, COMMITTEE = 100, 135
 REPO = os.path.dirname(os.path.abspath(__file__))
 
-# ordered by prior: exact/scan won the CPU sweep (throughput-bound), the
-# wide/assoc pair minimizes sequential depth (latency-bound TPU); if the
-# sweep budget runs out, the best of the configs measured so far wins
+# ordered by prior: exact/scan won the CPU sweep (throughput-bound); the
+# Pallas fused-normalize and the wide/assoc pair minimize sequential depth
+# (latency-bound TPU; the Pallas configs silently fall back to XLA when
+# the backend can't lower them, measuring ~= their base config). If the
+# sweep budget runs out, the best of the configs measured so far wins.
 CONFIGS = [
     {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "scan"},
+    {"GETHSHARDING_TPU_LIMB_FORM": "wide", "GETHSHARDING_TPU_CARRY": "scan",
+     "GETHSHARDING_TPU_PALLAS": "1"},
     {"GETHSHARDING_TPU_LIMB_FORM": "wide", "GETHSHARDING_TPU_CARRY": "assoc"},
+    {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "scan",
+     "GETHSHARDING_TPU_PALLAS": "1"},
     {"GETHSHARDING_TPU_LIMB_FORM": "wide", "GETHSHARDING_TPU_CARRY": "scan"},
     {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "assoc"},
 ]

@@ -143,6 +143,12 @@ if CARRY_IMPL not in ("scan", "assoc"):
     raise ValueError(
         f"GETHSHARDING_TPU_CARRY must be 'scan' or 'assoc', got {CARRY_IMPL!r}")
 
+# GETHSHARDING_TPU_PALLAS=1 routes `ModArith.normalize` through the fused
+# Pallas kernel (ops/pallas_norm.py) on non-CPU backends — one VMEM-
+# resident kernel per normalize instead of an XLA op chain. Off by
+# default; bench.py probes it as an autotune config.
+PALLAS_NORM = os.environ.get("GETHSHARDING_TPU_PALLAS", "0") == "1"
+
 
 def _carry_scan(z: jnp.ndarray):
     """Exact carry propagation along the last axis.
@@ -192,6 +198,15 @@ def _carry_scan(z: jnp.ndarray):
         [jnp.zeros_like(ev0[..., :1]), ev0[..., :-1]], axis=-1)
     out = (z + carries) & LIMB_MASK
     return c1 + c2 + ev0[..., -1], out
+
+
+def _pallas_wanted() -> bool:
+    """Pallas normalize only ever helps on an accelerator backend (the
+    interpreter path on CPU is for tests)."""
+    try:
+        return jax.default_backend() != "cpu"
+    except Exception:  # pragma: no cover - backend init failure
+        return False
 
 
 def _carry(z: jnp.ndarray) -> jnp.ndarray:
@@ -282,6 +297,14 @@ class ModArith:
         (instead of three for an exact-width form) is the point of the
         25-limb lazy representation.
         """
+        if PALLAS_NORM and _pallas_wanted():
+            try:
+                from gethsharding_tpu.ops.pallas_norm import normalize_pallas
+
+                return normalize_pallas(self, z)
+            except Exception:  # fall back to the XLA path
+                pass
+
         pad = [(0, 0)] * (z.ndim - 1)
 
         def relax3(v):

@@ -1,0 +1,149 @@
+"""Pallas TPU kernel: the limb engine's `normalize` as ONE fused kernel.
+
+`ModArith.normalize` (fold high limbs mod p -> relax rounds -> exact
+carry) is the inner loop of every field operation in the pairing stack;
+as stock XLA ops it compiles to a chain of elementwise kernels plus a
+serialized `lax.scan` per carry, each paying dispatch/HBM round-trips.
+This kernel (SURVEY.md §7.3's "C++/Pallas" requirement) keeps an entire
+batch block in VMEM and unrolls the whole pipeline — the carry chain
+becomes ~NLIMBS register-resident vector steps over the batch lanes
+instead of a while-loop over HBM-backed state.
+
+Layout: rows = batch (one field element per row), lanes = limbs. The
+fold is an unrolled multiply-accumulate against the per-modulus fold
+rows (closed over as compile-time constants), mirroring
+`ops/limb.ModArith.normalize` exactly for BOTH lazy forms; differential
+tests run the kernel in interpreter mode on CPU against the XLA path.
+
+Opt-in: GETHSHARDING_TPU_PALLAS=1 routes ModArith.normalize through this
+kernel on TPU backends (bench.py probes it as an autotune config).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from jax.experimental import pallas as pl
+
+BLOCK_ROWS = 256
+
+
+def _relax_round(z):
+    lo = z & 0xFFF
+    c = z >> 12
+    return lo + jnp.concatenate(
+        [jnp.zeros_like(c[:, :1]), c[:, :-1]], axis=1)
+
+
+def _relax3(z):
+    # width +3 was pre-padded by the wrapper: each round's top carry lands
+    # in the next pad lane, so nothing is dropped
+    for _ in range(3):
+        z = _relax_round(z)
+    return z
+
+
+def _exact_carry(z, out_width: int):
+    """Exact carry over `out_width` lanes; lanes beyond the input width
+    receive the propagating carry (the XLA path's zero-padding before its
+    scan)."""
+    cols = []
+    c = jnp.zeros_like(z[:, :1])
+    for k in range(out_width):
+        t = c if k >= z.shape[1] else z[:, k:k + 1] + c
+        cols.append(t & 0xFFF)
+        c = t >> 12
+    return jnp.concatenate(cols, axis=1)
+
+
+def _fold(z, fold_base: int, fold):
+    lo = z[:, :fold_base]
+    hi = z[:, fold_base:]
+    acc = lo
+    for k in range(hi.shape[1]):
+        acc = acc + hi[:, k:k + 1] * fold[k:k + 1, :]
+    return acc
+
+
+def _kernel(z_ref, fold_ref, lift_ref, out_ref, *, form: str, nlimbs: int,
+            fold_base: int):
+    fold = fold_ref[:]
+    z = _relax3(z_ref[:])
+    z = _fold(z, fold_base, fold)
+    if form == "wide":
+        z = z + lift_ref[:]
+        out_ref[:] = _exact_carry(z, nlimbs)
+        return
+    # "exact" form: the legacy 3-carry ladder
+    z = _relax3(jnp.concatenate(
+        [z, jnp.zeros((z.shape[0], 3), jnp.int32)], axis=1))
+    z = _fold(z, fold_base, fold)
+    z = _exact_carry(z, fold_base + 2)
+    z = _fold(z, fold_base, fold)
+    z = _exact_carry(z, fold_base + 1)
+    z = _fold(z, fold_base, fold)
+    out_ref[:] = _exact_carry(z, nlimbs)
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled(width: int, form: str, nlimbs: int, fold_base: int,
+              n_fold_rows: int, interpret: bool):
+    kernel = functools.partial(
+        _kernel, form=form, nlimbs=nlimbs, fold_base=fold_base)
+
+    @jax.jit
+    def run(flat, fold_rows, lift):
+        n = flat.shape[0]
+        grid = (n // BLOCK_ROWS,)
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((BLOCK_ROWS, width), lambda i: (i, 0)),
+                pl.BlockSpec((n_fold_rows, fold_base), lambda i: (0, 0)),
+                pl.BlockSpec((1, fold_base), lambda i: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec((BLOCK_ROWS, nlimbs), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((n, nlimbs), jnp.int32),
+            interpret=interpret,
+        )(flat, fold_rows, lift)
+
+    return run
+
+
+def normalize_pallas(arith, z: jnp.ndarray, *, interpret: bool = False
+                     ) -> jnp.ndarray:
+    """Drop-in for ModArith.normalize via the fused kernel.
+
+    `arith`: the ModArith instance (modulus constants). Accepts any
+    (..., W) accumulator the XLA path accepts."""
+    from gethsharding_tpu.ops import limb
+
+    lead = z.shape[:-1]
+    width = z.shape[-1] + 3  # room for the relax rounds' top carries
+    n = 1
+    for d in lead:
+        n *= d
+    flat = z.reshape(n, z.shape[-1])
+    flat = jnp.concatenate(
+        [flat, jnp.zeros((n, 3), jnp.int32)], axis=1)
+    pad_rows = (-n) % BLOCK_ROWS
+    if pad_rows:
+        flat = jnp.concatenate(
+            [flat, jnp.zeros((pad_rows, width), jnp.int32)], axis=0)
+    hi_rows = width - limb.FOLD_BASE
+    if hi_rows > arith.fold_j.shape[0]:
+        raise ValueError("accumulator too wide for the fold matrix")
+    run = _compiled(width, limb.LIMB_FORM, limb.NLIMBS, limb.FOLD_BASE,
+                    arith.fold_j.shape[0], interpret)
+    out = run(flat, jnp.asarray(arith.fold_j),
+              jnp.asarray(arith.lift[None, :]))
+    if pad_rows:
+        out = out[:n]
+    return out.reshape(lead + (limb.NLIMBS,))

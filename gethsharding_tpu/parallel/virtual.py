@@ -89,8 +89,15 @@ def force_virtual_cpu_devices(n: int,
     try:
         import jax._src.xla_bridge as xb
 
+        # Drop PLUGIN factories (e.g. the TPU-tunnel PJRT plugin whose
+        # patched backend lookup dials hardware even under JAX_PLATFORMS=
+        # cpu) but keep the builtins: removing e.g. "tpu" from the factory
+        # table also removes it from MLIR's known-platform registry, which
+        # breaks importing jax.experimental.pallas.
+        builtin = {"cpu", "tpu", "gpu", "cuda", "rocm", "metal",
+                   "interpreter"}
         for name in list(getattr(xb, "_backend_factories", {})):
-            if name != "cpu":
+            if name not in builtin:
                 xb._backend_factories.pop(name, None)
     except Exception:  # pragma: no cover - jax-internal layout drift
         pass

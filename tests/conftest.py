@@ -32,6 +32,7 @@ _SLOW_MODULES = {
     "test_limb",  # the Fermat-inversion pow chains dominate its compiles
     "test_replay",
     "test_stress",
+    "test_pallas",  # interpreter-mode kernels are slow per element
 }
 
 
