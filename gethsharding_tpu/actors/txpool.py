@@ -1,40 +1,209 @@
 """Shard transaction pool service.
 
-Parity: `sharding/txpool/service.go` — the reference emits a fake
-1024-random-byte tx every 5 s into an event feed (`sendTestTransaction
-:47`). This pool keeps that simulation mode (configurable interval) and
-additionally supports real intake via `submit()`, the step the reference
-stubs out.
+Parity targets, two tiers:
+- `sharding/txpool/service.go` — the reference's shard pool emits a fake
+  1024-random-byte tx every 5 s into an event feed (`sendTestTransaction
+  :47`). That simulation mode is kept (configurable interval).
+- `core/tx_pool.go:184` — the REAL pool underneath geth, which the
+  sharding stub never grew into: a validated, deduplicated, price-aware
+  pending set with per-sender nonce ordering, gapped-nonce queueing,
+  capacity eviction of the cheapest transactions, and a crash-safe
+  journal replayed on restart (`core/tx_journal.go:51`).
+
+`submit()` feeds both worlds: accepted transactions enter the pending
+structures AND are published on the feed the proposer subscribes to.
+Signed transactions are keyed by recovered sender; phase-1 opaque
+payloads (no signature) are admitted under a zero sender with feed-order
+nonce semantics.
 """
 
 from __future__ import annotations
 
 import os
-from typing import Optional
+from typing import Dict, List, Optional, Tuple
 
+from gethsharding_tpu import metrics
 from gethsharding_tpu.actors.base import Service
+from gethsharding_tpu.core.state_processor import recover_sender
 from gethsharding_tpu.core.types import Transaction
 from gethsharding_tpu.p2p.feed import Feed
+from gethsharding_tpu.utils.hexbytes import Address20
+
+
+class TxPoolError(Exception):
+    pass
 
 
 class TXPool(Service):
     name = "txpool"
 
     def __init__(self, simulate_interval: Optional[float] = 5.0,
-                 payload_size: int = 1024):
+                 payload_size: int = 1024, capacity: int = 4096,
+                 max_payload: int = 1 << 20,
+                 journal_path: Optional[str] = None):
         super().__init__()
         self.transactions_feed = Feed()
         self.simulate_interval = simulate_interval
         self.payload_size = payload_size
+        self.capacity = capacity
+        self.max_payload = max_payload
+        self.journal_path = journal_path
         self._nonce = 0
+        # sender -> {nonce: tx}; contiguous-from-lowest prefix is pending,
+        # the gapped remainder queued (tx_pool.go pending/queue split)
+        self._by_sender: Dict[Address20, Dict[int, Transaction]] = {}
+        self._hashes: set = set()
+        self.m_known = metrics.gauge("txpool/known")
+        self.m_dropped = metrics.counter("txpool/evicted")
+
+    # -- lifecycle ---------------------------------------------------------
 
     def on_start(self) -> None:
+        if self.journal_path:
+            self._replay_journal()
         if self.simulate_interval is not None:
             self.spawn(self._send_test_transactions)
 
+    # -- intake (core/tx_pool.go add/validateTx) ---------------------------
+
     def submit(self, tx: Transaction) -> int:
-        """Real tx intake: push into the feed, return subscriber count."""
+        """Validate + admit a transaction, journal it, and publish it on
+        the proposer feed. Returns the feed subscriber count.
+        Raises TxPoolError for invalid or duplicate transactions."""
+        self._admit(tx)
+        if self.journal_path:
+            self._journal(tx)
         return self.transactions_feed.send(tx)
+
+    def _admit(self, tx: Transaction) -> None:
+        if len(tx.payload) > self.max_payload:
+            raise TxPoolError("payload exceeds size cap")
+        tx_hash = bytes(tx.hash())
+        if tx_hash in self._hashes:
+            raise TxPoolError("already known")
+        sender = self._sender_of(tx)
+        slot = self._by_sender.setdefault(sender, {})
+        existing = slot.get(tx.nonce)
+        if existing is not None:
+            # replacement requires a strictly higher price (the reference's
+            # price-bump rule, simplified to >)
+            if tx.gas_price <= existing.gas_price:
+                raise TxPoolError("replacement transaction underpriced")
+            self._hashes.discard(bytes(existing.hash()))
+        slot[tx.nonce] = tx
+        self._hashes.add(tx_hash)
+        self._enforce_capacity()
+        self.m_known.set(len(self._hashes))
+
+    def _sender_of(self, tx: Transaction) -> Address20:
+        if tx.v or tx.r or tx.s:
+            sender = recover_sender(tx)
+            if sender is None:
+                raise TxPoolError("invalid signature")
+            return sender
+        return Address20()  # phase-1 opaque txs pool under the zero sender
+
+    def _enforce_capacity(self) -> None:
+        """Evict the globally cheapest transactions over capacity
+        (highest nonce first within a sender, so prefixes stay intact)."""
+        while len(self._hashes) > self.capacity:
+            cheapest: Optional[Tuple[Address20, int]] = None
+            cheapest_price = None
+            for sender, slot in self._by_sender.items():
+                nonce = max(slot)
+                price = slot[nonce].gas_price
+                if cheapest_price is None or price < cheapest_price:
+                    cheapest, cheapest_price = (sender, nonce), price
+            sender, nonce = cheapest
+            victim = self._by_sender[sender].pop(nonce)
+            if not self._by_sender[sender]:
+                del self._by_sender[sender]
+            self._hashes.discard(bytes(victim.hash()))
+            self.m_dropped.inc()
+
+    # -- views (tx_pool.go Pending) ----------------------------------------
+
+    def pending(self, limit: Optional[int] = None) -> List[Transaction]:
+        """Executable transactions: per sender the contiguous nonce run
+        from its lowest pooled nonce, merged across senders by price
+        (descending), nonce order preserved within a sender."""
+        runs = []
+        for sender, slot in self._by_sender.items():
+            nonces = sorted(slot)
+            run = [slot[nonces[0]]]
+            for prev, cur in zip(nonces, nonces[1:]):
+                if cur != prev + 1:
+                    break
+                run.append(slot[cur])
+            runs.append(run)
+        # price-greedy merge: repeatedly take the head with the best price
+        out: List[Transaction] = []
+        heads = [(run, 0) for run in runs]
+        while heads and (limit is None or len(out) < limit):
+            best = max(range(len(heads)),
+                       key=lambda i: heads[i][0][heads[i][1]].gas_price)
+            run, idx = heads[best]
+            out.append(run[idx])
+            if idx + 1 < len(run):
+                heads[best] = (run, idx + 1)
+            else:
+                heads.pop(best)
+        return out
+
+    def queued_count(self) -> int:
+        """Transactions parked behind nonce gaps."""
+        total = 0
+        for slot in self._by_sender.values():
+            nonces = sorted(slot)
+            run = 1
+            for prev, cur in zip(nonces, nonces[1:]):
+                if cur != prev + 1:
+                    break
+                run += 1
+            total += len(nonces) - run
+        return total
+
+    def known_count(self) -> int:
+        return len(self._hashes)
+
+    # -- journal (core/tx_journal.go) --------------------------------------
+
+    def _journal(self, tx: Transaction) -> None:
+        try:
+            with open(self.journal_path, "ab") as fh:
+                blob = tx.encode_rlp()
+                fh.write(len(blob).to_bytes(4, "big") + blob)
+        except OSError as exc:
+            self.record_error(f"journal write failed: {exc}")
+
+    def _replay_journal(self) -> None:
+        """Reload journaled transactions on restart (rotate semantics:
+        invalid/duplicate entries are dropped silently, like the
+        reference's journal.load device)."""
+        try:
+            with open(self.journal_path, "rb") as fh:
+                raw = fh.read()
+        except FileNotFoundError:
+            return
+        except OSError as exc:
+            self.record_error(f"journal read failed: {exc}")
+            return
+        offset, replayed = 0, 0
+        while offset + 4 <= len(raw):
+            size = int.from_bytes(raw[offset:offset + 4], "big")
+            blob = raw[offset + 4:offset + 4 + size]
+            if len(blob) < size:
+                break  # torn tail from a crash mid-write
+            offset += 4 + size
+            try:
+                self._admit(Transaction.decode_rlp(blob))
+                replayed += 1
+            except (TxPoolError, Exception):
+                continue
+        if replayed:
+            self.log.info("replayed %d journaled transactions", replayed)
+
+    # -- simulation mode (sharding/txpool/service.go parity) ---------------
 
     def _make_test_tx(self) -> Transaction:
         self._nonce += 1
@@ -46,4 +215,7 @@ class TXPool(Service):
 
     def _send_test_transactions(self) -> None:
         while not self.wait(self.simulate_interval):
-            self.submit(self._make_test_tx())
+            try:
+                self.submit(self._make_test_tx())
+            except TxPoolError as exc:
+                self.record_error(f"test tx rejected: {exc}")

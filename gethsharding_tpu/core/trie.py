@@ -1,8 +1,9 @@
 """Merkle-Patricia trie (hexary), hash-compatible with the reference `trie/`.
 
-Only the parts the sharding data path needs: insert-only tries whose root
-hash feeds `DeriveSha` (chunk roots, tx roots). Node encoding follows the
-Ethereum yellow-paper / go-ethereum 1.8 rules:
+Insert/update/get/delete plus merkle proofs (`prove`/`verify_proof`,
+parity: `trie/proof.go`) and the keccak-keyed `SecureTrie` wrapper
+(`trie/secure_trie.go`). Node encoding follows the Ethereum
+yellow-paper / go-ethereum 1.8 rules:
 
 - leaf/extension nodes: 2-item RLP list [hex-prefix-encoded path, value]
 - branch nodes: 17-item RLP list (16 children + value)
@@ -96,8 +97,15 @@ class Trie:
 
     def update(self, key: bytes, value: bytes) -> None:
         if value == b"":
-            raise ValueError("deletion not supported in this trie")
+            # geth semantics: updating to an empty value deletes the key
+            self.delete(key)
+            return
         self._root = self._insert(self._root, _to_nibbles(key), value)
+
+    def delete(self, key: bytes) -> None:
+        """Remove a key (no-op if absent), restructuring single-child
+        branches back into extensions/leaves (trie/trie.go delete)."""
+        self._root = self._delete(self._root, _to_nibbles(key))
 
     def get(self, key: bytes) -> Optional[bytes]:
         node = self._root
@@ -167,6 +175,89 @@ class Trie:
         node.children[path[0]] = self._insert(node.children[path[0]], path[1:], value)
         return node
 
+    def _delete(self, node: Optional[_Node], path: tuple) -> Optional[_Node]:
+        if node is None:
+            return None
+        if isinstance(node, _Leaf):
+            return None if node.path == path else node
+        if isinstance(node, _Extension):
+            n = len(node.path)
+            if path[:n] != node.path:
+                return node
+            child = self._delete(node.child, path[n:])
+            if child is None:
+                return None
+            return self._merge_extension(node.path, child)
+        # branch
+        if not path:
+            if node.value is None:
+                return node
+            node.value = None
+        else:
+            idx = path[0]
+            node.children[idx] = self._delete(node.children[idx], path[1:])
+        return self._collapse_branch(node)
+
+    def _merge_extension(self, prefix: tuple, child: _Node) -> _Node:
+        """Extension over `prefix` pointing at `child`, merging nested
+        extensions/leaves into one path segment."""
+        if isinstance(child, _Leaf):
+            return _Leaf(prefix + child.path, child.value)
+        if isinstance(child, _Extension):
+            return _Extension(prefix + child.path, child.child)
+        return _Extension(prefix, child)
+
+    def _collapse_branch(self, node: "_Branch") -> Optional[_Node]:
+        live = [(i, c) for i, c in enumerate(node.children) if c is not None]
+        if node.value is not None:
+            if live:
+                return node
+            return _Leaf((), node.value)
+        if len(live) > 1:
+            return node
+        if not live:
+            return None
+        idx, child = live[0]
+        return self._merge_extension((idx,), child)
+
+    # -- merkle proofs (trie/proof.go Prove/VerifyProof) -------------------
+
+    def prove(self, key: bytes) -> list:
+        """Ordered list of node RLP blobs from the root along `key`'s
+        path — every HASH-REFERENCED node on the path (embedded sub-nodes
+        travel inside their parent's blob, as in geth)."""
+        proof = []
+        node = self._root
+        path = _to_nibbles(key)
+        while node is not None:
+            proof.append(rlp_encode(self._node_structure(node)))
+            # advance to the next hash-referenced node on the path
+            node, path = self._next_hashed(node, path)
+        return proof
+
+    def _next_hashed(self, node: _Node, path: tuple):
+        """Walk within one blob (through embedded children) until the path
+        needs a node that is referenced by hash; returns (node, rest)."""
+        while True:
+            if isinstance(node, _Leaf):
+                return None, path
+            if isinstance(node, _Extension):
+                n = len(node.path)
+                if path[:n] != node.path:
+                    return None, path
+                path = path[n:]
+                child = node.child
+            else:
+                if not path:
+                    return None, path
+                child = node.children[path[0]]
+                path = path[1:]
+                if child is None:
+                    return None, path
+            if len(rlp_encode(self._node_structure(child))) >= 32:
+                return child, path
+            node = child  # embedded: keep walking inside this blob
+
     # -- hashing ----------------------------------------------------------
 
     def root_hash(self) -> bytes:
@@ -192,3 +283,96 @@ class Trie:
         if len(raw) >= 32:
             return keccak256(raw)
         return structure  # embedded node: nested list inside parent RLP
+
+
+def verify_proof(root_hash: bytes, key: bytes, proof: list) -> Optional[bytes]:
+    """Check a merkle proof against a root hash; returns the proven value,
+    None for a proven ABSENCE, and raises ValueError on an invalid proof.
+    Parity: `trie/proof.go VerifyProof`."""
+    from gethsharding_tpu.utils.rlp import rlp_decode
+
+    if not proof:
+        if root_hash == EMPTY_ROOT:
+            return None
+        raise ValueError("empty proof for non-empty root")
+    expected = bytes(root_hash)
+    path = _to_nibbles(key)
+    i = 0
+    structure = None
+    while True:
+        if structure is None:
+            if i >= len(proof):
+                raise ValueError("proof exhausted before path ended")
+            blob = bytes(proof[i])
+            if keccak256(blob) != expected:
+                raise ValueError("proof node hash mismatch")
+            structure = rlp_decode(blob)
+            i += 1
+        if not isinstance(structure, list):
+            raise ValueError("malformed proof node")
+        if len(structure) == 2:
+            path_seg, is_leaf = _hp_decode(structure[0])
+            if is_leaf:
+                if path_seg == path:
+                    if i != len(proof):
+                        raise ValueError("trailing proof nodes")
+                    return structure[1]
+                return None  # proven absence
+            if path[:len(path_seg)] != path_seg:
+                return None
+            path = path[len(path_seg):]
+            nxt = structure[1]
+        elif len(structure) == 17:
+            if not path:
+                value = structure[16]
+                return value if value != b"" else None
+            nxt = structure[path[0]]
+            path = path[1:]
+            if nxt == b"":
+                return None
+        else:
+            raise ValueError("malformed proof node")
+        if isinstance(nxt, list):
+            structure = nxt  # embedded child inside the same blob
+        else:
+            if len(nxt) != 32:
+                raise ValueError("malformed child reference")
+            expected = bytes(nxt)
+            structure = None
+
+
+def _hp_decode(encoded: bytes):
+    """Inverse of hex_prefix_encode -> (nibbles, is_leaf)."""
+    if not encoded:
+        raise ValueError("empty hex-prefix encoding")
+    flag = encoded[0] >> 4
+    nibbles = []
+    if flag & 1:
+        nibbles.append(encoded[0] & 0x0F)
+    for b in encoded[1:]:
+        nibbles.append(b >> 4)
+        nibbles.append(b & 0x0F)
+    return tuple(nibbles), bool(flag & 2)
+
+
+class SecureTrie:
+    """Trie over keccak256(key) — the state-trie keying scheme
+    (`trie/secure_trie.go`)."""
+
+    def __init__(self):
+        self._trie = Trie()
+
+    def update(self, key: bytes, value: bytes) -> None:
+        self._trie.update(keccak256(key), value)
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        return self._trie.get(keccak256(key))
+
+    def delete(self, key: bytes) -> None:
+        self._trie.delete(keccak256(key))
+
+    def prove(self, key: bytes) -> list:
+        return self._trie.prove(keccak256(key))
+
+    def root_hash(self) -> bytes:
+        return self._trie.root_hash()

@@ -87,3 +87,90 @@ def test_chunk_root_encodes_bytes_as_uint():
     assert chunk_root(b"\x00") == derive_sha([rlp_encode(0)])
     assert chunk_root(b"\x01") == derive_sha([rlp_encode(1)])
     assert chunk_root(b"\x80") == derive_sha([bytes.fromhex("8180")])
+
+
+def test_delete_matches_fresh_build():
+    """Insert/delete sequences must land on the same root as building a
+    fresh trie with the surviving pairs (structure fully canonicalized)."""
+    import random
+
+    from gethsharding_tpu.core.trie import EMPTY_ROOT, Trie
+
+    rng = random.Random(99)
+    for trial in range(6):
+        pairs = {}
+        trie = Trie()
+        for _ in range(rng.randrange(5, 80)):
+            k = bytes(rng.randrange(256) for _ in range(rng.randrange(1, 6)))
+            v = bytes([rng.randrange(1, 256)])
+            pairs[k] = v
+            trie.update(k, v)
+        doomed = rng.sample(sorted(pairs), k=len(pairs) // 2)
+        for k in doomed:
+            trie.delete(k)
+            del pairs[k]
+        trie.delete(b"\xde\xad\xbe\xef")  # absent key: no-op
+        fresh = Trie()
+        for k, v in pairs.items():
+            fresh.update(k, v)
+        assert trie.root_hash() == fresh.root_hash(), trial
+        for k, v in pairs.items():
+            assert trie.get(k) == v
+        # empty-value update deletes (geth semantics)
+        if pairs:
+            k = next(iter(pairs))
+            trie.update(k, b"")
+            assert trie.get(k) is None
+    empty = Trie()
+    empty.update(b"x", b"1")
+    empty.delete(b"x")
+    assert empty.root_hash() == EMPTY_ROOT
+
+
+def test_merkle_proofs_round_trip_and_tamper():
+    import random
+
+    from gethsharding_tpu.core.trie import Trie, verify_proof
+
+    rng = random.Random(7)
+    trie = Trie()
+    pairs = {}
+    for _ in range(120):
+        k = bytes(rng.randrange(256) for _ in range(rng.randrange(1, 5)))
+        v = bytes(rng.randrange(1, 256) for _ in range(rng.randrange(1, 40)))
+        pairs[k] = v
+        trie.update(k, v)
+    root = trie.root_hash()
+    for k, v in list(pairs.items())[:30]:
+        proof = trie.prove(k)
+        assert verify_proof(root, k, proof) == v
+    # absence proof: a key that is not present verifies to None
+    absent = b"\xff\xff\xff\xff\xff\xff"
+    assert verify_proof(root, absent, trie.prove(absent)) is None
+    # a tampered proof must be rejected
+    k = next(iter(pairs))
+    proof = trie.prove(k)
+    bad = [bytes(proof[0][:-1]) + bytes([proof[0][-1] ^ 1])] + proof[1:]
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        verify_proof(root, k, bad)
+
+
+def test_secure_trie_keys_are_hashed():
+    from gethsharding_tpu.core.trie import SecureTrie, Trie, verify_proof
+    from gethsharding_tpu.crypto.keccak import keccak256
+
+    st = SecureTrie()
+    st.update(b"account-1", b"\x01")
+    st.update(b"account-2", b"\x02")
+    plain = Trie()
+    plain.update(keccak256(b"account-1"), b"\x01")
+    plain.update(keccak256(b"account-2"), b"\x02")
+    assert st.root_hash() == plain.root_hash()
+    assert st.get(b"account-1") == b"\x01"
+    proof = st.prove(b"account-2")
+    assert verify_proof(st.root_hash(), keccak256(b"account-2"),
+                        proof) == b"\x02"
+    st.delete(b"account-1")
+    assert st.get(b"account-1") is None
