@@ -83,7 +83,13 @@ class Proposer(Service):
                     return
                 continue
             try:
-                self.create_and_submit([tx])
+                # a feed event wakes the proposer; the collation packs the
+                # pool's full price-ordered pending selection (the feed tx
+                # was admitted to the pool before publication), which the
+                # pool then drops as included — core/tx_pool Pending +
+                # mined-drop semantics
+                batch = self.txpool.take_pending()
+                self.create_and_submit(batch if batch else [tx])
             except Exception as exc:
                 self.record_error(f"create collation failed: {exc}")
 

@@ -166,6 +166,27 @@ class TXPool(Service):
     def known_count(self) -> int:
         return len(self._hashes)
 
+    def take_pending(self, limit: Optional[int] = None) -> List[Transaction]:
+        """Pop the current pending selection for inclusion in a collation
+        (the reference drops mined txs from the pool on block events)."""
+        out = self.pending(limit)
+        self.remove(out)
+        return out
+
+    def remove(self, txs: List[Transaction]) -> None:
+        for tx in txs:
+            tx_hash = bytes(tx.hash())
+            if tx_hash not in self._hashes:
+                continue
+            self._hashes.discard(tx_hash)
+            sender = self._sender_of(tx)
+            slot = self._by_sender.get(sender)
+            if slot is not None:
+                slot.pop(tx.nonce, None)
+                if not slot:
+                    del self._by_sender[sender]
+        self.m_known.set(len(self._hashes))
+
     # -- journal (core/tx_journal.go) --------------------------------------
 
     def _journal(self, tx: Transaction) -> None:
