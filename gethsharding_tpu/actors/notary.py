@@ -140,11 +140,8 @@ class Notary(Service):
                      if self.all_shards else [self.shard.shard_id])
 
         # phase 1: collect every eligible (shard, record) pair this period
-        me = self.client.account()
         candidates: List[Tuple[int, int, object]] = []
-        for shard_id in shard_ids:
-            if self.client.get_notary_in_committee(shard_id) != me:
-                continue
+        for shard_id in self._eligible_shards(shard_ids):
             record = self.client.collation_record(shard_id, period)
             if (record is None
                     or self.client.last_submitted_collation(shard_id) != period):
@@ -174,6 +171,36 @@ class Notary(Service):
             with self.m_validate_latency.time():
                 self.submit_vote(shard_id, p, record,
                                  proposer_sig_checked=True)
+
+    def _eligible_shards(self, shard_ids) -> List[int]:
+        """Committee eligibility for ALL shards from one sampling-context
+        view: the reference issues an eth_call per shard per head
+        (`notary.go:62`, the network-bound hot loop SURVEY.md §3.1 flags);
+        here the keccak sampling runs locally over the fetched context.
+        Falls back to per-shard calls when the backend lacks the view."""
+        from gethsharding_tpu.crypto.keccak import keccak256
+
+        ctx = self.client.committee_context()
+        me = self.client.account()
+        if ctx is None:
+            return [s for s in shard_ids
+                    if self.client.get_notary_in_committee(s) == me]
+        sample_size = ctx["sample_size"]
+        if sample_size <= 0:
+            return []
+        registry = self.client.notary_registry()
+        pool_index = registry.pool_index if registry is not None else 0
+        prefix = ctx["blockhash"] + pool_index.to_bytes(32, "big")
+        pool = ctx["pool"]
+        me_raw = bytes(me)
+        out = []
+        for shard_id in shard_ids:
+            digest = keccak256(prefix + shard_id.to_bytes(32, "big"))
+            slot = int.from_bytes(digest, "big") % sample_size
+            member = pool[slot] if slot < len(pool) else None
+            if member is not None and member == me_raw:
+                out.append(shard_id)
+        return out
 
     # -- voting (notary.go:413 submitVote) ---------------------------------
 

@@ -82,6 +82,12 @@ class SMCClient:
             sender if sender is not None else self._account.address, shard_id
         )
 
+    def committee_context(self) -> Optional[dict]:
+        """One-call sampling context for local all-shard eligibility
+        (None when the backend doesn't serve it)."""
+        fn = getattr(self.backend, "committee_context", None)
+        return fn() if fn is not None else None
+
     def notary_registry(self, address: Optional[Address20] = None):
         return self.backend.notary_registry(
             address if address is not None else self._account.address

@@ -211,6 +211,16 @@ class RemoteMainchain:
         return codec.dec_registry(self.rpc.call(
             "shard_notaryRegistry", codec.enc_bytes(address)))
 
+    def committee_context(self) -> dict:
+        ctx = self.rpc.call("shard_committeeContext")
+        return {
+            "period": ctx["period"],
+            "sample_size": ctx["sampleSize"],
+            "blockhash": codec.dec_bytes(ctx["blockhash"]),
+            "pool": [None if a is None else codec.dec_bytes(a)
+                     for a in ctx["pool"]],
+        }
+
     def collation_record(self, shard_id: int, period: int):
         return codec.dec_record(self.rpc.call(
             "shard_collationRecord", shard_id, period))

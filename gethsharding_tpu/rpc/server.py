@@ -167,6 +167,16 @@ class RPCServer:
         return codec.enc_bytes(self.backend.get_notary_in_committee(
             Address20(codec.dec_bytes(sender)), shard_id))
 
+    def rpc_committeeContext(self):
+        ctx = self.backend.committee_context()
+        return {
+            "period": ctx["period"],
+            "sampleSize": ctx["sample_size"],
+            "blockhash": codec.enc_bytes(ctx["blockhash"]),
+            "pool": [None if a is None else codec.enc_bytes(a)
+                     for a in ctx["pool"]],
+        }
+
     def rpc_notaryRegistry(self, address):
         return codec.enc_registry(self.backend.notary_registry(
             Address20(codec.dec_bytes(address))))

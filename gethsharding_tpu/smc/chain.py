@@ -230,6 +230,35 @@ class SimulatedMainchain:
         pool = self.smc.notary_pool
         return pool[index] if 0 <= index < len(pool) else None
 
+    def committee_context(self) -> dict:
+        """The sampling inputs for the CURRENT period in one view call:
+        clients compute all-shard committee eligibility locally (one
+        keccak batch) instead of one eth_call per shard — the reference's
+        per-head x per-shard scan (`sharding/notary/notary.go:62`,
+        SURVEY.md §3.1 hot loop) collapsed into a single round-trip.
+
+        Mirrors `get_notary_in_committee_view`'s sample-size simulation
+        exactly; `pool` is the raw slot array (None = emptied slot)."""
+        with self._lock:
+            smc = self.smc
+            period = self.current_period()
+            sample_size_last_updated = smc.sample_size_last_updated_period
+            current_size = smc.current_period_notary_sample_size
+            next_size = smc.next_period_notary_sample_size
+            if period >= sample_size_last_updated:
+                current_size = next_size
+                sample_size_last_updated = period
+            sample_size = (next_size if period > sample_size_last_updated
+                           else current_size)
+            latest_block = period * self.config.period_length - 1
+            return {
+                "period": period,
+                "sample_size": sample_size,
+                "blockhash": bytes(self.blockhash(latest_block)),
+                "pool": [None if a is None else bytes(a)
+                         for a in smc.notary_pool],
+            }
+
     def has_voted(self, shard_id: int, index: int) -> bool:
         return self.smc.has_voted(shard_id, index)
 

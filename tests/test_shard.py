@@ -102,3 +102,39 @@ def test_check_availability_without_chunk_root(shard):
     header = CollationHeader(shard_id=1, period=1)
     with pytest.raises(ShardError, match="no chunk root"):
         shard.check_availability(header)
+
+
+def test_concurrent_db_access_smoke():
+    """Concurrent readers/writers on one shard DB (the reference's
+    Test_DBConcurrent smoke, sharding/database/database_test.go:49)."""
+    import threading
+
+    from gethsharding_tpu.core.shard import Shard
+    from gethsharding_tpu.core.types import Collation, CollationHeader, Transaction
+    from gethsharding_tpu.db.kv import MemoryKV
+
+    shard = Shard(shard_id=0, shard_db=MemoryKV())
+    errors = []
+
+    def worker(worker_id: int):
+        try:
+            for i in range(25):
+                txs = [Transaction(nonce=i, payload=bytes([worker_id, i]))]
+                from gethsharding_tpu.core.types import serialize_txs_to_blob
+
+                header = CollationHeader(shard_id=0, period=i)
+                collation = Collation(header=header,
+                                      body=serialize_txs_to_blob(txs),
+                                      transactions=txs)
+                collation.calculate_chunk_root()
+                shard.save_collation(collation)
+                assert shard.check_availability(header)
+        except Exception as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors

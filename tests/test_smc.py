@@ -357,3 +357,39 @@ def test_events_emitted():
     assert "HeaderAdded" in names
     chain.submit_vote(accounts[0], 0, period, 0, root)
     assert chain.smc.events[-1].name == "VoteSubmitted"
+
+
+def test_committee_context_matches_per_shard_view():
+    """Local all-shard eligibility from committee_context must agree with
+    the per-shard get_notary_in_committee view for every (notary, shard)."""
+    from gethsharding_tpu.crypto.keccak import keccak256
+    from gethsharding_tpu.mainchain.accounts import AccountManager
+    from gethsharding_tpu.params import Config, ETHER
+    from gethsharding_tpu.smc.chain import SimulatedMainchain
+
+    config = Config(shard_count=16)
+    chain = SimulatedMainchain(config=config)
+    manager = AccountManager()
+    accounts = [manager.new_account(seed=bytes([i])) for i in range(7)]
+    for acct in accounts:
+        chain.fund(acct.address, 2000 * ETHER)
+        chain.register_notary(acct.address)
+    chain.fast_forward(2)
+    # a deregistration mid-stream exercises the emptied-slot path
+    chain.deregister_notary(accounts[2].address)
+    chain.fast_forward(1)
+
+    ctx = chain.committee_context()
+    for acct in accounts:
+        entry = chain.notary_registry(acct.address)
+        pool_index = entry.pool_index if entry is not None else 0
+        for shard in range(config.shard_count):
+            digest = keccak256(ctx["blockhash"]
+                               + pool_index.to_bytes(32, "big")
+                               + shard.to_bytes(32, "big"))
+            slot = int.from_bytes(digest, "big") % ctx["sample_size"]
+            member = (ctx["pool"][slot]
+                      if slot < len(ctx["pool"]) else None)
+            local = member is not None and member == bytes(acct.address)
+            view = chain.get_notary_in_committee(acct.address, shard)
+            assert local == (view == acct.address), (pool_index, shard)
