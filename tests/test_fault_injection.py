@@ -62,6 +62,12 @@ class FaultyClient(SMCClient):
         self._maybe("get_notary_in_committee")
         return super().get_notary_in_committee(shard_id, sender)
 
+    def committee_context(self):
+        self._maybe("committee_context")
+        if "no_committee_context" in self.fail:
+            return None  # backend without the batched view
+        return super().committee_context()
+
 
 def shard_fixture():
     return Shard(shard_id=0, shard_db=MemoryKV())
@@ -124,8 +130,10 @@ def test_notary_faulty_committee_caller_records_head_error():
     parity)."""
     config = Config(quorum_size=1)
     backend = SimulatedMainchain(config=config)
+    # fail the batched sampling view AND the per-shard fallback
     client = FaultyClient(backend=backend, config=config,
-                          fail={"get_notary_in_committee"})
+                          fail={"committee_context",
+                                "get_notary_in_committee"})
     backend.fund(client.account(), 2000 * ETHER)
     notary = Notary(client=client, shard=shard_fixture(), config=config,
                     deposit_flag=True)
@@ -180,3 +188,36 @@ def test_simulator_faulty_record_fetcher_records_error():
         simulator.stop()
         p2p.stop()
     assert any("simulator tick failed" in e for e in simulator.errors)
+
+
+def test_notary_falls_back_to_per_shard_view_without_context():
+    """A backend without the batched sampling view degrades to the
+    reference's per-shard calls, and votes still land."""
+    config = Config(quorum_size=1)
+    backend = SimulatedMainchain(config=config)
+    client = FaultyClient(backend=backend, config=config,
+                          fail={"no_committee_context"})
+    backend.fund(client.account(), 2000 * ETHER)
+    notary = Notary(client=client, shard=shard_fixture(), config=config,
+                    deposit_flag=True, all_shards=False)
+    notary.start()
+    try:
+        backend.fast_forward(1)
+        from gethsharding_tpu.actors.proposer import create_collation
+
+        period = backend.current_period()
+        collation = create_collation(client, 0, period, [Transaction(
+            nonce=1, payload=b"fallback")])
+        notary.shard.save_collation(collation)
+        client.add_header(0, period, collation.header.chunk_root,
+                          collation.header.proposer_signature)
+        approved = False
+        for _ in range(config.period_length - 1):
+            backend.commit()  # heads drive the notary loop
+            if wait_until(lambda: backend.last_approved_collation(0) == period,
+                          timeout=2.0):
+                approved = True
+                break
+        assert approved, notary.errors
+    finally:
+        notary.stop()
