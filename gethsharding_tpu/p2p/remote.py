@@ -1,0 +1,96 @@
+"""RemoteHub: the shardp2p feed bus across OS processes.
+
+The in-process `Hub` gives actors typed pub/sub within one process; this
+adapter runs the SAME `P2PServer` API over the RPC relay hosted by the
+chain process (`rpc/server.py` shard_p2p* methods), so body requests and
+responses between a proposer process and a notary process cross a real
+socket — the transport the reference's shardp2p stubs out
+(`sharding/p2p/service.go:41-50` Send/Broadcast TODOs) and defers to a
+future devp2p integration.
+
+Wire format: messages serialize through the codec registry in
+`rpc/codec.py` (type-tagged JSON); peers are relay-allocated ids, so a
+responder can reply directly to the requesting peer across processes.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+from gethsharding_tpu.p2p.service import Message, Peer
+from gethsharding_tpu.rpc import codec
+from gethsharding_tpu.rpc.client import RPCClient
+
+log = logging.getLogger("p2p.remote")
+
+
+class RemoteHub:
+    """Hub duck-type backed by the chain process's p2p relay.
+
+    One RemoteHub carries ONE attached P2PServer (one actor process); its
+    peer id is allocated by the relay and is meaningful across every
+    process attached to the same relay.
+    """
+
+    def __init__(self, rpc: RPCClient):
+        self.rpc = rpc
+        self._server = None
+        rpc.on_notification("shard_p2p", self._on_message)
+
+    @classmethod
+    def dial(cls, host: str, port: int) -> "RemoteHub":
+        return cls(RPCClient(host, port))
+
+    def close(self) -> None:
+        self.rpc.close()
+
+    # -- Hub surface (p2p/service.py) --------------------------------------
+
+    def attach(self, server) -> Peer:
+        if self._server is not None:
+            raise RuntimeError("RemoteHub carries exactly one P2PServer; "
+                               "dial another connection per actor")
+        # register the delivery target BEFORE the relay learns about the
+        # peer: it may start pushing the instant the attach call lands
+        self._server = server
+        try:
+            peer_id = self.rpc.call("shard_p2pAttach")
+        except Exception:
+            self._server = None
+            raise
+        return Peer(peer_id)
+
+    def detach(self, peer: Peer) -> None:
+        """Detach = end of this hub's life (it carries exactly one
+        P2PServer): deregister from the relay and close the connection,
+        so a stopped node leaks neither socket nor reader threads."""
+        self._server = None
+        try:
+            self.rpc.call("shard_p2pDetach", peer.peer_id)
+        except Exception:  # connection may already be down
+            pass
+        self.close()
+
+    def route(self, sender: Peer, target: Peer, data: Any) -> bool:
+        kind, payload = codec.enc_p2p(data)
+        return self.rpc.call("shard_p2pSend", sender.peer_id,
+                             target.peer_id, kind, payload)
+
+    def broadcast(self, sender: Peer, data: Any) -> int:
+        kind, payload = codec.enc_p2p(data)
+        return self.rpc.call("shard_p2pBroadcast", sender.peer_id, kind,
+                             payload)
+
+    # -- inbound -----------------------------------------------------------
+
+    def _on_message(self, params: dict) -> None:
+        server = self._server
+        if server is None:
+            return
+        try:
+            data = codec.dec_p2p(params["type"], params["payload"])
+        except Exception:
+            log.exception("undecodable p2p message")
+            return
+        server._deliver(Message(peer=Peer(params["from"]), data=data))

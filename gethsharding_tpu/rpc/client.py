@@ -70,6 +70,7 @@ class RPCClient:
         self._pending_lock = threading.Lock()
         self._write_lock = threading.Lock()
         self._head_subscribers: List[Callable] = []
+        self._notification_hooks: dict = {}
         self._timeout = timeout
         self._closed = False
         # notifications are dispatched OFF the reader thread: subscriber
@@ -127,6 +128,11 @@ class RPCClient:
 
         return unsubscribe
 
+    def on_notification(self, method: str, callback: Callable) -> None:
+        """Route push notifications with the given method (e.g. the
+        shard_p2p relay) to `callback(params)` off the reader thread."""
+        self._notification_hooks[method] = callback
+
     def _read_loop(self) -> None:
         try:
             for raw in self._file:
@@ -134,9 +140,13 @@ class RPCClient:
                     msg = json.loads(raw)
                 except json.JSONDecodeError:
                     continue
-                if msg.get("method") == "shard_subscription":
+                method = msg.get("method")
+                if method == "shard_subscription":
                     self._notifications.put(
-                        _dec_block(msg["params"]["result"]))
+                        ("heads", _dec_block(msg["params"]["result"])))
+                    continue
+                if method in self._notification_hooks:
+                    self._notifications.put((method, msg.get("params")))
                     continue
                 rid = msg.get("id")
                 with self._pending_lock:
@@ -163,14 +173,23 @@ class RPCClient:
 
     def _dispatch_loop(self) -> None:
         while True:
-            block = self._notifications.get()
-            if block is None:
+            item = self._notifications.get()
+            if item is None:
                 return
-            for callback in list(self._head_subscribers):
+            method, payload = item
+            if method == "heads":
+                for callback in list(self._head_subscribers):
+                    try:
+                        callback(payload)
+                    except Exception:  # noqa: BLE001 - subscriber owns it
+                        log.exception("head subscriber failed")
+                continue
+            hook = self._notification_hooks.get(method)
+            if hook is not None:
                 try:
-                    callback(block)
-                except Exception:  # noqa: BLE001 - subscriber owns it
-                    log.exception("head subscriber failed")
+                    hook(payload)
+                except Exception:  # noqa: BLE001
+                    log.exception("notification hook %s failed", method)
 
 
 class RemoteMainchain:

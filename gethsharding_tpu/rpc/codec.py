@@ -105,6 +105,52 @@ def dec_record(obj: Optional[dict]):
     )
 
 
+# -- shardp2p message codecs (type-tagged, for the cross-process relay) ----
+
+
+def enc_p2p(data) -> tuple:
+    """Message object -> (type tag, JSON payload)."""
+    from gethsharding_tpu.p2p import messages as m
+
+    if isinstance(data, m.CollationBodyRequest):
+        return "CollationBodyRequest", {
+            "chunkRoot": None if data.chunk_root is None
+            else enc_bytes(data.chunk_root),
+            "shardId": data.shard_id,
+            "period": data.period,
+            "proposer": None if data.proposer is None
+            else enc_bytes(data.proposer),
+            "signature": enc_bytes(data.signature),
+        }
+    if isinstance(data, m.CollationBodyResponse):
+        return "CollationBodyResponse", {
+            "headerHash": enc_bytes(data.header_hash),
+            "body": enc_bytes(data.body),
+        }
+    raise TypeError(f"no p2p wire codec for {type(data).__name__}")
+
+
+def dec_p2p(kind: str, payload: dict):
+    from gethsharding_tpu.p2p import messages as m
+
+    if kind == "CollationBodyRequest":
+        return m.CollationBodyRequest(
+            chunk_root=None if payload["chunkRoot"] is None
+            else Hash32(dec_bytes(payload["chunkRoot"])),
+            shard_id=payload["shardId"],
+            period=payload["period"],
+            proposer=None if payload["proposer"] is None
+            else Address20(dec_bytes(payload["proposer"])),
+            signature=dec_bytes(payload["signature"]),
+        )
+    if kind == "CollationBodyResponse":
+        return m.CollationBodyResponse(
+            header_hash=Hash32(dec_bytes(payload["headerHash"])),
+            body=dec_bytes(payload["body"]),
+        )
+    raise ValueError(f"unknown p2p message type {kind!r}")
+
+
 def enc_block(block) -> dict:
     return {"number": block.number, "hash": enc_bytes(block.hash),
             "parentHash": enc_bytes(block.parent_hash)}

@@ -56,6 +56,10 @@ class RPCServer:
         self.address = self._tcp.server_address  # (host, bound_port)
         self._thread: Optional[threading.Thread] = None
         self._unsubscribe = backend.subscribe_new_head(self._on_head)
+        # shardp2p relay: peer id -> (wfile, write lock); actors in other
+        # processes attach here and exchange typed messages through us
+        self._p2p_peers: dict = {}
+        self._p2p_ids = 1
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -113,6 +117,10 @@ class RPCServer:
         finally:
             with self._sub_lock:
                 self._subscribers.pop(handler.wfile, None)
+                dead = [pid for pid, (wf, _) in self._p2p_peers.items()
+                        if wf is handler.wfile]
+                for pid in dead:
+                    self._p2p_peers.pop(pid, None)
 
     def _dispatch(self, raw: bytes, handler, write_lock) -> Optional[dict]:
         try:
@@ -128,6 +136,12 @@ class RPCServer:
                 with self._sub_lock:
                     self._subscribers[handler.wfile] = write_lock
                 result = "newHeads"
+            elif method == "shard_p2pAttach":
+                with self._sub_lock:
+                    peer_id = self._p2p_ids
+                    self._p2p_ids += 1
+                    self._p2p_peers[peer_id] = (handler.wfile, write_lock)
+                result = peer_id
             else:
                 fn = getattr(self, "rpc_" + method.replace("shard_", "", 1),
                              None)
@@ -242,6 +256,52 @@ class RPCServer:
 
     # dev-mode chain control (the SimulatedBackend Commit/FastForward
     # surface, exposed so a test/driver process can steer the chain)
+
+    # shardp2p relay (the cross-process feed-bus transport; see
+    # gethsharding_tpu/p2p/remote.py)
+
+    def _p2p_push(self, peer_id, note_bytes) -> bool:
+        with self._sub_lock:
+            entry = self._p2p_peers.get(peer_id)
+        if entry is None:
+            return False
+        wfile, lock = entry
+        try:
+            with lock:
+                wfile.write(note_bytes)
+                wfile.flush()
+            return True
+        except OSError:
+            with self._sub_lock:
+                self._p2p_peers.pop(peer_id, None)
+            return False
+
+    @staticmethod
+    def _p2p_note(to_id, from_id, kind, payload) -> bytes:
+        return (json.dumps({
+            "jsonrpc": "2.0", "method": "shard_p2p",
+            "params": {"to": to_id, "from": from_id, "type": kind,
+                       "payload": payload},
+        }) + "\n").encode()
+
+    def rpc_p2pDetach(self, peer_id):
+        with self._sub_lock:
+            self._p2p_peers.pop(peer_id, None)
+        return True
+
+    def rpc_p2pSend(self, from_id, to_id, kind, payload):
+        return self._p2p_push(to_id,
+                              self._p2p_note(to_id, from_id, kind, payload))
+
+    def rpc_p2pBroadcast(self, from_id, kind, payload):
+        with self._sub_lock:
+            targets = [pid for pid in self._p2p_peers if pid != from_id]
+        delivered = 0
+        for pid in targets:
+            if self._p2p_push(pid, self._p2p_note(pid, from_id, kind,
+                                                  payload)):
+                delivered += 1
+        return delivered
 
     def rpc_fund(self, address, amount):
         self.backend.fund(Address20(codec.dec_bytes(address)), amount)

@@ -15,7 +15,6 @@ import pytest
 from gethsharding_tpu.actors import Notary, Proposer, TXPool
 from gethsharding_tpu.core.types import Transaction
 from gethsharding_tpu.node.backend import ShardNode
-from gethsharding_tpu.p2p.service import Hub
 from gethsharding_tpu.params import Config, ETHER
 from gethsharding_tpu.rpc import RemoteMainchain, RPCServer
 from gethsharding_tpu.smc.chain import SimulatedMainchain
@@ -84,7 +83,11 @@ def test_head_subscription_pushes(rpc_pair):
 def test_full_period_pipeline_cross_process(tmp_path):
     """test_end_to_end's period pipeline with the mainchain in its own OS
     process: proposer + notary live here, the chain and SMC live in the
-    child, every interaction crosses the JSON-RPC wire."""
+    child, and EVERYTHING crosses the JSON-RPC wire — SMC transactions,
+    head subscriptions, AND the shardp2p body sync (each node's p2p rides
+    its own socket through the chain process's relay)."""
+    from gethsharding_tpu.p2p.remote import RemoteHub
+
     proc = subprocess.Popen(
         [sys.executable, "-m", "gethsharding_tpu.rpc.chain_server",
          "--periodlength", "5", "--quorum", "1", "--runtime", "120"],
@@ -94,17 +97,18 @@ def test_full_period_pipeline_cross_process(tmp_path):
         endpoint = json.loads(proc.stdout.readline())
         config = Config(quorum_size=1)
         chain_ctl = RemoteMainchain.dial(endpoint["host"], endpoint["port"])
-        hub = Hub()
         shard_id = 2
 
         proposer_node = ShardNode(
             actor="proposer", shard_id=shard_id, config=config,
             backend=RemoteMainchain.dial(endpoint["host"], endpoint["port"]),
-            hub=hub, txpool_interval=None)
+            hub=RemoteHub.dial(endpoint["host"], endpoint["port"]),
+            txpool_interval=None)
         notary_node = ShardNode(
             actor="notary", shard_id=shard_id, config=config,
             backend=RemoteMainchain.dial(endpoint["host"], endpoint["port"]),
-            hub=hub, deposit=True)
+            hub=RemoteHub.dial(endpoint["host"], endpoint["port"]),
+            deposit=True)
         chain_ctl.fund(notary_node.client.account(), 2000 * ETHER)
 
         proposer_node.start()
