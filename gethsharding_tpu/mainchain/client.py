@@ -34,7 +34,10 @@ class SMCClient:
                  config: Config = DEFAULT_CONFIG):
         self.backend = backend if backend is not None else SimulatedMainchain(config)
         self.accounts = accounts or AccountManager()
-        self._account = account or self.accounts.new_account(seed=b"node")
+        # a FRESH identity per client unless one is supplied (keystore or
+        # caller): a fixed default seed would make every node in a
+        # multi-node deployment the same notary
+        self._account = account or self.accounts.new_account()
         self.deposit_flag = deposit_flag
         self.config = config
 
