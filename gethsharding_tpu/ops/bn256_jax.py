@@ -198,11 +198,6 @@ def _group_pad(n_groups: int) -> np.ndarray:
     return pad
 
 
-def _diag_onehot():
-    """The (22, 22, 43) anti-diagonal one-hot — limb.py's product table."""
-    return _limb._DIAG_ONEHOT
-
-
 @jax.jit
 def fp12_mul(x, y):
     """w-basis product: cyclic convolution with ξ wrap-around.
@@ -214,7 +209,6 @@ def fp12_mul(x, y):
     merges the 3 groups."""
     xiy = fp2_mul_xi(y)                      # (..., 6, 2, 22), ξ·y_j
     w = jnp.stack([y, xiy], axis=-4)         # (..., 2sel, 6, 2, 22)
-    onehot = jnp.asarray(_diag_onehot())
     comb = jnp.asarray(_COMB)
     pad = jnp.asarray(_group_pad(3))
 
@@ -222,7 +216,8 @@ def fp12_mul(x, y):
     for k in range(6):
         op = w[..., _CONV_SEL[k], _CONV_J[k], :, :]   # (..., 6, 2, 22)
         # cols[..., i, a, b, n] = sum_{l+m=n} x[i,a,l]·op[i,b,m]
-        cols = jnp.einsum("...ial,...ibm,lmn->...iabn", x, op, onehot)
+        prod = x[..., :, :, None, :, None] * op[..., :, None, :, None, :]
+        cols = _limb.conv_cols(prod)                  # (..., 6, 2, 2, 43)
         # fold into (component, group) accumulators, add pads
         acc = _pad_to(jnp.einsum("...iabn,iabcg->...cgn", cols, comb),
                       _ACC_W) + pad
@@ -420,14 +415,14 @@ def fp12_mul_line(f, line):
     lstack = jnp.stack([A, B, C], axis=-3)   # (..., 3, 2, 22)
     xif = fp2_mul_xi(f)
     w = jnp.stack([f, xif], axis=-4)         # (..., 2sel, 6, 2, 22)
-    onehot = jnp.asarray(_diag_onehot())
     comb = jnp.asarray(_LCOMB)
     pad = jnp.asarray(_group_pad(2))
 
     group_cols = []
     for k in range(6):
         op = w[..., _LINE_SEL[k], _LINE_J[k], :, :]   # (..., 3, 2, 22)
-        cols = jnp.einsum("...tal,...tbm,lmn->...tabn", lstack, op, onehot)
+        prod = lstack[..., :, :, None, :, None] * op[..., :, None, :, None, :]
+        cols = _limb.conv_cols(prod)                  # (..., 3, 2, 2, 43)
         acc = _pad_to(jnp.einsum("...tabn,tabcg->...cgn", cols, comb),
                       _ACC_W) + pad
         group_cols.append(acc)

@@ -149,6 +149,70 @@ if CARRY_IMPL not in ("scan", "assoc"):
 # default; bench.py probes it as an autotune config.
 PALLAS_NORM = os.environ.get("GETHSHARDING_TPU_PALLAS", "0") == "1"
 
+# The schoolbook column sum z[n] = sum_{l+m=n} x_l·y_m has two
+# implementations ($GETHSHARDING_TPU_CONV):
+# - "gather" (default): one static gather aligns prod row l to a
+#   l-shifted view, then a plain sum over rows. Work per output row =
+#   L·(2L-1) gathered elements + adds — each limb product is touched
+#   exactly once.
+# - "onehot": contract the (..., L, M) product planes against a constant
+#   (L, M, L+M-1) one-hot via einsum. XLA lowers this to a DENSE integer
+#   matmul doing (L+M-1)× redundant multiply-accumulates on the VPU
+#   (int32 never rides the MXU): the r1 bench showed it dominating the
+#   pairing dispatch. Kept for comparison.
+CONV_IMPL = os.environ.get("GETHSHARDING_TPU_CONV", "gather")
+if CONV_IMPL not in ("gather", "onehot"):
+    raise ValueError(
+        f"GETHSHARDING_TPU_CONV must be 'gather' or 'onehot', got {CONV_IMPL!r}")
+
+
+def conv_cols(prod: jnp.ndarray) -> jnp.ndarray:
+    """Anti-diagonal column sums: (..., L, M) -> (..., L+M-1) with
+    out[n] = sum over l of prod[l, n-l] (0 <= n-l < M).
+
+    The building block of every limb product. `gather` pads one zero
+    column, uses a static (L, L+M-1) index table sending out-of-window
+    positions to the zero column, and sums over rows — O(L·(L+M)) adds.
+    """
+    L, M = prod.shape[-2], prod.shape[-1]
+    ncols = L + M - 1
+    if CONV_IMPL == "onehot":
+        return jnp.einsum("...ij,ijk->...k", prod, _conv_onehot(L, M))
+    prod_p = jnp.pad(prod, [(0, 0)] * (prod.ndim - 1) + [(0, 1)])
+    idx = _conv_gather_idx(L, M)  # (L, ncols) static
+    rows = jnp.take_along_axis(
+        prod_p, jnp.broadcast_to(idx, prod_p.shape[:-2] + (L, ncols)), axis=-1)
+    return rows.sum(axis=-2)
+
+
+def _conv_gather_idx(L: int, M: int) -> np.ndarray:
+    key = (L, M)
+    cached = _CONV_IDX_CACHE.get(key)
+    if cached is None:
+        n = np.arange(L + M - 1)[None, :]
+        l = np.arange(L)[:, None]
+        m = n - l
+        cached = np.where((m >= 0) & (m < M), m, M).astype(np.int32)
+        _CONV_IDX_CACHE[key] = cached
+    return cached
+
+
+def _conv_onehot(L: int, M: int) -> np.ndarray:
+    key = (L, M)
+    cached = _CONV_ONEHOT_CACHE.get(key)
+    if cached is None:
+        e = np.zeros((L, M, L + M - 1), np.int32)
+        for i in range(L):
+            for j in range(M):
+                e[i, j, i + j] = 1
+        cached = e
+        _CONV_ONEHOT_CACHE[key] = cached
+    return cached
+
+
+_CONV_IDX_CACHE: dict = {}
+_CONV_ONEHOT_CACHE: dict = {}
+
 
 def _carry_scan(z: jnp.ndarray):
     """Exact carry propagation along the last axis.
@@ -362,10 +426,7 @@ class ModArith:
         Callers own the int32 range proof: each column must stay < 2^31.
         """
         prod = x[..., :, None] * y[..., None, :]  # (..., 25, 25) 24-bit terms
-        # Column sums z[k] = sum_{i+j=k} prod[i,j] via anti-diagonal einsum
-        # against a static one-hot (25,25,49): contracts to an integer
-        # matmul XLA maps well.
-        return jnp.einsum("...ij,ijk->...k", prod, _DIAG_ONEHOT)
+        return conv_cols(prod)
 
     def pad_mult(self, bits: int) -> np.ndarray:
         """Limb form of the smallest multiple of p >= 2^bits (cached).
@@ -439,22 +500,6 @@ class ModArith:
 
     def from_ints(self, values: Sequence[int]) -> jnp.ndarray:
         return jnp.asarray(ints_to_limbs([v % self.p for v in values]))
-
-
-def _make_diag_onehot() -> np.ndarray:
-    """(25, 25, 49) one-hot E[i, j, i+j] = 1 for the anti-diagonal sum.
-
-    Kept as numpy: jnp.einsum accepts numpy operands and constant-folds it
-    identically under jit, and importing this module must not trigger JAX
-    backend initialization (the TPU-tunnel PJRT plugin can be flaky)."""
-    e = np.zeros((NLIMBS, NLIMBS, 2 * NLIMBS - 1), np.int32)
-    for i in range(NLIMBS):
-        for j in range(NLIMBS):
-            e[i, j, i + j] = 1
-    return e
-
-
-_DIAG_ONEHOT = _make_diag_onehot()
 
 
 def _cond_sub(z: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
