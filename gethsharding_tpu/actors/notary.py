@@ -24,7 +24,6 @@ from gethsharding_tpu import metrics
 from gethsharding_tpu.actors.base import Service
 from gethsharding_tpu.core.shard import Shard, ShardError
 from gethsharding_tpu.core.types import CollationHeader
-from gethsharding_tpu.crypto import bn256 as bls
 from gethsharding_tpu.mainchain.client import SMCClient
 from gethsharding_tpu.p2p.messages import CollationBodyRequest
 from gethsharding_tpu.p2p.service import P2PServer
@@ -290,7 +289,7 @@ class Notary(Service):
         Returns True (all consistent), False (mismatch), or None (nothing
         auditable this period).
         """
-        shards, msgs, sigs, pks = [], [], [], []
+        shards, msgs, sig_rows, pk_rows = [], [], [], []
         signed_counts, total_counts, expected = [], [], []
         for shard_id in range(self.client.shard_count()):
             record = self.client.collation_record(shard_id, period)
@@ -310,17 +309,20 @@ class Notary(Service):
                 continue
             shards.append(shard_id)
             msgs.append(vote_digest(shard_id, period, record.chunk_root))
-            sigs.append(bls.bls_aggregate_sigs(
-                [v.sig for v in record.vote_sigs.values()]))
-            pks.append(bls.bls_aggregate_pks(member_pks))
+            sig_rows.append([v.sig for v in record.vote_sigs.values()])
+            pk_rows.append(member_pks)
             signed_counts.append(len(record.vote_sigs))
             total_counts.append(record.vote_count)
             expected.append(bool(record.is_elected))
         if not shards:
             return None
 
+        # aggregation + verification are ONE backend call: with sigbackend
+        # 'jax' the per-shard point sums AND the batched pairing happen in
+        # a single device dispatch (no host point arithmetic per vote)
         with self.m_audit_latency.time():
-            ok = self.sig_backend.bls_verify_aggregates(msgs, sigs, pks)
+            ok = self.sig_backend.bls_verify_committees(
+                msgs, sig_rows, pk_rows)
         self.audits_run += 1
         verified = sum(n for n, good in zip(signed_counts, ok) if good)
         self.aggregate_sigs_verified += verified

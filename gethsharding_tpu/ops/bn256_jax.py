@@ -732,37 +732,99 @@ _TWF2_X = _const_fp2(ref.TWIST_FROB2_X.a, ref.TWIST_FROB2_X.b)
 _TWF2_Y = _const_fp2(ref.TWIST_FROB2_Y.a, ref.TWIST_FROB2_Y.b)
 
 
-def _bls_miller_opt(sx, sy, hx, hy, pkx, pky):
+def _jadd_step(X1, Y1, Z1, cand, px, py):
+    """Full Jacobian + Jacobian chord step against candidate Q₂ (its
+    per-shard constants precomputed: X2, Y2, Z2, Z2², Z2³).
+
+    Line ℓ·(Z1Z2)³ = py·Z3 − px·R + (X1Y2Z1 − X2Y1Z2) — the true chord
+    through T and Q₂ up to an Fp2 scale (killed by the final
+    exponentiation), reducing to `_madd_step`'s line when Z2 = 1."""
+    x2, y2, z2, zz2, zzz2 = cand
+    Z1Z1 = fp2_sqr(Z1)
+    U1 = fp2_mul(X1, zz2)
+    U2 = fp2_mul(x2, Z1Z1)
+    S1 = fp2_mul(Y1, zzz2)
+    S2 = fp2_mul(y2, fp2_mul(Z1, Z1Z1))
+    H = fp2_sub(U2, U1)
+    R = fp2_sub(S2, S1)
+    HH = fp2_sqr(H)
+    V = fp2_mul(U1, HH)
+    HHH = fp2_mul(H, HH)
+    X3 = fp2_sub(fp2_sub(fp2_sqr(R), HHH), fp2_scalar(V, 2))
+    Y3 = fp2_sub(fp2_mul(R, fp2_sub(V, X3)), fp2_mul(S1, HHH))
+    Z3 = fp2_mul(fp2_mul(Z1, z2), H)
+    c_const = fp2_sub(fp2_mul(fp2_mul(X1, y2), Z1),
+                      fp2_mul(fp2_mul(x2, Y1), z2))
+    line = (fp2_mul_fp(Z3, py), fp2_mul_fp(fp2_neg(R), px), c_const)
+    return line, X3, Y3, Z3
+
+
+def _bls_miller_opt(sig, hx, hy, pk):
     """Shared-accumulator optimal-ate Miller product for the BLS check.
 
     Pair 0: (sig, G2_GEN) via precomputed static lines evaluated at sig.
     Pair 1: (-H, pk) via a dynamic Jacobian walk on the twist.
     Returns f = miller(sig, G2)·miller(-H, pk) before final exponentiation.
+
+    `sig` = (sx, sy, sz) PROJECTIVE G1 limbs and `pk` = (pkx, pky, pkz)
+    projective G2 limbs — the on-device aggregation outputs, consumed
+    without any field inversion: pair 0's lines absorb sz as an Fp scale,
+    and pk enters the walk through the Jacobian lift (X·Z, Y·Z², Z) with
+    full-Jacobian chord steps. Every extra scale lives in Fp2* and dies
+    in the final exponentiation. Affine callers pass z = None — a
+    TRACE-TIME specialization that keeps the cheaper mixed-addition
+    steps and constant generator-line terms of the affine form.
     """
+    sx, sy, sz = sig
+    pkx, pky, pkz = pk
+    affine = pkz is None
     shape = sx.shape[:-1]
     hy_neg = FP.neg(hy)
 
-    # dynamic add candidates: [+Q, -Q, πQ, -π²Q] for Q = pk
+    # dynamic add candidates [+Q, -Q, πQ, -π²Q] for Q = pk: affine pairs,
+    # or Jacobian lifts of the projective candidates (Xc·Zc, Yc·Zc², Zc)
+    # with their Z2 powers precomputed once per shard
     q1x = fp2_mul(fp2_conj(pkx), jnp.asarray(_TWF_X))
     q1y = fp2_mul(fp2_conj(pky), jnp.asarray(_TWF_Y))
     q2x = fp2_mul(pkx, jnp.asarray(_TWF2_X))
     q2ny = FP.neg(fp2_mul(pky, jnp.asarray(_TWF2_Y)))
-    cand_x = jnp.stack([pkx, pkx, q1x, q2x])       # (4, ..., 2, 22)
-    cand_y = jnp.stack([pky, FP.neg(pky), q1y, q2ny])
+    proj_x = [pkx, pkx, q1x, q2x]
+    proj_y = [pky, FP.neg(pky), q1y, q2ny]
+    if affine:
+        cand = (jnp.stack(proj_x), jnp.stack(proj_y))
+    else:
+        zconj = fp2_conj(pkz)
+        proj_z = [pkz, pkz, zconj, pkz]
+        jac = []
+        for cx, cy, cz in zip(proj_x, proj_y, proj_z):
+            zz = fp2_sqr(cz)
+            jac.append((fp2_mul(cx, cz), fp2_mul(cy, zz), cz, zz,
+                        fp2_mul(cz, zz)))
+        cand = tuple(jnp.stack([j[k] for j in jac]) for k in range(5))
 
     vzero = (sx[..., :1] * 0)[..., None]           # (..., 1, 1)
     f = FP.normalize(jnp.broadcast_to(jnp.asarray(FP12_ONE),
                                       shape + (6, 2, NLIMBS)) + vzero[..., None])
-    X = FP.normalize(jnp.broadcast_to(pkx, shape + (2, NLIMBS)))
-    Y = FP.normalize(jnp.broadcast_to(pky, shape + (2, NLIMBS)))
-    Z = FP.normalize(jnp.broadcast_to(jnp.asarray(FP2_ONE),
-                                      shape + (2, NLIMBS)) + vzero)
+    if affine:
+        X = FP.normalize(jnp.broadcast_to(pkx, shape + (2, NLIMBS)))
+        Y = FP.normalize(jnp.broadcast_to(pky, shape + (2, NLIMBS)))
+        Z = FP.normalize(jnp.broadcast_to(jnp.asarray(FP2_ONE),
+                                          shape + (2, NLIMBS)) + vzero)
+    else:
+        # walk start T = Q as the Jacobian lift of projective pk
+        X = fp2_mul(pkx, pkz)
+        Y = fp2_mul(pky, fp2_sqr(pkz))
+        Z = FP.normalize(jnp.broadcast_to(pkz, shape + (2, NLIMBS)))
 
     def gen_line(line_c):
-        """Static generator line evaluated at P0 = (sx, sy)."""
+        """Static generator line evaluated at P0 = sig:
+        (c_py·y + c_px·x + c_const)·z — sz scales the constant term
+        (skipped when sig is affine: z = 1)."""
         A = fp2_mul_fp(line_c[0], sy)
         B = fp2_mul_fp(line_c[1], sx)
         C = jnp.broadcast_to(FP.normalize(line_c[2]), shape + (2, NLIMBS))
+        if sz is not None:
+            C = fp2_mul_fp(C, sz)
         return A, B, C
 
     def dbl_branch(f, X, Y, Z, line_c, op):
@@ -774,9 +836,17 @@ def _bls_miller_opt(sx, sy, hx, hy, pkx, pky):
 
     def add_branch(f, X, Y, Z, line_c, op):
         idx = op - 1
-        x2 = lax.dynamic_index_in_dim(cand_x, idx, axis=0, keepdims=False)
-        y2 = lax.dynamic_index_in_dim(cand_y, idx, axis=0, keepdims=False)
-        line1, X, Y, Z = _madd_step(X, Y, Z, x2, y2, hx, hy_neg)
+        if affine:
+            x2 = lax.dynamic_index_in_dim(cand[0], idx, axis=0,
+                                          keepdims=False)
+            y2 = lax.dynamic_index_in_dim(cand[1], idx, axis=0,
+                                          keepdims=False)
+            line1, X, Y, Z = _madd_step(X, Y, Z, x2, y2, hx, hy_neg)
+        else:
+            q2 = tuple(
+                lax.dynamic_index_in_dim(c, idx, axis=0, keepdims=False)
+                for c in cand)
+            line1, X, Y, Z = _jadd_step(X, Y, Z, q2, hx, hy_neg)
         f = fp12_mul_line(f, gen_line(line_c))
         f = fp12_mul_line(f, line1)
         return f, X, Y, Z
@@ -799,6 +869,104 @@ G2_GEN_X = np.stack([int_to_limbs(ref.G2_GEN[0].a), int_to_limbs(ref.G2_GEN[0].b
 G2_GEN_Y = np.stack([int_to_limbs(ref.G2_GEN[1].a), int_to_limbs(ref.G2_GEN[1].b)])
 
 
+# == On-device committee aggregation =======================================
+# The aggregation half of BLS verification (sum of 135 signature points +
+# 135 pubkeys per shard — host-side python point adds in r1, ~0.7 s per
+# 100-shard audit) moves on device as a masked tree reduction over the
+# committee axis. Point addition is the COMPLETE projective formula set of
+# Renes–Costello–Batina 2016 (algorithm 7, a = 0): branchless, no special
+# cases for infinity/doubling/negation — exactly what a batched masked
+# kernel needs (padded slots are the identity (0:1:0); duplicate pubkeys
+# hit the doubling path of the same formulas). The reference's analog is
+# the scalar `PairingCheck` caller doing per-vote adds in Go
+# (crypto/bn256/cloudflare/curve.go Add); this is the batch-first rework.
+
+_B3_G2 = (ref.B2.scalar(3))  # 3·b' = 9/ξ on the D-twist y² = x³ + 3/ξ
+_B3_G2_LIMBS = _const_fp2(_B3_G2.a, _B3_G2.b)
+
+
+def _proj_add(x1, y1, z1, x2, y2, z2, mul, add, sub, mul_b3):
+    """RCB16 algorithm 7 (a = 0 short Weierstrass, projective X:Y:Z).
+
+    Complete: handles identity (0:1:0), doubling and inverse pairs with
+    no branches. `mul/add/sub/mul_b3` abstract the field (Fp or Fp2)."""
+    t0 = mul(x1, x2)
+    t1 = mul(y1, y2)
+    t2 = mul(z1, z2)
+    t3 = sub(mul(add(x1, y1), add(x2, y2)), add(t0, t1))  # x1y2 + x2y1
+    t4 = sub(mul(add(y1, z1), add(y2, z2)), add(t1, t2))  # y1z2 + y2z1
+    t5 = sub(mul(add(x1, z1), add(x2, z2)), add(t0, t2))  # x1z2 + x2z1
+    t0 = add(add(t0, t0), t0)        # 3·x1x2
+    t2 = mul_b3(t2)                  # b3·z1z2
+    zs = add(t1, t2)                 # y1y2 + b3z1z2
+    t1 = sub(t1, t2)                 # y1y2 - b3z1z2
+    y3 = mul_b3(t5)                  # b3·(x1z2 + x2z1)
+    x3 = sub(mul(t3, t1), mul(t4, y3))
+    y3 = add(mul(t1, zs), mul(t0, y3))
+    z3 = add(mul(zs, t4), mul(t0, t3))
+    return x3, y3, z3
+
+
+def _g1_proj_add(p1, p2):
+    return _proj_add(*p1, *p2, mul=FP.mul, add=FP.add, sub=FP.sub,
+                     mul_b3=lambda v: FP.mul_small(v, 9))
+
+
+def _g2_proj_add(p1, p2):
+    b3 = jnp.asarray(_B3_G2_LIMBS)
+    return _proj_add(*p1, *p2, mul=fp2_mul, add=fp2_add, sub=fp2_sub,
+                     mul_b3=lambda v: fp2_mul(v, b3))
+
+
+def _tree_reduce(point, axis, add_fn):
+    """Sum (X, Y, Z) coordinate stacks along committee axis `axis`
+    (negative, counted from the end; the same for all three coords) by
+    repeated halving. The axis length must be a power of two — callers
+    pad with the identity (0:1:0), which the complete formulas absorb."""
+    px, py, pz = point
+    if px.shape[axis] & (px.shape[axis] - 1):
+        # halving an odd length would silently DROP points — a wrong
+        # aggregate that verifies honest committees as forged
+        raise ValueError(
+            f"committee axis must be a power of two, got {px.shape[axis]}")
+    while px.shape[axis] > 1:
+        half = px.shape[axis] // 2
+
+        def split(a):
+            lo = jnp.take(a, np.arange(half), axis=axis)
+            hi = jnp.take(a, np.arange(half, 2 * half), axis=axis)
+            return lo, hi
+
+        (xl, xh), (yl, yh), (zl, zh) = split(px), split(py), split(pz)
+        px, py, pz = add_fn((xl, yl, zl), (xh, yh, zh))
+    return (jnp.squeeze(px, axis), jnp.squeeze(py, axis),
+            jnp.squeeze(pz, axis))
+
+
+def aggregate_g1_proj(xs, ys, mask):
+    """Masked committee sum of G1 points, on device.
+
+    xs/ys: (..., C, 22) affine limbs; mask: (..., C) bool (False slots
+    contribute the identity). C must be a power of two. Returns the
+    projective (X, Y, Z) sum, each (..., 22)."""
+    m = mask[..., None]
+    one = jnp.broadcast_to(jnp.asarray(FP.one), xs.shape)
+    px = jnp.where(m, xs, 0)
+    py = jnp.where(m, ys, one)
+    pz = jnp.where(m, one, 0)
+    return _tree_reduce((px, py, pz), -2, _g1_proj_add)
+
+
+def aggregate_g2_proj(xs, ys, mask):
+    """Masked committee sum of G2 points: xs/ys (..., C, 2, 22)."""
+    m = mask[..., None, None]
+    one = jnp.broadcast_to(jnp.asarray(FP2_ONE), xs.shape)
+    px = jnp.where(m, xs, 0)
+    py = jnp.where(m, ys, one)
+    pz = jnp.where(m, one, 0)
+    return _tree_reduce((px, py, pz), -3, _g2_proj_add)
+
+
 def bls_verify_aggregate_batch(hx, hy, sx, sy, pkx, pky, valid):
     """Batched BLS aggregate-vote verification (BASELINE.md config 2/3).
 
@@ -811,8 +979,32 @@ def bls_verify_aggregate_batch(hx, hy, sx, sy, pkx, pky, valid):
     host-side) return False.
     Returns (...,) bool.
     """
-    f = _bls_miller_opt(sx, sy, hx, hy, pkx, pky)
+    f = _bls_miller_opt((sx, sy, None), hx, hy, (pkx, pky, None))
     return pairing_is_one(f) & valid
+
+
+def bls_aggregate_verify_committee_batch(hx, hy, sigx, sigy, sig_mask,
+                                         pkx, pky, pk_mask, valid):
+    """Aggregate AND verify per-shard committee votes in one dispatch.
+
+    The full notary hot-loop kernel: per batch row (= shard), sum the
+    masked committee signature points (G1) and voter pubkeys (G2) with
+    the complete projective tree reduction, then run the optimal-ate
+    check e(aggsig, G2)·e(-H, aggpk) == 1 directly on the projective
+    aggregates — no host aggregation, no field inversion anywhere.
+
+    hx/hy: (B, 22) message-hash limbs; sigx/sigy: (B, C, 22) vote
+    signatures with sig_mask (B, C); pkx/pky: (B, C, 2, 22) registered
+    voter pubkeys with pk_mask (B, C); C a power of two (pad masked).
+    Identity aggregates (empty committee or adversarial cancellation)
+    are rejected, matching the scalar `bls_verify_aggregate`.
+    Returns (B,) bool.
+    """
+    sX, sY, sZ = aggregate_g1_proj(sigx, sigy, sig_mask)
+    pX, pY, pZ = aggregate_g2_proj(pkx, pky, pk_mask)
+    inf = FP.is_zero(sZ) | fp2_is_zero(pZ)
+    f = _bls_miller_opt((sX, sY, sZ), hx, hy, (pX, pY, pZ))
+    return pairing_is_one(f) & valid & ~inf
 
 
 # == host-side converters ==================================================
@@ -846,6 +1038,54 @@ def g2_to_limbs(points: Sequence[ref.G2Point]):
             ys.append(np.stack([int_to_limbs(y.a), int_to_limbs(y.b)]))
             ok.append(True)
     return (np.stack(xs), np.stack(ys), np.asarray(ok))
+
+
+def g1_committee_to_limbs(rows: Sequence[Sequence[ref.G1Point]], width: int):
+    """B rows of ≤width G1 points (None = empty slot) -> the committee
+    kernel inputs (B, width, 22) ×2 + mask (B, width). Vectorized through
+    the bulk `ints_to_limbs` bit-plane path — this sits on the audit's
+    host marshalling critical path (B·width points per dispatch)."""
+    B = len(rows)
+    flat_x, flat_y = [], []
+    mask = np.zeros((B, width), bool)
+    for b, row in enumerate(rows):
+        if len(row) > width:
+            raise ValueError(f"committee of {len(row)} exceeds width {width}")
+        for c in range(width):
+            pt = row[c] if c < len(row) else None
+            if pt is None:
+                flat_x.append(0)
+                flat_y.append(0)
+            else:
+                flat_x.append(pt[0] % P)
+                flat_y.append(pt[1] % P)
+                mask[b, c] = True
+    xs = ints_to_limbs(flat_x).reshape(B, width, NLIMBS)
+    ys = ints_to_limbs(flat_y).reshape(B, width, NLIMBS)
+    return xs, ys, mask
+
+
+def g2_committee_to_limbs(rows: Sequence[Sequence[ref.G2Point]], width: int):
+    """B rows of ≤width G2 points -> (B, width, 2, 22) ×2 + mask."""
+    B = len(rows)
+    flat_x, flat_y = [], []
+    mask = np.zeros((B, width), bool)
+    for b, row in enumerate(rows):
+        if len(row) > width:
+            raise ValueError(f"committee of {len(row)} exceeds width {width}")
+        for c in range(width):
+            pt = row[c] if c < len(row) else None
+            if pt is None:
+                flat_x.extend((0, 0))
+                flat_y.extend((0, 0))
+            else:
+                x, y = pt
+                flat_x.extend((x.a % P, x.b % P))
+                flat_y.extend((y.a % P, y.b % P))
+                mask[b, c] = True
+    xs = ints_to_limbs(flat_x).reshape(B, width, 2, NLIMBS)
+    ys = ints_to_limbs(flat_y).reshape(B, width, 2, NLIMBS)
+    return xs, ys, mask
 
 
 # tower-order interop: w-coeff k ↔ tower slot (h, l) with k = 2l + h

@@ -45,6 +45,19 @@ class SigBackend:
         """Verify one aggregate committee vote per message."""
         raise NotImplementedError
 
+    def bls_verify_committees(
+            self,
+            messages: Sequence[bytes],
+            sig_rows: Sequence[Sequence[bls.G1Point]],
+            pk_rows: Sequence[Sequence[bls.G2Point]]) -> List[bool]:
+        """Aggregate each row's vote signatures + voter pubkeys and verify
+        the aggregate against the row's message. The batch form of the
+        whole committee check: with the jax backend both the aggregation
+        (masked projective tree reduction) and the pairing run in ONE
+        device dispatch. Empty rows are rejections (an empty committee
+        proves nothing)."""
+        raise NotImplementedError
+
 
 class PythonSigBackend(SigBackend):
     """Scalar host crypto — parity baseline."""
@@ -67,6 +80,13 @@ class PythonSigBackend(SigBackend):
             for m, s, pk in zip(messages, agg_sigs, agg_pks)
         ]
 
+    def bls_verify_committees(self, messages, sig_rows, pk_rows):
+        return [
+            bls.bls_verify_aggregate(
+                bytes(m), bls.bls_aggregate_sigs(sigs), list(pks))
+            for m, sigs, pks in zip(messages, sig_rows, pk_rows)
+        ]
+
 
 class JaxSigBackend(SigBackend):
     """Batched accelerator kernels; one dispatch per batch."""
@@ -85,6 +105,8 @@ class JaxSigBackend(SigBackend):
         self._sec = secp256k1_jax
         self._recover = jax.jit(secp256k1_jax.ecrecover_batch)
         self._bls = jax.jit(bn256_jax.bls_verify_aggregate_batch)
+        self._bls_committee = jax.jit(
+            bn256_jax.bls_aggregate_verify_committee_batch)
 
     @staticmethod
     def _bucket(n: int) -> int:
@@ -154,6 +176,32 @@ class JaxSigBackend(SigBackend):
             jnp.asarray(hx), jnp.asarray(hy), jnp.asarray(sx),
             jnp.asarray(sy), jnp.asarray(pkx), jnp.asarray(pky),
             jnp.asarray(valid))
+        return [bool(b) for b in np.asarray(out)[:n]]
+
+    def bls_verify_committees(self, messages, sig_rows, pk_rows):
+        import numpy as np
+
+        jnp = self._jnp
+        n = len(messages)
+        if n == 0:
+            return []
+        pad = self._bucket(n) - n
+        # committee axis padded to a power-of-two bucket (256 at protocol
+        # scale) so the tree reduction halves evenly and the node compiles
+        # a handful of kernel shapes
+        width = max([1] + [len(r) for r in sig_rows]
+                    + [len(r) for r in pk_rows])
+        width = self._bucket(width)
+        hashes = [bls.hash_to_g1(bytes(m)) for m in messages] + [None] * pad
+        hx, hy, hok = self._bn.g1_to_limbs(hashes)
+        sx, sy, sm = self._bn.g1_committee_to_limbs(
+            list(sig_rows) + [[]] * pad, width)
+        px, py, pm = self._bn.g2_committee_to_limbs(
+            list(pk_rows) + [[]] * pad, width)
+        out = self._bls_committee(
+            jnp.asarray(hx), jnp.asarray(hy), jnp.asarray(sx),
+            jnp.asarray(sy), jnp.asarray(sm), jnp.asarray(px),
+            jnp.asarray(py), jnp.asarray(pm), jnp.asarray(hok))
         return [bool(b) for b in np.asarray(out)[:n]]
 
 

@@ -175,3 +175,84 @@ def test_fp12_sqr_matches_mul():
     arr = jnp.asarray(_fp12_to_arr(a))
     sq = np.asarray(_arr_to_coeffs(jax.jit(k.fp12_sqr)(arr)))
     assert (sq == _fp12_coeffs(a * a)).all()
+
+
+@slow
+def test_committee_aggregation_matches_host():
+    """Device projective tree-sum == host point addition, including the
+    complete-formula corner cases: identity padding, duplicate points
+    (doubling), and an inverse pair that cancels to infinity."""
+    rows = []
+    base = [ref.g1_mul(7 + i, ref.G1_GEN) for i in range(6)]
+    rows.append(base)                     # plain sum
+    rows.append([base[0], base[0]])       # doubling
+    rows.append([base[1], ref.g1_neg(base[1])])  # cancels to infinity
+    rows.append([base[2]])                # single point
+    xs, ys, mask = k.g1_committee_to_limbs(rows, 8)
+    X, Y, Z = jax.jit(k.aggregate_g1_proj)(
+        jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(mask))
+    Xi, Yi, Zi = (k.FP.to_ints(v) for v in (X, Y, Z))
+    for b, row in enumerate(rows):
+        host = ref.bls_aggregate_sigs(row)
+        if host is None:
+            assert int(Zi[b]) % ref.P == 0
+            continue
+        zinv = pow(int(Zi[b]), ref.P - 2, ref.P)
+        assert (int(Xi[b]) * zinv % ref.P,
+                int(Yi[b]) * zinv % ref.P) == host
+
+
+@slow
+def test_g2_committee_aggregation_matches_host():
+    """The Fp2 reduction branch (distinct b3' = 9/xi constant) against
+    host G2 addition, incl. doubling, cancellation, identity padding."""
+    base = [ref.g2_mul(11 + i, ref.G2_GEN) for i in range(5)]
+    rows = [base,
+            [base[0], base[0]],
+            [base[1], ref.g2_neg(base[1])],
+            [base[2]]]
+    xs, ys, mask = k.g2_committee_to_limbs(rows, 8)
+    X, Y, Z = jax.jit(k.aggregate_g2_proj)(
+        jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(mask))
+    Xi, Yi, Zi = (k.FP.to_ints(np.asarray(k.FP.canon(v)))
+                  for v in (X, Y, Z))
+    for b, row in enumerate(rows):
+        host = ref.bls_aggregate_pks(row)
+        zc = ref.Fp2(int(Zi[b][0]), int(Zi[b][1]))
+        if host is None:
+            assert zc.is_zero()
+            continue
+        zinv = zc.inv()
+        got = (ref.Fp2(int(Xi[b][0]), int(Xi[b][1])) * zinv,
+               ref.Fp2(int(Yi[b][0]), int(Yi[b][1])) * zinv)
+        assert got == host
+
+
+@slow
+def test_committee_verify_rejects_cancelled_aggregates():
+    """Adversarial cancellation: a non-empty row whose signatures (or
+    pubkeys) sum to infinity must be rejected, not vacuously accepted."""
+    tag = b"cancel"
+    keys = [ref.bls_keygen(tag + bytes([j])) for j in range(2)]
+    sigs = [ref.bls_sign(tag, sk) for sk, _ in keys]
+    pks = [pk for _, pk in keys]
+    rows_sig = [[sigs[0], ref.g1_neg(sigs[0])],   # sig aggregate = inf
+                sigs]                              # pk aggregate = inf
+    rows_pk = [pks,
+               [pks[0], ref.g2_neg(pks[0])]]
+    msgs = [tag, tag]
+    hx, hy, hok = k.g1_to_limbs([ref.hash_to_g1(m) for m in msgs])
+    sx, sy, sm = k.g1_committee_to_limbs(rows_sig, 2)
+    px, py, pm = k.g2_committee_to_limbs(rows_pk, 2)
+    out = jax.jit(k.bls_aggregate_verify_committee_batch)(
+        jnp.asarray(hx), jnp.asarray(hy), jnp.asarray(sx), jnp.asarray(sy),
+        jnp.asarray(sm), jnp.asarray(px), jnp.asarray(py), jnp.asarray(pm),
+        jnp.asarray(hok))
+    assert [bool(v) for v in np.asarray(out)] == [False, False]
+
+
+def test_tree_reduce_rejects_non_power_of_two():
+    xs = jnp.zeros((2, 6, k.NLIMBS), jnp.int32)
+    mask = jnp.ones((2, 6), bool)
+    with pytest.raises(ValueError, match="power of two"):
+        k.aggregate_g1_proj(xs, xs, mask)

@@ -97,3 +97,50 @@ def test_notary_rejects_bad_proposer_signature():
     results = notary.verify_proposer_signatures(
         [(0, 1, good), (0, 1, bad)])
     assert results == [True, False]
+
+
+@pytest.mark.parametrize("name", ["python", "jax"])
+def test_bls_committee_rows(name):
+    """Committee-level verification: aggregation + pairing in one call.
+
+    Rows cover: honest multi-voter, single voter, duplicate pubkey
+    (doubling path), empty committee (reject), tampered message, and a
+    signature from a key outside the pk row."""
+    backend = get_backend(name)
+    msgs, sig_rows, pk_rows = [], [], []
+
+    def committee(tag, n, dup=False):
+        keys = [bls.bls_keygen(tag + bytes([j])) for j in range(n)]
+        if dup and n >= 2:
+            keys[1] = keys[0]
+        sigs = [bls.bls_sign(tag, sk) for sk, _ in keys]
+        return sigs, [pk for _, pk in keys]
+
+    s, p = committee(b"row0", 5)
+    msgs.append(b"row0"); sig_rows.append(s); pk_rows.append(p)
+    s, p = committee(b"row1", 1)
+    msgs.append(b"row1"); sig_rows.append(s); pk_rows.append(p)
+    s, p = committee(b"row2", 4, dup=True)
+    msgs.append(b"row2"); sig_rows.append(s); pk_rows.append(p)
+    msgs.append(b"row3"); sig_rows.append([]); pk_rows.append([])
+    s, p = committee(b"row4", 3)
+    msgs.append(b"not-row4"); sig_rows.append(s); pk_rows.append(p)
+    s, p = committee(b"row5", 3)
+    s[0] = bls.bls_sign(b"row5", bls.bls_keygen(b"outsider")[0])
+    msgs.append(b"row5"); sig_rows.append(s); pk_rows.append(p)
+
+    got = backend.bls_verify_committees(msgs, sig_rows, pk_rows)
+    assert got == [True, True, True, False, False, False]
+
+
+def test_bls_committee_backends_agree():
+    msgs, sig_rows, pk_rows = [], [], []
+    for i in range(3):
+        tag = b"agree-%d" % i
+        keys = [bls.bls_keygen(tag + bytes([j])) for j in range(i + 1)]
+        sig_rows.append([bls.bls_sign(tag, sk) for sk, _ in keys])
+        pk_rows.append([pk for _, pk in keys])
+        msgs.append(tag)
+    py = get_backend("python").bls_verify_committees(msgs, sig_rows, pk_rows)
+    jx = get_backend("jax").bls_verify_committees(msgs, sig_rows, pk_rows)
+    assert py == jx == [True, True, True]
