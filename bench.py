@@ -19,9 +19,10 @@ aggregate), config 4 (collation replay, 1 shard), config 5 (the fused
 1024-shard stress step) — skipped automatically when the backend is too
 slow to fit the budget (hermetic CPU runs).
 
-The kernel has two build-time knobs whose best setting depends on the
+The kernel has build-time knobs whose best setting depends on the
 backend (GETHSHARDING_TPU_LIMB_FORM = wide|exact, GETHSHARDING_TPU_CARRY
-= scan|assoc, read at import): the bench AUTOTUNES by re-executing itself
+= scan|assoc, GETHSHARDING_TPU_CONV = gather|onehot, GETHSHARDING_TPU_PALLAS,
+all read at import): the bench AUTOTUNES by re-executing itself
 per configuration in a subprocess and reports the fastest, caching the
 winner per backend in .bench_autotune.json. Signing workloads are cached
 in .bench_workload.npz (first build ~3 min of host-side scalar crypto).
@@ -40,20 +41,20 @@ import numpy as np
 SHARDS, COMMITTEE = 100, 135
 REPO = os.path.dirname(os.path.abspath(__file__))
 
-# ordered by prior: exact/scan won the CPU sweep (throughput-bound); the
-# Pallas fused-normalize and the wide/assoc pair minimize sequential depth
-# (latency-bound TPU; the Pallas configs silently fall back to XLA when
-# the backend can't lower them, measuring ~= their base config). If the
-# sweep budget runs out, the best of the configs measured so far wins.
+# ordered by prior: exact/scan/gather won the r2 TPU sweep (the gather
+# convolution replaced the dense one-hot contraction that dominated r1;
+# `onehot` is kept as a regression check). The assoc carry and the Pallas
+# fused-normalize lost on TPU in r2 but stay as probes — backends change.
+# If the sweep budget runs out, the best of the configs measured so far
+# wins.
 CONFIGS = [
     {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "scan"},
-    {"GETHSHARDING_TPU_LIMB_FORM": "wide", "GETHSHARDING_TPU_CARRY": "scan",
-     "GETHSHARDING_TPU_PALLAS": "1"},
-    {"GETHSHARDING_TPU_LIMB_FORM": "wide", "GETHSHARDING_TPU_CARRY": "assoc"},
+    {"GETHSHARDING_TPU_LIMB_FORM": "wide", "GETHSHARDING_TPU_CARRY": "scan"},
+    {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "scan",
+     "GETHSHARDING_TPU_CONV": "onehot"},
+    {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "assoc"},
     {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "scan",
      "GETHSHARDING_TPU_PALLAS": "1"},
-    {"GETHSHARDING_TPU_LIMB_FORM": "wide", "GETHSHARDING_TPU_CARRY": "scan"},
-    {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "assoc"},
 ]
 
 SWEEP_BUDGET_S = float(os.environ.get("GETHSHARDING_BENCH_BUDGET_S", "1200"))
@@ -319,12 +320,19 @@ def _measure_extras(dispatch_s: float) -> dict:
 def _run_config(cfg: dict, extras: bool = False) -> dict | None:
     env = dict(os.environ)
     env.update(cfg)
+    # the winner's extras pass (configs 1/2/4/5) compiles several extra
+    # kernels — the r1 run lost its extras to the sweep-probe timeout, so
+    # it gets a budget of its own, scaled with the run's overall budget
+    # knob so a capped hermetic run stays capped
+    timeout = min(1500, 1.25 * SWEEP_BUDGET_S) if extras else min(
+        560, SWEEP_BUDGET_S)
     if extras:
         env["GETHSHARDING_BENCH_EXTRAS"] = "1"
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--single"],
-            env=env, capture_output=True, text=True, timeout=560, cwd=REPO)
+            env=env, capture_output=True, text=True, timeout=timeout,
+            cwd=REPO)
         for line in reversed(proc.stdout.strip().splitlines()):
             try:
                 stats = json.loads(line)
@@ -402,16 +410,21 @@ def main() -> None:
                 best = stats
 
     sig_rate = best["sig_rate"]
-    form = best_cfg.get("GETHSHARDING_TPU_LIMB_FORM", "wide")
-    carry = best_cfg.get("GETHSHARDING_TPU_CARRY", "scan")
+    # label from the FULL winning config (any knob may decide the sweep)
+    knobs = "/".join(
+        [best_cfg.get("GETHSHARDING_TPU_LIMB_FORM", "wide"),
+         best_cfg.get("GETHSHARDING_TPU_CARRY", "scan"),
+         best_cfg.get("GETHSHARDING_TPU_CONV", "gather")]
+        + (["pallas"] if best_cfg.get("GETHSHARDING_TPU_PALLAS") == "1"
+           else []))
     extra = {key: val for key, val in best.items()
              if key not in ("platform", "sig_rate")}
     print(json.dumps({
         "metric": "notary_sig_verifications_per_sec",
         "value": sig_rate,
-        "unit": (f"sigs/sec (100-shard period audit, 135-vote BLS "
-                 f"aggregates, protocol-generated workload, opt-ate "
-                 f"bn256, {form}/{carry}, {best['platform']})"),
+        "unit": (f"sigs/sec (100-shard period audit, on-device 135-vote "
+                 f"BLS aggregation+verification, protocol-generated "
+                 f"workload, opt-ate bn256, {knobs}, {best['platform']})"),
         "vs_baseline": round(sig_rate / 100_000.0, 4),
         "extra": extra,
     }))

@@ -1,0 +1,285 @@
+"""Regenerate the JSON conformance fixtures in this directory.
+
+Mirrors the reference's cross-client JSON-suite pattern (`tests/` wiring
+BlockchainTests/GeneralStateTests/... at `tests/init_test.go:36-40`): the
+protocol's wire/hash/state behaviors are pinned as frozen JSON vectors so
+any reimplementation — the batched JAX kernels, the native C runtime, or
+a future port — can be validated against the same fixtures, and silent
+behavior drift in the scalar implementation breaks `test_conformance.py`.
+
+Run from the repo root:  python tests/testdata/generate_fixtures.py
+The output files are committed; regeneration is only needed when the
+protocol itself (not an implementation) changes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _hex(b: bytes) -> str:
+    return b.hex()
+
+
+def gen_keccak():
+    from gethsharding_tpu.crypto.keccak import keccak256
+
+    cases = [
+        b"",
+        b"abc",
+        b"The quick brown fox jumps over the lazy dog",
+        bytes(32),
+        bytes(range(256)),
+        b"\xfe" * 135,   # one byte short of the rate
+        b"\xfe" * 136,   # exactly one block
+        b"\xfe" * 137,   # rate + 1
+        b"gethsharding-tpu" * 100,
+    ]
+    return [{"in": _hex(m), "out": _hex(keccak256(m))} for m in cases]
+
+
+def gen_rlp():
+    from gethsharding_tpu.utils.rlp import rlp_encode
+
+    def case(item):
+        return {"decoded": _tree_hex(item), "encoded": _hex(rlp_encode(item))}
+
+    def _tree_hex(item):
+        if isinstance(item, bytes):
+            return _hex(item)
+        return [_tree_hex(x) for x in item]
+
+    return [
+        case(b""),
+        case(b"\x00"),
+        case(b"\x7f"),
+        case(b"\x80"),
+        case(b"dog"),
+        case(b"x" * 55),
+        case(b"x" * 56),
+        case(b"y" * 300),
+        case([]),
+        case([b"cat", b"dog"]),
+        case([[], [[]], [[], [[]]]]),   # the set-theoretic nesting classic
+        case([b"a" * 60, [b"b", [b"c" * 70]], b""]),
+    ]
+
+
+def gen_trie():
+    from gethsharding_tpu.core.trie import SecureTrie, Trie
+
+    suites = []
+
+    def run(ops):
+        trie = Trie()
+        for op in ops:
+            if op[0] == "put":
+                trie.update(bytes.fromhex(op[1]), bytes.fromhex(op[2]))
+            else:
+                trie.delete(bytes.fromhex(op[1]))
+        return _hex(trie.root_hash())
+
+    scripts = [
+        [],
+        [["put", b"do".hex(), b"verb".hex()],
+         ["put", b"dog".hex(), b"puppy".hex()],
+         ["put", b"doge".hex(), b"coin".hex()],
+         ["put", b"horse".hex(), b"stallion".hex()]],
+        [["put", b"A".hex(), (b"aaaa" * 20).hex()]],
+        [["put", b"k1".hex(), b"v1".hex()],
+         ["put", b"k2".hex(), b"v2".hex()],
+         ["del", b"k2".hex()]],
+        [["put", bytes(1).hex(), b"zero".hex()],
+         ["put", bytes(2).hex(), b"zz".hex()],
+         ["put", b"\x00\x01".hex(), b"mid".hex()],
+         ["del", bytes(1).hex()]],
+    ]
+    for ops in scripts:
+        suites.append({"ops": ops, "root": run(ops)})
+
+    secure = SecureTrie()
+    secure.update(b"key", b"value")
+    secure.update(b"other", b"thing")
+    suites.append({"secure": True,
+                   "ops": [["put", b"key".hex(), b"value".hex()],
+                           ["put", b"other".hex(), b"thing".hex()]],
+                   "root": _hex(secure.root_hash())})
+    return suites
+
+
+def gen_collation():
+    from gethsharding_tpu.core.derive_sha import chunk_root, poc_root
+    from gethsharding_tpu.core.types import (
+        CollationHeader, Transaction, serialize_txs_to_blob)
+    from gethsharding_tpu.utils.hexbytes import Address20, Hash32
+
+    out = []
+    txs = [
+        Transaction(nonce=i, gas_price=10 + i, gas_limit=21000,
+                    to=Address20(bytes([i + 1]) * 20), value=1000 * i,
+                    payload=b"payload-%d" % i)
+        for i in range(3)
+    ]
+    blob = serialize_txs_to_blob(txs)
+    header = CollationHeader(
+        shard_id=7, chunk_root=Hash32(chunk_root(blob)), period=42,
+        proposer_address=Address20(b"\xaa" * 20))
+    unsigned_hash = header.hash()
+    header.add_sig(b"\x01" * 65)
+    out.append({
+        "txs": [
+            {"nonce": t.nonce, "gas_price": t.gas_price,
+             "gas_limit": t.gas_limit, "to": _hex(bytes(t.to)),
+             "value": t.value, "payload": _hex(t.payload),
+             "tx_hash": _hex(bytes(t.hash())),
+             "sig_hash_homestead": _hex(bytes(t.sig_hash())),
+             "sig_hash_eip155_1": _hex(bytes(t.sig_hash(chain_id=1)))}
+            for t in txs
+        ],
+        "blob": _hex(blob),
+        "chunk_root": _hex(chunk_root(blob)),
+        "poc_root_salt00": _hex(poc_root(blob, b"\x00" * 32)),
+        "header_rlp": _hex(header.encode_rlp()),
+        "header_hash_unsigned": _hex(bytes(unsigned_hash)),
+        "header_hash_signed": _hex(bytes(header.hash())),
+    })
+    # edge blobs: empty, exactly 31·k, trailing partial chunk
+    from gethsharding_tpu.utils.blob import RawBlob, serialize_blobs
+
+    for body in (b"", b"z" * 31, b"z" * 62, b"z" * 40):
+        wire = serialize_blobs([RawBlob(data=body)]) if body else b""
+        out.append({"raw_blob_body": _hex(body),
+                    "serialized": _hex(wire),
+                    "chunk_root": _hex(chunk_root(wire))})
+    return out
+
+
+def gen_ecdsa():
+    from gethsharding_tpu.crypto import secp256k1 as ecdsa
+    from gethsharding_tpu.crypto.keccak import keccak256
+
+    out = []
+    for i in range(4):
+        priv = int.from_bytes(keccak256(b"conform-ecdsa-%d" % i), "big") % ecdsa.N
+        digest = keccak256(b"digest-%d" % i)
+        sig = ecdsa.sign(digest, priv)
+        out.append({
+            "digest": _hex(digest),
+            "priv": hex(priv),
+            "address": _hex(bytes(ecdsa.priv_to_address(priv))),
+            "sig65": _hex(sig.to_bytes65()),
+        })
+    return out
+
+
+def gen_bls():
+    from gethsharding_tpu.crypto import bn256 as bls
+
+    out = []
+    msgs = [b"conform-bls-0", b"conform-bls-1"]
+    for msg in msgs:
+        keys = [bls.bls_keygen(msg + bytes([j])) for j in range(3)]
+        sigs = [bls.bls_sign(msg, sk) for sk, _ in keys]
+        agg_sig = bls.bls_aggregate_sigs(sigs)
+        agg_pk = bls.bls_aggregate_pks([pk for _, pk in keys])
+        h = bls.hash_to_g1(msg)
+        out.append({
+            "msg": _hex(msg),
+            "hash_to_g1": [hex(h[0]), hex(h[1])],
+            "secret_keys": [hex(sk) for sk, _ in keys],
+            "pubkeys": [[hex(pk[0].a), hex(pk[0].b), hex(pk[1].a),
+                         hex(pk[1].b)] for _, pk in keys],
+            "sigs": [[hex(s[0]), hex(s[1])] for s in sigs],
+            "agg_sig": [hex(agg_sig[0]), hex(agg_sig[1])],
+            "agg_pk": [hex(agg_pk[0].a), hex(agg_pk[0].b),
+                       hex(agg_pk[1].a), hex(agg_pk[1].b)],
+            "verifies": True,
+        })
+    return out
+
+
+def gen_smc():
+    """Deterministic SMC scenario scripts with expected outcomes,
+    including the reference contract's quirks (vote-count low byte,
+    period gating, double-vote rejection)."""
+    from gethsharding_tpu.crypto.keccak import keccak256
+    from gethsharding_tpu.mainchain.accounts import AccountManager
+    from gethsharding_tpu.params import Config, ETHER
+    from gethsharding_tpu.smc.chain import SimulatedMainchain
+    from gethsharding_tpu.utils.hexbytes import Hash32
+
+    config = Config(shard_count=3, committee_size=4, quorum_size=2)
+    chain = SimulatedMainchain(config=config)
+    manager = AccountManager()
+    accounts = [manager.new_account(seed=b"conform-smc-%d" % i)
+                for i in range(4)]
+    script = []
+    for acct in accounts:
+        chain.fund(acct.address, 2000 * ETHER)
+        chain.register_notary(
+            acct.address, bls_pubkey=acct.bls_pubkey,
+            bls_pop=manager.bls_proof_of_possession(acct.address))
+        script.append({"op": "register", "addr": _hex(bytes(acct.address))})
+    chain.fast_forward(1)
+    period = chain.current_period()
+    script.append({"op": "fast_forward", "periods": 1})
+    root = Hash32(keccak256(b"conform-root"))
+    proposer = accounts[0]
+    chain.add_header(proposer.address, 1, period, root)
+    script.append({"op": "add_header", "shard": 1, "period": period,
+                   "chunk_root": _hex(bytes(root))})
+    votes = []
+    from gethsharding_tpu.smc.state_machine import vote_digest
+
+    digest = bytes(vote_digest(1, period, root))
+    for acct in accounts:
+        member = chain.get_notary_in_committee(acct.address, 1)
+        if member != acct.address:
+            continue
+        entry = chain.smc.notary_registry[acct.address]
+        chain.submit_vote(acct.address, 1, period, entry.pool_index, root,
+                          bls_sig=manager.bls_sign(acct.address, digest))
+        votes.append(_hex(bytes(acct.address)))
+    record = chain.smc.collation_records[(1, period)]
+    return {
+        "config": {"shard_count": 3, "committee_size": 4, "quorum_size": 2},
+        "script": script,
+        "account_seeds": ["conform-smc-%d" % i for i in range(4)],
+        "addresses": [_hex(bytes(a.address)) for a in accounts],
+        "sampled_voters": votes,
+        "expected": {
+            "period": period,
+            "vote_count": record.vote_count,
+            "is_elected": record.is_elected,
+            "last_approved": chain.last_approved_collation(1),
+            "vote_digest": _hex(digest),
+        },
+    }
+
+
+def main():
+    suites = {
+        "keccak.json": gen_keccak(),
+        "rlp.json": gen_rlp(),
+        "trie.json": gen_trie(),
+        "collation.json": gen_collation(),
+        "ecdsa.json": gen_ecdsa(),
+        "bls.json": gen_bls(),
+        "smc.json": gen_smc(),
+    }
+    for name, data in suites.items():
+        path = os.path.join(HERE, name)
+        with open(path, "w") as fh:
+            json.dump(data, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"wrote {name}")
+
+
+if __name__ == "__main__":
+    main()
