@@ -17,6 +17,12 @@ class Service:
     """Base lifecycle: start() spawns registered loops, stop() joins them."""
 
     name = "service"
+    # restart-as-fresh-instance eligibility (node/service.go:78-83: "New
+    # instance of the service will be constructed" on restart). Leaf actor
+    # services opt in; infrastructure services other services hold direct
+    # references to (DB, client, txpool) stay False — replacing them would
+    # leave dependents pointing at the dead instance.
+    supervisable = False
 
     def __init__(self):
         self._threads: List[threading.Thread] = []
@@ -24,6 +30,7 @@ class Service:
         self.errors: List[str] = []
         self.log = logging.getLogger(f"sharding.{self.name}")
         self._started = False
+        self._crashed = False
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -72,8 +79,33 @@ class Service:
                 target()
             except Exception as exc:  # funnel, never crash the node
                 self.record_error(f"{self.name} loop crashed: {exc!r}")
+                self._crashed = True
 
         return runner
+
+    @property
+    def crashed(self) -> bool:
+        """True when a background loop died on an exception (the signal a
+        supervisor restarts on); cleared only by a fresh instance."""
+        return self._crashed
+
+    # -- callback-driven failure detection ---------------------------------
+    # Services without their own loops (head-subscription actors like the
+    # notary) funnel per-callback errors; a run of consecutive failures
+    # with no success in between marks the service crashed so the
+    # supervisor treats it like a dead loop.
+
+    FAILURE_THRESHOLD = 5
+
+    def record_failure(self, message: str) -> None:
+        self.record_error(message)
+        self._consecutive_failures = getattr(
+            self, "_consecutive_failures", 0) + 1
+        if self._consecutive_failures >= self.FAILURE_THRESHOLD:
+            self._crashed = True
+
+    def record_success(self) -> None:
+        self._consecutive_failures = 0
 
     def record_error(self, message: str) -> None:
         self.errors.append(message)

@@ -34,6 +34,7 @@ from gethsharding_tpu.smc.state_machine import SMCRevert, vote_digest
 
 class Notary(Service):
     name = "notary"
+    supervisable = True
 
     def __init__(self, client: SMCClient, shard: Shard,
                  p2p: Optional[P2PServer] = None,
@@ -117,8 +118,12 @@ class Notary(Service):
     def _on_head(self, block) -> None:
         try:
             self.notarize_collations()
+            self.record_success()
         except Exception as exc:
-            self.record_error(f"notarize failed at head {block.number}: {exc}")
+            # a run of consecutive head failures marks the service crashed
+            # for the supervisor (callback actors have no loop to die)
+            self.record_failure(
+                f"notarize failed at head {block.number}: {exc}")
 
     def notarize_collations(self) -> None:
         if not self.is_account_in_notary_pool():

@@ -15,6 +15,7 @@ from gethsharding_tpu.mainchain.client import SMCClient
 
 class Observer(Service):
     name = "observer"
+    supervisable = True
 
     def __init__(self, client: SMCClient, shard: Shard):
         super().__init__()
@@ -33,6 +34,13 @@ class Observer(Service):
             self._unsubscribe()
 
     def _on_head(self, block) -> None:
+        try:
+            self._observe_head()
+            self.record_success()
+        except Exception as exc:
+            self.record_failure(f"observe failed: {exc}")
+
+    def _observe_head(self) -> None:
         period = self.client.current_period()
         shard_id = self.shard.shard_id
         if period in self.seen_periods:

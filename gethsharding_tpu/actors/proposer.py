@@ -52,6 +52,7 @@ def check_header_added(client: SMCClient, shard_id: int, period: int) -> bool:
 
 class Proposer(Service):
     name = "proposer"
+    supervisable = True
 
     def __init__(self, client: SMCClient, txpool: TXPool, shard: Shard,
                  config: Config = DEFAULT_CONFIG,

@@ -16,6 +16,7 @@ from gethsharding_tpu.p2p.service import P2PServer
 
 class Simulator(Service):
     name = "simulator"
+    supervisable = True
 
     def __init__(self, client: SMCClient, p2p: P2PServer, shard_id: int,
                  tick_interval: float = 15.0):

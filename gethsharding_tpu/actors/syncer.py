@@ -37,6 +37,7 @@ def request_collation_body(caller, shard_id: int,
 
 class Syncer(Service):
     name = "syncer"
+    supervisable = True
 
     def __init__(self, client: SMCClient, shard: Shard, p2p: P2PServer,
                  poll_interval: float = 0.05):

@@ -45,7 +45,9 @@ class ShardNode:
                  txpool_interval: Optional[float] = 5.0,
                  simulator_interval: float = 15.0,
                  sig_backend: str = "python",
-                 password: Optional[str] = None):
+                 password: Optional[str] = None,
+                 supervise: bool = False,
+                 supervise_interval: float = 1.0):
         if actor not in self.ACTORS:
             raise ValueError(f"unknown actor {actor!r}; pick from {self.ACTORS}")
         self.actor = actor
@@ -53,6 +55,12 @@ class ShardNode:
         self.config = config
         self._services: Dict[Type, object] = {}
         self._order: List[object] = []
+        self._factories: Dict[Type, object] = {}
+        self.restarts: Dict[str, int] = {}
+        self._restart_times: Dict[str, List[float]] = {}
+        self.supervisor: Optional[Supervisor] = (
+            Supervisor(self, interval=supervise_interval)
+            if supervise else None)
 
         # registration order mirrors backend.go:55-96
         shard_db = ShardDB(data_dir=data_dir, in_memory=in_memory_db)
@@ -85,22 +93,27 @@ class ShardNode:
         if actor == "proposer":
             txpool = TXPool(simulate_interval=txpool_interval)
             self._register(txpool)
-            self._register(Proposer(client=client, txpool=txpool,
-                                    shard=shard, config=config))
+            self._register_factory(
+                lambda: Proposer(client=client, txpool=txpool,
+                                 shard=shard, config=config))
         elif actor == "notary":
-            self._register(Notary(client=client, shard=shard, p2p=p2p,
-                                  config=config, deposit_flag=deposit,
-                                  sig_backend=get_backend(sig_backend)))
+            self._register_factory(
+                lambda: Notary(client=client, shard=shard, p2p=p2p,
+                               config=config, deposit_flag=deposit,
+                               sig_backend=get_backend(sig_backend)))
         else:
-            self._register(Observer(client=client, shard=shard))
+            self._register_factory(
+                lambda: Observer(client=client, shard=shard))
 
         if actor != "notary":
             # non-notary nodes run the simulator (backend.go:303)
-            self._register(Simulator(client=client, p2p=p2p,
-                                     shard_id=shard_id,
-                                     tick_interval=simulator_interval))
+            self._register_factory(
+                lambda: Simulator(client=client, p2p=p2p,
+                                  shard_id=shard_id,
+                                  tick_interval=simulator_interval))
 
-        self._register(Syncer(client=client, shard=shard, p2p=p2p))
+        self._register_factory(
+            lambda: Syncer(client=client, shard=shard, p2p=p2p))
 
     # -- registry (backend.go:151-174) ------------------------------------
 
@@ -110,6 +123,14 @@ class ShardNode:
             raise ValueError(f"service {kind.__name__} already registered")
         self._services[kind] = service
         self._order.append(service)
+
+    def _register_factory(self, factory) -> None:
+        """Register a service built by `factory`; the factory is kept so a
+        supervisor can replace a crashed instance with a FRESH one
+        (restart-as-fresh-instance, node/service.go:78-83)."""
+        service = factory()
+        self._register(service)
+        self._factories[type(service)] = factory
 
     def service(self, kind: Type[S]) -> S:
         """Typed fetch (fetchService parity)."""
@@ -126,13 +147,78 @@ class ShardNode:
     def start(self) -> None:
         for service in self._order:
             service.start()
+        if self.supervisor is not None:
+            self.supervisor.start()
 
     def stop(self) -> None:
+        if self.supervisor is not None:
+            self.supervisor.stop()
         for service in reversed(self._order):
             try:
                 service.stop()
             except Exception:
                 pass
+
+    # -- supervision (failure detection / elastic recovery) ----------------
+
+    MAX_RESTARTS = 3          # ... within RESTART_WINDOW seconds
+    RESTART_WINDOW = 300.0    # transient crashes outside the window decay
+
+    def heal(self) -> List[str]:
+        """Replace every crashed supervisable service with a fresh
+        instance built by its registered factory. Returns the names of
+        services restarted in this pass. The restart budget is a RATE:
+        more than MAX_RESTARTS replacements within RESTART_WINDOW seconds
+        means the crash is systemic, not transient — the instance is then
+        stopped and left down (old restarts age out, so a rare transient
+        crash never permanently disables a service)."""
+        import time
+
+        restarted: List[str] = []
+        now = time.monotonic()
+        for i, service in enumerate(list(self._order)):
+            if not isinstance(service, Service) or not service.crashed:
+                continue
+            if not service.supervisable:
+                continue
+            kind = type(service)
+            factory = self._factories.get(kind)
+            if factory is None:
+                continue
+            window = [t for t in self._restart_times.get(service.name, [])
+                      if now - t < self.RESTART_WINDOW]
+            if len(window) >= self.MAX_RESTARTS:
+                self._restart_times[service.name] = window
+                if service.running:  # budget exhausted: leave it DOWN
+                    try:
+                        service.stop()
+                    except Exception:
+                        pass
+                continue
+            window.append(now)
+            self._restart_times[service.name] = window
+            self.restarts[service.name] = self.restarts.get(
+                service.name, 0) + 1
+            try:
+                service.stop()
+            except Exception:
+                pass
+            try:
+                fresh = factory()
+                # carry the crash history forward for observability
+                fresh.errors.extend(service.errors)
+                fresh.start()
+            except Exception as exc:
+                # a failed rebuild must not kill the supervisor loop; the
+                # attempt still burned restart budget, so a systemically
+                # broken factory converges to "left down"
+                service.record_error(
+                    f"restart of {service.name} failed: {exc!r}")
+                continue
+            self._services[kind] = fresh
+            self._order[i] = fresh
+            restarted.append(fresh.name)
+        return restarted
 
     # -- conveniences ------------------------------------------------------
 
@@ -150,3 +236,34 @@ class ShardNode:
             if isinstance(service, Service):
                 out.extend(service.errors)
         return out
+
+
+class Supervisor(Service):
+    """Failure detector + elastic recovery for one ShardNode.
+
+    The reference has no supervisor — `node/service.go:78-83` only
+    PROMISES that a restarted service is a freshly constructed instance
+    and leaves restarting to the operator. Here the contract is enforced
+    by a watch loop: every `interval` it scans the node's services for
+    crashed background loops and replaces them through `ShardNode.heal`
+    (fresh construction via the registered factory, bounded by
+    ShardNode.MAX_RESTARTS).
+    """
+
+    name = "supervisor"
+
+    def __init__(self, node: ShardNode, interval: float = 1.0):
+        super().__init__()
+        self.node = node
+        self.interval = interval
+        self.restarts_performed = 0
+
+    def on_start(self) -> None:
+        self.spawn(self._watch)
+
+    def _watch(self) -> None:
+        while not self.wait(self.interval):
+            for name in self.node.heal():
+                self.restarts_performed += 1
+                self.log.warning("restarted crashed service %s "
+                                 "(fresh instance)", name)

@@ -66,6 +66,10 @@ def build_parser() -> argparse.ArgumentParser:
                           help="report the metrics registry periodically "
                                "and dump it at exit (metrics.go:22 gate)")
     sharding.add_argument("--metrics-interval", type=float, default=10.0)
+    sharding.add_argument("--supervise", action="store_true",
+                          help="watch actor services and restart crashed "
+                               "ones as fresh instances (bounded; "
+                               "node/service.go:78-83 restart semantics)")
     sharding.add_argument("--profile", default="",
                           help="write a JAX profiler trace to this directory "
                                "while running (the --pprof/--trace analog, "
@@ -107,6 +111,7 @@ def run_sharding_node(args) -> int:
         txpool_interval=args.txinterval,
         sig_backend=args.sigbackend,
         password=password,
+        supervise=args.supervise,
     )
     # dev mode: fund the node account so --deposit can stake
     backend.fund(node.client.account(), 2000 * ETHER)

@@ -885,50 +885,60 @@ _B3_G2 = (ref.B2.scalar(3))  # 3·b' = 9/ξ on the D-twist y² = x³ + 3/ξ
 _B3_G2_LIMBS = _const_fp2(_B3_G2.a, _B3_G2.b)
 
 
-def _proj_add(x1, y1, z1, x2, y2, z2, mul, add, sub, mul_b3):
+def _proj_add_impl(x1, y1, z1, x2, y2, z2, mul_many, add, sub, mul_b3):
     """RCB16 algorithm 7 (a = 0 short Weierstrass, projective X:Y:Z).
 
     Complete: handles identity (0:1:0), doubling and inverse pairs with
-    no branches. `mul/add/sub/mul_b3` abstract the field (Fp or Fp2)."""
-    t0 = mul(x1, x2)
-    t1 = mul(y1, y2)
-    t2 = mul(z1, z2)
-    t3 = sub(mul(add(x1, y1), add(x2, y2)), add(t0, t1))  # x1y2 + x2y1
-    t4 = sub(mul(add(y1, z1), add(y2, z2)), add(t1, t2))  # y1z2 + y2z1
-    t5 = sub(mul(add(x1, z1), add(x2, z2)), add(t0, t2))  # x1z2 + x2z1
+    no branches. Field ops are abstract (Fp or Fp2); the 12 field
+    products run as THREE stacked batched muls via `mul_many`
+    (independent products share one normalize chain each), which keeps
+    the 8-level committee tree's op count flat."""
+    t0, t1, t2 = mul_many([(x1, x2), (y1, y2), (z1, z2)])
+    m3, m4, m5 = mul_many([(add(x1, y1), add(x2, y2)),
+                           (add(y1, z1), add(y2, z2)),
+                           (add(x1, z1), add(x2, z2))])
+    t3 = sub(m3, add(t0, t1))        # x1y2 + x2y1
+    t4 = sub(m4, add(t1, t2))        # y1z2 + y2z1
+    t5 = sub(m5, add(t0, t2))        # x1z2 + x2z1
     t0 = add(add(t0, t0), t0)        # 3·x1x2
     t2 = mul_b3(t2)                  # b3·z1z2
     zs = add(t1, t2)                 # y1y2 + b3z1z2
     t1 = sub(t1, t2)                 # y1y2 - b3z1z2
-    y3 = mul_b3(t5)                  # b3·(x1z2 + x2z1)
-    x3 = sub(mul(t3, t1), mul(t4, y3))
-    y3 = add(mul(t1, zs), mul(t0, y3))
-    z3 = add(mul(zs, t4), mul(t0, t3))
-    return x3, y3, z3
+    yb = mul_b3(t5)                  # b3·(x1z2 + x2z1)
+    p1, p2, p3, p4, p5, p6 = mul_many([
+        (t3, t1), (t4, yb), (t1, zs), (t0, yb), (zs, t4), (t0, t3)])
+    return sub(p1, p2), add(p3, p4), add(p5, p6)
 
 
 def _g1_proj_add(p1, p2):
-    return _proj_add(*p1, *p2, mul=FP.mul, add=FP.add, sub=FP.sub,
-                     mul_b3=lambda v: FP.mul_small(v, 9))
+    def mul_many(pairs):
+        xs = jnp.stack([a for a, _ in pairs], axis=-2)
+        ys = jnp.stack([b for _, b in pairs], axis=-2)
+        out = FP.mul(xs, ys)
+        return [out[..., i, :] for i in range(len(pairs))]
+
+    return _proj_add_impl(*p1, *p2, mul_many=mul_many, add=FP.add,
+                          sub=FP.sub, mul_b3=lambda v: FP.mul_small(v, 9))
 
 
 def _g2_proj_add(p1, p2):
     b3 = jnp.asarray(_B3_G2_LIMBS)
-    return _proj_add(*p1, *p2, mul=fp2_mul, add=fp2_add, sub=fp2_sub,
-                     mul_b3=lambda v: fp2_mul(v, b3))
+
+    def mul_many(pairs):
+        xs = jnp.stack([a for a, _ in pairs], axis=-3)
+        ys = jnp.stack([b for _, b in pairs], axis=-3)
+        out = fp2_mul(xs, ys)
+        return [out[..., i, :, :] for i in range(len(pairs))]
+
+    return _proj_add_impl(*p1, *p2, mul_many=mul_many, add=fp2_add,
+                          sub=fp2_sub, mul_b3=lambda v: fp2_mul(v, b3))
 
 
-def _tree_reduce(point, axis, add_fn):
+def _tree_reduce_pow2(point, axis, add_fn):
     """Sum (X, Y, Z) coordinate stacks along committee axis `axis`
     (negative, counted from the end; the same for all three coords) by
-    repeated halving. The axis length must be a power of two — callers
-    pad with the identity (0:1:0), which the complete formulas absorb."""
+    repeated halving; the axis length must be a power of two here."""
     px, py, pz = point
-    if px.shape[axis] & (px.shape[axis] - 1):
-        # halving an odd length would silently DROP points — a wrong
-        # aggregate that verifies honest committees as forged
-        raise ValueError(
-            f"committee axis must be a power of two, got {px.shape[axis]}")
     while px.shape[axis] > 1:
         half = px.shape[axis] // 2
 
@@ -943,12 +953,36 @@ def _tree_reduce(point, axis, add_fn):
             jnp.squeeze(pz, axis))
 
 
+def _tree_reduce(point, axis, add_fn):
+    """Point sum along `axis` for ANY width: the width's binary
+    decomposition gives power-of-two segments (135 -> 128+4+2+1), each
+    tree-reduced, partial sums folded in — C-1 adds total instead of
+    the up-to-2x of padding to the next power of two."""
+    px, py, pz = point
+    width = px.shape[axis]
+    if width == 0:
+        raise ValueError("empty committee axis")
+    partials = []
+    start = 0
+    while start < width:
+        size = 1 << ((width - start).bit_length() - 1)
+        seg = tuple(
+            jnp.take(a, np.arange(start, start + size), axis=axis)
+            for a in (px, py, pz))
+        partials.append(_tree_reduce_pow2(seg, axis, add_fn))
+        start += size
+    acc = partials[0]
+    for part in partials[1:]:
+        acc = add_fn(acc, part)
+    return acc
+
+
 def aggregate_g1_proj(xs, ys, mask):
     """Masked committee sum of G1 points, on device.
 
     xs/ys: (..., C, 22) affine limbs; mask: (..., C) bool (False slots
-    contribute the identity). C must be a power of two. Returns the
-    projective (X, Y, Z) sum, each (..., 22)."""
+    contribute the identity); any C >= 1. Returns the projective
+    (X, Y, Z) sum, each (..., 22)."""
     m = mask[..., None]
     one = jnp.broadcast_to(jnp.asarray(FP.one), xs.shape)
     px = jnp.where(m, xs, 0)
@@ -995,7 +1029,7 @@ def bls_aggregate_verify_committee_batch(hx, hy, sigx, sigy, sig_mask,
 
     hx/hy: (B, 22) message-hash limbs; sigx/sigy: (B, C, 22) vote
     signatures with sig_mask (B, C); pkx/pky: (B, C, 2, 22) registered
-    voter pubkeys with pk_mask (B, C); C a power of two (pad masked).
+    voter pubkeys with pk_mask (B, C); any C >= 1 (pad rows masked).
     Identity aggregates (empty committee or adversarial cancellation)
     are rejected, matching the scalar `bls_verify_aggregate`.
     Returns (B,) bool.

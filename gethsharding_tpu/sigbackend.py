@@ -186,12 +186,13 @@ class JaxSigBackend(SigBackend):
         if n == 0:
             return []
         pad = self._bucket(n) - n
-        # committee axis padded to a power-of-two bucket (256 at protocol
-        # scale) so the tree reduction halves evenly and the node compiles
-        # a handful of kernel shapes
+        # committee axis: the tree reduction takes any width (binary
+        # segment decomposition), so bucket only enough to bound the
+        # number of compiled shapes — next multiple of 32 (135 -> 160),
+        # power of two below that
         width = max([1] + [len(r) for r in sig_rows]
                     + [len(r) for r in pk_rows])
-        width = self._bucket(width)
+        width = self._bucket(width) if width <= 32 else -(-width // 32) * 32
         hashes = [bls.hash_to_g1(bytes(m)) for m in messages] + [None] * pad
         hx, hy, hok = self._bn.g1_to_limbs(hashes)
         sx, sy, sm = self._bn.g1_committee_to_limbs(

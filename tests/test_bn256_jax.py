@@ -251,8 +251,17 @@ def test_committee_verify_rejects_cancelled_aggregates():
     assert [bool(v) for v in np.asarray(out)] == [False, False]
 
 
-def test_tree_reduce_rejects_non_power_of_two():
-    xs = jnp.zeros((2, 6, k.NLIMBS), jnp.int32)
-    mask = jnp.ones((2, 6), bool)
-    with pytest.raises(ValueError, match="power of two"):
-        k.aggregate_g1_proj(xs, xs, mask)
+@slow
+def test_tree_reduce_non_power_of_two_width():
+    """Widths that are not powers of two reduce via binary segment
+    decomposition — same sum as the host, no dropped points."""
+    pts = [ref.g1_mul(3 + i, ref.G1_GEN) for i in range(6)]
+    xs, ys, mask = k.g1_committee_to_limbs([pts, pts[:5]], 6)
+    X, Y, Z = jax.jit(k.aggregate_g1_proj)(
+        jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(mask))
+    Xi, Yi, Zi = (k.FP.to_ints(v) for v in (X, Y, Z))
+    for b, row in enumerate([pts, pts[:5]]):
+        host = ref.bls_aggregate_sigs(row)
+        zinv = pow(int(Zi[b]), ref.P - 2, ref.P)
+        assert (int(Xi[b]) * zinv % ref.P,
+                int(Yi[b]) * zinv % ref.P) == host
