@@ -21,7 +21,7 @@ slow to fit the budget (hermetic CPU runs).
 
 The kernel has build-time knobs whose best setting depends on the
 backend (GETHSHARDING_TPU_LIMB_FORM = wide|exact, GETHSHARDING_TPU_CARRY
-= scan|assoc, GETHSHARDING_TPU_CONV = gather|onehot, GETHSHARDING_TPU_PALLAS,
+= scan|assoc, GETHSHARDING_TPU_CONV = shift|slices|gather|onehot, GETHSHARDING_TPU_PALLAS,
 all read at import): the bench AUTOTUNES by re-executing itself
 per configuration in a subprocess and reports the fastest, caching the
 winner per backend in .bench_autotune.json. Signing workloads are cached
@@ -49,6 +49,8 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 # wins.
 CONFIGS = [
     {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "scan"},
+    {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "scan",
+     "GETHSHARDING_TPU_CONV": "slices"},
     {"GETHSHARDING_TPU_LIMB_FORM": "wide", "GETHSHARDING_TPU_CARRY": "scan"},
     {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "scan",
      "GETHSHARDING_TPU_CONV": "onehot"},
@@ -414,7 +416,7 @@ def main() -> None:
     knobs = "/".join(
         [best_cfg.get("GETHSHARDING_TPU_LIMB_FORM", "wide"),
          best_cfg.get("GETHSHARDING_TPU_CARRY", "scan"),
-         best_cfg.get("GETHSHARDING_TPU_CONV", "gather")]
+         best_cfg.get("GETHSHARDING_TPU_CONV", "shift")]
         + (["pallas"] if best_cfg.get("GETHSHARDING_TPU_PALLAS") == "1"
            else []))
     extra = {key: val for key, val in best.items()

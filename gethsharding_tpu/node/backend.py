@@ -190,6 +190,10 @@ class ShardNode:
             if len(window) >= self.MAX_RESTARTS:
                 self._restart_times[service.name] = window
                 if service.running:  # budget exhausted: leave it DOWN
+                    service.record_error(
+                        f"giving up on {service.name}: {len(window)} "
+                        f"restarts within {self.RESTART_WINDOW:.0f}s — "
+                        f"crash is systemic, leaving the service down")
                     try:
                         service.stop()
                     except Exception:

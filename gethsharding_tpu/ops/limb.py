@@ -149,35 +149,56 @@ if CARRY_IMPL not in ("scan", "assoc"):
 # default; bench.py probes it as an autotune config.
 PALLAS_NORM = os.environ.get("GETHSHARDING_TPU_PALLAS", "0") == "1"
 
-# The schoolbook column sum z[n] = sum_{l+m=n} x_l·y_m has two
+# The schoolbook column sum z[n] = sum_{l+m=n} x_l·y_m has four
 # implementations ($GETHSHARDING_TPU_CONV):
-# - "gather" (default): one static gather aligns prod row l to a
-#   l-shifted view, then a plain sum over rows. Work per output row =
-#   L·(2L-1) gathered elements + adds — each limb product is touched
-#   exactly once.
+# - "shift" (default): pad each row with L zeros, flatten, re-view at
+#   width M+L-1 — element (l, m) then sits at column l+m exactly — and
+#   sum rows. FOUR flat ops, working set ~2x the product tensor; wins
+#   on both the latency-bound pairing and the bandwidth-bound
+#   aggregation tree.
+# - "gather": a static gather aligns prod row l to an l-shifted view,
+#   then sums rows. Few graph nodes but materializes an (..., L, L+M-1)
+#   intermediate — ~L× the product tensor — catastrophically
+#   memory-bound on big batches (the r2 CPU bench regression).
+# - "slices": accumulate row l into out[l : l+M] with L static
+#   slice-adds — minimal working set (best dispatch on XLA:CPU), but L
+#   graph nodes per conv (heaviest compile).
 # - "onehot": contract the (..., L, M) product planes against a constant
 #   (L, M, L+M-1) one-hot via einsum. XLA lowers this to a DENSE integer
 #   matmul doing (L+M-1)× redundant multiply-accumulates on the VPU
 #   (int32 never rides the MXU): the r1 bench showed it dominating the
 #   pairing dispatch. Kept for comparison.
-CONV_IMPL = os.environ.get("GETHSHARDING_TPU_CONV", "gather")
-if CONV_IMPL not in ("gather", "onehot"):
-    raise ValueError(
-        f"GETHSHARDING_TPU_CONV must be 'gather' or 'onehot', got {CONV_IMPL!r}")
+CONV_IMPL = os.environ.get("GETHSHARDING_TPU_CONV", "shift")
+if CONV_IMPL not in ("shift", "slices", "gather", "onehot"):
+    raise ValueError(f"GETHSHARDING_TPU_CONV must be 'shift', 'slices', "
+                     f"'gather' or 'onehot', got {CONV_IMPL!r}")
 
 
-def conv_cols(prod: jnp.ndarray) -> jnp.ndarray:
+def conv_cols(prod: jnp.ndarray, impl: str = None) -> jnp.ndarray:
     """Anti-diagonal column sums: (..., L, M) -> (..., L+M-1) with
     out[n] = sum over l of prod[l, n-l] (0 <= n-l < M).
 
-    The building block of every limb product. `gather` pads one zero
-    column, uses a static (L, L+M-1) index table sending out-of-window
-    positions to the zero column, and sums over rows — O(L·(L+M)) adds.
-    """
+    The building block of every limb product. `impl` overrides the
+    module default per call site."""
     L, M = prod.shape[-2], prod.shape[-1]
     ncols = L + M - 1
-    if CONV_IMPL == "onehot":
+    impl = impl or CONV_IMPL
+    if impl == "onehot":
         return jnp.einsum("...ij,ijk->...k", prod, _conv_onehot(L, M))
+    if impl == "slices":
+        out = jnp.zeros(prod.shape[:-2] + (ncols,), prod.dtype)
+        for l in range(L):
+            out = out.at[..., l:l + M].add(prod[..., l, :])
+        return out
+    if impl == "shift":
+        # row-major layout: (l, m) of the (..., L, M+L) padded rows sits
+        # at flat position l·(M+L) + m = l·(M+L-1) + (l+m); re-viewing at
+        # width M+L-1 makes the column index exactly n = l+m (always
+        # < M+L-1), so a row-sum IS the anti-diagonal sum.
+        batch = prod.shape[:-2]
+        padded = jnp.pad(prod, [(0, 0)] * (prod.ndim - 2) + [(0, 0), (0, L)])
+        flat = padded.reshape(batch + (L * (M + L),))[..., :L * (M + L - 1)]
+        return flat.reshape(batch + (L, M + L - 1)).sum(axis=-2)
     prod_p = jnp.pad(prod, [(0, 0)] * (prod.ndim - 1) + [(0, 1)])
     idx = _conv_gather_idx(L, M)  # (L, ncols) static
     rows = jnp.take_along_axis(

@@ -147,3 +147,22 @@ def test_assoc_carry_impl_matches_scan(monkeypatch):
     assert [int(v) for v in got_scan] == expect
     assert [int(v) for v in got_assoc] == [(a * b - b) % p
                                            for a, b in zip(vals_a, vals_b)]
+
+
+def test_conv_impls_agree():
+    """Every conv_cols implementation computes the same anti-diagonal
+    sums (the autotune sweep may deploy any of them)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from gethsharding_tpu.ops import limb
+
+    rng = np.random.default_rng(7)
+    for L, M in [(22, 22), (25, 49), (3, 7), (1, 5), (22, 43)]:
+        prod = rng.integers(-2**20, 2**20, size=(2, 3, L, M),
+                            dtype=np.int64).astype(np.int32)
+        want = limb.conv_cols(jnp.asarray(prod), impl="onehot")
+        for impl in ("shift", "slices", "gather"):
+            got = limb.conv_cols(jnp.asarray(prod), impl=impl)
+            assert np.array_equal(np.asarray(got), np.asarray(want)), (
+                L, M, impl)
