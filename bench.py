@@ -347,6 +347,15 @@ def _run_config(cfg: dict, extras: bool = False) -> dict | None:
     return None
 
 
+def _sweep_fingerprint() -> str:
+    """Identity of the config set: a cache written for a different sweep
+    (older knob set) must not short-circuit the new sweep."""
+    import hashlib
+
+    return hashlib.sha256(
+        json.dumps(CONFIGS, sort_keys=True).encode()).hexdigest()[:12]
+
+
 def _cache_path() -> str:
     return os.path.join(REPO, ".bench_autotune.json")
 
@@ -371,7 +380,8 @@ def main() -> None:
     try:
         cached = json.load(open(_cache_path()))
         cache_key = cached.get("platform")
-        if all(key in cached for key in ("config", "platform")):
+        if (cached.get("sweep") == _sweep_fingerprint()
+                and all(key in cached for key in ("config", "platform"))):
             best_cfg = cached["config"]
     except Exception:
         pass
@@ -402,7 +412,8 @@ def main() -> None:
         else:
             best_cfg, best = max(results, key=lambda r: r[1]["sig_rate"])
             try:
-                json.dump({"config": best_cfg, "platform": best["platform"]},
+                json.dump({"config": best_cfg, "platform": best["platform"],
+                           "sweep": _sweep_fingerprint()},
                           open(_cache_path(), "w"))
             except OSError:
                 pass

@@ -1,0 +1,159 @@
+"""Interactive console attached to a running chain process.
+
+The analog of the reference's JS REPL (`console/console.go` over any RPC
+endpoint, wired as `geth attach`): `tpu-sharding attach --port N` dials
+the chain process's RPC server (`rpc/chain_server.py`) and offers an
+interactive command loop over the same surface the actors use
+(`rpc/client.py` RemoteMainchain) — chain inspection, SMC state queries,
+and dev-mode block production. Commands are line-oriented (cmd module)
+rather than a JS interpreter: the capability target is "operator can
+inspect and poke a live node", not otto/duktape parity.
+"""
+
+from __future__ import annotations
+
+import cmd
+import shlex
+from typing import Optional
+
+from gethsharding_tpu.utils.hexbytes import Address20
+
+
+def _addr(arg: str) -> Address20:
+    raw = arg[2:] if arg.startswith("0x") else arg
+    return Address20(bytes.fromhex(raw))
+
+
+class ShardingConsole(cmd.Cmd):
+    """One command per line; `help` lists everything."""
+
+    intro = ("tpu-sharding console — attached. Type help or ? to list "
+             "commands, quit to leave.")
+    prompt = "> "
+
+    def __init__(self, chain, stdin=None, stdout=None):
+        super().__init__(stdin=stdin, stdout=stdout)
+        if stdin is not None:
+            self.use_rawinput = False
+        self.chain = chain
+
+    def emit(self, text: str) -> None:
+        self.stdout.write(str(text) + "\n")
+
+    # -- chain view --------------------------------------------------------
+
+    def do_block(self, arg):
+        """block — current block number"""
+        self.emit(self.chain.block_number)
+
+    def do_period(self, arg):
+        """period — current period"""
+        self.emit(self.chain.current_period())
+
+    def do_shards(self, arg):
+        """shards — shard count"""
+        self.emit(self.chain.shard_count())
+
+    def do_balance(self, arg):
+        """balance <address> — account balance in wei"""
+        self.emit(self.chain.balance_of(_addr(arg.strip())))
+
+    # -- SMC state ---------------------------------------------------------
+
+    def do_record(self, arg):
+        """record <shard> [period] — collation record for (shard, period)"""
+        parts = shlex.split(arg)
+        shard = int(parts[0])
+        period = int(parts[1]) if len(parts) > 1 else self.chain.current_period()
+        record = self.chain.collation_record(shard, period)
+        if record is None:
+            self.emit("no record")
+            return
+        self.emit(f"chunk_root=0x{bytes(record.chunk_root).hex()} "
+                  f"proposer=0x{bytes(record.proposer).hex()} "
+                  f"votes={record.vote_count} elected={record.is_elected}")
+
+    def do_registry(self, arg):
+        """registry <address> — notary registry entry"""
+        entry = self.chain.notary_registry(_addr(arg.strip()))
+        if entry is None or not entry.deposited:
+            self.emit("not a deposited notary")
+            return
+        self.emit(f"pool_index={entry.pool_index} "
+                  f"deregistered_period={entry.deregistered_period} "
+                  f"bls={'yes' if entry.bls_pubkey is not None else 'no'}")
+
+    def do_committee(self, arg):
+        """committee <address> <shard> — is the address sampled for the
+        shard's committee this period?"""
+        parts = shlex.split(arg)
+        addr = _addr(parts[0])
+        member = self.chain.get_notary_in_committee(addr, int(parts[1]))
+        self.emit("sampled" if member == addr else "not sampled")
+
+    def do_votes(self, arg):
+        """votes <shard> — current vote count for the shard"""
+        self.emit(self.chain.get_vote_count(int(arg.strip())))
+
+    def do_submitted(self, arg):
+        """submitted <shard> — last period with a submitted collation"""
+        self.emit(self.chain.last_submitted_collation(int(arg.strip())))
+
+    def do_approved(self, arg):
+        """approved <shard> — last period with an approved collation"""
+        self.emit(self.chain.last_approved_collation(int(arg.strip())))
+
+    # -- dev-mode chain driving -------------------------------------------
+
+    def do_commit(self, arg):
+        """commit — mine one block (dev chain)"""
+        block = self.chain.commit()
+        self.emit(f"block {block.number}")
+
+    def do_fastforward(self, arg):
+        """fastforward [periods] — advance whole periods (dev chain)"""
+        periods = int(arg.strip()) if arg.strip() else 1
+        self.emit(self.chain.fast_forward(periods))
+
+    def do_fund(self, arg):
+        """fund <address> <wei> — credit a dev-chain balance"""
+        parts = shlex.split(arg)
+        self.chain.fund(_addr(parts[0]), int(parts[1]))
+        self.emit("ok")
+
+    # -- session -----------------------------------------------------------
+
+    def do_quit(self, arg):
+        """quit — leave the console"""
+        return True
+
+    do_exit = do_quit
+    do_EOF = do_quit
+
+    def emptyline(self):  # do not repeat the last command on blank input
+        return False
+
+    def onecmd(self, line):
+        try:
+            return super().onecmd(line)
+        except SystemExit:
+            raise
+        except Exception as exc:  # bad args must not kill the session
+            self.emit(f"error: {exc}")
+            return False
+
+
+def run_attach(host: str, port: int,
+               stdin=None, stdout=None) -> int:
+    from gethsharding_tpu.rpc.client import RemoteMainchain
+
+    try:
+        chain = RemoteMainchain.dial(host, port)
+    except OSError as exc:
+        print(f"unable to attach to {host}:{port}: {exc}")
+        return 1
+    try:
+        ShardingConsole(chain, stdin=stdin, stdout=stdout).cmdloop()
+    finally:
+        chain.close()
+    return 0

@@ -47,7 +47,8 @@ class ShardNode:
                  sig_backend: str = "python",
                  password: Optional[str] = None,
                  supervise: bool = False,
-                 supervise_interval: float = 1.0):
+                 supervise_interval: float = 1.0,
+                 http_port: Optional[int] = None):
         if actor not in self.ACTORS:
             raise ValueError(f"unknown actor {actor!r}; pick from {self.ACTORS}")
         self.actor = actor
@@ -114,6 +115,12 @@ class ShardNode:
 
         self._register_factory(
             lambda: Syncer(client=client, shard=shard, p2p=p2p))
+
+        if http_port is not None:
+            # observability endpoint (dashboard/ethstats/expvar analog)
+            from gethsharding_tpu.node.http_status import StatusServer
+
+            self._register(StatusServer(self, port=http_port))
 
     # -- registry (backend.go:151-174) ------------------------------------
 

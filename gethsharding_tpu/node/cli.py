@@ -66,6 +66,9 @@ def build_parser() -> argparse.ArgumentParser:
                           help="report the metrics registry periodically "
                                "and dump it at exit (metrics.go:22 gate)")
     sharding.add_argument("--metrics-interval", type=float, default=10.0)
+    sharding.add_argument("--http", type=int, default=None, metavar="PORT",
+                          help="serve /healthz /metrics /status on this "
+                               "port (dashboard/ethstats analog)")
     sharding.add_argument("--supervise", action="store_true",
                           help="watch actor services and restart crashed "
                                "ones as fresh instances (bounded; "
@@ -74,6 +77,14 @@ def build_parser() -> argparse.ArgumentParser:
                           help="write a JAX profiler trace to this directory "
                                "while running (the --pprof/--trace analog, "
                                "internal/debug/flags.go:40-90)")
+    attach = sub.add_parser(
+        "attach", help="interactive console on a running chain process "
+                       "(the geth attach / console analog)")
+    attach.add_argument("--host", default="127.0.0.1")
+    attach.add_argument("--port", type=int, required=True,
+                        help="chain process RPC port")
+    attach.add_argument("--verbosity", default="warning",
+                        choices=("debug", "info", "warning", "error"))
     return parser
 
 
@@ -86,6 +97,10 @@ def run_cli(argv: Optional[List[str]] = None) -> int:
     )
     if args.command == "sharding":
         return run_sharding_node(args)
+    if args.command == "attach":
+        from gethsharding_tpu.console import run_attach
+
+        return run_attach(args.host, args.port)
     return 2
 
 
@@ -112,6 +127,7 @@ def run_sharding_node(args) -> int:
         sig_backend=args.sigbackend,
         password=password,
         supervise=args.supervise,
+        http_port=args.http,
     )
     # dev mode: fund the node account so --deposit can stake
     backend.fund(node.client.account(), 2000 * ETHER)

@@ -1,0 +1,119 @@
+"""HTTP status + metrics endpoint for a running node.
+
+The native counterpart of the reference's observability servers: the
+embedded dashboard streaming system samples (`dashboard/dashboard.go:36`),
+the ethstats push reporter (`ethstats/ethstats.go:86`), and the expvar
+metrics exporter (`metrics/exp`). One small stdlib HTTP server exposes:
+
+  GET /healthz  -> {"status": "ok"|"degraded", "services": {...}}
+  GET /metrics  -> the metrics registry snapshot (counters/gauges/timers)
+  GET /status   -> node identity + chain view (actor, shard, account,
+                   period, restart counts)
+
+JSON over plain HTTP so `curl` replaces the embedded React bundle — the
+data surface is the parity target, not the UI. Runs as a Service on the
+node (started/stopped with it).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from gethsharding_tpu.actors.base import Service
+from gethsharding_tpu.metrics import DEFAULT_REGISTRY
+
+
+class StatusServer(Service):
+    """Serves /healthz, /metrics and /status for one ShardNode."""
+
+    name = "http-status"
+
+    def __init__(self, node, host: str = "127.0.0.1", port: int = 0):
+        super().__init__()
+        self.node = node
+        self.host = host
+        self.port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    # -- payloads ----------------------------------------------------------
+
+    def health_payload(self) -> dict:
+        services = {}
+        degraded = False
+        for service in self.node.services:
+            if not isinstance(service, Service):
+                continue
+            state = ("crashed" if service.crashed
+                     else "running" if service.running else "stopped")
+            degraded = degraded or state != "running"
+            services[service.name] = state
+        return {"status": "degraded" if degraded else "ok",
+                "services": services}
+
+    def status_payload(self) -> dict:
+        node = self.node
+        try:
+            period = node.client.current_period()
+            block = node.client.block_number
+        except Exception:
+            period, block = None, None
+        return {
+            "actor": node.actor,
+            "shard_id": node.shard_id,
+            "account": node.client.account().hex_str,
+            "block_number": block,
+            "period": period,
+            "restarts": dict(node.restarts),
+        }
+
+    def metrics_payload(self) -> dict:
+        return DEFAULT_REGISTRY.snapshot()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def on_start(self) -> None:
+        status = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # route through our logger
+                status.log.debug("http %s", fmt % args)
+
+            def do_GET(self):
+                routes = {
+                    "/healthz": status.health_payload,
+                    "/metrics": status.metrics_payload,
+                    "/status": status.status_payload,
+                }
+                fn = routes.get(self.path.split("?")[0])
+                if fn is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                try:
+                    body = json.dumps(fn()).encode()
+                    code = 200
+                except Exception as exc:  # degraded node must still answer
+                    body = json.dumps({"error": repr(exc)}).encode()
+                    code = 500
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]  # resolved for port=0
+        thread = threading.Thread(target=self._httpd.serve_forever,
+                                  name="http-status", daemon=True)
+        self._threads.append(thread)
+        thread.start()
+        self.log.info("status endpoint on http://%s:%d", self.host, self.port)
+
+    def on_stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -174,7 +174,7 @@ if CONV_IMPL not in ("shift", "slices", "gather", "onehot"):
                      f"'gather' or 'onehot', got {CONV_IMPL!r}")
 
 
-def conv_cols(prod: jnp.ndarray, impl: str = None) -> jnp.ndarray:
+def conv_cols(prod: jnp.ndarray, impl: "str | None" = None) -> jnp.ndarray:
     """Anti-diagonal column sums: (..., L, M) -> (..., L+M-1) with
     out[n] = sum over l of prod[l, n-l] (0 <= n-l < M).
 

@@ -1,0 +1,152 @@
+"""HTTP status endpoint + console REPL (dashboard/console analogs)."""
+
+import io
+import json
+import subprocess
+import sys
+import urllib.request
+
+from gethsharding_tpu.node.backend import ShardNode
+from gethsharding_tpu.smc.chain import SimulatedMainchain
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_status_endpoint_serves_health_metrics_status():
+    node = ShardNode(actor="observer", backend=SimulatedMainchain(),
+                     txpool_interval=None, http_port=0)
+    node.start()
+    try:
+        from gethsharding_tpu.node.http_status import StatusServer
+
+        port = node.service(StatusServer).port
+        code, health = _get(port, "/healthz")
+        assert code == 200
+        assert health["status"] == "ok"
+        assert health["services"]["syncer"] == "running"
+
+        code, status = _get(port, "/status")
+        assert code == 200
+        assert status["actor"] == "observer"
+        assert status["period"] == 0
+        assert status["account"].startswith("0x")
+
+        code, metrics = _get(port, "/metrics")
+        assert code == 200
+        assert isinstance(metrics, dict)
+
+        # unknown path -> 404
+        try:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/nope", timeout=5)
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as exc:
+            assert exc.code == 404
+    finally:
+        node.stop()
+
+
+def test_status_endpoint_reports_degraded_on_crash():
+    from gethsharding_tpu.actors.syncer import Syncer
+    from gethsharding_tpu.node.http_status import StatusServer
+
+    node = ShardNode(actor="observer", backend=SimulatedMainchain(),
+                     txpool_interval=None, http_port=0)
+    node.start()
+    try:
+        victim = node.service(Syncer)
+        victim.spawn(lambda: (_ for _ in ()).throw(RuntimeError("boom")),
+                     name="crash")
+        import time
+
+        deadline = time.time() + 3.0
+        while time.time() < deadline and not victim.crashed:
+            time.sleep(0.02)
+        port = node.service(StatusServer).port
+        _, health = _get(port, "/healthz")
+        assert health["status"] == "degraded"
+        assert health["services"]["syncer"] == "crashed"
+    finally:
+        node.stop()
+
+
+def test_console_drives_a_chain_over_rpc():
+    """Console commands against a real chain process over a socket."""
+    from gethsharding_tpu.console import ShardingConsole
+    from gethsharding_tpu.crypto.keccak import keccak256
+    from gethsharding_tpu.mainchain.accounts import AccountManager
+    from gethsharding_tpu.params import ETHER
+    from gethsharding_tpu.rpc.client import RemoteMainchain
+    from gethsharding_tpu.rpc.server import RPCServer
+    from gethsharding_tpu.utils.hexbytes import Hash32
+
+    backend = SimulatedMainchain()
+    server = RPCServer(backend, port=0)
+    server.start()
+    try:
+        manager = AccountManager()
+        acct = manager.new_account(seed=b"console")
+        backend.fund(acct.address, 2000 * ETHER)
+        backend.register_notary(
+            acct.address, bls_pubkey=acct.bls_pubkey,
+            bls_pop=manager.bls_proof_of_possession(acct.address))
+        backend.fast_forward(1)
+        root = Hash32(keccak256(b"console-root"))
+        backend.add_header(acct.address, 3, backend.current_period(), root)
+
+        chain = RemoteMainchain.dial(*server.address)
+        addr_hex = "0x" + bytes(acct.address).hex()
+        script = "\n".join([
+            "block", "period", "shards",
+            f"balance {addr_hex}",
+            f"registry {addr_hex}",
+            "record 3",
+            "record 99",
+            "votes 3",
+            "submitted 3",
+            "commit",
+            "fastforward 2",
+            "bogus-command",
+            "record not-a-number",
+            "quit",
+        ]) + "\n"
+        out = io.StringIO()
+        console = ShardingConsole(chain, stdin=io.StringIO(script),
+                                  stdout=out)
+        console.cmdloop()
+        chain.close()
+        text = out.getvalue()
+        assert f"{backend.config.shard_count}" in text
+        assert "pool_index=0" in text
+        assert "chunk_root=0x" + bytes(root).hex() in text
+        assert "no record" in text
+        assert "block 6" in text      # commit mined block 6 (period 1 + 1)
+        assert "error:" in text       # bad args answered, session survived
+        # the two dev commands really advanced the remote chain
+        assert backend.current_period() == 3
+    finally:
+        server.stop()
+
+
+def test_cli_attach_subcommand_end_to_end():
+    """`tpu-sharding attach` as a real subprocess against a chain-server
+    subprocess — the full operator flow across two OS processes."""
+    chain_proc = subprocess.Popen(
+        [sys.executable, "-m", "gethsharding_tpu.rpc.chain_server",
+         "--port", "0", "--runtime", "30"],
+        stdout=subprocess.PIPE, text=True)
+    try:
+        info = json.loads(chain_proc.stdout.readline())
+        out = subprocess.run(
+            [sys.executable, "-m", "gethsharding_tpu.node.cli", "attach",
+             "--port", str(info["port"])],
+            input="period\ncommit\nquit\n", text=True,
+            capture_output=True, timeout=30)
+        assert out.returncode == 0
+        assert "block 1" in out.stdout
+    finally:
+        chain_proc.terminate()
+        chain_proc.wait(timeout=10)
