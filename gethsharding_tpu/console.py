@@ -20,8 +20,7 @@ from gethsharding_tpu.utils.hexbytes import Address20
 
 
 def _addr(arg: str) -> Address20:
-    raw = arg[2:] if arg.startswith("0x") else arg
-    return Address20(bytes.fromhex(raw))
+    return Address20(arg)  # accepts 0x-prefixed or bare hex
 
 
 class ShardingConsole(cmd.Cmd):
@@ -102,6 +101,20 @@ class ShardingConsole(cmd.Cmd):
     def do_approved(self, arg):
         """approved <shard> — last period with an approved collation"""
         self.emit(self.chain.last_approved_collation(int(arg.strip())))
+
+    def do_peers(self, arg):
+        """peers — shardp2p relay peer table"""
+        peers = self.chain.p2p_peers()
+        if not peers:
+            self.emit("no peers attached")
+            return
+        for peer in peers:
+            self.emit(f"peer {peer['id']}: account={peer.get('account')} "
+                      f"version={peer.get('version')}")
+
+    def do_network(self, arg):
+        """network — chain network id"""
+        self.emit(self.chain.network_id())
 
     # -- dev-mode chain driving -------------------------------------------
 

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import sys
 import time
 from typing import List, Optional
 
@@ -66,6 +67,12 @@ def build_parser() -> argparse.ArgumentParser:
                           help="report the metrics registry periodically "
                                "and dump it at exit (metrics.go:22 gate)")
     sharding.add_argument("--metrics-interval", type=float, default=10.0)
+    sharding.add_argument("--endpoint", default="",
+                          metavar="HOST:PORT",
+                          help="dial a running chain process instead of "
+                               "hosting an in-process dev chain (the "
+                               "`geth sharding [endpoint]` topology: N "
+                               "actor processes, one mainchain)")
     sharding.add_argument("--http", type=int, default=None, metavar="PORT",
                           help="serve /healthz /metrics /status on this "
                                "port (dashboard/ethstats analog)")
@@ -107,7 +114,27 @@ def run_cli(argv: Optional[List[str]] = None) -> int:
 def run_sharding_node(args) -> int:
     config = Config(period_length=args.periodlength,
                     windback_depth=args.windback)
-    backend = SimulatedMainchain(config=config)
+    hub = None
+    if args.endpoint:
+        from gethsharding_tpu.p2p.remote import RemoteHub
+        from gethsharding_tpu.rpc.client import RemoteMainchain
+
+        host, _, port = args.endpoint.rpartition(":")
+        if not port.isdigit():
+            print(f"--endpoint must be HOST:PORT, got {args.endpoint!r}",
+                  file=sys.stderr)
+            return 2
+        backend = RemoteMainchain.dial(host or "127.0.0.1", int(port))
+        # the chain process owns the protocol constants: adopt its config
+        # so every attached actor agrees on periods/committees (a stated
+        # mismatch would silently skew period math — the real cross-
+        # process divergence risk, not the network id)
+        config = backend.chain_config(
+            windback_depth=args.windback)
+        hub = RemoteHub.dial(host or "127.0.0.1", int(port),
+                             network_id=config.network_id)
+    else:
+        backend = SimulatedMainchain(config=config)
     password = args.password
     if password is not None:
         try:  # geth convention: --password usually names a file
@@ -128,7 +155,11 @@ def run_sharding_node(args) -> int:
         password=password,
         supervise=args.supervise,
         http_port=args.http,
+        hub=hub,
     )
+    if hub is not None:
+        # the node's public identity in the relay's peer table
+        hub.account = node.client.account().hex_str
     # dev mode: fund the node account so --deposit can stake
     backend.fund(node.client.account(), 2000 * ETHER)
 
@@ -158,6 +189,8 @@ def run_sharding_node(args) -> int:
     try:
         while deadline is None or time.monotonic() < deadline:
             time.sleep(args.blocktime)
+            if args.endpoint:
+                continue  # the chain process owns block production
             block = backend.commit()
             if block.number % config.period_length == 0:
                 log.info("period %d sealed (block %d)",

@@ -18,7 +18,8 @@ from __future__ import annotations
 import logging
 from typing import Any, Optional
 
-from gethsharding_tpu.p2p.service import Message, Peer
+from gethsharding_tpu.p2p.service import (
+    Message, Peer, PROTOCOL_NAME, PROTOCOL_VERSION)
 from gethsharding_tpu.rpc import codec
 from gethsharding_tpu.rpc.client import RPCClient
 
@@ -33,14 +34,24 @@ class RemoteHub:
     process attached to the same relay.
     """
 
-    def __init__(self, rpc: RPCClient):
+    def __init__(self, rpc: RPCClient, network_id: Optional[int] = None,
+                 account: Optional[str] = None):
         self.rpc = rpc
+        self.network_id = network_id
+        self.account = account
         self._server = None
         rpc.on_notification("shard_p2p", self._on_message)
 
     @classmethod
-    def dial(cls, host: str, port: int) -> "RemoteHub":
-        return cls(RPCClient(host, port))
+    def dial(cls, host: str, port: int,
+             network_id: Optional[int] = None,
+             account: Optional[str] = None) -> "RemoteHub":
+        """Dial the relay. `network_id`/`account` go into the attach
+        handshake: a stated network id must match the chain process's
+        (protocol/version always must), and the account becomes the
+        peer's public identity in the relay's peer table."""
+        return cls(RPCClient(host, port), network_id=network_id,
+                   account=account)
 
     def close(self) -> None:
         self.rpc.close()
@@ -54,8 +65,14 @@ class RemoteHub:
         # register the delivery target BEFORE the relay learns about the
         # peer: it may start pushing the instant the attach call lands
         self._server = server
+        handshake = {"protocol": PROTOCOL_NAME,
+                     "version": PROTOCOL_VERSION}
+        if self.network_id is not None:
+            handshake["network_id"] = self.network_id
+        if self.account is not None:
+            handshake["account"] = self.account
         try:
-            peer_id = self.rpc.call("shard_p2pAttach")
+            peer_id = self.rpc.call("shard_p2pAttach", handshake)
         except Exception:
             self._server = None
             raise

@@ -20,6 +20,13 @@ from typing import Any, Dict, Optional, Type
 
 from gethsharding_tpu.p2p.feed import Feed, Subscription
 
+# Protocol identity carried in the cross-process handshake — the
+# `p2p.Protocol{Name, Version}` + NetworkId gate of the reference's RLPx
+# layer (p2p/protocol.go:26, eth/handler.go status exchange), minus the
+# crypto (the relay rides a trusted local RPC link, not the open internet).
+PROTOCOL_NAME = "shardp2p"
+PROTOCOL_VERSION = 1
+
 
 @dataclass(frozen=True)
 class Peer:

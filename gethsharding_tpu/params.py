@@ -32,6 +32,9 @@ class Config:
     # may vote to extend a shard chain. 0 disables (the reference ships
     # the requirement as documented intent only; --windback on the CLI).
     windback_depth: int = 0
+    # dev-chain network identity (--networkid parity, flags.go NetworkId):
+    # shardp2p handshakes reject peers from a different network
+    network_id: int = 1337
 
 
 DEFAULT_CONFIG = Config()

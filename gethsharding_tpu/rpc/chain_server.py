@@ -31,6 +31,7 @@ def main(argv=None) -> int:
     parser.add_argument("--quorum", type=int, default=None,
                         help="override QUORUM_SIZE (dev/test chains)")
     parser.add_argument("--shardcount", type=int, default=None)
+    parser.add_argument("--networkid", type=int, default=None)
     parser.add_argument("--blocktime", type=float, default=0.0,
                         help="auto block production interval (0 = manual "
                              "via shard_commit / shard_fastForward)")
@@ -45,6 +46,8 @@ def main(argv=None) -> int:
         overrides["quorum_size"] = args.quorum
     if args.shardcount is not None:
         overrides["shard_count"] = args.shardcount
+    if args.networkid is not None:
+        overrides["network_id"] = args.networkid
     config = Config(**overrides)
     backend = SimulatedMainchain(config=config)
     server = RPCServer(backend, host=args.host, port=args.port)

@@ -305,6 +305,23 @@ class RemoteMainchain:
             index, codec.enc_bytes(chunk_root), codec.enc_g1(bls_sig)))
 
     # dev-mode chain control
+    def network_id(self) -> int:
+        return self.rpc.call("shard_networkId")
+
+    def chain_config(self, **overrides):
+        """Fetch the chain process's protocol constants as a Config.
+        `overrides` replace node-local knobs (e.g. windback_depth) that
+        are not chain consensus parameters."""
+        from gethsharding_tpu.params import Config
+
+        fields = self.rpc.call("shard_chainConfig")
+        fields.update(overrides)
+        return Config(**fields)
+
+    def p2p_peers(self) -> list:
+        """The relay's attached-peer table (admin_peers analog)."""
+        return self.rpc.call("shard_p2pPeers")
+
     def fund(self, account: Address20, amount: int) -> None:
         self.rpc.call("shard_fund", codec.enc_bytes(account), amount)
 

@@ -21,6 +21,10 @@ import threading
 from typing import Optional
 
 from gethsharding_tpu.rpc import codec
+from gethsharding_tpu.p2p.service import (
+    PROTOCOL_NAME as P2P_PROTOCOL_NAME,
+    PROTOCOL_VERSION as P2P_PROTOCOL_VERSION,
+)
 from gethsharding_tpu.smc.chain import SimulatedMainchain
 from gethsharding_tpu.smc.state_machine import SMCRevert
 from gethsharding_tpu.utils.hexbytes import Address20, Hash32
@@ -59,6 +63,7 @@ class RPCServer:
         # shardp2p relay: peer id -> (wfile, write lock); actors in other
         # processes attach here and exchange typed messages through us
         self._p2p_peers: dict = {}
+        self._p2p_meta: dict = {}
         self._p2p_ids = 1
 
     # -- lifecycle ---------------------------------------------------------
@@ -121,6 +126,7 @@ class RPCServer:
                         if wf is handler.wfile]
                 for pid in dead:
                     self._p2p_peers.pop(pid, None)
+                    self._p2p_meta.pop(pid, None)
 
     def _dispatch(self, raw: bytes, handler, write_lock) -> Optional[dict]:
         try:
@@ -137,10 +143,17 @@ class RPCServer:
                     self._subscribers[handler.wfile] = write_lock
                 result = "newHeads"
             elif method == "shard_p2pAttach":
+                handshake = params[0] if params else {}
+                self._check_handshake(handshake)
                 with self._sub_lock:
                     peer_id = self._p2p_ids
                     self._p2p_ids += 1
                     self._p2p_peers[peer_id] = (handler.wfile, write_lock)
+                    self._p2p_meta[peer_id] = {
+                        "account": handshake.get("account"),
+                        "version": handshake.get(
+                            "version", P2P_PROTOCOL_VERSION),
+                    }
                 result = peer_id
             else:
                 fn = getattr(self, "rpc_" + method.replace("shard_", "", 1),
@@ -284,9 +297,44 @@ class RPCServer:
                        "payload": payload},
         }) + "\n").encode()
 
+    def _check_handshake(self, handshake: dict) -> None:
+        """Protocol/version/network gate (p2p/protocol.go + the eth status
+        exchange, scoped to the relay's trust model). Absent fields pass —
+        an attacher that states nothing claims nothing — but any STATED
+        field must match."""
+        proto = handshake.get("protocol", P2P_PROTOCOL_NAME)
+        if proto != P2P_PROTOCOL_NAME:
+            raise ValueError(f"protocol mismatch: {proto!r}")
+        version = handshake.get("version", P2P_PROTOCOL_VERSION)
+        if version != P2P_PROTOCOL_VERSION:
+            raise ValueError(
+                f"version mismatch: peer {version}, ours {P2P_PROTOCOL_VERSION}")
+        network = handshake.get("network_id")
+        ours = self.backend.config.network_id
+        if network is not None and network != ours:
+            raise ValueError(f"network mismatch: peer {network}, ours {ours}")
+
+    def rpc_p2pPeers(self):
+        """Attached-peer table (admin_peers parity for the relay)."""
+        with self._sub_lock:
+            return [{"id": pid, **self._p2p_meta.get(pid, {})}
+                    for pid in sorted(self._p2p_peers)]
+
+    def rpc_networkId(self):
+        return self.backend.config.network_id
+
+    def rpc_chainConfig(self):
+        """The chain process's protocol constants — attached actors adopt
+        these instead of trusting their own flags (one source of truth
+        for period/committee math across processes)."""
+        import dataclasses
+
+        return dataclasses.asdict(self.backend.config)
+
     def rpc_p2pDetach(self, peer_id):
         with self._sub_lock:
             self._p2p_peers.pop(peer_id, None)
+            self._p2p_meta.pop(peer_id, None)
         return True
 
     def rpc_p2pSend(self, from_id, to_id, kind, payload):

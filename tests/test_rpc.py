@@ -146,3 +146,54 @@ def test_full_period_pipeline_cross_process(tmp_path):
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+def test_p2p_handshake_and_peer_table():
+    """Protocol/version/network gate on relay attach (the RLPx handshake +
+    eth status-exchange analog) and the admin_peers-style table."""
+    import pytest
+
+    from gethsharding_tpu.p2p.remote import RemoteHub
+    from gethsharding_tpu.p2p.service import P2PServer
+    from gethsharding_tpu.params import Config
+    from gethsharding_tpu.rpc.client import RemoteMainchain
+    from gethsharding_tpu.rpc.server import RPCServer
+    from gethsharding_tpu.smc.chain import SimulatedMainchain
+
+    backend = SimulatedMainchain(config=Config(network_id=77))
+    server = RPCServer(backend, port=0)
+    server.start()
+    try:
+        host, port = server.address
+
+        # matching network + stated identity -> attached, listed
+        hub = RemoteHub.dial(host, port, network_id=77, account="0xabc")
+        p2p = P2PServer(hub=hub)
+        p2p.start()
+        chain = RemoteMainchain.dial(host, port)
+        assert chain.network_id() == 77
+        peers = chain.p2p_peers()
+        assert [p["account"] for p in peers] == ["0xabc"]
+        assert peers[0]["version"] == 1
+
+        # wrong network -> rejected at attach
+        bad_hub = RemoteHub.dial(host, port, network_id=78)
+        bad_p2p = P2PServer(hub=bad_hub)
+        with pytest.raises(Exception, match="network mismatch"):
+            bad_p2p.start()
+        bad_hub.close()
+
+        # wrong protocol version -> rejected
+        worse = RemoteHub.dial(host, port)
+        worse.rpc.call  # connected
+        with pytest.raises(Exception, match="version mismatch"):
+            worse.rpc.call("shard_p2pAttach", {"protocol": "shardp2p",
+                                               "version": 99})
+        worse.close()
+
+        # detach drops the peer from the table
+        p2p.stop()
+        assert chain.p2p_peers() == []
+        chain.close()
+    finally:
+        server.stop()
