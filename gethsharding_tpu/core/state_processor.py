@@ -142,6 +142,19 @@ def process(state: ShardState, txs: Sequence[Transaction],
     return [apply_transaction(state, tx, coinbase) for tx in txs]
 
 
+def replay_account_table(txs: Sequence[Transaction],
+                         genesis_addrs,
+                         coinbase: Address20) -> List[Address20]:
+    """The fixed account table a replay operates over: genesis accounts ∪
+    every touched address, ascending by bytes. ONE definition shared by
+    the device marshalling (`ops/replay_jax.build_replay_inputs`) and the
+    host fold-back — the row order IS the account identity."""
+    addrs = {bytes(a): a for a in genesis_addrs}
+    for addr in touched_addresses(txs, coinbase):
+        addrs.setdefault(bytes(addr), addr)
+    return [addrs[k] for k in sorted(addrs)]
+
+
 def touched_addresses(txs: Sequence[Transaction],
                       coinbase: Address20) -> List[Address20]:
     """Every address a replay can touch, deduplicated, sorted — the fixed

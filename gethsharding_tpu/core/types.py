@@ -143,10 +143,15 @@ class CollationHeader:
                  "proposer_signature")
         fields = [_expect_bytes(item, name) for item, name in zip(items, names)]
         return cls(
-            shard_id=decode_int(fields[0]) if fields[0] != b"" else None,
+            # integer fields decode empty as ZERO (big.Int RLP parity):
+            # shard 0 / period 0 and "unset" share the empty encoding, and
+            # picking None here made shard-0 headers change identity
+            # across a DB round-trip (the canonical lookup key embeds
+            # shard_id — a None key never matches the shard-0 write)
+            shard_id=decode_int(fields[0]),
             chunk_root=Hash32(_expect_sized(fields[1], "chunk_root", 32))
             if fields[1] != b"" else None,
-            period=decode_int(fields[2]) if fields[2] != b"" else None,
+            period=decode_int(fields[2]),
             proposer_address=Address20(
                 _expect_sized(fields[3], "proposer_address", 20)
             )

@@ -104,7 +104,9 @@ class ShardNode:
                                sig_backend=get_backend(sig_backend)))
         else:
             self._register_factory(
-                lambda: Observer(client=client, shard=shard))
+                lambda: Observer(client=client, shard=shard,
+                                 replay_engine=("jax" if sig_backend == "jax"
+                                                else "python")))
 
         if actor != "notary":
             # non-notary nodes run the simulator (backend.go:303)

@@ -241,12 +241,9 @@ def build_replay_inputs(
     The account table per shard = genesis ∪ touched addresses, ascending;
     uneven shards are padded (zero account rows, invalid tx rows)."""
     s = len(shard_txs)
-    tables: List[List[Address20]] = []
-    for txs, gen, coinbase in zip(shard_txs, genesis, coinbases):
-        addrs = {bytes(a): a for a in gen}
-        for a in ref.touched_addresses(txs, coinbase):
-            addrs.setdefault(bytes(a), a)
-        tables.append([addrs[k] for k in sorted(addrs)])
+    tables: List[List[Address20]] = [
+        ref.replay_account_table(txs, gen, coinbase)
+        for txs, gen, coinbase in zip(shard_txs, genesis, coinbases)]
 
     a_max = max(max((len(t) for t in tables), default=1), 1)
     t_max = max(max((len(t) for t in shard_txs), default=1), 1)

@@ -298,3 +298,97 @@ def test_windback_blocks_vote_until_prior_body_available():
         assert backend.last_approved_collation(0) == 2
     finally:
         notary.stop()
+
+
+def test_observer_replays_canonical_collations():
+    """The observer maintains shard state by replaying canonical
+    collations (the state_processor Process analog on the live node)."""
+    from gethsharding_tpu.actors.observer import Observer
+    from gethsharding_tpu.core import state_processor as sp
+    from gethsharding_tpu.core.shard import Shard
+    from gethsharding_tpu.core.types import (
+        Collation, CollationHeader, Transaction)
+    from gethsharding_tpu.crypto import secp256k1
+    from gethsharding_tpu.crypto.keccak import keccak256
+    from gethsharding_tpu.db.kv import MemoryKV
+    from gethsharding_tpu.mainchain.client import SMCClient
+    from gethsharding_tpu.smc.chain import SimulatedMainchain
+    from gethsharding_tpu.utils.hexbytes import Address20, Hash32
+
+    priv = 0xD00D
+    sender = secp256k1.priv_to_address(priv)
+    to = secp256k1.priv_to_address(0xFEED)
+    proposer = secp256k1.priv_to_address(0xF00)
+
+    txs = [sp.sign_transaction(
+        Transaction(nonce=i, gas_price=2, gas_limit=30000, to=to,
+                    value=100, payload=b"pay"), priv) for i in range(3)]
+    txs.append(sp.sign_transaction(  # bad nonce -> rejected, state intact
+        Transaction(nonce=99, gas_price=2, gas_limit=30000, to=to,
+                    value=100, payload=b"bad"), priv))
+
+    chain = SimulatedMainchain()
+    client = SMCClient(backend=chain)
+    shard = Shard(shard_id=0, shard_db=MemoryKV())
+    observer = Observer(client=client, shard=shard,
+                        genesis={sender: sp.AccountState(balance=10**12)})
+
+    header = CollationHeader(shard_id=0, chunk_root=Hash32(keccak256(b"x")),
+                             period=1, proposer_address=proposer)
+    collation = Collation(header=header, transactions=txs)
+    root = observer.replay_collation(1, collation)
+
+    assert observer.txs_replayed == 3
+    assert observer.txs_rejected == 1
+    assert observer.state.get(sender).nonce == 3
+    assert observer.state.get(to).balance == 300
+    assert observer.state_roots[1] == root
+
+    # parity: an independent scalar replay reaches the same root
+    twin = sp.ShardState({sender: sp.AccountState(balance=10**12)})
+    sp.process(twin, txs, proposer)
+    assert twin.root() == root
+
+
+def test_observer_engines_agree_when_all_txs_rejected():
+    """Zero-row parity: a collation whose txs are ALL rejected must leave
+    both engines at the same root (the device table materializes zero
+    accounts for touched addresses; the python engine must too)."""
+    from gethsharding_tpu.actors.observer import Observer
+    from gethsharding_tpu.core import state_processor as sp
+    from gethsharding_tpu.core.shard import Shard
+    from gethsharding_tpu.core.types import (
+        Collation, CollationHeader, Transaction)
+    from gethsharding_tpu.crypto import secp256k1
+    from gethsharding_tpu.crypto.keccak import keccak256
+    from gethsharding_tpu.db.kv import MemoryKV
+    from gethsharding_tpu.mainchain.client import SMCClient
+    from gethsharding_tpu.smc.chain import SimulatedMainchain
+    from gethsharding_tpu.utils.hexbytes import Hash32
+
+    priv = 0xDEAD01
+    sender = secp256k1.priv_to_address(priv)
+    fresh = secp256k1.priv_to_address(0xF5E5)
+    proposer = secp256k1.priv_to_address(0xFACADE)
+    bad = [sp.sign_transaction(  # bad nonce -> rejected
+        Transaction(nonce=9, gas_price=1, gas_limit=30000, to=fresh,
+                    value=1, payload=b""), priv)]
+    header = CollationHeader(shard_id=0, chunk_root=Hash32(keccak256(b"z")),
+                             period=1, proposer_address=proposer)
+    collation = Collation(header=header, transactions=bad)
+    genesis = {sender: sp.AccountState(balance=10**9)}
+    roots = {}
+    for engine in ("python",):  # device twin covered in slow tests
+        obs = Observer(client=SMCClient(backend=SimulatedMainchain()),
+                       shard=Shard(0, MemoryKV()), replay_engine=engine,
+                       genesis=genesis)
+        roots[engine] = obs.replay_collation(1, collation)
+        assert obs.txs_rejected == 1
+        # zero rows exist for every touched address
+        assert bytes(fresh) in {bytes(a) for a in obs.state.accounts}
+    # scalar twin of the device table semantics
+    twin = sp.ShardState({sender: sp.AccountState(balance=10**9)})
+    for addr in sp.replay_account_table(bad, twin.accounts, proposer):
+        twin.get(addr)
+    sp.process(twin, bad, proposer)
+    assert twin.root() == roots["python"]

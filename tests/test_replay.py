@@ -135,3 +135,71 @@ def test_proposer_path_collation_replay(scenario):
         == [r.status == 1 for r in receipts]
     assert bytes(np.asarray(out.roots[0])) == bytes(
         replay_jax.scalar_root_with_padding(state, inp.addrs.shape[1]))
+
+
+def test_observer_device_replay_matches_python_engine():
+    """The live observer's jax path (batched recovery + transition, folded
+    back into the host table) ends at the same state root as the python
+    engine replaying the same collations."""
+    from gethsharding_tpu.actors.observer import Observer
+    from gethsharding_tpu.core import state_processor as sp
+    from gethsharding_tpu.core.shard import Shard
+    from gethsharding_tpu.core.types import (
+        Collation, CollationHeader, Transaction)
+    from gethsharding_tpu.crypto import secp256k1
+    from gethsharding_tpu.crypto.keccak import keccak256
+    from gethsharding_tpu.db.kv import MemoryKV
+    from gethsharding_tpu.mainchain.client import SMCClient
+    from gethsharding_tpu.smc.chain import SimulatedMainchain
+    from gethsharding_tpu.utils.hexbytes import Hash32
+
+    priv_a, priv_b = 0xAAA1, 0xBBB2
+    a = secp256k1.priv_to_address(priv_a)
+    b = secp256k1.priv_to_address(priv_b)
+    proposer = secp256k1.priv_to_address(0xCCC3)
+    genesis = {a: sp.AccountState(balance=10**12),
+               b: sp.AccountState(balance=10**9)}
+
+    def collation(period, txs):
+        header = CollationHeader(
+            shard_id=0, chunk_root=Hash32(keccak256(b"r%d" % period)),
+            period=period, proposer_address=proposer)
+        return Collation(header=header, transactions=txs)
+
+    col1 = collation(1, [
+        sp.sign_transaction(Transaction(nonce=0, gas_price=3,
+                                        gas_limit=25000, to=b, value=500,
+                                        payload=b"one"), priv_a),
+        sp.sign_transaction(Transaction(nonce=0, gas_price=1,
+                                        gas_limit=25000, to=a, value=9,
+                                        payload=b""), priv_b),
+        sp.sign_transaction(Transaction(nonce=7, gas_price=1,  # bad nonce
+                                        gas_limit=25000, to=a, value=9,
+                                        payload=b""), priv_b),
+    ])
+    col2 = collation(2, [
+        sp.sign_transaction(Transaction(nonce=1, gas_price=2,
+                                        gas_limit=30000, to=b, value=1,
+                                        payload=b"x" * 40), priv_a),
+    ])
+    fresh = secp256k1.priv_to_address(0xFFF7)
+    col3 = collation(3, [  # ALL rejected: zero-row materialization parity
+        sp.sign_transaction(Transaction(nonce=42, gas_price=1,
+                                        gas_limit=25000, to=fresh, value=1,
+                                        payload=b""), priv_b),
+    ])
+
+    roots = {}
+    for engine in ("python", "jax"):
+        observer = Observer(
+            client=SMCClient(backend=SimulatedMainchain()),
+            shard=Shard(shard_id=0, shard_db=MemoryKV()),
+            replay_engine=engine, genesis=genesis)
+        observer.replay_collation(1, col1)
+        roots[engine, 1] = observer.state_roots[1]
+        roots[engine, 2] = observer.replay_collation(2, col2)
+        roots[engine, 3] = observer.replay_collation(3, col3)
+        assert observer.txs_replayed == 3
+        assert observer.txs_rejected == 2
+    for period in (1, 2, 3):
+        assert roots["python", period] == roots["jax", period], period

@@ -138,3 +138,21 @@ def test_concurrent_db_access_smoke():
     for t in threads:
         t.join()
     assert not errors, errors
+
+
+def test_shard_zero_canonical_roundtrip():
+    """Regression: shard_id=0 / period encode as the empty RLP string;
+    decode must map that back to 0 (big.Int parity), or the canonical
+    lookup key written after a DB round-trip embeds shardID=None and
+    shard 0 can never resolve its canonical collations."""
+    collation = make_collation(shard_id=0, period=1)
+    header = collation.header
+    decoded = CollationHeader.decode_rlp(header.encode_rlp())
+    assert decoded.shard_id == 0
+    assert decoded.period == 1
+    assert decoded.hash() == header.hash()
+
+    shard = Shard(shard_id=0, shard_db=MemoryKV())
+    shard.save_collation(collation)
+    shard.set_canonical(header)
+    assert shard.canonical_collation(0, 1).header.hash() == header.hash()
