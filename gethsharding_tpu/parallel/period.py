@@ -64,55 +64,63 @@ def _step(inp: PeriodInputs, quorum: int, axis: Optional[str]):
     return PeriodOutputs(ok, approved, total_votes, total_approved)
 
 
-class PeriodPipeline:
-    """Compiled per-period verifier, optionally sharded over a mesh.
+def _compile_step(step, quorum: int, mesh: Optional[Mesh], tuple_cls):
+    """jit (single device) or shard_map-jit (mesh) of a period step over
+    `tuple_cls` inputs, shard axis = mesh axis."""
+    if mesh is None:
+        return jax.jit(lambda inp: step(inp, quorum, None))
+    n_fields = len(tuple_cls._fields)
+    return jax.jit(shard_map(
+        lambda inp: step(inp, quorum, "shard"),
+        mesh=mesh,
+        in_specs=(tuple_cls(*([PS("shard")] * n_fields)),),
+        out_specs=PeriodOutputs(PS("shard"), PS("shard"), PS(), PS()),
+    ))
 
-    Uneven shard counts are handled transparently: `run` pads the batch
-    with masked (has_header=False) rows up to the next multiple of the
-    mesh axis size and slices the per-shard outputs back — masked rows
-    contribute nothing to the `psum` tallies.
-    """
+
+def _run_padded(fn, mesh: Optional[Mesh], inputs, tuple_cls):
+    """Run a compiled period step, padding the shard axis with masked
+    zero rows (has_header False) to the next multiple of the mesh size
+    and slicing the per-shard outputs back — masked rows contribute
+    nothing to the psum tallies."""
+    n = int(inputs[0].shape[0])
+    if mesh is None:
+        return fn(inputs)
+    n_dev = mesh.devices.size
+    padded = -(-n // n_dev) * n_dev
+    if padded != n:
+        pad = padded - n
+
+        def pad_rows(a):
+            widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+            return jnp.pad(a, widths)
+
+        inputs = tuple_cls(*(pad_rows(a) for a in inputs))
+    sharding = shard_axis_sharding(mesh)
+    inputs = tuple_cls(*(jax.device_put(a, sharding) for a in inputs))
+    out = fn(inputs)
+    if padded != n:
+        out = PeriodOutputs(
+            verified=out.verified[:n], approved=out.approved[:n],
+            total_votes=out.total_votes,
+            total_approved=out.total_approved)
+    return out
+
+
+class PeriodPipeline:
+    """Compiled per-period verifier over PRE-AGGREGATED committee points,
+    optionally sharded over a mesh; uneven shard counts pad with masked
+    rows (see `_run_padded`)."""
 
     def __init__(self, config: Config = DEFAULT_CONFIG,
                  mesh: Optional[Mesh] = None):
         self.config = config
         self.mesh = mesh
-        quorum = config.quorum_size
-        if mesh is None:
-            self._fn = jax.jit(lambda inp: _step(inp, quorum, None))
-        else:
-            self._fn = jax.jit(shard_map(
-                lambda inp: _step(inp, quorum, "shard"),
-                mesh=mesh,
-                in_specs=(PeriodInputs(*([PS("shard")] * 8)),),
-                out_specs=PeriodOutputs(
-                    PS("shard"), PS("shard"), PS(), PS()),
-            ))
+        self._fn = _compile_step(_step, config.quorum_size, mesh,
+                                 PeriodInputs)
 
     def run(self, inputs: PeriodInputs) -> PeriodOutputs:
-        n = int(inputs.hx.shape[0])
-        if self.mesh is None:
-            return self._fn(inputs)
-        n_dev = self.mesh.devices.size
-        padded = -(-n // n_dev) * n_dev
-        if padded != n:
-            pad = padded - n
-
-            def pad_rows(a):
-                widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
-                return jnp.pad(a, widths)  # zeros: has_header rows False
-
-            inputs = PeriodInputs(*(pad_rows(a) for a in inputs))
-        sharding = shard_axis_sharding(self.mesh)
-        inputs = PeriodInputs(
-            *(jax.device_put(a, sharding) for a in inputs))
-        out = self._fn(inputs)
-        if padded != n:
-            out = PeriodOutputs(
-                verified=out.verified[:n], approved=out.approved[:n],
-                total_votes=out.total_votes,
-                total_approved=out.total_approved)
-        return out
+        return _run_padded(self._fn, self.mesh, inputs, PeriodInputs)
 
     # -- host-side assembly -------------------------------------------------
 
@@ -134,4 +142,80 @@ class PeriodPipeline:
             pkx=jnp.asarray(pkx), pky=jnp.asarray(pky),
             vote_count=jnp.asarray(np.asarray(vote_counts, np.int32)),
             has_header=jnp.asarray(has_header),
+        )
+
+
+class CommitteePeriodInputs(NamedTuple):
+    """Per-period inputs at COMMITTEE granularity (leading axis = shard):
+    raw vote signatures and voter pubkeys, aggregated on device inside
+    the verification dispatch (the production audit path)."""
+
+    hx: jnp.ndarray        # (S, 22) G1 hash-to-curve of each header
+    hy: jnp.ndarray
+    sigx: jnp.ndarray      # (S, C, 22) per-vote signatures
+    sigy: jnp.ndarray
+    sig_mask: jnp.ndarray  # (S, C) bool — filled vote slots
+    pkx: jnp.ndarray       # (S, C, 2, 22) voter pubkeys
+    pky: jnp.ndarray
+    pk_mask: jnp.ndarray   # (S, C) bool
+    has_header: jnp.ndarray  # (S,) bool
+
+
+def _committee_step(inp: CommitteePeriodInputs, quorum: int,
+                    axis: Optional[str]):
+    ok = bn.bls_aggregate_verify_committee_batch(
+        inp.hx, inp.hy, inp.sigx, inp.sigy, inp.sig_mask,
+        inp.pkx, inp.pky, inp.pk_mask, inp.has_header)
+    # the vote count IS the filled signature slots — the device holds the
+    # ground truth, so a stale/forged host-side count cannot inflate the
+    # quorum
+    counted = jnp.where(ok, jnp.sum(inp.sig_mask.astype(jnp.int32),
+                                    axis=-1), 0)
+    approved = ok & (counted >= quorum)
+    total_votes = jnp.sum(counted)
+    total_approved = jnp.sum(approved.astype(jnp.int32))
+    if axis is not None:
+        total_votes = jax.lax.psum(total_votes, axis_name=axis)
+        total_approved = jax.lax.psum(total_approved, axis_name=axis)
+    return PeriodOutputs(ok, approved, total_votes, total_approved)
+
+
+class CommitteePeriodPipeline:
+    """The production period step: per-shard committee aggregation (masked
+    projective tree reduction over the committee axis) + batched pairing
+    verification + quorum tally, with the SHARD axis over the mesh and
+    tallies riding `psum` — aggregation work stays device-local, only the
+    two scalar totals cross the interconnect."""
+
+    def __init__(self, config: Config = DEFAULT_CONFIG,
+                 mesh: Optional[Mesh] = None):
+        self.config = config
+        self.mesh = mesh
+        self._fn = _compile_step(_committee_step, config.quorum_size, mesh,
+                                 CommitteePeriodInputs)
+
+    def run(self, inputs: CommitteePeriodInputs) -> PeriodOutputs:
+        return _run_padded(self._fn, self.mesh, inputs,
+                           CommitteePeriodInputs)
+
+    def build_inputs(self, headers: Sequence[Optional[bytes]],
+                     sig_rows: Sequence[Sequence[bls.G1Point]],
+                     pk_rows: Sequence[Sequence[bls.G2Point]],
+                     width: Optional[int] = None) -> CommitteePeriodInputs:
+        """Host vote records -> committee-granular device arrays. The
+        committee axis pads to `width` (default: the config committee
+        size) so the compiled shape is period-invariant."""
+        width = width or self.config.committee_size
+        hashes = [bls.hash_to_g1(h) if h is not None else None
+                  for h in headers]
+        hx, hy, hok = bn.g1_to_limbs(hashes)
+        sigx, sigy, sig_mask = bn.g1_committee_to_limbs(sig_rows, width)
+        pkx, pky, pk_mask = bn.g2_committee_to_limbs(pk_rows, width)
+        return CommitteePeriodInputs(
+            hx=jnp.asarray(hx), hy=jnp.asarray(hy),
+            sigx=jnp.asarray(sigx), sigy=jnp.asarray(sigy),
+            sig_mask=jnp.asarray(sig_mask),
+            pkx=jnp.asarray(pkx), pky=jnp.asarray(pky),
+            pk_mask=jnp.asarray(pk_mask),
+            has_header=jnp.asarray(hok),
         )

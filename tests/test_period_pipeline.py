@@ -65,3 +65,42 @@ def test_mesh_matches_single_device():
     np.testing.assert_array_equal(np.asarray(a.approved), np.asarray(b.approved))
     assert int(a.total_votes) == int(b.total_votes)
     assert int(a.total_approved) == int(b.total_approved)
+
+
+def test_committee_pipeline_mesh_matches_single_device():
+    """The committee-granular period step (device aggregation + pairing +
+    psum tally) gives identical outcomes on the 8-device mesh and a
+    single device, with uneven shards AND uneven committees."""
+    from gethsharding_tpu.crypto import bn256 as ref
+    from gethsharding_tpu.parallel import make_mesh
+    from gethsharding_tpu.parallel.period import CommitteePeriodPipeline
+    from gethsharding_tpu.params import Config
+
+    config = Config(committee_size=4, quorum_size=2)
+    keys = [ref.bls_keygen(bytes([40 + i])) for i in range(4)]
+    n_shards = 11  # not a multiple of 8: exercises row padding
+    headers, sig_rows, pk_rows, counts = [], [], [], []
+    for s in range(n_shards):
+        header = b"cpp-%d" % s
+        voters = keys[: 1 + (s % 4)]
+        sigs = [ref.bls_sign(header, sk) for sk, _ in voters]
+        if s == 5:
+            sigs = [ref.bls_sign(b"evil", voters[0][0])] + sigs[1:]
+        headers.append(header if s != 7 else None)  # shard 7: no header
+        sig_rows.append(sigs)
+        pk_rows.append([pk for _, pk in voters])
+        counts.append(len(voters))
+
+    single = CommitteePeriodPipeline(config=config, mesh=None)
+    meshed = CommitteePeriodPipeline(config=config, mesh=make_mesh(8))
+    out_s = single.run(single.build_inputs(headers, sig_rows, pk_rows))
+    out_m = meshed.run(meshed.build_inputs(headers, sig_rows, pk_rows))
+    assert np.array_equal(np.asarray(out_s.verified),
+                          np.asarray(out_m.verified))
+    assert np.array_equal(np.asarray(out_s.approved),
+                          np.asarray(out_m.approved))
+    assert int(out_s.total_votes) == int(out_m.total_votes)
+    assert int(out_s.total_approved) == int(out_m.total_approved)
+    verified = np.asarray(out_s.verified)
+    assert not verified[5] and not verified[7]
+    assert verified[[i for i in range(n_shards) if i not in (5, 7)]].all()
