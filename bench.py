@@ -368,12 +368,57 @@ def ensure_workload_cache() -> None:
     _load_or_build_vote_sigs(accounts, manager, digests)
 
 
+def _print_metric(sig_rate: float, stats: dict, knobs: str) -> None:
+    """THE one JSON line the driver records (single output contract for
+    the autotuned and fallback paths)."""
+    extra = {key: val for key, val in stats.items()
+             if key not in ("platform", "sig_rate")}
+    print(json.dumps({
+        "metric": "notary_sig_verifications_per_sec",
+        "value": sig_rate,
+        "unit": (f"sigs/sec (100-shard period audit, on-device 135-vote "
+                 f"BLS aggregation+verification, protocol-generated "
+                 f"workload, opt-ate bn256, {knobs})"),
+        "vs_baseline": round(sig_rate / 100_000.0, 4),
+        "extra": extra,
+    }))
+
+
+def _probe_backend(timeout: float = 120.0):
+    """Is an accelerator reachable? The TPU tunnel can die and then ANY
+    jax backend init hangs forever — probe in a bounded subprocess so the
+    driver's bench run always produces a number."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout, cwd=REPO)
+        lines = proc.stdout.strip().splitlines()
+        return lines[-1] if proc.returncode == 0 and lines else None
+    except (subprocess.TimeoutExpired, OSError):
+        return None
+
+
 def main() -> None:
     if "--single" in sys.argv:
         print(json.dumps(measure_single()))
         return
 
     ensure_workload_cache()
+
+    if os.environ.get("GETHSHARDING_BENCH_CPU") != "1":
+        platform = _probe_backend()
+        if platform is None:
+            # dead accelerator tunnel: fall back to the hermetic CPU path
+            # in-process (no sweep — CPU probes would eat the budget) so
+            # the run still reports a real, correctness-gated number
+            print("# accelerator unreachable; hermetic CPU fallback",
+                  file=sys.stderr)
+            os.environ["GETHSHARDING_BENCH_CPU"] = "1"
+            stats = measure_single()
+            _print_metric(stats["sig_rate"], stats,
+                          "CPU FALLBACK - accelerator tunnel unreachable")
+            return
 
     best_cfg, best = None, None
     cache_key = None
@@ -407,7 +452,16 @@ def main() -> None:
                 print(f"# config {cfg} -> {stats['sig_rate']:.1f} sigs/sec "
                       f"[{stats['platform']}]", file=sys.stderr)
         if not results:
-            os.environ["GETHSHARDING_BENCH_EXTRAS"] = "1"
+            # every sweep probe failed; before measuring in-process,
+            # re-probe — the tunnel may have died MID-RUN, and an
+            # in-process backend init against a dead tunnel hangs forever
+            if (os.environ.get("GETHSHARDING_BENCH_CPU") != "1"
+                    and _probe_backend() is None):
+                print("# accelerator died mid-run; hermetic CPU fallback",
+                      file=sys.stderr)
+                os.environ["GETHSHARDING_BENCH_CPU"] = "1"
+            else:
+                os.environ["GETHSHARDING_BENCH_EXTRAS"] = "1"
             best_cfg, best = {}, measure_single()
         else:
             best_cfg, best = max(results, key=lambda r: r[1]["sig_rate"])
@@ -422,7 +476,6 @@ def main() -> None:
             if stats is not None:
                 best = stats
 
-    sig_rate = best["sig_rate"]
     # label from the FULL winning config (any knob may decide the sweep)
     knobs = "/".join(
         [best_cfg.get("GETHSHARDING_TPU_LIMB_FORM", "wide"),
@@ -430,17 +483,7 @@ def main() -> None:
          best_cfg.get("GETHSHARDING_TPU_CONV", "shift")]
         + (["pallas"] if best_cfg.get("GETHSHARDING_TPU_PALLAS") == "1"
            else []))
-    extra = {key: val for key, val in best.items()
-             if key not in ("platform", "sig_rate")}
-    print(json.dumps({
-        "metric": "notary_sig_verifications_per_sec",
-        "value": sig_rate,
-        "unit": (f"sigs/sec (100-shard period audit, on-device 135-vote "
-                 f"BLS aggregation+verification, protocol-generated "
-                 f"workload, opt-ate bn256, {knobs}, {best['platform']})"),
-        "vs_baseline": round(sig_rate / 100_000.0, 4),
-        "extra": extra,
-    }))
+    _print_metric(best["sig_rate"], best, f"{knobs}, {best['platform']}")
 
 
 if __name__ == "__main__":

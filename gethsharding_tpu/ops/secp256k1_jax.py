@@ -235,7 +235,6 @@ def limbs_to_pubkeys(qx, qy, ok):
     """Device outputs -> [(x, y) | None] host points."""
     xs = FQ.to_ints(np.asarray(qx))
     ys = FQ.to_ints(np.asarray(qy))
-    out = []
-    for i in range(len(np.asarray(ok))):
-        out.append((int(xs[i]), int(ys[i])) if bool(np.asarray(ok)[i]) else None)
-    return out
+    oks = np.asarray(ok)
+    return [(int(x), int(y)) if good else None
+            for x, y, good in zip(xs, ys, oks)]

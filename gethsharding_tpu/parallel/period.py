@@ -205,7 +205,8 @@ class CommitteePeriodPipeline:
         """Host vote records -> committee-granular device arrays. The
         committee axis pads to `width` (default: the config committee
         size) so the compiled shape is period-invariant."""
-        width = width or self.config.committee_size
+        width = (width if width is not None
+                 else self.config.committee_size)
         hashes = [bls.hash_to_g1(h) if h is not None else None
                   for h in headers]
         hx, hy, hok = bn.g1_to_limbs(hashes)

@@ -6,7 +6,10 @@ axis, combining every per-period kernel the framework has:
   addHeader vote-plane reset  (ops/smc_jax.add_header_reset_masked)
   -> submitVote batch          (ops/smc_jax.submit_votes_batch:
                                 committee sampling, bitfield, quorum)
-  -> aggregate BLS verification (ops/bn256_jax, one Miller product/shard)
+  -> committee BLS aggregation + verification (ops/bn256_jax: masked
+                                 projective tree sum + one Miller
+                                 product per shard — the production
+                                 audit dispatch)
   -> collation tx replay        (ops/replay_jax: batched ecrecover +
                                  ordered state transitions + state roots)
   -> period totals as `psum` over ICI (the all-reduce of the north star)
@@ -57,13 +60,15 @@ class StressInputs(NamedTuple):
     att_chunk_root: jnp.ndarray  # (S, V, 32) uint8
     att_deposited: jnp.ndarray   # (S, V) bool
     att_valid: jnp.ndarray       # (S, V) bool
-    # aggregate BLS vote per shard
+    # committee BLS votes per shard (aggregated ON DEVICE)
     hx: jnp.ndarray              # (S, NLIMBS)
     hy: jnp.ndarray
-    sx: jnp.ndarray
-    sy: jnp.ndarray
-    pkx: jnp.ndarray             # (S, 2, NLIMBS)
+    sigx: jnp.ndarray            # (S, Cw, NLIMBS) raw vote signatures
+    sigy: jnp.ndarray
+    sig_mask: jnp.ndarray        # (S, Cw) bool
+    pkx: jnp.ndarray             # (S, Cw, 2, NLIMBS) voter pubkeys
     pky: jnp.ndarray
+    pk_mask: jnp.ndarray         # (S, Cw) bool
     agg_valid: jnp.ndarray       # (S,) bool
     # collation replay (see ops/replay_jax.ReplayInputs)
     addrs: jnp.ndarray
@@ -124,10 +129,11 @@ def _step(inp: StressInputs, pool_addr, blockhash, period, sample_size,
         sample_size=sample_size, committee_size=committee_size,
         quorum_size=quorum_size, sample_shard=shard_ids + base)
 
-    # 3. aggregate BLS verification (one shared-accumulator Miller product
-    # per local shard)
-    agg_ok = bn.bls_verify_aggregate_batch(
-        inp.hx, inp.hy, inp.sx, inp.sy, inp.pkx, inp.pky, inp.agg_valid)
+    # 3. committee BLS aggregation + verification (masked projective tree
+    # sum, then one shared-accumulator Miller product per local shard)
+    agg_ok = bn.bls_aggregate_verify_committee_batch(
+        inp.hx, inp.hy, inp.sigx, inp.sigy, inp.sig_mask,
+        inp.pkx, inp.pky, inp.pk_mask, inp.agg_valid)
 
     # 4. collation replay (batched recovery + ordered transitions)
     tflat = lambda x: x.reshape((s_local * t,) + x.shape[2:])
@@ -273,19 +279,20 @@ def build_stress_inputs(n_shards: int, *, votes_per_shard: int = 3,
             att_pool_index[shard, j] = j
             att_sender[shard, j] = pool_addr[sampled_slot(j, shard)]
 
-    # distinct aggregate BLS vote per shard (small committee for build
-    # speed; the verification cost per shard is committee-size-invariant)
+    # distinct committee BLS votes per shard, aggregated ON DEVICE (small
+    # committee for host build speed; the pairing cost per shard is
+    # committee-size-invariant and the tree cost is measured by the
+    # committee width knob)
     keys = [bls.bls_keygen(bytes([seed % 256, i])) for i in range(2)]
-    h_pts, s_pts, pk_pts = [], [], []
+    h_pts, sig_rows, pk_rows = [], [], []
     for shard in range(s):
         digest = vote_digest(shard, period, Hash32(bytes(roots[shard])))
-        sigs = [bls.bls_sign(digest, sk) for sk, _ in keys]
         h_pts.append(bls.hash_to_g1(digest))
-        s_pts.append(bls.bls_aggregate_sigs(sigs))
-        pk_pts.append(bls.bls_aggregate_pks([pk for _, pk in keys]))
+        sig_rows.append([bls.bls_sign(digest, sk) for sk, _ in keys])
+        pk_rows.append([pk for _, pk in keys])
     hx, hy, hok = bn.g1_to_limbs(h_pts)
-    sx, sy, sok = bn.g1_to_limbs(s_pts)
-    pkx, pky, pok = bn.g2_to_limbs(pk_pts)
+    sigx, sigy, sig_mask = bn.g1_committee_to_limbs(sig_rows, len(keys))
+    pkx, pky, pk_mask = bn.g2_committee_to_limbs(pk_rows, len(keys))
 
     # distinct replay data per shard: one funded sender pays a recipient
     priv = [(int(rng.integers(1, 2 ** 31)) * 2663 + shard) % secp256k1.N or 1
@@ -321,9 +328,11 @@ def build_stress_inputs(n_shards: int, *, votes_per_shard: int = 3,
         att_deposited=jnp.asarray(att_deposited),
         att_valid=jnp.asarray(att_valid),
         hx=jnp.asarray(hx), hy=jnp.asarray(hy),
-        sx=jnp.asarray(sx), sy=jnp.asarray(sy),
+        sigx=jnp.asarray(sigx), sigy=jnp.asarray(sigy),
+        sig_mask=jnp.asarray(sig_mask),
         pkx=jnp.asarray(pkx), pky=jnp.asarray(pky),
-        agg_valid=jnp.asarray(hok & sok & pok),
+        pk_mask=jnp.asarray(pk_mask),
+        agg_valid=jnp.asarray(hok),
         addrs=rep.addrs, nonces=rep.nonces, balances=rep.balances,
         coinbase_ix=rep.coinbase_ix,
         tx_e=rep.tx_e, tx_r=rep.tx_r, tx_s=rep.tx_s,

@@ -102,5 +102,8 @@ def test_committee_pipeline_mesh_matches_single_device():
     assert int(out_s.total_votes) == int(out_m.total_votes)
     assert int(out_s.total_approved) == int(out_m.total_approved)
     verified = np.asarray(out_s.verified)
+    # the tally counts exactly the verified shards' filled vote slots
+    assert int(out_s.total_votes) == sum(
+        c for c, v in zip(counts, verified) if v)
     assert not verified[5] and not verified[7]
     assert verified[[i for i in range(n_shards) if i not in (5, 7)]].all()
