@@ -154,6 +154,17 @@ class SMCClient:
         fn = getattr(self.backend, "verify_period_batch", None)
         return fn(period) if fn is not None else None
 
+    def mirror_snapshot(self) -> dict:
+        """One consistent snapshot of the hot-loop SMC read surface —
+        a single round trip against backends that serve it in bulk
+        (the RPC chain process), assembled locally otherwise."""
+        fn = getattr(self.backend, "mirror_snapshot", None)
+        if fn is not None:
+            return fn()
+        from gethsharding_tpu.mainchain.mirror import assemble_snapshot
+
+        return assemble_snapshot(self)
+
     # -- tx resilience (WaitForTransaction parity) ------------------------
 
     def wait_for_transaction(self, tx_hash: Hash32,

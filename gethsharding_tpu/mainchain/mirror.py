@@ -1,0 +1,193 @@
+"""Periodic SMC state mirror: the actor-side analog of chain sync.
+
+The reference's downloader/fetcher stack (`eth/downloader`,
+`eth/fetcher`) keeps a full node's local chain state current;
+SURVEY.md §2.2 maps that role here to "a periodic SMC state mirror" —
+actors don't import blocks, they track the one authoritative contract.
+`StateMirror` maintains a per-head snapshot of the SMC surface an actor
+reads in its hot loop (period, committee-sampling context, per-shard
+submission/approval watermarks and current-period records) and persists
+it in the shard DB, so:
+
+- reads between heads hit the local snapshot instead of another RPC
+  round trip (a remote actor's per-head chatter drops to ONE
+  `mirror_snapshot`-shaped pull), and
+- a restarted actor warm-starts from the last persisted snapshot
+  before its first head arrives (checkpoint/resume §5.4: the SMC is
+  the authoritative state; the mirror is the local cache of it).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, Optional
+
+from gethsharding_tpu.actors.base import Service
+from gethsharding_tpu.mainchain.client import SMCClient
+from gethsharding_tpu.utils.hexbytes import Hash32
+
+_DB_KEY = b"smc-mirror:latest"
+
+
+class StateMirror(Service):
+    """Tracks SMC state per head; serves stale-bounded local reads."""
+
+    name = "state-mirror"
+    supervisable = True
+
+    def __init__(self, client: SMCClient, shard_db=None):
+        super().__init__()
+        self.client = client
+        self.db = shard_db
+        self._lock = threading.Lock()
+        self._snapshot: Optional[dict] = None
+        self.refreshes = 0
+        self._unsubscribe = None
+        if self.db is not None:
+            self._load_persisted()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def on_start(self) -> None:
+        self._unsubscribe = self.client.subscribe_new_head(self._on_head)
+        try:
+            self.refresh()  # don't wait for the first head
+        except Exception as exc:
+            self.record_error(f"initial mirror refresh failed: {exc}")
+
+    def on_stop(self) -> None:
+        if self._unsubscribe is not None:
+            self._unsubscribe()
+
+    def _on_head(self, block) -> None:
+        try:
+            self.refresh()
+            self.record_success()
+        except Exception as exc:
+            self.record_failure(f"mirror refresh failed: {exc}")
+
+    # -- the sync step -----------------------------------------------------
+
+    def refresh(self) -> dict:
+        """Pull one consistent snapshot of the hot-loop SMC surface —
+        ONE bulk round trip when the backend serves `mirror_snapshot`
+        (the RPC server does), the per-shard walk otherwise."""
+        snapshot = self.client.mirror_snapshot()
+        with self._lock:
+            self._snapshot = snapshot
+        self.refreshes += 1
+        if self.db is not None:
+            try:
+                self.db.put(_DB_KEY, _encode(snapshot))
+            except Exception as exc:
+                self.record_error(f"mirror persist failed: {exc}")
+        return snapshot
+
+    # -- reads -------------------------------------------------------------
+
+    def snapshot(self) -> Optional[dict]:
+        with self._lock:
+            return self._snapshot
+
+    def period(self) -> Optional[int]:
+        snap = self.snapshot()
+        return None if snap is None else snap["period"]
+
+    def record(self, shard_id: int) -> Optional[dict]:
+        """The current-period record mirror for a shard (None if absent)."""
+        snap = self.snapshot()
+        if snap is None:
+            return None
+        return snap["records"].get(shard_id)
+
+    @property
+    def resumed_from_disk(self) -> bool:
+        """True when the snapshot predates this process (warm start)."""
+        return self._resumed
+
+    # -- persistence -------------------------------------------------------
+
+    _resumed = False
+
+    def _load_persisted(self) -> None:
+        try:
+            raw = self.db.get(_DB_KEY)
+        except Exception:
+            return
+        if not raw:
+            return
+        try:
+            snapshot = _decode(raw)
+        except (ValueError, KeyError):
+            return  # a corrupt mirror is just a cold start
+        with self._lock:
+            self._snapshot = snapshot
+        self._resumed = True
+
+
+def assemble_snapshot(source) -> dict:
+    """Build the mirror snapshot from anything with the client read
+    surface (SMCClient, SimulatedMainchain, the RPC server's backend) —
+    the ONE definition shared by the in-process walk and the bulk
+    `shard_mirrorSnapshot` RPC method."""
+    period = source.current_period()
+    shard_count = source.shard_count()
+    block_number = source.block_number
+    if callable(block_number):  # pragma: no cover - surface variance
+        block_number = block_number()
+    submitted: Dict[int, int] = {}
+    records: Dict[int, dict] = {}
+    approved: Dict[int, int] = {}
+    for shard_id in range(shard_count):
+        last_sub = source.last_submitted_collation(shard_id)
+        submitted[shard_id] = last_sub
+        approved[shard_id] = source.last_approved_collation(shard_id)
+        if last_sub == period:
+            record = source.collation_record(shard_id, period)
+            if record is not None:
+                records[shard_id] = {
+                    "chunk_root": bytes(record.chunk_root).hex(),
+                    "proposer": bytes(record.proposer).hex(),
+                    "vote_count": record.vote_count,
+                    "is_elected": bool(record.is_elected),
+                }
+    return {
+        "block_number": block_number,
+        "period": period,
+        "shard_count": shard_count,
+        "committee_context": _ctx_jsonable(source.committee_context()),
+        "last_submitted": submitted,
+        "last_approved": approved,
+        "records": records,
+    }
+
+
+def _ctx_jsonable(ctx: Optional[dict]) -> Optional[dict]:
+    if ctx is None:
+        return None
+    out = {}
+    for key, val in ctx.items():
+        if isinstance(val, (bytes, Hash32)):
+            out[key] = bytes(val).hex()
+        elif isinstance(val, (list, tuple)):
+            out[key] = [bytes(v).hex() if isinstance(v, bytes) else v
+                        for v in val]
+        else:
+            out[key] = val
+    return out
+
+
+def _encode(snapshot: dict) -> bytes:
+    return json.dumps(snapshot, sort_keys=True).encode()
+
+
+def restore_int_keys(snapshot: dict) -> dict:
+    """JSON stringifies int dict keys; restore them in place."""
+    for field in ("last_submitted", "last_approved", "records"):
+        snapshot[field] = {int(k): v for k, v in snapshot[field].items()}
+    return snapshot
+
+
+def _decode(raw: bytes) -> dict:
+    return restore_int_keys(json.loads(raw))

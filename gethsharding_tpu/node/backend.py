@@ -118,6 +118,13 @@ class ShardNode:
         self._register_factory(
             lambda: Syncer(client=client, shard=shard, p2p=p2p))
 
+        # the downloader/fetcher analog: a periodic SMC state mirror
+        # giving local reads between heads and warm restart snapshots
+        from gethsharding_tpu.mainchain.mirror import StateMirror
+
+        self._register_factory(
+            lambda: StateMirror(client=client, shard_db=shard_db.db))
+
         if http_port is not None:
             # observability endpoint (dashboard/ethstats/expvar analog)
             from gethsharding_tpu.node.http_status import StatusServer

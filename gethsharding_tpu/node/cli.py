@@ -92,6 +92,23 @@ def build_parser() -> argparse.ArgumentParser:
                         help="chain process RPC port")
     attach.add_argument("--verbosity", default="warning",
                         choices=("debug", "info", "warning", "error"))
+
+    key = sub.add_parser("key", help="keystore tool (the ethkey analog)")
+    key.add_argument("action", choices=("new", "list", "inspect"))
+    key.add_argument("--keystore", required=True,
+                     help="keystore directory")
+    key.add_argument("--address", default=None)
+    key.add_argument("--password", default=None,
+                     help="password or password file (prompts if absent)")
+    key.add_argument("--show-private", action="store_true")
+    key.add_argument("--verbosity", default="warning")
+
+    rlp = sub.add_parser("rlpdump",
+                         help="pretty-print an RLP blob (rlpdump analog)")
+    rlp.add_argument("data", help="hex string, or - for stdin")
+    rlp.add_argument("--file", action="store_true",
+                     help="treat DATA as a file path of raw bytes")
+    rlp.add_argument("--verbosity", default="warning")
     return parser
 
 
@@ -108,6 +125,14 @@ def run_cli(argv: Optional[List[str]] = None) -> int:
         from gethsharding_tpu.console import run_attach
 
         return run_attach(args.host, args.port)
+    if args.command == "key":
+        from gethsharding_tpu.tools import run_key
+
+        return run_key(args)
+    if args.command == "rlpdump":
+        from gethsharding_tpu.tools import run_rlpdump
+
+        return run_rlpdump(args)
     return 2
 
 

@@ -308,6 +308,12 @@ class RemoteMainchain:
     def network_id(self) -> int:
         return self.rpc.call("shard_networkId")
 
+    def mirror_snapshot(self) -> dict:
+        """Bulk SMC state snapshot (json int keys restored in place)."""
+        from gethsharding_tpu.mainchain.mirror import restore_int_keys
+
+        return restore_int_keys(self.rpc.call("shard_mirrorSnapshot"))
+
     def chain_config(self, **overrides):
         """Fetch the chain process's protocol constants as a Config.
         `overrides` replace node-local knobs (e.g. windback_depth) that

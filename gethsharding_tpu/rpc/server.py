@@ -323,6 +323,13 @@ class RPCServer:
     def rpc_networkId(self):
         return self.backend.config.network_id
 
+    def rpc_mirrorSnapshot(self):
+        """Bulk state-mirror pull: ONE round trip for what would be
+        ~3 calls per shard (mainchain/mirror.py)."""
+        from gethsharding_tpu.mainchain.mirror import assemble_snapshot
+
+        return assemble_snapshot(self.backend)
+
     def rpc_chainConfig(self):
         """The chain process's protocol constants — attached actors adopt
         these instead of trusting their own flags (one source of truth

@@ -1,0 +1,110 @@
+"""Small operator tools: the `ethkey` and `rlpdump` analogs.
+
+The reference ships standalone helper binaries under `cmd/` — `ethkey`
+(generate/inspect/changepassword on keystore files) and `rlpdump`
+(pretty-print any RLP blob). Here they are CLI subcommands over the same
+library code the node uses (`mainchain/keystore.py`, `utils/rlp.py`):
+
+  tpu-sharding key new --keystore DIR [--password PW]
+  tpu-sharding key list --keystore DIR
+  tpu-sharding key inspect --keystore DIR --address 0x.. --password PW
+  tpu-sharding rlpdump HEX (or --file PATH, or - for stdin)
+"""
+
+from __future__ import annotations
+
+import getpass
+import sys
+
+
+def _password(args) -> str:
+    if args.password is not None:
+        try:  # geth convention: --password usually names a file
+            with open(args.password) as fh:
+                return fh.read().strip()
+        except OSError:
+            return args.password
+    return getpass.getpass("password: ")
+
+
+def run_key(args) -> int:
+    from gethsharding_tpu.crypto import secp256k1
+    from gethsharding_tpu.mainchain.keystore import Keystore, KeystoreError
+
+    keystore = Keystore(args.keystore)
+    if args.action == "new":
+        import secrets
+
+        priv = int.from_bytes(secrets.token_bytes(32), "big") % secp256k1.N
+        account = keystore.store(priv or 1, _password(args))
+        print(f"address: {account.address.hex_str}")
+        print(f"file: {account.path}")
+        return 0
+    if args.action == "list":
+        for account in keystore.accounts():
+            print(f"{account.address.hex_str}  {account.path}")
+        return 0
+    if args.action == "inspect":
+        from gethsharding_tpu.utils.hexbytes import Address20
+
+        if args.address is None:
+            print("key inspect requires --address", file=sys.stderr)
+            return 2
+        address = Address20(args.address)
+        try:
+            priv = keystore.unlock(address, _password(args))
+        except KeystoreError as exc:
+            print(f"unlock failed: {exc}", file=sys.stderr)
+            return 1
+        pub = secp256k1.pubkey_from_priv(priv)
+        print(f"address: {address.hex_str}")
+        print(f"public key: 0x{secp256k1.pubkey_to_bytes(pub).hex()}")
+        if args.show_private:
+            print(f"private key: 0x{priv:064x}")
+        return 0
+    return 2
+
+
+def run_rlpdump(args) -> int:
+    if args.data == "-":
+        raw = sys.stdin.read().strip()
+    elif args.file:
+        with open(args.data, "rb") as fh:
+            return _dump(fh.read())
+    else:
+        raw = args.data
+    raw = raw[2:] if raw.startswith("0x") else raw
+    try:
+        blob = bytes.fromhex(raw)
+    except ValueError:
+        print("not hex input", file=sys.stderr)
+        return 1
+    return _dump(blob)
+
+
+def _dump(blob: bytes) -> int:
+    from gethsharding_tpu.utils.rlp import DecodingError, rlp_decode
+
+    try:
+        item = rlp_decode(blob)
+    except DecodingError as exc:
+        print(f"invalid RLP: {exc}", file=sys.stderr)
+        return 1
+    _print_item(item, 0)
+    return 0
+
+
+def _print_item(item, depth: int) -> None:
+    pad = "  " * depth
+    if isinstance(item, bytes):
+        if not item:
+            print(f'{pad}""')
+        elif all(32 <= b < 127 for b in item):
+            print(f'{pad}"{item.decode()}"')
+        else:
+            print(f"{pad}0x{item.hex()}")
+        return
+    print(f"{pad}[")
+    for sub in item:
+        _print_item(sub, depth + 1)
+    print(f"{pad}]")

@@ -173,3 +173,70 @@ def test_consecutive_callback_failures_mark_crashed():
     assert not service.crashed
     service.record_failure("boom")
     assert service.crashed
+
+
+def test_state_mirror_tracks_and_resumes():
+    """Downloader-analog: the mirror snapshots SMC state per head, serves
+    local reads, persists to the shard DB, and a fresh instance over the
+    same DB warm-starts from the snapshot before any head arrives."""
+    from gethsharding_tpu.crypto.keccak import keccak256
+    from gethsharding_tpu.db.kv import MemoryKV
+    from gethsharding_tpu.mainchain.accounts import AccountManager
+    from gethsharding_tpu.mainchain.client import SMCClient
+    from gethsharding_tpu.mainchain.mirror import StateMirror
+    from gethsharding_tpu.params import Config, ETHER
+    from gethsharding_tpu.smc.chain import SimulatedMainchain
+    from gethsharding_tpu.utils.hexbytes import Hash32
+
+    config = Config(shard_count=4)
+    chain = SimulatedMainchain(config=config)
+    manager = AccountManager()
+    acct = manager.new_account(seed=b"mirror")
+    chain.fund(acct.address, 2000 * ETHER)
+    client = SMCClient(backend=chain, accounts=manager, account=acct,
+                       config=config)
+    db = MemoryKV()
+    mirror = StateMirror(client=client, shard_db=db)
+    mirror.start()
+    try:
+        assert mirror.refreshes >= 1  # initial refresh at start
+        chain.fast_forward(1)
+        period = chain.current_period()
+        root = Hash32(keccak256(b"mirror-root"))
+        chain.add_header(acct.address, 2, period, root)
+        chain.commit()  # head -> refresh
+        snap = mirror.snapshot()
+        assert snap["period"] == period
+        assert snap["last_submitted"][2] == period
+        assert mirror.record(2)["chunk_root"] == bytes(root).hex()
+        assert mirror.record(2)["vote_count"] == 0
+        assert mirror.record(0) is None
+        assert snap["committee_context"] is not None
+    finally:
+        mirror.stop()
+
+    # a new instance over the same DB resumes before any head
+    cold = StateMirror(client=client, shard_db=db)
+    assert cold.resumed_from_disk
+    assert cold.record(2)["chunk_root"] == bytes(root).hex()
+    assert cold.period() == period
+
+    # without a DB: cold start, no resume
+    assert not StateMirror(client=client).resumed_from_disk
+
+
+def test_node_runs_a_state_mirror():
+    from gethsharding_tpu.mainchain.mirror import StateMirror
+    from gethsharding_tpu.node.backend import ShardNode
+    from gethsharding_tpu.smc.chain import SimulatedMainchain
+
+    backend = SimulatedMainchain()
+    node = ShardNode(actor="observer", backend=backend, txpool_interval=None)
+    node.start()
+    try:
+        mirror = node.service(StateMirror)
+        backend.commit()
+        assert mirror.snapshot() is not None
+        assert mirror.period() == backend.current_period()
+    finally:
+        node.stop()

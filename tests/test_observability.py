@@ -150,3 +150,40 @@ def test_cli_attach_subcommand_end_to_end():
     finally:
         chain_proc.terminate()
         chain_proc.wait(timeout=10)
+
+
+def test_key_tool_roundtrip(tmp_path):
+    """ethkey analog: new -> list -> inspect over the CLI."""
+    from gethsharding_tpu.node.cli import run_cli
+
+    ks = str(tmp_path / "keystore")
+    pw = tmp_path / "pw"
+    pw.write_text("secret\n")
+    assert run_cli(["key", "new", "--keystore", ks,
+                    "--password", str(pw)]) == 0
+    from gethsharding_tpu.mainchain.keystore import Keystore
+
+    accounts = Keystore(ks).accounts()
+    assert len(accounts) == 1
+    assert run_cli(["key", "list", "--keystore", ks]) == 0
+    assert run_cli(["key", "inspect", "--keystore", ks,
+                    "--address", accounts[0].address.hex_str,
+                    "--password", str(pw)]) == 0
+    # wrong password -> clean failure
+    bad = tmp_path / "bad"
+    bad.write_text("wrong")
+    assert run_cli(["key", "inspect", "--keystore", ks,
+                    "--address", accounts[0].address.hex_str,
+                    "--password", str(bad)]) == 1
+
+
+def test_rlpdump_tool(capsys):
+    from gethsharding_tpu.node.cli import run_cli
+    from gethsharding_tpu.utils.rlp import rlp_encode
+
+    blob = rlp_encode([b"cat", [b"dog", b""], b"\x01\x02"])
+    assert run_cli(["rlpdump", blob.hex()]) == 0
+    out = capsys.readouterr().out
+    assert '"cat"' in out and '"dog"' in out and "0x0102" in out
+    assert run_cli(["rlpdump", "zz-not-hex"]) == 1
+    assert run_cli(["rlpdump", "c1"]) == 1  # truncated list payload

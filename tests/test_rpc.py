@@ -197,3 +197,53 @@ def test_p2p_handshake_and_peer_table():
         chain.close()
     finally:
         server.stop()
+
+
+def test_mirror_snapshot_bulk_over_rpc():
+    """A remote actor's state mirror pulls ONE bulk snapshot per head
+    instead of ~3 RPC calls per shard."""
+    from gethsharding_tpu.crypto.keccak import keccak256
+    from gethsharding_tpu.mainchain.accounts import AccountManager
+    from gethsharding_tpu.mainchain.client import SMCClient
+    from gethsharding_tpu.mainchain.mirror import StateMirror
+    from gethsharding_tpu.params import Config, ETHER
+    from gethsharding_tpu.rpc.client import RemoteMainchain
+    from gethsharding_tpu.rpc.server import RPCServer
+    from gethsharding_tpu.smc.chain import SimulatedMainchain
+    from gethsharding_tpu.utils.hexbytes import Hash32
+
+    config = Config(shard_count=5)
+    backend = SimulatedMainchain(config=config)
+    manager = AccountManager()
+    acct = manager.new_account(seed=b"mirror-rpc")
+    backend.fund(acct.address, 2000 * ETHER)
+    server = RPCServer(backend, port=0)
+    server.start()
+    try:
+        remote = RemoteMainchain.dial(*server.address)
+        client = SMCClient(backend=remote, accounts=manager, account=acct,
+                           config=config)
+        mirror = StateMirror(client=client)
+        mirror.start()
+        try:
+            backend.fast_forward(1)
+            period = backend.current_period()
+            root = Hash32(keccak256(b"rpc-mirror"))
+            backend.add_header(acct.address, 4, period, root)
+            backend.commit()
+            import time
+
+            deadline = time.time() + 5.0
+            while time.time() < deadline:
+                if (mirror.period() == period
+                        and mirror.record(4) is not None):
+                    break
+                time.sleep(0.05)
+            assert mirror.period() == period
+            assert mirror.record(4)["chunk_root"] == bytes(root).hex()
+            assert mirror.snapshot()["last_submitted"][4] == period
+        finally:
+            mirror.stop()
+        remote.close()
+    finally:
+        server.stop()
