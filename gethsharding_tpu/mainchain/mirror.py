@@ -75,6 +75,12 @@ class StateMirror(Service):
         (the RPC server does), the per-shard walk otherwise."""
         snapshot = self.client.mirror_snapshot()
         with self._lock:
+            held = self._snapshot
+            if (held is not None
+                    and held["block_number"] > snapshot["block_number"]):
+                # a concurrent refresh already stored something NEWER
+                # (head callback vs the on_start refresh): never regress
+                return held
             self._snapshot = snapshot
         self.refreshes += 1
         if self.db is not None:

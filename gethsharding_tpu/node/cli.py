@@ -101,14 +101,16 @@ def build_parser() -> argparse.ArgumentParser:
     key.add_argument("--password", default=None,
                      help="password or password file (prompts if absent)")
     key.add_argument("--show-private", action="store_true")
-    key.add_argument("--verbosity", default="warning")
+    key.add_argument("--verbosity", default="warning",
+                     choices=("debug", "info", "warning", "error"))
 
     rlp = sub.add_parser("rlpdump",
                          help="pretty-print an RLP blob (rlpdump analog)")
     rlp.add_argument("data", help="hex string, or - for stdin")
     rlp.add_argument("--file", action="store_true",
                      help="treat DATA as a file path of raw bytes")
-    rlp.add_argument("--verbosity", default="warning")
+    rlp.add_argument("--verbosity", default="warning",
+                     choices=("debug", "info", "warning", "error"))
     return parser
 
 

@@ -36,5 +36,57 @@ def make_mesh(n_devices: Optional[int] = None,
 
 
 def shard_axis_sharding(mesh: Mesh) -> NamedSharding:
-    """NamedSharding that splits the leading (shardID) axis over the mesh."""
-    return NamedSharding(mesh, PartitionSpec("shard"))
+    """NamedSharding that splits the leading (shardID) axis over ALL mesh
+    axes (1-D "shard" meshes and 2-D ("dcn", "ici") meshes alike)."""
+    return NamedSharding(mesh, PartitionSpec(tuple(mesh.axis_names)))
+
+
+def make_multihost_mesh(n_hosts: Optional[int] = None,
+                        devices_per_host: Optional[int] = None,
+                        devices: Optional[Sequence] = None) -> Mesh:
+    """A 2-D mesh ``("dcn", "ici")`` for multi-host deployments.
+
+    The shard axis factors over both: a host owns a slab of shards split
+    across its local chips. Reductions then lower hierarchically — a fast
+    intra-host all-reduce over ICI, then one small inter-host all-reduce
+    over DCN (the layout rule of SURVEY.md §5.8: collectives should ride
+    ICI; only the reduced scalars cross DCN — exactly what
+    `hierarchical_psum` emits).
+
+    On a real multi-host pod pass `devices=jax.devices()` under
+    `jax.distributed.initialize()` and the (process, local-device)
+    structure gives the host axis; single-process callers (tests, the
+    dryrun's virtual CPU platform) get an explicit factorization.
+    """
+    if devices is None:
+        devices = jax.devices()
+    # group by host FIRST: jax.devices() ordering is not guaranteed to be
+    # process-contiguous, and a grid row mixing hosts would silently send
+    # the "ici" reduce over DCN — the exact layout this mesh exists to
+    # avoid
+    devices = sorted(devices, key=lambda d: (d.process_index, d.id))
+    if n_hosts is None:
+        n_hosts = max(d.process_index for d in devices) + 1
+    if devices_per_host is None:
+        if len(devices) % n_hosts:
+            raise ValueError(
+                f"{len(devices)} devices do not factor over {n_hosts} hosts")
+        devices_per_host = len(devices) // n_hosts
+    need = n_hosts * devices_per_host
+    if need > len(devices):
+        raise ValueError(
+            f"requested {n_hosts}x{devices_per_host} devices, only "
+            f"{len(devices)} visible")
+    grid = np.asarray(devices[:need]).reshape(n_hosts, devices_per_host)
+    return Mesh(grid, axis_names=("dcn", "ici"))
+
+
+def hierarchical_psum(value, mesh: Mesh):
+    """Sum over every mesh axis, innermost (ICI) first.
+
+    Inside `shard_map` over a ``("dcn", "ici")`` mesh this emits the
+    intra-host reduce before the cross-host one, so the DCN hop carries
+    one already-reduced scalar per host."""
+    for axis in reversed(mesh.axis_names):
+        value = jax.lax.psum(value, axis_name=axis)
+    return value

@@ -28,7 +28,8 @@ from jax.sharding import Mesh, PartitionSpec as PS
 from gethsharding_tpu.crypto import bn256 as bls
 from gethsharding_tpu.ops import bn256_jax as bn
 from gethsharding_tpu.params import Config, DEFAULT_CONFIG
-from gethsharding_tpu.parallel.mesh import shard_axis_sharding
+from gethsharding_tpu.parallel.mesh import (
+    hierarchical_psum, shard_axis_sharding)
 
 
 class PeriodInputs(NamedTuple):
@@ -51,30 +52,38 @@ class PeriodOutputs(NamedTuple):
     total_approved: jnp.ndarray  # () int32 — Σ approved shards
 
 
-def _step(inp: PeriodInputs, quorum: int, axis: Optional[str]):
-    ok = bn.bls_verify_aggregate_batch(
-        inp.hx, inp.hy, inp.sx, inp.sy, inp.pkx, inp.pky, inp.has_header)
-    counted = jnp.where(ok, inp.vote_count, 0)
+def _tally(ok, counted, quorum: int, mesh: Optional[Mesh]) -> PeriodOutputs:
+    """Quorum + period totals, reduced hierarchically over the mesh —
+    the ONE tail shared by both pipeline granularities."""
     approved = ok & (counted >= quorum)
     total_votes = jnp.sum(counted)
     total_approved = jnp.sum(approved.astype(jnp.int32))
-    if axis is not None:
-        total_votes = jax.lax.psum(total_votes, axis_name=axis)
-        total_approved = jax.lax.psum(total_approved, axis_name=axis)
+    if mesh is not None:
+        total_votes = hierarchical_psum(total_votes, mesh)
+        total_approved = hierarchical_psum(total_approved, mesh)
     return PeriodOutputs(ok, approved, total_votes, total_approved)
+
+
+def _step(inp: PeriodInputs, quorum: int, mesh: Optional[Mesh]):
+    ok = bn.bls_verify_aggregate_batch(
+        inp.hx, inp.hy, inp.sx, inp.sy, inp.pkx, inp.pky, inp.has_header)
+    return _tally(ok, jnp.where(ok, inp.vote_count, 0), quorum, mesh)
 
 
 def _compile_step(step, quorum: int, mesh: Optional[Mesh], tuple_cls):
     """jit (single device) or shard_map-jit (mesh) of a period step over
-    `tuple_cls` inputs, shard axis = mesh axis."""
+    `tuple_cls` inputs; the leading shard axis splits over ALL mesh axes
+    (1-D shard meshes and 2-D ("dcn", "ici") multi-host meshes alike),
+    with tallies reduced hierarchically — ICI first, then DCN."""
     if mesh is None:
         return jax.jit(lambda inp: step(inp, quorum, None))
     n_fields = len(tuple_cls._fields)
+    spec = PS(tuple(mesh.axis_names))
     return jax.jit(shard_map(
-        lambda inp: step(inp, quorum, "shard"),
+        lambda inp: step(inp, quorum, mesh),
         mesh=mesh,
-        in_specs=(tuple_cls(*([PS("shard")] * n_fields)),),
-        out_specs=PeriodOutputs(PS("shard"), PS("shard"), PS(), PS()),
+        in_specs=(tuple_cls(*([spec] * n_fields)),),
+        out_specs=PeriodOutputs(spec, spec, PS(), PS()),
     ))
 
 
@@ -162,7 +171,7 @@ class CommitteePeriodInputs(NamedTuple):
 
 
 def _committee_step(inp: CommitteePeriodInputs, quorum: int,
-                    axis: Optional[str]):
+                    mesh: Optional[Mesh]):
     ok = bn.bls_aggregate_verify_committee_batch(
         inp.hx, inp.hy, inp.sigx, inp.sigy, inp.sig_mask,
         inp.pkx, inp.pky, inp.pk_mask, inp.has_header)
@@ -171,13 +180,7 @@ def _committee_step(inp: CommitteePeriodInputs, quorum: int,
     # quorum
     counted = jnp.where(ok, jnp.sum(inp.sig_mask.astype(jnp.int32),
                                     axis=-1), 0)
-    approved = ok & (counted >= quorum)
-    total_votes = jnp.sum(counted)
-    total_approved = jnp.sum(approved.astype(jnp.int32))
-    if axis is not None:
-        total_votes = jax.lax.psum(total_votes, axis_name=axis)
-        total_approved = jax.lax.psum(total_approved, axis_name=axis)
-    return PeriodOutputs(ok, approved, total_votes, total_approved)
+    return _tally(ok, counted, quorum, mesh)
 
 
 class CommitteePeriodPipeline:

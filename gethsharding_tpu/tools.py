@@ -66,11 +66,13 @@ def run_key(args) -> int:
 
 
 def run_rlpdump(args) -> int:
-    if args.data == "-":
-        raw = sys.stdin.read().strip()
-    elif args.file:
+    if args.file:
+        if args.data == "-":  # raw bytes from stdin
+            return _dump(sys.stdin.buffer.read())
         with open(args.data, "rb") as fh:
             return _dump(fh.read())
+    if args.data == "-":
+        raw = sys.stdin.read().strip()
     else:
         raw = args.data
     raw = raw[2:] if raw.startswith("0x") else raw

@@ -107,3 +107,41 @@ def test_committee_pipeline_mesh_matches_single_device():
         c for c, v in zip(counts, verified) if v)
     assert not verified[5] and not verified[7]
     assert verified[[i for i in range(n_shards) if i not in (5, 7)]].all()
+
+
+def test_committee_pipeline_on_multihost_mesh():
+    """The same period step over a 2x4 ("dcn", "ici") mesh — the
+    multi-host layout: tallies reduce over ICI first, one scalar crosses
+    DCN — must match the 1-D mesh and single-device outcomes."""
+    from gethsharding_tpu.crypto import bn256 as ref
+    from gethsharding_tpu.parallel.mesh import make_multihost_mesh
+    from gethsharding_tpu.parallel.period import CommitteePeriodPipeline
+    from gethsharding_tpu.params import Config
+
+    config = Config(committee_size=4, quorum_size=2)
+    keys = [ref.bls_keygen(bytes([60 + i])) for i in range(3)]
+    headers, sig_rows, pk_rows = [], [], []
+    for s in range(13):  # uneven over 8 devices
+        header = b"mh-%d" % s
+        voters = keys[: 1 + (s % 3)]
+        sigs = [ref.bls_sign(header, sk) for sk, _ in voters]
+        if s == 9:
+            sigs[0] = ref.bls_sign(b"zz", voters[0][0])
+        headers.append(header)
+        sig_rows.append(sigs)
+        pk_rows.append([pk for _, pk in voters])
+
+    mesh = make_multihost_mesh(n_hosts=2, devices_per_host=4)
+    assert mesh.axis_names == ("dcn", "ici")
+    single = CommitteePeriodPipeline(config=config, mesh=None)
+    multihost = CommitteePeriodPipeline(config=config, mesh=mesh)
+    out_s = single.run(single.build_inputs(headers, sig_rows, pk_rows))
+    out_m = multihost.run(multihost.build_inputs(headers, sig_rows,
+                                                 pk_rows))
+    assert np.array_equal(np.asarray(out_s.verified),
+                          np.asarray(out_m.verified))
+    assert np.array_equal(np.asarray(out_s.approved),
+                          np.asarray(out_m.approved))
+    assert int(out_s.total_votes) == int(out_m.total_votes)
+    assert int(out_s.total_approved) == int(out_m.total_approved)
+    assert not np.asarray(out_s.verified)[9]
