@@ -41,11 +41,13 @@ import numpy as np
 SHARDS, COMMITTEE = 100, 135
 REPO = os.path.dirname(os.path.abspath(__file__))
 
-# ordered by prior: exact/scan/gather won the r2 TPU sweep (the gather
-# convolution replaced the dense one-hot contraction that dominated r1;
-# `onehot` is kept as a regression check). The assoc carry and the Pallas
-# fused-normalize lost on TPU in r2 but stay as probes — backends change.
-# If the sweep budget runs out, the best of the configs measured so far
+# ordered by prior: exact/scan won the r2 TPU sweep (then measured with
+# the one-hot conv; `shift` — the module default — replaced it after CPU
+# profiling showed gather memory-bound and onehot doing redundant MACs,
+# but shift/slices have NOT yet been measured on TPU: the tunnel was down
+# for the rest of r2, so this sweep decides). The assoc carry and the
+# Pallas fused-normalize lost on TPU in r2 but stay as probes — backends
+# change. If the sweep budget runs out, the best config measured so far
 # wins.
 CONFIGS = [
     {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "scan"},
