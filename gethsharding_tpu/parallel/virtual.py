@@ -23,6 +23,28 @@ from typing import Optional
 _COUNT_RE = re.compile(r"--xla_force_host_platform_device_count=(\d+)")
 
 
+def host_fingerprint() -> str:
+    """Short stable id of THIS machine's CPU capabilities. The persistent
+    compile cache stores AOT executables specialized to the compiling
+    host's ISA extensions; loading an entry produced on a different
+    machine can SIGILL or segfault inside the cache read (observed r2:
+    a cache carried over from another host crashed the suite). Keying
+    the cache directory by host makes cross-machine reuse impossible."""
+    import hashlib
+    import platform
+
+    probe = platform.machine() + platform.processor()
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith("flags"):
+                    probe += line
+                    break
+    except OSError:
+        pass
+    return hashlib.sha256(probe.encode()).hexdigest()[:10]
+
+
 def requested_virtual_cpu_count() -> int:
     """Virtual CPU device count currently requested via XLA_FLAGS (0 if none)."""
     m = _COUNT_RE.search(os.environ.get("XLA_FLAGS", ""))
@@ -78,7 +100,8 @@ def force_virtual_cpu_devices(n: int,
     jax.config.update("jax_platforms", "cpu")
 
     if cache_dir is None:
-        cache_dir = str(Path(__file__).resolve().parents[2] / ".jax_cache")
+        cache_dir = str(Path(__file__).resolve().parents[2]
+                        / f".jax_cache-{host_fingerprint()}")
     try:
         jax.config.update("jax_compilation_cache_dir", str(cache_dir))
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
