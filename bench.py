@@ -65,20 +65,12 @@ SWEEP_BUDGET_S = float(os.environ.get("GETHSHARDING_BENCH_BUDGET_S", "1200"))
 
 
 def _enable_compile_cache() -> None:
-    import jax
+    # persistent compile cache: first run pays ~1 min, repeats don't.
+    # Host-keyed (entries from another machine can segfault on load);
+    # one shared definition with tests/dryrun.
+    from gethsharding_tpu.parallel.virtual import configure_compile_cache
 
-    from gethsharding_tpu.parallel.virtual import host_fingerprint
-
-    try:  # persistent compile cache: first run pays ~1 min, repeats
-        # don't. Host-keyed: entries from another machine can segfault
-        # on load (AOT ISA mismatch).
-        jax.config.update("jax_compilation_cache_dir",
-                          os.path.join(REPO,
-                                       f".jax_cache-{host_fingerprint()}"))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-    except Exception:
-        pass
+    configure_compile_cache()
 
 
 # == protocol-generated workload (host scalar crypto, disk-cached) =========

@@ -45,6 +45,27 @@ def host_fingerprint() -> str:
     return hashlib.sha256(probe.encode()).hexdigest()[:10]
 
 
+def default_cache_dir() -> str:
+    """The repo-local host-keyed compile cache directory."""
+    return str(Path(__file__).resolve().parents[2]
+               / f".jax_cache-{host_fingerprint()}")
+
+
+def configure_compile_cache(cache_dir=None) -> None:
+    """Point JAX's persistent compile cache at the host-keyed dir — the
+    ONE definition shared by tests/dryrun (`force_virtual_cpu_devices`)
+    and `bench.py`, so they can never drift onto different caches."""
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          str(cache_dir or default_cache_dir()))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:  # pragma: no cover - config name drift across jax
+        pass
+
+
 def requested_virtual_cpu_count() -> int:
     """Virtual CPU device count currently requested via XLA_FLAGS (0 if none)."""
     m = _COUNT_RE.search(os.environ.get("XLA_FLAGS", ""))
@@ -84,8 +105,9 @@ def force_virtual_cpu_devices(n: int,
     the first backend init to have any effect on the device count.
 
     Also points JAX's persistent compilation cache at the repo-local
-    ``.jax_cache`` (the pairing kernels take minutes to compile cold on
-    XLA:CPU; cache hits make repeat runs take seconds).
+    host-keyed ``.jax_cache-<fingerprint>`` via `configure_compile_cache`
+    (the pairing kernels take minutes to compile cold on XLA:CPU; cache
+    hits make repeat runs take seconds).
     """
     flags = os.environ.get("XLA_FLAGS", "")
     if requested_virtual_cpu_count() < n:
@@ -99,15 +121,7 @@ def force_virtual_cpu_devices(n: int,
 
     jax.config.update("jax_platforms", "cpu")
 
-    if cache_dir is None:
-        cache_dir = str(Path(__file__).resolve().parents[2]
-                        / f".jax_cache-{host_fingerprint()}")
-    try:
-        jax.config.update("jax_compilation_cache_dir", str(cache_dir))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-    except Exception:  # pragma: no cover - config name drift across jax
-        pass
+    configure_compile_cache(cache_dir)
 
     try:
         import jax._src.xla_bridge as xb
