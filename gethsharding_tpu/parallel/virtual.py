@@ -51,15 +51,19 @@ def default_cache_dir() -> str:
                / f".jax_cache-{host_fingerprint()}")
 
 
-def configure_compile_cache(cache_dir=None) -> None:
+def configure_compile_cache(cache_dir=None, enabled: bool = True) -> None:
     """Point JAX's persistent compile cache at the host-keyed dir — the
     ONE definition shared by tests/dryrun (`force_virtual_cpu_devices`)
-    and `bench.py`, so they can never drift onto different caches."""
+    and `bench.py`, so they can never drift onto different caches.
+    `enabled=False` turns the cache off through the same seam (used by
+    multi-file pytest runs, where XLA's executable (de)serialization
+    segfaults after ~150 live programs)."""
     import jax
 
     try:
         jax.config.update("jax_compilation_cache_dir",
-                          str(cache_dir or default_cache_dir()))
+                          str(cache_dir or default_cache_dir())
+                          if enabled else None)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     except Exception:  # pragma: no cover - config name drift across jax

@@ -1,0 +1,18 @@
+#!/bin/bash
+# The canonical full-suite run: one short-lived pytest process per test
+# file, each with the host-keyed persistent compile cache enabled.
+#
+# Why not one big `pytest tests/`? XLA:CPU deterministically segfaults
+# (de)serializing one of the large mesh executables once a process holds
+# ~150 compiled programs (see tests/conftest.py) — and without the cache
+# a monolithic run pays every heavyweight kernel compile cold. Per-file
+# processes sidestep the crash AND keep the cache speedup. Coverage is
+# identical; a failing file fails the script.
+set -u
+cd "$(dirname "$0")/.."
+fail=0
+for f in tests/test_*.py; do
+    echo "== $f"
+    GETHSHARDING_CACHE_WRITES=1 python -m pytest "$f" -q --no-header || fail=1
+done
+exit $fail

@@ -18,6 +18,22 @@ from gethsharding_tpu.parallel.virtual import force_virtual_cpu_devices
 
 force_virtual_cpu_devices(8)
 
+# The persistent compile cache is DISABLED for MULTI-file pytest runs:
+# XLA:CPU deterministically segfaults (de)serializing one of the big
+# mesh executables once the process holds ~150 compiled programs
+# (observed r2 on a 1-core host, both on cache write and on read of an
+# entry this same host wrote). Short-lived processes are safe, so
+# single-file invocations keep the cache automatically (decided at
+# collection time below), GETHSHARDING_CACHE_WRITES=1 forces it on, and
+# `scripts/run_suite.sh` runs the complete suite one process per file —
+# full cache speedup, identical coverage, no crash.
+import os as _os
+
+from gethsharding_tpu.parallel.virtual import configure_compile_cache
+
+if _os.environ.get("GETHSHARDING_CACHE_WRITES") != "1":
+    configure_compile_cache(enabled=False)
+
 # Test tiers: everything in these modules compiles the heavyweight batched
 # kernels (pairing Miller loops, 256-step recovery ladders) — minutes of
 # XLA:CPU compile when the persistent cache is cold. They are auto-marked
@@ -37,6 +53,13 @@ _SLOW_MODULES = {
 
 
 def pytest_collection_modifyitems(config, items):
+    modules = set()
     for item in items:
+        modules.add(item.module.__name__)
         if item.module.__name__ in _SLOW_MODULES:
             item.add_marker(pytest.mark.slow)
+    if len(modules) == 1:
+        # a single-module run is a short-lived process — the safe case;
+        # re-enable the cache (nothing has compiled yet at collection
+        # time, so the config change takes full effect)
+        configure_compile_cache()
