@@ -42,6 +42,9 @@ class StateMirror(Service):
         self.db = shard_db
         self._lock = threading.Lock()
         self._snapshot: Optional[dict] = None
+        self._gen = 0              # bumps with every stored snapshot
+        self._persist_lock = threading.Lock()
+        self._persisted_gen = 0
         self.refreshes = 0
         self._unsubscribe = None
         if self.db is not None:
@@ -77,15 +80,26 @@ class StateMirror(Service):
         with self._lock:
             held = self._snapshot
             if (held is not None
-                    and held["block_number"] > snapshot["block_number"]):
+                    and (held["block_number"] or 0)
+                    > (snapshot["block_number"] or 0)):
                 # a concurrent refresh already stored something NEWER
                 # (head callback vs the on_start refresh): never regress
                 return held
             self._snapshot = snapshot
+            self._gen += 1
+            gen = self._gen
         self.refreshes += 1
         if self.db is not None:
+            # persist OUTSIDE the read lock (disk I/O must not block
+            # hot-loop snapshot() readers), but generation-checked so a
+            # slower refresh that lost the in-memory race can never
+            # overwrite a newer snapshot on disk
+            payload = _encode(snapshot)
             try:
-                self.db.put(_DB_KEY, _encode(snapshot))
+                with self._persist_lock:
+                    if gen > self._persisted_gen:
+                        self.db.put(_DB_KEY, payload)
+                        self._persisted_gen = gen
             except Exception as exc:
                 self.record_error(f"mirror persist failed: {exc}")
         return snapshot

@@ -59,6 +59,7 @@ class ShardNode:
         self._factories: Dict[Type, object] = {}
         self.restarts: Dict[str, int] = {}
         self._restart_times: Dict[str, List[float]] = {}
+        self._given_up: set = set()
         self.supervisor: Optional[Supervisor] = (
             Supervisor(self, interval=supervise_interval)
             if supervise else None)
@@ -186,8 +187,9 @@ class ShardNode:
         services restarted in this pass. The restart budget is a RATE:
         more than MAX_RESTARTS replacements within RESTART_WINDOW seconds
         means the crash is systemic, not transient — the instance is then
-        stopped and left down (old restarts age out, so a rare transient
-        crash never permanently disables a service)."""
+        stopped and left down PERMANENTLY (the give-up is sticky; old
+        restart timestamps aging out must not resurrect a service that
+        was declared systemically broken)."""
         import time
 
         restarted: List[str] = []
@@ -201,10 +203,13 @@ class ShardNode:
             factory = self._factories.get(kind)
             if factory is None:
                 continue
+            if service.name in self._given_up:
+                continue
             window = [t for t in self._restart_times.get(service.name, [])
                       if now - t < self.RESTART_WINDOW]
             if len(window) >= self.MAX_RESTARTS:
-                self._restart_times[service.name] = window
+                self._restart_times.pop(service.name, None)
+                self._given_up.add(service.name)
                 if service.running:  # budget exhausted: leave it DOWN
                     service.record_error(
                         f"giving up on {service.name}: {len(window)} "

@@ -150,6 +150,11 @@ def test_supervisor_gives_up_after_max_restarts():
         # budget exhausted: the final crashed instance is left DOWN, not
         # half-alive (threads/subscriptions stopped)
         assert not node.service(Syncer).running
+        # the give-up is STICKY: even after the restart timestamps age
+        # out of RESTART_WINDOW, a systemically broken service stays down
+        node._restart_times["syncer"] = []
+        assert "syncer" not in node.heal()
+        assert not node.service(Syncer).running
     finally:
         node.stop()
 
@@ -223,6 +228,28 @@ def test_state_mirror_tracks_and_resumes():
 
     # without a DB: cold start, no resume
     assert not StateMirror(client=client).resumed_from_disk
+
+
+def test_state_mirror_tolerates_none_block_number():
+    """A backend surfacing block_number=None must not TypeError the
+    regression guard; None compares as 0."""
+    from gethsharding_tpu.mainchain.mirror import StateMirror
+
+    class Stub:
+        def __init__(self):
+            self.calls = 0
+
+        def mirror_snapshot(self):
+            self.calls += 1
+            return {"block_number": 5 if self.calls == 1 else None,
+                    "period": 1, "records": {}, "last_submitted": {},
+                    "committee_context": None}
+
+    mirror = StateMirror(client=Stub())
+    first = mirror.refresh()
+    assert first["block_number"] == 5
+    # a later None-numbered snapshot never regresses the held one
+    assert mirror.refresh() is first
 
 
 def test_node_runs_a_state_mirror():
