@@ -21,7 +21,8 @@ slow to fit the budget (hermetic CPU runs).
 
 The kernel has build-time knobs whose best setting depends on the
 backend (GETHSHARDING_TPU_LIMB_FORM = wide|exact, GETHSHARDING_TPU_CARRY
-= scan|assoc, GETHSHARDING_TPU_CONV = shift|slices|gather|onehot, GETHSHARDING_TPU_PALLAS,
+= scan|assoc, GETHSHARDING_TPU_CONV = shift|slices|gather|onehot|mxu8,
+GETHSHARDING_TPU_PAIRCONV = xla|pallas, GETHSHARDING_TPU_PALLAS,
 all read at import): the bench AUTOTUNES by re-executing itself
 per configuration in a subprocess and reports the fastest, caching the
 winner per backend in .bench_autotune.json. Signing workloads are cached
@@ -51,8 +52,19 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 # wins.
 CONFIGS = [
     {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "scan"},
+    # r3 additions, probed right after the champion: the fused Pallas
+    # pair-conv (never materializes the product tensor in HBM), alone,
+    # + fused-normalize, and the int8-plane MXU column contraction
+    {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "scan",
+     "GETHSHARDING_TPU_PAIRCONV": "pallas"},
+    {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "scan",
+     "GETHSHARDING_TPU_PAIRCONV": "pallas", "GETHSHARDING_TPU_PALLAS": "1"},
+    {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "scan",
+     "GETHSHARDING_TPU_CONV": "mxu8"},
     {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "scan",
      "GETHSHARDING_TPU_CONV": "slices"},
+    {"GETHSHARDING_TPU_LIMB_FORM": "wide", "GETHSHARDING_TPU_CARRY": "scan",
+     "GETHSHARDING_TPU_PAIRCONV": "pallas"},
     {"GETHSHARDING_TPU_LIMB_FORM": "wide", "GETHSHARDING_TPU_CARRY": "scan"},
     {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "scan",
      "GETHSHARDING_TPU_CONV": "onehot"},
@@ -482,7 +494,9 @@ def main() -> None:
         [best_cfg.get("GETHSHARDING_TPU_LIMB_FORM", "wide"),
          best_cfg.get("GETHSHARDING_TPU_CARRY", "scan"),
          best_cfg.get("GETHSHARDING_TPU_CONV", "shift")]
-        + (["pallas"] if best_cfg.get("GETHSHARDING_TPU_PALLAS") == "1"
+        + (["pairconv-pallas"]
+           if best_cfg.get("GETHSHARDING_TPU_PAIRCONV") == "pallas" else [])
+        + (["pallas-norm"] if best_cfg.get("GETHSHARDING_TPU_PALLAS") == "1"
            else []))
     _print_metric(best["sig_rate"], best, f"{knobs}, {best['platform']}")
 

@@ -34,6 +34,7 @@ Batch axes are leading axes; `vmap`/`shard_map` compose.
 
 from __future__ import annotations
 
+import os
 from typing import Sequence
 
 import numpy as np
@@ -58,6 +59,34 @@ FP = ModArith(P)
 # product of two lazy values is < 2^(2·273), so a sum of two subtracted
 # products needs a multiple of p ≥ 2^547.
 _PAD530 = FP.pad_mult(2 * _limb.LAZY_BITS + 1)  # ≥ two subtracted products
+
+# GETHSHARDING_TPU_PAIRCONV=pallas routes the product-convolution+combine
+# of every Fp2/Fp12 multiply through the fused Pallas kernel
+# (ops/pallas_conv.py) on accelerator backends — the (..., G, 2, 2, NL,
+# NL) product tensor then never round-trips through HBM. Off by default;
+# bench.py probes it as an autotune config.
+PAIRCONV = os.environ.get("GETHSHARDING_TPU_PAIRCONV", "xla")
+if PAIRCONV not in ("xla", "pallas"):
+    raise ValueError(f"GETHSHARDING_TPU_PAIRCONV must be 'xla' or "
+                     f"'pallas', got {PAIRCONV!r}")
+
+
+def _use_pallas_conv() -> bool:
+    return PAIRCONV == "pallas" and _limb._pallas_wanted()
+
+
+def _pair_conv_combine(x, y, comb: np.ndarray) -> jnp.ndarray:
+    """cols[..., i, a, b, n] = sum_{l+m=n} x[i,a,l]·y[i,b,m], contracted
+    against the static combine tensor -> (..., C, Gr, 2·NL-1) raw column
+    accumulators. One fused Pallas kernel on TPU, broadcast-multiply +
+    conv_cols + einsum under XLA."""
+    if _use_pallas_conv():
+        from gethsharding_tpu.ops.pallas_conv import pair_conv_combine
+
+        return pair_conv_combine(x, y, comb)
+    prod = x[..., :, :, None, :, None] * y[..., :, None, :, None, :]
+    cols = _limb.conv_cols(prod)
+    return jnp.einsum("...iabn,iabcg->...cgn", cols, jnp.asarray(comb))
 
 
 def _pad_to(cols: jnp.ndarray, width: int) -> jnp.ndarray:
@@ -90,18 +119,38 @@ def fp2_neg(x):
     return FP.neg(x)
 
 
+# combine tensors for the (a+bi)(c+di) product planes: re = ac - bd,
+# im = ad + bc; the square variant folds im into ONE plane with coef 2
+# (conv(a,b) == conv(b,a)), so the fused kernel skips a whole plane
+_COMB_FP2 = np.zeros((1, 2, 2, 2, 1), np.int32)
+_COMB_FP2[0, 0, 0, 0, 0] = 1
+_COMB_FP2[0, 1, 1, 0, 0] = -1
+_COMB_FP2[0, 0, 1, 1, 0] = 1
+_COMB_FP2[0, 1, 0, 1, 0] = 1
+_COMB_FP2_SQR = np.zeros((1, 2, 2, 2, 1), np.int32)
+_COMB_FP2_SQR[0, 0, 0, 0, 0] = 1
+_COMB_FP2_SQR[0, 1, 1, 0, 0] = -1
+_COMB_FP2_SQR[0, 0, 1, 1, 0] = 2
+
+_FP2_W = max(2 * NLIMBS - 1, _PAD530.shape[0])
+_FP2_PAD = np.zeros((2, _FP2_W), np.int32)  # pad only the subtracting re
+_FP2_PAD[0, : _PAD530.shape[0]] = _PAD530
+
+
 @jax.jit
 def fp2_mul(x, y):
-    """(a+bi)(c+di) = (ac - bd) + (ad + bc)i — fused, 2 normalizes."""
-    a, b = x[..., 0, :], x[..., 1, :]
-    c, d = y[..., 0, :], y[..., 1, :]
-    rr = _red_sub(FP.mul_cols(a, c), FP.mul_cols(b, d))
-    ii = _red(FP.mul_cols(a, d) + FP.mul_cols(b, c))
-    return jnp.stack([rr, ii], axis=-2)
+    """(a+bi)(c+di) = (ac - bd) + (ad + bc)i — fused, ONE normalize."""
+    acc = _pair_conv_combine(x[..., None, :, :], y[..., None, :, :],
+                             _COMB_FP2)[..., 0, :]  # (..., 2, ncols)
+    return FP.normalize(_pad_to(acc, _FP2_W) + jnp.asarray(_FP2_PAD))
 
 
 @jax.jit
 def fp2_sqr(x):
+    if _use_pallas_conv():
+        acc = _pair_conv_combine(x[..., None, :, :], x[..., None, :, :],
+                                 _COMB_FP2_SQR)[..., 0, :]
+        return FP.normalize(_pad_to(acc, _FP2_W) + jnp.asarray(_FP2_PAD))
     a, b = x[..., 0, :], x[..., 1, :]
     rr = _red_sub(FP.mul_cols(a, a), FP.mul_cols(b, b))
     ii = _red(FP.mul_cols(a, b) * 2)
@@ -209,18 +258,14 @@ def fp12_mul(x, y):
     merges the 3 groups."""
     xiy = fp2_mul_xi(y)                      # (..., 6, 2, 22), ξ·y_j
     w = jnp.stack([y, xiy], axis=-4)         # (..., 2sel, 6, 2, 22)
-    comb = jnp.asarray(_COMB)
     pad = jnp.asarray(_group_pad(3))
 
     group_cols = []
     for k in range(6):
         op = w[..., _CONV_SEL[k], _CONV_J[k], :, :]   # (..., 6, 2, 22)
-        # cols[..., i, a, b, n] = sum_{l+m=n} x[i,a,l]·op[i,b,m]
-        prod = x[..., :, :, None, :, None] * op[..., :, None, :, None, :]
-        cols = _limb.conv_cols(prod)                  # (..., 6, 2, 2, 43)
-        # fold into (component, group) accumulators, add pads
-        acc = _pad_to(jnp.einsum("...iabn,iabcg->...cgn", cols, comb),
-                      _ACC_W) + pad
+        # cols[..., i, a, b, n] = sum_{l+m=n} x[i,a,l]·op[i,b,m], folded
+        # into (component, group) accumulators; plus pads
+        acc = _pad_to(_pair_conv_combine(x, op, _COMB), _ACC_W) + pad
         group_cols.append(acc)
     acc = jnp.stack(group_cols, axis=-4)     # (..., 6, 2, 3, width)
     parts = FP.normalize(acc)                # (..., 6, 2, 3, 22)
@@ -415,15 +460,12 @@ def fp12_mul_line(f, line):
     lstack = jnp.stack([A, B, C], axis=-3)   # (..., 3, 2, 22)
     xif = fp2_mul_xi(f)
     w = jnp.stack([f, xif], axis=-4)         # (..., 2sel, 6, 2, 22)
-    comb = jnp.asarray(_LCOMB)
     pad = jnp.asarray(_group_pad(2))
 
     group_cols = []
     for k in range(6):
         op = w[..., _LINE_SEL[k], _LINE_J[k], :, :]   # (..., 3, 2, 22)
-        prod = lstack[..., :, :, None, :, None] * op[..., :, None, :, None, :]
-        cols = _limb.conv_cols(prod)                  # (..., 3, 2, 2, 43)
-        acc = _pad_to(jnp.einsum("...tabn,tabcg->...cgn", cols, comb),
+        acc = _pad_to(_pair_conv_combine(lstack, op, _LCOMB),
                       _ACC_W) + pad
         group_cols.append(acc)
     acc = jnp.stack(group_cols, axis=-4)     # (..., 6, 2, 2, width)

@@ -168,10 +168,17 @@ PALLAS_NORM = os.environ.get("GETHSHARDING_TPU_PALLAS", "0") == "1"
 #   matmul doing (L+M-1)× redundant multiply-accumulates on the VPU
 #   (int32 never rides the MXU): the r1 bench showed it dominating the
 #   pairing dispatch. Kept for comparison.
+# - "mxu8": split the 24-bit products into four 7-bit planes and contract
+#   them against the constant one-hot as int8×int8→int32 matmuls — the
+#   shape the MXU's integer path takes (the reference's answer to this
+#   layer is gfp_amd64.s scalar asm; this is the systolic-array answer).
+#   The column ACCUMULATION rides the MXU; the products stay on the VPU.
+#   Requires non-negative product entries (true for every limb-product
+#   call site: products of canonical <2^12 limbs).
 CONV_IMPL = os.environ.get("GETHSHARDING_TPU_CONV", "shift")
-if CONV_IMPL not in ("shift", "slices", "gather", "onehot"):
+if CONV_IMPL not in ("shift", "slices", "gather", "onehot", "mxu8"):
     raise ValueError(f"GETHSHARDING_TPU_CONV must be 'shift', 'slices', "
-                     f"'gather' or 'onehot', got {CONV_IMPL!r}")
+                     f"'gather', 'onehot' or 'mxu8', got {CONV_IMPL!r}")
 
 
 def conv_cols(prod: jnp.ndarray, impl: "str | None" = None) -> jnp.ndarray:
@@ -185,6 +192,22 @@ def conv_cols(prod: jnp.ndarray, impl: "str | None" = None) -> jnp.ndarray:
     impl = impl or CONV_IMPL
     if impl == "onehot":
         return jnp.einsum("...ij,ijk->...k", prod, _conv_onehot(L, M))
+    if impl == "mxu8":
+        # int8 MXU path: 7-bit planes of the (non-negative, <2^28)
+        # entries, each contracted against the flat one-hot; the exact
+        # value re-assembles as sum_k plane_sums[k] << 7k (every partial
+        # term is bounded by the true column value, so int32-safe).
+        onehot = _conv_onehot(L, M).reshape(L * M, ncols).astype(np.int8)
+        flat = prod.reshape(prod.shape[:-2] + (L * M,))
+        planes = jnp.stack(
+            [(flat >> (7 * k)) & 0x7F for k in range(4)],
+            axis=-2).astype(jnp.int8)                    # (..., 4, L·M)
+        sums = lax.dot_general(
+            planes, jnp.asarray(onehot),
+            (((planes.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)            # (..., 4, ncols)
+        weights = np.array([1 << (7 * k) for k in range(4)], np.int32)
+        return (sums * weights[:, None]).sum(axis=-2)
     if impl == "slices":
         out = jnp.zeros(prod.shape[:-2] + (ncols,), prod.dtype)
         for l in range(L):

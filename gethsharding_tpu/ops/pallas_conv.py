@@ -1,0 +1,161 @@
+"""Pallas TPU kernel: fused limb-product convolution + combine.
+
+The multiply hot path of the pairing stack (SURVEY.md §7.3; the
+reference's answer is hand-written field-multiply assembly,
+`crypto/bn256/cloudflare/gfp_amd64.s:108` gfpMul) is the schoolbook
+column sum
+
+    cols[i, a, b, n] = sum_{l+m=n} x[i, a, l] * y[i, b, m]
+
+followed by a small static contraction against a combine tensor mapping
+the (i, a, b) product planes onto (component, group) accumulators
+(`ops/bn256_jax.fp12_mul`). As stock XLA ops the product tensor
+(..., G, 2, 2, NL, NL) — ~46 KB per batch row for Fp12 — round-trips
+through HBM between the broadcast-multiply and the column reduction; on
+a bandwidth-bound TPU that traffic, not the MACs, is the cost.
+
+This kernel fuses product, column sum and combine in VMEM: it reads the
+two operand stacks, unrolls the NL shift-MACs per (i, a, b) plane on
+full vector tiles, applies the compile-time combine coefficients while
+accumulating, and writes only the (C, Gr, 2*NL-1) accumulator — a ~20x
+cut in HBM bytes for the Fp12 case.
+
+Layout: limbs/planes on sublanes, batch on lanes ((width, BLOCK_COLS)
+blocks) so every MAC is a full-width vector op; the host wrapper
+transposes in/out (two cheap XLA transposes vs. the product-tensor
+round trip).
+
+Opt-in via GETHSHARDING_TPU_PAIRCONV=pallas (read by ops/bn256_jax at
+import); bench.py probes it as an autotune config. Differential tests
+run the kernel in interpreter mode on CPU against the XLA path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from jax.experimental import pallas as pl
+
+BLOCK_COLS = 256  # batch rows per grid step (the minor/lane axis)
+
+
+def comb_terms(comb: np.ndarray) -> Tuple:
+    """Static (i, a, b) -> [(c, g, coef), ...] plan from a combine tensor
+    (G, A, B, C, Gr); hashable, so it keys the compiled-kernel cache."""
+    G, A, B, C, Gr = comb.shape
+    terms = []
+    for i in range(G):
+        for a in range(A):
+            for b in range(B):
+                targets = tuple(
+                    (c, g, int(comb[i, a, b, c, g]))
+                    for c in range(C) for g in range(Gr)
+                    if comb[i, a, b, c, g] != 0)
+                if targets:
+                    terms.append(((i, a, b), targets))
+    return tuple(terms)
+
+
+def _kernel(x_ref, y_ref, o_ref, *, terms, nl: int, a_dim: int, b_dim: int,
+            c_dim: int, g_dim: int):
+    ncols = 2 * nl - 1
+    x = x_ref[:]
+    y = y_ref[:]
+    cols = x.shape[-1]
+    accs = {}
+    for (i, a, b), targets in terms:
+        xs = x[(i * a_dim + a) * nl:(i * a_dim + a + 1) * nl, :]
+        ys = y[(i * b_dim + b) * nl:(i * b_dim + b + 1) * nl, :]
+        # conv[n] = sum_l xs[l] * ys[n-l], as nl shift-MACs on full tiles
+        conv = None
+        for l in range(nl):
+            term = xs[l:l + 1, :] * ys
+            parts = []  # no zero-row operands: Mosaic concat edge case
+            if l:
+                parts.append(jnp.zeros((l, cols), jnp.int32))
+            parts.append(term)
+            if ncols - nl - l:
+                parts.append(jnp.zeros((ncols - nl - l, cols), jnp.int32))
+            shifted = parts[0] if len(parts) == 1 else jnp.concatenate(
+                parts, axis=0)
+            conv = shifted if conv is None else conv + shifted
+        for c, g, coef in targets:
+            scaled = conv * coef if coef not in (1, -1) else (
+                conv if coef == 1 else -conv)
+            key = (c, g)
+            accs[key] = scaled if key not in accs else accs[key] + scaled
+    out = jnp.concatenate(
+        [accs.get((c, g), jnp.zeros((ncols, cols), jnp.int32))
+         for c in range(c_dim) for g in range(g_dim)], axis=0)
+    o_ref[:] = out
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled(terms, nl: int, a_dim: int, b_dim: int, g_in: int,
+              c_dim: int, g_dim: int, interpret: bool):
+    ncols = 2 * nl - 1
+    x_w = g_in * a_dim * nl
+    y_w = g_in * b_dim * nl
+    o_w = c_dim * g_dim * ncols
+    kernel = functools.partial(_kernel, terms=terms, nl=nl, a_dim=a_dim,
+                               b_dim=b_dim, c_dim=c_dim, g_dim=g_dim)
+
+    @jax.jit
+    def run(xt, yt):
+        n = xt.shape[1]
+        grid = (n // BLOCK_COLS,)
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((x_w, BLOCK_COLS), lambda i: (0, i)),
+                pl.BlockSpec((y_w, BLOCK_COLS), lambda i: (0, i)),
+            ],
+            out_specs=pl.BlockSpec((o_w, BLOCK_COLS), lambda i: (0, i)),
+            out_shape=jax.ShapeDtypeStruct((o_w, n), jnp.int32),
+            interpret=interpret,
+        )(xt, yt)
+
+    return run
+
+
+def pair_conv_combine(x: jnp.ndarray, y: jnp.ndarray, comb: np.ndarray,
+                      *, interpret: bool = False) -> jnp.ndarray:
+    """Fused equivalent of
+
+        prod = x[..., :, :, None, :, None] * y[..., :, None, :, None, :]
+        cols = conv_cols(prod)
+        acc  = einsum("...iabn,iabcg->...cgn", cols, comb)
+
+    x: (..., G, A, NL) canonical-limb int32; y: (..., G, B, NL);
+    comb: constant (G, A, B, C, Gr) small ints. Returns
+    (..., C, Gr, 2*NL-1) raw column accumulators (caller pads/normalizes,
+    exactly like the XLA path). Same int32 range contract as the caller's
+    comb design (<= 4 products per accumulator)."""
+    G, A, NL = x.shape[-3:]
+    B = y.shape[-2]
+    _, _, _, C, Gr = comb.shape
+    ncols = 2 * NL - 1
+    lead = x.shape[:-3]
+    n = 1
+    for d in lead:
+        n *= d
+    xt = x.reshape((n, G * A * NL)).T
+    yt = y.reshape((n, G * B * NL)).T
+    pad = (-n) % BLOCK_COLS
+    if pad:
+        xt = jnp.concatenate(
+            [xt, jnp.zeros((xt.shape[0], pad), jnp.int32)], axis=1)
+        yt = jnp.concatenate(
+            [yt, jnp.zeros((yt.shape[0], pad), jnp.int32)], axis=1)
+    run = _compiled(comb_terms(comb), NL, A, B, G, C, Gr, interpret)
+    out = run(xt, yt)  # (C*Gr*ncols, n+pad)
+    if pad:
+        out = out[:, :n]
+    return out.T.reshape(lead + (C, Gr, ncols))

@@ -166,3 +166,10 @@ def test_conv_impls_agree():
             got = limb.conv_cols(jnp.asarray(prod), impl=impl)
             assert np.array_equal(np.asarray(got), np.asarray(want)), (
                 L, M, impl)
+        # mxu8's int8-plane split assumes non-negative entries (the
+        # limb-product contract: products of canonical <2^12 limbs)
+        pos = np.abs(prod)
+        want_pos = limb.conv_cols(jnp.asarray(pos), impl="shift")
+        got_pos = limb.conv_cols(jnp.asarray(pos), impl="mxu8")
+        assert np.array_equal(np.asarray(got_pos), np.asarray(want_pos)), (
+            L, M, "mxu8")
