@@ -46,6 +46,10 @@ class Observer(Service):
             {addr: dataclasses.replace(acct)
              for addr, acct in genesis.items()} if genesis else None)
         self.state_roots: Dict[int, Hash32] = {}
+        # canonical secure-MPT roots (statedb.go:562 parity) per period —
+        # the commitment a Go node recomputes; state_roots stays the fast
+        # flat integrity check shared bit-for-bit with the device kernel
+        self.canonical_roots: Dict[int, Hash32] = {}
         self.txs_replayed = 0
         self.txs_rejected = 0
         self.seen_periods = set()
@@ -121,10 +125,13 @@ class Observer(Service):
         self.m_txs_rejected.inc(len(txs) - applied)
         root = self.state.root()
         self.state_roots[period] = root
+        canonical = self.state.trie_root()
+        self.canonical_roots[period] = canonical
         self.log.info("Replayed collation: shard %d period %d applied %d/%d "
-                      "root 0x%s", self.shard.shard_id, period, applied,
-                      len(txs), bytes(root).hex()[:16])
-        return root
+                      "root 0x%s state_root 0x%s", self.shard.shard_id,
+                      period, applied, len(txs), bytes(root).hex()[:16],
+                      bytes(canonical).hex()[:16])
+        return canonical
 
     def _replay_on_device(self, txs, coinbase: Address20) -> int:
         """One batched device dispatch (recovery ladder + vmapped

@@ -15,11 +15,18 @@ bit-compatible: (1) sender recovery, (2) nonce equality, (3) buy gas
 whole transaction with no state change (phase-1 has no partial execution,
 so a failed tx burns nothing).
 
-The state commitment (`ShardState.root`) is keccak256 over the accounts
-in ascending address order, each row addr(20) || nonce_be(8) ||
-balance_be(32) — a flat, fixed-shape commitment the batched device kernel
-(`ops/replay_jax.py`) reproduces byte-identically; the MPT-rooted variant
-of `core/trie.py` remains available for header chunk roots.
+Two state commitments:
+
+- `ShardState.root` — keccak256 over the accounts in ascending address
+  order, each row addr(20) || nonce_be(8) || balance_be(32): a flat,
+  fixed-shape integrity check the batched device kernel
+  (`ops/replay_jax.py`) reproduces byte-identically on device.
+- `ShardState.trie_root` — the CANONICAL secure-MPT state root
+  (`core/state/statedb.go:562` IntermediateRoot parity): value
+  RLP([nonce, balance, storageRoot, codeHash]) keyed by
+  keccak256(address), empty accounts absent (the EIP-158 delete-empty
+  rule geth applies at finalize), so a Go node replaying the same
+  transactions recomputes this exact hash.
 
 This scalar engine is the differential-testing twin of the vmapped device
 replay (BASELINE.md config 4).
@@ -105,6 +112,48 @@ class ShardState:
                                      key=lambda kv: bytes(kv[0]))
         )
         return Hash32(keccak256(blob))
+
+    def trie_root(self) -> Hash32:
+        """Canonical secure-MPT state root (see module docstring)."""
+        return state_trie_root(self.accounts)
+
+
+EMPTY_CODE_HASH = keccak256(b"")  # no shard account carries code in phase 1
+
+
+def account_rlp(nonce: int, balance: int) -> bytes:
+    """The state-trie account value: RLP([nonce, balance, storageRoot,
+    codeHash]) with the empty storage root and empty code hash
+    (`core/state/state_object.go` Account; phase 1 has no shard-side
+    storage or code)."""
+    from gethsharding_tpu.core.trie import EMPTY_ROOT
+    from gethsharding_tpu.utils.rlp import rlp_encode
+
+    return rlp_encode([nonce, balance, EMPTY_ROOT, EMPTY_CODE_HASH])
+
+
+def state_trie_root(accounts: Dict[Address20, AccountState]) -> Hash32:
+    """Secure-MPT root over non-empty accounts — the commitment a geth
+    node computes at `statedb.go:562`. Bulk native build
+    (`native/mpt.c`, 32-byte keccak keys) when available; the Python
+    SecureTrie is the fallback and differential twin."""
+    from gethsharding_tpu.core.trie import EMPTY_ROOT, Trie
+
+    items = sorted(
+        (keccak256(bytes(addr)), account_rlp(acct.nonce, acct.balance))
+        for addr, acct in accounts.items()
+        if acct.nonce or acct.balance)
+    if not items:
+        return Hash32(EMPTY_ROOT)
+    from gethsharding_tpu import native
+
+    root = native.mpt_root([k for k, _ in items], [v for _, v in items])
+    if root is not None:
+        return Hash32(root)
+    trie = Trie()  # keys are pre-hashed: plain trie == SecureTrie here
+    for key, value in items:
+        trie.update(key, value)
+    return Hash32(trie.root_hash())
 
 
 def apply_transaction(state: ShardState, tx: Transaction,

@@ -29,8 +29,8 @@ from typing import List, Optional, Sequence
 log = logging.getLogger("native")
 
 _SOURCES = ["keccak.c", "mpt.c"]
-_KEY_CAP = 16
-_VAL_CAP = 64
+_KEY_CAP = 32
+_VAL_CAP = 128
 
 _lock = threading.Lock()
 _lib = None

@@ -323,6 +323,31 @@ def build_replay_inputs(
     )
 
 
+def canonical_state_roots(inp: ReplayInputs, out: ReplayOutputs):
+    """Host-side canonical secure-MPT roots of the post-replay account
+    tables, one per shard (`core/state/statedb.go:562` parity via
+    `state_processor.state_trie_root`). The device's flat keccak
+    commitment (`ReplayOutputs.roots`) remains the fast on-device
+    integrity check; THIS root is the one a Go node recomputes. Padding
+    rows and emptied accounts drop out (empty accounts are absent from
+    the trie)."""
+    addrs = np.asarray(inp.addrs)
+    lens = np.asarray(inp.table_len)
+    nonces = np.asarray(out.nonces)
+    balances = np.asarray(out.balances).astype(np.uint8)
+    roots = []
+    for s in range(addrs.shape[0]):
+        accounts = {}
+        for i in range(int(lens[s])):
+            nonce = int(nonces[s, i])
+            balance = int.from_bytes(bytes(balances[s, i]), "little")
+            if nonce or balance:
+                accounts[Address20(bytes(addrs[s, i]))] = ref.AccountState(
+                    nonce=nonce, balance=balance)
+        roots.append(ref.state_trie_root(accounts))
+    return roots
+
+
 def scalar_root_with_padding(state: ref.ShardState, a_total: int):
     """The scalar twin of the device commitment: the device hashes the
     FULL padded table (zero rows included), so the scalar root must pad to

@@ -26,8 +26,8 @@
 
 void gs_keccak256(const uint8_t *in, uint64_t len, uint8_t *out32);
 
-#define KEY_CAP 16      /* max key bytes -> max 32 nibbles */
-#define VAL_CAP 64      /* max value bytes */
+#define KEY_CAP 32      /* max key bytes -> max 64 nibbles (secure-trie keccak keys) */
+#define VAL_CAP 128     /* max value bytes (state-account RLP <= 110) */
 #define MAX_NIB (2 * KEY_CAP)
 /* worst node: branch of 16 embedded children (<32B each) + value + header */
 #define NODE_BUF 1024

@@ -342,12 +342,15 @@ def test_observer_replays_canonical_collations():
     assert observer.txs_rejected == 1
     assert observer.state.get(sender).nonce == 3
     assert observer.state.get(to).balance == 300
-    assert observer.state_roots[1] == root
+    assert observer.state_roots[1] == observer.state.root()
+    assert observer.canonical_roots[1] == root  # the returned root is canonical
 
-    # parity: an independent scalar replay reaches the same root
+    # parity: an independent scalar replay reaches the same roots (flat
+    # integrity check AND the canonical secure-MPT state root)
     twin = sp.ShardState({sender: sp.AccountState(balance=10**12)})
     sp.process(twin, txs, proposer)
-    assert twin.root() == root
+    assert twin.root() == observer.state_roots[1]
+    assert twin.trie_root() == root
 
 
 def test_observer_engines_agree_when_all_txs_rejected():
@@ -391,4 +394,8 @@ def test_observer_engines_agree_when_all_txs_rejected():
     for addr in sp.replay_account_table(bad, twin.accounts, proposer):
         twin.get(addr)
     sp.process(twin, bad, proposer)
-    assert twin.root() == roots["python"]
+    # canonical root: zero-row materialization must NOT change it (empty
+    # accounts are absent from the state trie)
+    assert twin.trie_root() == roots["python"]
+    assert sp.ShardState({sender: sp.AccountState(balance=10**9)}
+                         ).trie_root() == roots["python"]
