@@ -88,6 +88,10 @@ class ShardNode:
         client = SMCClient(backend=backend, config=config, deposit_flag=deposit,
                            accounts=accounts_mgr, account=account)
         self._register(client)
+        if hub is not None and hasattr(hub, "set_identity"):
+            # cross-process hubs sign their attach/peer handshakes with
+            # the node's key: account identity is proven, not claimed
+            hub.set_identity(client.accounts, client.account())
 
         shard = Shard(shard_id=shard_id, shard_db=shard_db.db)
         self.shard = shard

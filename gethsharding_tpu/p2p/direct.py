@@ -1,0 +1,235 @@
+"""Direct authenticated peer sockets for shardp2p (the de-starred data
+plane).
+
+The chain-process relay (`rpc/server.py` shard_p2p*) remains the
+INTRODUCTION service — it allocates peer ids and keeps the table of
+(account, listener endpoint) per peer — but directed message payloads
+flow over direct TCP sockets between actor processes. This is the
+reference's RLPx role split (`p2p/rlpx.go:86,178` authenticated
+transport vs `p2p/dial.go`/discovery introduction), with the secp256k1
+challenge handshake providing authentication; the ECIES/AES encryption
+layer is out of scope here (authentication is mandatory, encryption a
+stretch goal).
+
+Wire protocol — newline-delimited JSON frames:
+
+    listener -> dialer : {"challenge": hex32}
+    dialer  -> listener: {"peer_id": N, "account": hex20, "sig": hex65}
+        sig over keccak256(b"shardp2p-direct:" || network_id_be8 ||
+        challenge) with the node's key
+    listener -> dialer : {"ok": true} | {"error": reason}
+    dialer  -> listener: {"type": kind, "payload": ...}   (repeated)
+
+The listener binds the claimed relay `peer_id` to the PROVEN account by
+resolving the relay's peer table: a dialer that cannot sign for the
+account the relay has on file for that id is refused, so a relay peer id
+cannot be impersonated even by another authenticated peer.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import secrets
+import socket
+import socketserver
+import threading
+from typing import Callable, Optional, Tuple
+
+from gethsharding_tpu.crypto import secp256k1
+from gethsharding_tpu.crypto.keccak import keccak256
+from gethsharding_tpu.p2p.service import Message, Peer
+from gethsharding_tpu.rpc import codec
+
+log = logging.getLogger("p2p.direct")
+
+HANDSHAKE_TIMEOUT = 10.0
+
+
+def attach_digest(network_id: int, challenge: bytes) -> bytes:
+    """What an attaching node signs to prove its account to the relay."""
+    return keccak256(b"shardp2p-attach:" + network_id.to_bytes(8, "big")
+                     + challenge)
+
+
+def direct_digest(network_id: int, challenge: bytes) -> bytes:
+    """What a dialing node signs to prove its account to a peer."""
+    return keccak256(b"shardp2p-direct:" + network_id.to_bytes(8, "big")
+                     + challenge)
+
+
+def prove(digest: bytes, sig65: bytes, account_hex: str) -> bool:
+    """Does the signature recover to the claimed 20-byte hex account?"""
+    try:
+        addr = secp256k1.ecrecover_address(
+            digest, secp256k1.Signature.from_bytes65(sig65))
+    except (ValueError, AssertionError):
+        return False
+    return bytes(addr).hex() == account_hex.lower().removeprefix("0x")
+
+
+class PeerListener:
+    """Inbound side: accepts authenticated peer connections and delivers
+    their frames into the local P2PServer."""
+
+    def __init__(self, deliver: Callable[[Message], None],
+                 resolve: Callable[[int], Optional[dict]],
+                 network_id: int, host: str = "127.0.0.1"):
+        self.deliver = deliver
+        self.resolve = resolve
+        self.network_id = network_id
+        listener = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self) -> None:
+                listener._handle(self)
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._tcp = Server((host, 0), Handler)
+        self.address: Tuple[str, int] = self._tcp.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._tcp.serve_forever, daemon=True, name="p2p-listener")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._tcp.shutdown()
+        self._tcp.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    # -- connection handling ----------------------------------------------
+
+    def _handle(self, handler) -> None:
+        handler.connection.settimeout(HANDSHAKE_TIMEOUT)
+        challenge = secrets.token_bytes(32)
+        try:
+            handler.wfile.write(
+                (json.dumps({"challenge": challenge.hex()}) + "\n").encode())
+            handler.wfile.flush()
+            hello = json.loads(handler.rfile.readline())
+            peer_id = int(hello["peer_id"])
+            account = str(hello["account"])
+            sig = bytes.fromhex(hello["sig"])
+            err = self._verify(peer_id, account, sig, challenge)
+            reply = {"ok": True} if err is None else {"error": err}
+            handler.wfile.write((json.dumps(reply) + "\n").encode())
+            handler.wfile.flush()
+            if err is not None:
+                log.warning("refused direct peer %s: %s", account, err)
+                return
+        except (OSError, ValueError, KeyError, TypeError,
+                json.JSONDecodeError):
+            return
+        handler.connection.settimeout(None)
+        try:
+            for raw in handler.rfile:
+                raw = raw.strip()
+                if not raw:
+                    continue
+                frame = json.loads(raw)
+                data = codec.dec_p2p(frame["type"], frame["payload"])
+                self.deliver(Message(peer=Peer(peer_id), data=data))
+        except (OSError, ValueError, KeyError, json.JSONDecodeError):
+            log.debug("direct peer %d connection ended", peer_id)
+
+    def _verify(self, peer_id: int, account: str, sig: bytes,
+                challenge: bytes) -> Optional[str]:
+        if not prove(direct_digest(self.network_id, challenge), sig, account):
+            return "signature does not prove the claimed account"
+        meta = self.resolve(peer_id)
+        if meta is None:
+            return f"unknown relay peer id {peer_id}"
+        on_file = (meta.get("account") or "").lower().removeprefix("0x")
+        if on_file != account.lower().removeprefix("0x"):
+            return "account does not match the relay's table for this peer"
+        return None
+
+
+class DirectDialer:
+    """Outbound side: a cache of authenticated connections to peer
+    listeners; `send` dials + handshakes on first use per endpoint."""
+
+    def __init__(self, network_id: int, account_hex: str,
+                 sign: Callable[[bytes], bytes]):
+        self.network_id = network_id
+        self.account_hex = account_hex
+        self.sign = sign
+        self._conns: dict = {}  # (host, port) -> (sock, rfile, wfile, lock)
+        self._lock = threading.Lock()
+
+    def close(self) -> None:
+        with self._lock:
+            conns, self._conns = dict(self._conns), {}
+        for sock, *_ in conns.values():
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def send(self, endpoint: Tuple[str, int], self_peer_id: int,
+             kind: str, payload) -> bool:
+        """One frame to the peer listening at `endpoint`; False when the
+        peer is unreachable or refuses the handshake (caller falls back
+        to the relay)."""
+        frame = (json.dumps({"type": kind, "payload": payload}) + "\n"
+                 ).encode()
+        for attempt in (0, 1):  # one retry on a stale cached connection
+            conn = self._get(tuple(endpoint), self_peer_id)
+            if conn is None:
+                return False
+            _, _, wfile, lock = conn
+            try:
+                with lock:
+                    wfile.write(frame)
+                    wfile.flush()
+                return True
+            except OSError:
+                self._drop(tuple(endpoint))
+        return False
+
+    def _get(self, endpoint: Tuple[str, int], self_peer_id: int):
+        with self._lock:
+            conn = self._conns.get(endpoint)
+        if conn is not None:
+            return conn
+        try:
+            sock = socket.create_connection(endpoint,
+                                            timeout=HANDSHAKE_TIMEOUT)
+            rfile = sock.makefile("rb")
+            wfile = sock.makefile("wb")
+            challenge = bytes.fromhex(
+                json.loads(rfile.readline())["challenge"])
+            sig = self.sign(direct_digest(self.network_id, challenge))
+            hello = {"peer_id": self_peer_id, "account": self.account_hex,
+                     "sig": sig.hex()}
+            wfile.write((json.dumps(hello) + "\n").encode())
+            wfile.flush()
+            reply = json.loads(rfile.readline())
+            if not reply.get("ok"):
+                log.warning("direct handshake refused by %s: %s", endpoint,
+                            reply.get("error"))
+                sock.close()
+                return None
+            sock.settimeout(None)
+        except (OSError, ValueError, KeyError, json.JSONDecodeError) as exc:
+            log.debug("direct dial to %s failed: %s", endpoint, exc)
+            return None
+        conn = (sock, rfile, wfile, threading.Lock())
+        with self._lock:
+            self._conns[endpoint] = conn
+        return conn
+
+    def _drop(self, endpoint: Tuple[str, int]) -> None:
+        with self._lock:
+            conn = self._conns.pop(endpoint, None)
+        if conn is not None:
+            try:
+                conn[0].close()
+            except OSError:
+                pass

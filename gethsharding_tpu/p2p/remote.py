@@ -1,16 +1,23 @@
 """RemoteHub: the shardp2p feed bus across OS processes.
 
 The in-process `Hub` gives actors typed pub/sub within one process; this
-adapter runs the SAME `P2PServer` API over the RPC relay hosted by the
-chain process (`rpc/server.py` shard_p2p* methods), so body requests and
-responses between a proposer process and a notary process cross a real
-socket — the transport the reference's shardp2p stubs out
-(`sharding/p2p/service.go:41-50` Send/Broadcast TODOs) and defers to a
-future devp2p integration.
+adapter runs the SAME `P2PServer` API across processes, with the role
+split of the reference's p2p stack:
 
-Wire format: messages serialize through the codec registry in
-`rpc/codec.py` (type-tagged JSON); peers are relay-allocated ids, so a
-responder can reply directly to the requesting peer across processes.
+- the chain process's relay (`rpc/server.py` shard_p2p*) is the
+  INTRODUCTION tier — authenticated attach, peer table, broadcast
+  fan-out (the discovery/dial-scheduling role, `p2p/discover`,
+  `p2p/dial.go`);
+- directed messages flow PEER TO PEER over direct TCP sockets
+  (`p2p/direct.py`), authenticated by a secp256k1 challenge handshake —
+  the RLPx transport role (`p2p/rlpx.go:86,178`), minus encryption.
+
+Attaching REQUIRES an identity: the handshake carries the node's
+account and a signature over a relay-issued challenge, so `account` in
+the peer table is proven, not claimed. The relay refuses unsigned or
+forged attaches; peers refuse direct connections whose account doesn't
+match the relay's table. Wire format: the codec registry in
+`rpc/codec.py` (type-tagged JSON).
 """
 
 from __future__ import annotations
@@ -18,6 +25,7 @@ from __future__ import annotations
 import logging
 from typing import Any, Optional
 
+from gethsharding_tpu.p2p import direct
 from gethsharding_tpu.p2p.service import (
     Message, Peer, PROTOCOL_NAME, PROTOCOL_VERSION)
 from gethsharding_tpu.rpc import codec
@@ -27,7 +35,7 @@ log = logging.getLogger("p2p.remote")
 
 
 class RemoteHub:
-    """Hub duck-type backed by the chain process's p2p relay.
+    """Hub duck-type backed by the chain-process relay + direct sockets.
 
     One RemoteHub carries ONE attached P2PServer (one actor process); its
     peer id is allocated by the relay and is meaningful across every
@@ -35,26 +43,48 @@ class RemoteHub:
     """
 
     def __init__(self, rpc: RPCClient, network_id: Optional[int] = None,
-                 account: Optional[str] = None):
+                 accounts=None, account=None):
         self.rpc = rpc
         self.network_id = network_id
-        self.account = account
         self._server = None
+        self._self_peer: Optional[Peer] = None
+        self._accounts = accounts      # AccountManager (holds the key)
+        self._account = account        # Address20
+        self._listener: Optional[direct.PeerListener] = None
+        self._dialer: Optional[direct.DirectDialer] = None
+        self._peer_info_cache: dict = {}  # peer ids never recycle
         rpc.on_notification("shard_p2p", self._on_message)
 
     @classmethod
     def dial(cls, host: str, port: int,
              network_id: Optional[int] = None,
-             account: Optional[str] = None) -> "RemoteHub":
-        """Dial the relay. `network_id`/`account` go into the attach
-        handshake: a stated network id must match the chain process's
-        (protocol/version always must), and the account becomes the
-        peer's public identity in the relay's peer table."""
+             accounts=None, account=None) -> "RemoteHub":
+        """Dial the relay. The identity (accounts manager + address) can
+        also be supplied later via `set_identity` — it must be present by
+        the time a P2PServer attaches."""
         return cls(RPCClient(host, port), network_id=network_id,
-                   account=account)
+                   accounts=accounts, account=account)
+
+    def set_identity(self, accounts, account) -> None:
+        """Bind the node's key (AccountManager + Address20) used to sign
+        the attach and direct-peer handshakes."""
+        self._accounts = accounts
+        self._account = account
+
+    @property
+    def account_hex(self) -> Optional[str]:
+        return None if self._account is None else bytes(self._account).hex()
 
     def close(self) -> None:
+        if self._dialer is not None:
+            self._dialer.close()
+        if self._listener is not None:
+            self._listener.stop()
+            self._listener = None
         self.rpc.close()
+
+    def _sign(self, digest: bytes) -> bytes:
+        return self._accounts.sign_hash(self._account, digest)
 
     # -- Hub surface (p2p/service.py) --------------------------------------
 
@@ -62,21 +92,41 @@ class RemoteHub:
         if self._server is not None:
             raise RuntimeError("RemoteHub carries exactly one P2PServer; "
                                "dial another connection per actor")
+        if self._accounts is None or self._account is None:
+            raise RuntimeError(
+                "p2p identity required: the relay refuses unsigned "
+                "attaches (set_identity or dial(accounts=, account=))")
+        if self.network_id is None:
+            self.network_id = self.rpc.call("shard_networkId")
         # register the delivery target BEFORE the relay learns about the
         # peer: it may start pushing the instant the attach call lands
         self._server = server
-        handshake = {"protocol": PROTOCOL_NAME,
-                     "version": PROTOCOL_VERSION}
-        if self.network_id is not None:
-            handshake["network_id"] = self.network_id
-        if self.account is not None:
-            handshake["account"] = self.account
+        self._listener = direct.PeerListener(
+            deliver=self._deliver, resolve=self.peer_info,
+            network_id=self.network_id)
+        self._listener.start()
+        challenge = bytes.fromhex(self.rpc.call("shard_p2pChallenge"))
+        handshake = {
+            "protocol": PROTOCOL_NAME,
+            "version": PROTOCOL_VERSION,
+            "network_id": self.network_id,
+            "account": self.account_hex,
+            "sig": self._sign(
+                direct.attach_digest(self.network_id, challenge)).hex(),
+            "endpoint": list(self._listener.address),
+        }
         try:
             peer_id = self.rpc.call("shard_p2pAttach", handshake)
         except Exception:
             self._server = None
+            self._listener.stop()
+            self._listener = None
             raise
-        return Peer(peer_id)
+        self._dialer = direct.DirectDialer(
+            network_id=self.network_id, account_hex=self.account_hex,
+            sign=self._sign)
+        self._self_peer = Peer(peer_id)
+        return self._self_peer
 
     def detach(self, peer: Peer) -> None:
         """Detach = end of this hub's life (it carries exactly one
@@ -89,8 +139,30 @@ class RemoteHub:
             pass
         self.close()
 
+    def peer_info(self, peer_id: int) -> Optional[dict]:
+        """Relay peer-table lookup (cached: relay ids never recycle)."""
+        info = self._peer_info_cache.get(peer_id)
+        if info is None:
+            try:
+                info = self.rpc.call("shard_p2pPeerInfo", peer_id)
+            except Exception:
+                return None
+            if info is not None:
+                self._peer_info_cache[peer_id] = info
+        return info
+
     def route(self, sender: Peer, target: Peer, data: Any) -> bool:
+        """Directed send: peer-to-peer over the direct socket; the relay
+        is the fallback only when the peer's listener is unreachable."""
         kind, payload = codec.enc_p2p(data)
+        info = self.peer_info(target.peer_id)
+        if (info is not None and info.get("endpoint")
+                and self._dialer is not None):
+            if self._dialer.send(tuple(info["endpoint"]), sender.peer_id,
+                                 kind, payload):
+                return True
+            log.warning("direct send to peer %d failed; relay fallback",
+                        target.peer_id)
         return self.rpc.call("shard_p2pSend", sender.peer_id,
                              target.peer_id, kind, payload)
 
@@ -101,13 +173,17 @@ class RemoteHub:
 
     # -- inbound -----------------------------------------------------------
 
-    def _on_message(self, params: dict) -> None:
+    def _deliver(self, message: Message) -> None:
         server = self._server
-        if server is None:
+        if server is not None:
+            server._deliver(message)
+
+    def _on_message(self, params: dict) -> None:
+        if self._server is None:
             return
         try:
             data = codec.dec_p2p(params["type"], params["payload"])
         except Exception:
             log.exception("undecodable p2p message")
             return
-        server._deliver(Message(peer=Peer(params["from"]), data=data))
+        self._deliver(Message(peer=Peer(params["from"]), data=data))

@@ -61,10 +61,14 @@ class RPCServer:
         self._thread: Optional[threading.Thread] = None
         self._unsubscribe = backend.subscribe_new_head(self._on_head)
         # shardp2p relay: peer id -> (wfile, write lock); actors in other
-        # processes attach here and exchange typed messages through us
+        # processes attach here for introduction (authenticated peer
+        # table + broadcast); directed payloads flow peer-to-peer over
+        # the listeners the peers advertise (p2p/direct.py)
         self._p2p_peers: dict = {}
         self._p2p_meta: dict = {}
         self._p2p_ids = 1
+        self._p2p_challenges: dict = {}  # wfile -> pending nonce
+        self.p2p_relayed_sends = 0  # directed sends that fell back to us
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -122,6 +126,7 @@ class RPCServer:
         finally:
             with self._sub_lock:
                 self._subscribers.pop(handler.wfile, None)
+                self._p2p_challenges.pop(handler.wfile, None)
                 dead = [pid for pid, (wf, _) in self._p2p_peers.items()
                         if wf is handler.wfile]
                 for pid in dead:
@@ -142,15 +147,26 @@ class RPCServer:
                 with self._sub_lock:
                     self._subscribers[handler.wfile] = write_lock
                 result = "newHeads"
+            elif method == "shard_p2pChallenge":
+                import secrets
+
+                nonce = secrets.token_bytes(32)
+                with self._sub_lock:
+                    self._p2p_challenges[handler.wfile] = nonce
+                result = nonce.hex()
             elif method == "shard_p2pAttach":
                 handshake = params[0] if params else {}
                 self._check_handshake(handshake)
+                account = self._check_attach_signature(handshake, handler)
+                endpoint = handshake.get("endpoint")
                 with self._sub_lock:
                     peer_id = self._p2p_ids
                     self._p2p_ids += 1
                     self._p2p_peers[peer_id] = (handler.wfile, write_lock)
                     self._p2p_meta[peer_id] = {
-                        "account": handshake.get("account"),
+                        "account": account,
+                        "endpoint": (None if endpoint is None
+                                     else list(endpoint)),
                         "version": handshake.get(
                             "version", P2P_PROTOCOL_VERSION),
                     }
@@ -314,6 +330,32 @@ class RPCServer:
         if network is not None and network != ours:
             raise ValueError(f"network mismatch: peer {network}, ours {ours}")
 
+    def _check_attach_signature(self, handshake: dict, handler) -> str:
+        """Authenticated attach: the claimed account must be PROVEN by a
+        secp256k1 signature over a challenge this relay issued on this
+        connection. Unsigned or forged attaches are refused — the
+        reference's RLPx authenticates both ends cryptographically
+        (p2p/rlpx.go:178); a self-claimed identity would let any process
+        impersonate a notary on the data-availability plane."""
+        from gethsharding_tpu.p2p import direct
+
+        account = handshake.get("account")
+        sig_hex = handshake.get("sig")
+        if not account or not sig_hex:
+            raise ValueError(
+                "unsigned attach refused: account + sig required")
+        with self._sub_lock:
+            challenge = self._p2p_challenges.pop(handler.wfile, None)
+        if challenge is None:
+            raise ValueError(
+                "no pending challenge: call shard_p2pChallenge first")
+        digest = direct.attach_digest(self.backend.config.network_id,
+                                      challenge)
+        if not direct.prove(digest, bytes.fromhex(sig_hex), account):
+            raise ValueError(
+                "attach signature does not prove the claimed account")
+        return account.lower().removeprefix("0x")
+
     def rpc_p2pPeers(self):
         """Attached-peer table (admin_peers parity for the relay)."""
         with self._sub_lock:
@@ -344,7 +386,19 @@ class RPCServer:
             self._p2p_meta.pop(peer_id, None)
         return True
 
+    def rpc_p2pPeerInfo(self, peer_id):
+        """Introduction lookup: the proven account + direct-listener
+        endpoint for one peer (None if unknown)."""
+        with self._sub_lock:
+            meta = self._p2p_meta.get(peer_id)
+        return None if meta is None else dict(meta)
+
+    def rpc_p2pStats(self):
+        return {"relayed_sends": self.p2p_relayed_sends,
+                "peers": len(self._p2p_peers)}
+
     def rpc_p2pSend(self, from_id, to_id, kind, payload):
+        self.p2p_relayed_sends += 1
         return self._p2p_push(to_id,
                               self._p2p_note(to_id, from_id, kind, payload))
 

@@ -139,6 +139,11 @@ def test_full_period_pipeline_cross_process(tmp_path):
             assert record.is_elected is True
             assert record.vote_sigs  # the BLS-signed vote crossed the wire
             assert wait_until(lambda: notary.canonical_set >= 1, timeout=5.0)
+            # de-starred data plane: every directed body response flowed
+            # peer-to-peer over the direct sockets; the chain process
+            # relayed ZERO directed sends
+            stats = chain_ctl.rpc.call("shard_p2pStats")
+            assert stats["relayed_sends"] == 0, stats
         finally:
             notary_node.stop()
             proposer_node.stop()
@@ -148,9 +153,18 @@ def test_full_period_pipeline_cross_process(tmp_path):
         proc.wait(timeout=10)
 
 
+def _hub_identity(seed: bytes):
+    from gethsharding_tpu.mainchain.accounts import AccountManager
+
+    manager = AccountManager()
+    account = manager.new_account(seed=seed)
+    return manager, account.address
+
+
 def test_p2p_handshake_and_peer_table():
-    """Protocol/version/network gate on relay attach (the RLPx handshake +
-    eth status-exchange analog) and the admin_peers-style table."""
+    """Protocol/version/network gate + PROVEN identity on relay attach
+    (the RLPx authenticated-handshake analog, p2p/rlpx.go:178) and the
+    admin_peers-style table."""
     import pytest
 
     from gethsharding_tpu.p2p.remote import RemoteHub
@@ -165,19 +179,24 @@ def test_p2p_handshake_and_peer_table():
     server.start()
     try:
         host, port = server.address
+        manager, address = _hub_identity(b"peer-table")
 
-        # matching network + stated identity -> attached, listed
-        hub = RemoteHub.dial(host, port, network_id=77, account="0xabc")
+        # matching network + proven identity -> attached, listed
+        hub = RemoteHub.dial(host, port, network_id=77,
+                             accounts=manager, account=address)
         p2p = P2PServer(hub=hub)
         p2p.start()
         chain = RemoteMainchain.dial(host, port)
         assert chain.network_id() == 77
         peers = chain.p2p_peers()
-        assert [p["account"] for p in peers] == ["0xabc"]
+        assert [p["account"] for p in peers] == [bytes(address).hex()]
         assert peers[0]["version"] == 1
+        assert peers[0]["endpoint"]  # the direct-listener introduction
 
-        # wrong network -> rejected at attach
-        bad_hub = RemoteHub.dial(host, port, network_id=78)
+        # wrong network -> rejected at attach (before signature checks)
+        mgr2, addr2 = _hub_identity(b"wrong-net")
+        bad_hub = RemoteHub.dial(host, port, network_id=78,
+                                 accounts=mgr2, account=addr2)
         bad_p2p = P2PServer(hub=bad_hub)
         with pytest.raises(Exception, match="network mismatch"):
             bad_p2p.start()
@@ -185,7 +204,6 @@ def test_p2p_handshake_and_peer_table():
 
         # wrong protocol version -> rejected
         worse = RemoteHub.dial(host, port)
-        worse.rpc.call  # connected
         with pytest.raises(Exception, match="version mismatch"):
             worse.rpc.call("shard_p2pAttach", {"protocol": "shardp2p",
                                                "version": 99})
@@ -195,6 +213,140 @@ def test_p2p_handshake_and_peer_table():
         p2p.stop()
         assert chain.p2p_peers() == []
         chain.close()
+    finally:
+        server.stop()
+
+
+def test_unsigned_and_forged_attaches_refused():
+    """The relay's trust model: `account` is proven by a signature over a
+    relay-issued challenge — an unsigned attach, a forged account, and a
+    replayed/absent challenge are all refused."""
+    import pytest
+
+    from gethsharding_tpu.p2p import direct
+    from gethsharding_tpu.p2p.remote import RemoteHub
+    from gethsharding_tpu.p2p.service import P2PServer
+    from gethsharding_tpu.params import Config
+    from gethsharding_tpu.rpc.server import RPCServer
+    from gethsharding_tpu.smc.chain import SimulatedMainchain
+
+    backend = SimulatedMainchain(config=Config(network_id=5))
+    server = RPCServer(backend, port=0)
+    server.start()
+    try:
+        host, port = server.address
+        manager, address = _hub_identity(b"honest")
+        thief_mgr, thief_addr = _hub_identity(b"thief")
+
+        # no identity at all -> the client itself refuses to attach
+        anon = RemoteHub.dial(host, port)
+        with pytest.raises(RuntimeError, match="identity required"):
+            P2PServer(hub=anon).start()
+        anon.close()
+
+        # unsigned attach straight at the wire -> refused by the relay
+        bare = RemoteHub.dial(host, port)
+        with pytest.raises(Exception, match="unsigned attach"):
+            bare.rpc.call("shard_p2pAttach", {
+                "protocol": "shardp2p", "version": 1, "network_id": 5,
+                "account": bytes(address).hex()})
+
+        # forged: thief signs with its own key but claims the honest
+        # account -> signature does not prove the claim
+        challenge = bytes.fromhex(bare.rpc.call("shard_p2pChallenge"))
+        sig = thief_mgr.sign_hash(thief_addr, direct.attach_digest(
+            5, challenge))
+        with pytest.raises(Exception, match="does not prove"):
+            bare.rpc.call("shard_p2pAttach", {
+                "protocol": "shardp2p", "version": 1, "network_id": 5,
+                "account": bytes(address).hex(), "sig": sig.hex()})
+
+        # a correct signature without a FRESH challenge -> refused (the
+        # failed attach above consumed it)
+        sig = manager.sign_hash(address, direct.attach_digest(5, challenge))
+        with pytest.raises(Exception, match="no pending challenge"):
+            bare.rpc.call("shard_p2pAttach", {
+                "protocol": "shardp2p", "version": 1, "network_id": 5,
+                "account": bytes(address).hex(), "sig": sig.hex()})
+        bare.close()
+
+        # the honest flow still works
+        hub = RemoteHub.dial(host, port, accounts=manager, account=address)
+        p2p = P2PServer(hub=hub)
+        p2p.start()
+        p2p.stop()
+    finally:
+        server.stop()
+
+
+def test_directed_messages_flow_peer_to_peer():
+    """De-starred data plane: a directed send crosses a direct socket
+    between the two actor processes' listeners — the relay sees ZERO
+    relayed sends — and a forged direct connection is refused."""
+    import socket
+
+    from gethsharding_tpu.p2p import direct
+    from gethsharding_tpu.p2p.messages import CollationBodyRequest
+    from gethsharding_tpu.p2p.remote import RemoteHub
+    from gethsharding_tpu.p2p.service import P2PServer
+    from gethsharding_tpu.params import Config
+    from gethsharding_tpu.rpc.server import RPCServer
+    from gethsharding_tpu.smc.chain import SimulatedMainchain
+    from gethsharding_tpu.utils.hexbytes import Hash32
+
+    backend = SimulatedMainchain(config=Config(network_id=9))
+    server = RPCServer(backend, port=0)
+    server.start()
+    try:
+        host, port = server.address
+        mgr_a, addr_a = _hub_identity(b"alice")
+        mgr_b, addr_b = _hub_identity(b"bob")
+        hub_a = RemoteHub.dial(host, port, accounts=mgr_a, account=addr_a)
+        hub_b = RemoteHub.dial(host, port, accounts=mgr_b, account=addr_b)
+        a, b = P2PServer(hub=hub_a), P2PServer(hub=hub_b)
+        a.start()
+        b.start()
+        try:
+            sub = b.subscribe(CollationBodyRequest)
+            req = CollationBodyRequest(
+                shard_id=1, period=2, chunk_root=Hash32(b"\x11" * 32),
+                proposer=addr_a)
+            assert a.send(req, b.self_peer) is True
+            msg = sub.get(timeout=5.0)
+            assert msg.data == req
+            assert msg.peer == a.self_peer  # reply routing intact
+            # ...and the relay never carried it
+            assert server.p2p_relayed_sends == 0
+            # reply back over B's own direct connection to A
+            sub_a = a.subscribe(CollationBodyRequest)
+            assert b.send(req, msg.peer) is True
+            assert sub_a.get(timeout=5.0).peer == b.self_peer
+            assert server.p2p_relayed_sends == 0
+
+            # forged direct connection: correct wire protocol, but the
+            # signature can't prove the account the relay has for peer A
+            info = hub_a.peer_info(a.self_peer.peer_id)
+            thief_mgr, thief_addr = _hub_identity(b"mallory")
+            with socket.create_connection(tuple(
+                    hub_b.peer_info(b.self_peer.peer_id)["endpoint"]),
+                    timeout=5.0) as sock:
+                rfile = sock.makefile("rb")
+                wfile = sock.makefile("wb")
+                challenge = bytes.fromhex(
+                    json.loads(rfile.readline())["challenge"])
+                sig = thief_mgr.sign_hash(
+                    thief_addr, direct.direct_digest(9, challenge))
+                wfile.write((json.dumps({
+                    "peer_id": a.self_peer.peer_id,  # claims to be A
+                    "account": bytes(addr_a).hex(),
+                    "sig": sig.hex()}) + "\n").encode())
+                wfile.flush()
+                reply = json.loads(rfile.readline())
+            assert "error" in reply and "prove" in reply["error"]
+            assert info["account"] == bytes(addr_a).hex()
+        finally:
+            a.stop()
+            b.stop()
     finally:
         server.stop()
 
