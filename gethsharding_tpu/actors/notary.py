@@ -41,11 +41,18 @@ class Notary(Service):
                  config: Config = DEFAULT_CONFIG,
                  deposit_flag: bool = False,
                  all_shards: bool = True,
-                 sig_backend: Optional[SigBackend] = None):
+                 sig_backend: Optional[SigBackend] = None,
+                 mirror=None):
         super().__init__()
         self.client = client
         self.shard = shard
         self.p2p = p2p
+        # eth/downloader analog (mainchain/mirror.StateMirror): when set,
+        # the per-head phase-1 scan reads records/watermarks/committee
+        # context from ONE bulk snapshot pull instead of O(shards) client
+        # round trips — the difference between 1 and ~300 RPC calls per
+        # head for a remote (--endpoint) notary
+        self.mirror = mirror
         self.config = config
         self.deposit_flag = deposit_flag
         # notaries watch every shard (the reference scans 0..shardCount)
@@ -117,7 +124,7 @@ class Notary(Service):
 
     def _on_head(self, block) -> None:
         try:
-            self.notarize_collations()
+            self.notarize_collations(head=block.number)
             self.record_success()
         except Exception as exc:
             # a run of consecutive head failures marks the service crashed
@@ -125,10 +132,35 @@ class Notary(Service):
             self.record_failure(
                 f"notarize failed at head {block.number}: {exc}")
 
-    def notarize_collations(self) -> None:
+    def _head_snapshot(self, head: Optional[int]):
+        """The mirror snapshot for this head, refreshed if the mirror has
+        not caught up yet (ONE bulk pull); None = read via the client."""
+        if self.mirror is None:
+            return None
+        if head is None:
+            head = self.client.block_number
+        try:
+            snap = self.mirror.snapshot()
+            if snap is None or (snap["block_number"] or 0) < head:
+                snap = self.mirror.refresh()
+        except Exception:
+            return None  # degraded mirror: fall back to direct reads
+        if snap is None or (snap["block_number"] or 0) < head:
+            return None
+        return snap
+
+    def notarize_collations(self, head: Optional[int] = None) -> None:
         if not self.is_account_in_notary_pool():
             return
-        period = self.client.current_period()
+        snap = self._head_snapshot(head)
+        if snap is not None:
+            period = snap["period"]
+            block_number = snap["block_number"]
+            shard_count = snap["shard_count"]
+        else:
+            period = self.client.current_period()
+            block_number = self.client.block_number
+            shard_count = self.client.shard_count()
         # audit the previous period's aggregate votes once, in one batched
         # device dispatch (the re-architected hot loop; see audit_period)
         if period > 0 and self._last_audited_period < period:
@@ -137,18 +169,29 @@ class Notary(Service):
         # a vote submitted now executes in the PENDING block; if that block
         # already belongs to the next period the SMC will revert with
         # "period is not current" — skip and wait for the new period's head
-        pending_period = (self.client.block_number + 1) // self.config.period_length
+        pending_period = (block_number + 1) // self.config.period_length
         if pending_period != period:
             return
-        shard_ids = (range(self.client.shard_count())
+        shard_ids = (range(shard_count)
                      if self.all_shards else [self.shard.shard_id])
 
         # phase 1: collect every eligible (shard, record) pair this period
+        # — from the snapshot (zero extra round trips) when mirrored
         candidates: List[Tuple[int, int, object]] = []
-        for shard_id in self._eligible_shards(shard_ids):
-            record = self.client.collation_record(shard_id, period)
-            if (record is None
-                    or self.client.last_submitted_collation(shard_id) != period):
+        for shard_id in self._eligible_shards(shard_ids, snap):
+            if snap is not None:
+                from gethsharding_tpu.mainchain.mirror import decode_record
+
+                if snap["last_submitted"].get(shard_id) != period:
+                    continue
+                rec = snap["records"].get(shard_id)
+                record = None if rec is None else decode_record(rec)
+            else:
+                record = self.client.collation_record(shard_id, period)
+                if (record is not None and self.client
+                        .last_submitted_collation(shard_id) != period):
+                    record = None
+            if record is None:
                 continue
             candidates.append((shard_id, period, record))
         if not candidates:
@@ -176,15 +219,23 @@ class Notary(Service):
                 self.submit_vote(shard_id, p, record,
                                  proposer_sig_checked=True)
 
-    def _eligible_shards(self, shard_ids) -> List[int]:
+    def _eligible_shards(self, shard_ids, snap=None) -> List[int]:
         """Committee eligibility for ALL shards from one sampling-context
         view: the reference issues an eth_call per shard per head
         (`notary.go:62`, the network-bound hot loop SURVEY.md §3.1 flags);
-        here the keccak sampling runs locally over the fetched context.
-        Falls back to per-shard calls when the backend lacks the view."""
+        here the keccak sampling runs locally over the fetched context —
+        taken from the mirror snapshot when one is current, so a remote
+        notary spends zero extra round trips on it. Falls back to
+        per-shard calls when the backend lacks the view."""
         from gethsharding_tpu.crypto.keccak import keccak256
 
-        ctx = self.client.committee_context()
+        if snap is not None:
+            from gethsharding_tpu.mainchain.mirror import (
+                decode_committee_context)
+
+            ctx = decode_committee_context(snap["committee_context"])
+        else:
+            ctx = self.client.committee_context()
         me = self.client.account()
         if ctx is None:
             return [s for s in shard_ids
@@ -294,31 +345,38 @@ class Notary(Service):
         Returns True (all consistent), False (mismatch), or None (nothing
         auditable this period).
         """
+        from gethsharding_tpu.rpc import codec
+        from gethsharding_tpu.utils.hexbytes import Hash32
+
+        # ONE bulk pull: records + vote sigs + voter pubkeys, resolved by
+        # the attribution recorded AT VOTE TIME (pool slots can be freed/
+        # reused before the audit runs; registry entries persist until
+        # release). Remote backends serve this in a single round trip
+        # (shard_auditData) instead of O(shards) record reads + O(votes)
+        # registry lookups.
+        data = self.client.audit_data(period)
         shards, msgs, sig_rows, pk_rows = [], [], [], []
         signed_counts, total_counts, expected = [], [], []
-        for shard_id in range(self.client.shard_count()):
-            record = self.client.collation_record(shard_id, period)
-            if record is None or not record.vote_sigs:
-                continue
-            # resolve voter pubkeys by the attribution recorded AT VOTE
-            # TIME (pool slots can be freed/reused before the audit runs;
-            # registry entries persist until release)
-            member_pks = []
-            for vote in record.vote_sigs.values():
-                entry = self.client.notary_registry_of(vote.signer)
-                if entry is None or entry.bls_pubkey is None:
+        for shard_id in sorted(data["shards"]):
+            rec = data["shards"][shard_id]
+            member_pks, sigs = [], []
+            for vote in rec["votes"]:
+                pk = codec.dec_g2(vote["pubkey"])
+                if pk is None:
                     member_pks = None  # released voter: not resolvable
                     break
-                member_pks.append(entry.bls_pubkey)
+                member_pks.append(pk)
+                sigs.append(codec.dec_g1(vote["sig"]))
             if member_pks is None:
                 continue
             shards.append(shard_id)
-            msgs.append(vote_digest(shard_id, period, record.chunk_root))
-            sig_rows.append([v.sig for v in record.vote_sigs.values()])
+            msgs.append(vote_digest(
+                shard_id, period, Hash32(bytes.fromhex(rec["chunk_root"]))))
+            sig_rows.append(sigs)
             pk_rows.append(member_pks)
-            signed_counts.append(len(record.vote_sigs))
-            total_counts.append(record.vote_count)
-            expected.append(bool(record.is_elected))
+            signed_counts.append(len(rec["votes"]))
+            total_counts.append(rec["vote_count"])
+            expected.append(bool(rec["is_elected"]))
         if not shards:
             return None
 

@@ -165,6 +165,16 @@ class SMCClient:
 
         return assemble_snapshot(self)
 
+    def audit_data(self, period: int) -> dict:
+        """Bulk period-audit data (records + vote sigs + voter pubkeys) —
+        one round trip against backends that serve it in bulk."""
+        fn = getattr(self.backend, "audit_data", None)
+        if fn is not None:
+            return fn(period)
+        from gethsharding_tpu.mainchain.mirror import assemble_audit_data
+
+        return assemble_audit_data(self, period)
+
     # -- tx resilience (WaitForTransaction parity) ------------------------
 
     def wait_for_transaction(self, tx_hash: Hash32,

@@ -21,13 +21,51 @@ from __future__ import annotations
 
 import json
 import threading
-from typing import Dict, Optional
+from typing import Dict, NamedTuple, Optional
 
 from gethsharding_tpu.actors.base import Service
 from gethsharding_tpu.mainchain.client import SMCClient
-from gethsharding_tpu.utils.hexbytes import Hash32
+from gethsharding_tpu.utils.hexbytes import Address20, Hash32
 
 _DB_KEY = b"smc-mirror:latest"
+
+
+class MirrorRecord(NamedTuple):
+    """Decoded snapshot record — the read surface of an SMC collation
+    record (chain.py CollationRecord duck-type) the notary hot loop
+    consumes."""
+
+    chunk_root: Hash32
+    proposer: Address20
+    vote_count: int
+    is_elected: bool
+    signature: bytes
+
+
+def decode_record(rec: dict) -> MirrorRecord:
+    return MirrorRecord(
+        chunk_root=Hash32(bytes.fromhex(rec["chunk_root"])),
+        proposer=Address20(bytes.fromhex(rec["proposer"])),
+        vote_count=rec["vote_count"],
+        is_elected=bool(rec["is_elected"]),
+        signature=bytes.fromhex(rec.get("signature", "")),
+    )
+
+
+def decode_committee_context(ctx: Optional[dict]) -> Optional[dict]:
+    """Inverse of `_ctx_jsonable` for the fields the sampling loop reads
+    (blockhash + pool back to raw bytes)."""
+    if ctx is None:
+        return None
+    out = dict(ctx)
+    blockhash = out.get("blockhash")
+    if isinstance(blockhash, str):
+        out["blockhash"] = bytes.fromhex(blockhash)
+    pool = out.get("pool")
+    if pool is not None:
+        out["pool"] = [bytes.fromhex(p) if isinstance(p, str) else p
+                       for p in pool]
+    return out
 
 
 class StateMirror(Service):
@@ -121,6 +159,11 @@ class StateMirror(Service):
             return None
         return snap["records"].get(shard_id)
 
+    def record_view(self, shard_id: int) -> Optional[MirrorRecord]:
+        """`record` decoded to the CollationRecord read surface."""
+        rec = self.record(shard_id)
+        return None if rec is None else decode_record(rec)
+
     @property
     def resumed_from_disk(self) -> bool:
         """True when the snapshot predates this process (warm start)."""
@@ -171,6 +214,7 @@ def assemble_snapshot(source) -> dict:
                     "proposer": bytes(record.proposer).hex(),
                     "vote_count": record.vote_count,
                     "is_elected": bool(record.is_elected),
+                    "signature": bytes(record.signature or b"").hex(),
                 }
     return {
         "block_number": block_number,
@@ -181,6 +225,39 @@ def assemble_snapshot(source) -> dict:
         "last_approved": approved,
         "records": records,
     }
+
+
+def assemble_audit_data(source, period: int) -> dict:
+    """Bulk audit pull: for every shard with a collation record in
+    `period`, the record's vote signatures AND the voters' registered
+    BLS pubkeys (resolved by vote-time attribution), jsonable — ONE
+    round trip for the remote notary's period audit instead of
+    O(shards) record reads + O(votes) registry lookups. Shared by
+    SMCClient's local walk and the `shard_auditData` RPC method."""
+    from gethsharding_tpu.rpc import codec
+
+    shards: Dict[int, dict] = {}
+    for shard_id in range(source.shard_count()):
+        record = source.collation_record(shard_id, period)
+        if record is None or not record.vote_sigs:
+            continue
+        votes = []
+        for index, vote in record.vote_sigs.items():
+            entry = source.notary_registry(vote.signer)
+            pubkey = None if entry is None else entry.bls_pubkey
+            votes.append({
+                "index": index,
+                "signer": bytes(vote.signer).hex(),
+                "sig": codec.enc_g1(vote.sig),
+                "pubkey": codec.enc_g2(pubkey),
+            })
+        shards[shard_id] = {
+            "chunk_root": bytes(record.chunk_root).hex(),
+            "vote_count": record.vote_count,
+            "is_elected": bool(record.is_elected),
+            "votes": votes,
+        }
+    return {"period": period, "shards": shards}
 
 
 def _ctx_jsonable(ctx: Optional[dict]) -> Optional[dict]:

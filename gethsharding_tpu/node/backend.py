@@ -96,6 +96,15 @@ class ShardNode:
         shard = Shard(shard_id=shard_id, shard_db=shard_db.db)
         self.shard = shard
 
+        # the downloader/fetcher analog: a periodic SMC state mirror
+        # giving local reads between heads and warm restart snapshots.
+        # Registered BEFORE the actors (like geth starts eth-sync services
+        # before the miner) so the notary's hot loop can consume it.
+        from gethsharding_tpu.mainchain.mirror import StateMirror
+
+        self._register_factory(
+            lambda: StateMirror(client=client, shard_db=shard_db.db))
+
         if actor == "proposer":
             txpool = TXPool(simulate_interval=txpool_interval)
             self._register(txpool)
@@ -106,7 +115,8 @@ class ShardNode:
             self._register_factory(
                 lambda: Notary(client=client, shard=shard, p2p=p2p,
                                config=config, deposit_flag=deposit,
-                               sig_backend=get_backend(sig_backend)))
+                               sig_backend=get_backend(sig_backend),
+                               mirror=self.service(StateMirror)))
         else:
             self._register_factory(
                 lambda: Observer(client=client, shard=shard,
@@ -122,13 +132,6 @@ class ShardNode:
 
         self._register_factory(
             lambda: Syncer(client=client, shard=shard, p2p=p2p))
-
-        # the downloader/fetcher analog: a periodic SMC state mirror
-        # giving local reads between heads and warm restart snapshots
-        from gethsharding_tpu.mainchain.mirror import StateMirror
-
-        self._register_factory(
-            lambda: StateMirror(client=client, shard_db=shard_db.db))
 
         if http_port is not None:
             # observability endpoint (dashboard/ethstats/expvar analog)

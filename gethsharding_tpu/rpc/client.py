@@ -314,6 +314,12 @@ class RemoteMainchain:
 
         return restore_int_keys(self.rpc.call("shard_mirrorSnapshot"))
 
+    def audit_data(self, period: int) -> dict:
+        """Bulk period-audit data (one round trip; shard keys restored)."""
+        data = self.rpc.call("shard_auditData", period)
+        data["shards"] = {int(k): v for k, v in data["shards"].items()}
+        return data
+
     def chain_config(self, **overrides):
         """Fetch the chain process's protocol constants as a Config.
         `overrides` replace node-local knobs (e.g. windback_depth) that

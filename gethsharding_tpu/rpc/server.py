@@ -69,6 +69,7 @@ class RPCServer:
         self._p2p_ids = 1
         self._p2p_challenges: dict = {}  # wfile -> pending nonce
         self.p2p_relayed_sends = 0  # directed sends that fell back to us
+        self.method_calls: dict = {}  # per-method request counts
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -142,6 +143,8 @@ class RPCServer:
         rid = req.get("id")
         method = req.get("method", "")
         params = req.get("params", [])
+        with self._sub_lock:
+            self.method_calls[method] = self.method_calls.get(method, 0) + 1
         try:
             if method == "shard_subscribe":
                 with self._sub_lock:
@@ -365,6 +368,14 @@ class RPCServer:
     def rpc_networkId(self):
         return self.backend.config.network_id
 
+    def rpc_auditData(self, period):
+        """Bulk period-audit pull (records + vote sigs + voter pubkeys):
+        ONE round trip for what would be O(shards) record reads plus
+        O(votes) registry lookups (mainchain/mirror.assemble_audit_data)."""
+        from gethsharding_tpu.mainchain.mirror import assemble_audit_data
+
+        return assemble_audit_data(self.backend, period)
+
     def rpc_mirrorSnapshot(self):
         """Bulk state-mirror pull: ONE round trip for what would be
         ~3 calls per shard (mainchain/mirror.py)."""
@@ -396,6 +407,12 @@ class RPCServer:
     def rpc_p2pStats(self):
         return {"relayed_sends": self.p2p_relayed_sends,
                 "peers": len(self._p2p_peers)}
+
+    def rpc_methodStats(self):
+        """Per-method request counts (chatter observability: the mirror's
+        O(1)-per-head contract is asserted against these)."""
+        with self._sub_lock:
+            return dict(self.method_calls)
 
     def rpc_p2pSend(self, from_id, to_id, kind, payload):
         self.p2p_relayed_sends += 1

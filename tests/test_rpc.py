@@ -399,3 +399,53 @@ def test_mirror_snapshot_bulk_over_rpc():
         remote.close()
     finally:
         server.stop()
+
+
+def test_remote_notary_hot_loop_is_o1_per_head():
+    """The mirror-backed hot loop: a remote notary's per-head read
+    chatter is ONE bulk mirrorSnapshot pull, not O(shards) record/
+    watermark calls — asserted against the server's per-method counters
+    with a 32-shard config."""
+    from gethsharding_tpu.actors.notary import Notary
+    from gethsharding_tpu.mainchain.mirror import StateMirror
+
+    config = Config(shard_count=32, quorum_size=1)
+    backend = SimulatedMainchain(config=config)
+    server = RPCServer(backend, port=0)
+    server.start()
+    node = None
+    try:
+        remote = RemoteMainchain.dial(*server.address)
+        node = ShardNode(actor="notary", backend=remote, config=config,
+                         deposit=False, txpool_interval=None)
+        backend.fund(node.client.account(), 2000 * ETHER)
+        node.client.register_notary()
+        node.start()
+        notary = node.service(Notary)
+        assert node.service(StateMirror) is notary.mirror
+
+        baseline = dict(server.method_calls)
+        heads = 3 * config.period_length
+        for _ in range(heads):
+            backend.commit()
+        assert wait_until(
+            lambda: (node.service(StateMirror).snapshot() or {}).get(
+                "block_number", 0) >= backend.block_number)
+
+        calls = {m: n - baseline.get(m, 0)
+                 for m, n in server.method_calls.items()}
+        # the O(shards) scan methods never cross the wire per head
+        assert calls.get("shard_collationRecord", 0) == 0, calls
+        assert calls.get("shard_lastSubmittedCollation", 0) == 0, calls
+        assert calls.get("shard_committeeContext", 0) == 0, calls
+        assert calls.get("shard_getNotaryInCommittee", 0) == 0, calls
+        # the bulk pull happens about once per head (head callback +
+        # at most one catch-up refresh from the notary)
+        assert calls.get("shard_mirrorSnapshot", 0) <= 2 * heads + 2, calls
+        # total per-head chatter is O(1): bounded well under shard_count
+        per_head = sum(calls.values()) / heads
+        assert per_head < 8, (per_head, calls)
+    finally:
+        if node is not None:
+            node.stop()
+        server.stop()
