@@ -71,6 +71,12 @@ class SqliteKV(KVStore):
         self._conn = sqlite3.connect(path, check_same_thread=False)
         self._lock = threading.Lock()
         with self._lock:
+            # WAL + NORMAL matches the durability class of the LevelDB
+            # this stands in for (ethdb writes are not fsync-per-put
+            # either); without it every put pays a full journal fsync —
+            # an order of magnitude on spinning/virtual disks
+            self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.execute("PRAGMA synchronous=NORMAL")
             self._conn.execute(
                 "CREATE TABLE IF NOT EXISTS kv (k BLOB PRIMARY KEY, v BLOB NOT NULL)"
             )
