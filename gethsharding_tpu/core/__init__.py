@@ -5,6 +5,7 @@ Parity targets (SURVEY.md §2.1, §2.4): `sharding/collation.go`,
 """
 
 from gethsharding_tpu.core.trie import Trie, EMPTY_ROOT  # noqa: F401
+from gethsharding_tpu.core.trie_db import TrieDatabase, TrieSync  # noqa: F401
 from gethsharding_tpu.core.derive_sha import derive_sha, chunk_root  # noqa: F401
 from gethsharding_tpu.core.types import (  # noqa: F401
     CollationHeader,
