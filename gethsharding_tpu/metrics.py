@@ -202,3 +202,87 @@ class PeriodicReporter:
         while not self._stop.wait(self.interval):
             for name, snap in self.registry.snapshot().items():
                 self.log.info("%s %s", name, snap)
+
+
+class InfluxLineExporter:
+    """Registry snapshots as InfluxDB line protocol (the
+    `metrics/influxdb` exporter analog), pushed on an interval to a
+    file (Telegraf `tail`) or a UDP endpoint (InfluxDB's classic
+    zero-dependency ingestion listener).
+
+    One line per metric: ``<namespace>.<name> f1=v1,f2=v2 <ns-epoch>``
+    with metric path separators normalized and every field emitted as a
+    float (a stable schema: influx rejects type flips per field)."""
+
+    def __init__(self, registry: Registry = DEFAULT_REGISTRY,
+                 interval: float = 10.0, path: Optional[str] = None,
+                 udp: Optional[tuple] = None,
+                 namespace: str = "gethsharding") -> None:
+        if (path is None) == (udp is None):
+            raise ValueError("exactly one sink: path= or udp=(host, port)")
+        self.registry = registry
+        self.interval = interval
+        self.path = path
+        self.udp = udp
+        self.namespace = namespace
+        self.pushes = 0
+        self._sock = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @staticmethod
+    def _escape(name: str) -> str:
+        return (name.replace("/", ".").replace(" ", "_")
+                .replace(",", "_").replace("=", "_"))
+
+    def encode_snapshot(self, timestamp_ns: Optional[int] = None) -> bytes:
+        ts = (time.time_ns() if timestamp_ns is None else timestamp_ns)
+        lines = []
+        for name, snap in self.registry.snapshot().items():
+            fields = ",".join(
+                f"{self._escape(k)}={float(v)}"
+                for k, v in sorted(snap.items())
+                if isinstance(v, (int, float)))
+            if fields:
+                lines.append(
+                    f"{self.namespace}.{self._escape(name)} {fields} {ts}")
+        return ("\n".join(lines) + "\n").encode() if lines else b""
+
+    def push(self) -> None:
+        payload = self.encode_snapshot()
+        if not payload:
+            return
+        if self.path is not None:
+            with open(self.path, "ab") as fh:
+                fh.write(payload)
+        else:
+            import socket
+
+            if self._sock is None:
+                self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            self._sock.sendto(payload, self.udp)
+        self.pushes += 1
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="metrics-influx")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+        try:
+            self.push()  # final flush
+        except OSError:
+            pass
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.push()
+            except OSError:
+                pass  # sink unavailable: keep collecting, retry next tick

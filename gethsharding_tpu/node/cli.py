@@ -67,6 +67,10 @@ def build_parser() -> argparse.ArgumentParser:
                           help="report the metrics registry periodically "
                                "and dump it at exit (metrics.go:22 gate)")
     sharding.add_argument("--metrics-interval", type=float, default=10.0)
+    sharding.add_argument("--metrics-influx", default=None,
+                          help="push line-protocol metrics to HOST:PORT "
+                               "(UDP) or a file path (metrics/influxdb "
+                               "exporter analog)")
     sharding.add_argument("--endpoint", default="",
                           metavar="HOST:PORT",
                           help="dial a running chain process instead of "
@@ -200,6 +204,18 @@ def run_sharding_node(args) -> int:
 
         reporter = PeriodicReporter(interval=args.metrics_interval)
         reporter.start()
+    influx = None
+    if args.metrics_influx:
+        from gethsharding_tpu.metrics import InfluxLineExporter
+
+        host, _, port = args.metrics_influx.rpartition(":")
+        if host and port.isdigit():
+            influx = InfluxLineExporter(interval=args.metrics_interval,
+                                        udp=(host, int(port)))
+        else:
+            influx = InfluxLineExporter(interval=args.metrics_interval,
+                                        path=args.metrics_influx)
+        influx.start()
     profiling = False
     if args.profile:
         try:
@@ -232,6 +248,8 @@ def run_sharding_node(args) -> int:
             jax.profiler.stop_trace()
         if reporter is not None:
             reporter.stop()
+        if influx is not None:
+            influx.stop()
     if args.metrics:
         from gethsharding_tpu.metrics import DEFAULT_REGISTRY
 

@@ -89,3 +89,50 @@ def test_notary_instruments_baseline_metrics():
     assert DEFAULT_REGISTRY.get("notary/aggregate_sig_verifications") is not None
     assert DEFAULT_REGISTRY.get("notary/validate_latency") is not None
     assert notary.m_votes is DEFAULT_REGISTRY.get("notary/votes_submitted")
+
+
+def test_influx_line_exporter_file_and_udp(tmp_path):
+    """metrics/influxdb exporter analog: registry snapshots as line
+    protocol, pushed to a file sink and over UDP."""
+    import socket
+
+    from gethsharding_tpu.metrics import InfluxLineExporter, Registry
+
+    registry = Registry()
+    registry.counter("notary/votes").inc(3)
+    registry.gauge("pool size").set(2.5)
+    with registry.timer("audit/latency").time():
+        pass
+
+    # file sink
+    path = str(tmp_path / "metrics.influx")
+    exporter = InfluxLineExporter(registry=registry, path=path)
+    exporter.push()
+    exporter.push()
+    lines = open(path).read().strip().splitlines()
+    assert len(lines) >= 6  # 3 metrics x 2 pushes
+    sample = [ln for ln in lines if ln.startswith("gethsharding.notary.votes ")]
+    assert sample, lines
+    measurement, fields, ts = sample[0].split(" ")
+    assert measurement == "gethsharding.notary.votes"
+    assert "count=3.0" in fields.split(",")
+    assert int(ts) > 0
+    # names with separators/spaces are escaped, never break the protocol
+    assert any(ln.startswith("gethsharding.pool_size ") for ln in lines)
+
+    # UDP sink
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.bind(("127.0.0.1", 0))
+    sock.settimeout(5.0)
+    udp_exporter = InfluxLineExporter(registry=registry,
+                                      udp=sock.getsockname())
+    udp_exporter.push()
+    payload = sock.recv(65536).decode()
+    assert "gethsharding.audit.latency " in payload
+    udp_exporter.stop()
+    sock.close()
+
+    import pytest
+
+    with pytest.raises(ValueError):
+        InfluxLineExporter(registry=registry)  # no sink
