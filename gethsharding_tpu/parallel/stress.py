@@ -101,7 +101,12 @@ class StressOutputs(NamedTuple):
 
 
 def _step(inp: StressInputs, pool_addr, blockhash, period, sample_size,
-          committee_size: int, quorum_size: int, axis: Optional[str]):
+          committee_size: int, quorum_size: int, axis,
+          axis_sizes: tuple = ()):
+    """`axis`: None (single device) or the mesh axis-name tuple. With a
+    multi-axis mesh (("dcn", "ici")) the slab index linearizes over the
+    axes in order and the tallies psum over all of them — ICI innermost
+    (hierarchical_psum ordering)."""
     s_local, v = inp.att_index.shape
     t = inp.tx_recid.shape[1]
 
@@ -117,8 +122,14 @@ def _step(inp: StressInputs, pool_addr, blockhash, period, sample_size,
     # state routing, with GLOBAL shard ids for the committee sampling
     flat = lambda x: x.reshape((s_local * v,) + x.shape[2:])
     shard_ids = jnp.repeat(jnp.arange(s_local, dtype=jnp.int32), v)
-    base = (jax.lax.axis_index(axis).astype(jnp.int32) * s_local
-            if axis is not None else jnp.int32(0))
+    if axis is not None:
+        device_ix = jnp.int32(0)
+        for name, size in zip(axis, axis_sizes):
+            device_ix = (device_ix * size
+                         + jax.lax.axis_index(name).astype(jnp.int32))
+        base = device_ix * s_local
+    else:
+        base = jnp.int32(0)
     attempts = smc_jax.VoteAttempts(
         shard=shard_ids, index=flat(inp.att_index),
         pool_index=flat(inp.att_pool_index), sender=flat(inp.att_sender),
@@ -153,9 +164,10 @@ def _step(inp: StressInputs, pool_addr, blockhash, period, sample_size,
     total_elected = jnp.sum(state.is_elected.astype(jnp.int32))
     total_txs = jnp.sum(tx_status.astype(jnp.int32))
     if axis is not None:
-        total_votes = jax.lax.psum(total_votes, axis_name=axis)
-        total_elected = jax.lax.psum(total_elected, axis_name=axis)
-        total_txs = jax.lax.psum(total_txs, axis_name=axis)
+        for name in reversed(axis):  # ICI first, then DCN (§5.8)
+            total_votes = jax.lax.psum(total_votes, axis_name=name)
+            total_elected = jax.lax.psum(total_elected, axis_name=name)
+            total_txs = jax.lax.psum(total_txs, axis_name=name)
 
     return StressOutputs(
         accepted=accepted.reshape(s_local, v), vote_count=state.vote_count,
@@ -178,24 +190,30 @@ class StressPipeline:
         self.mesh = mesh
         c, q = config.committee_size, config.quorum_size
 
-        def run_fn(inp, pool_addr, blockhash, period, sample_size, axis):
+        def run_fn(inp, pool_addr, blockhash, period, sample_size, axis,
+                   axis_sizes=()):
             return _step(inp, pool_addr, blockhash, period, sample_size,
-                         c, q, axis)
+                         c, q, axis, axis_sizes)
 
         if mesh is None:
             self._fn = jax.jit(
                 lambda inp, pool, bh, per, ss: run_fn(inp, pool, bh, per,
                                                       ss, None))
         else:
+            # any mesh rank: 1-D ("shard",) and 2-D ("dcn", "ici") alike —
+            # the shard axis splits over ALL mesh axes, tallies reduce
+            # hierarchically
+            axes = tuple(mesh.axis_names)
+            sizes = tuple(mesh.shape[name] for name in axes)
             n_fields = len(StressInputs._fields)
             self._fn = jax.jit(shard_map(
-                lambda inp, pool, bh, per, ss: run_fn(inp, pool, bh, per,
-                                                      ss, "shard"),
+                lambda inp, pool, bh, per, ss: run_fn(
+                    inp, pool, bh, per, ss, axes, sizes),
                 mesh=mesh,
-                in_specs=(StressInputs(*([PS("shard")] * n_fields)),
+                in_specs=(StressInputs(*([PS(axes)] * n_fields)),
                           PS(), PS(), PS(), PS()),
                 out_specs=StressOutputs(
-                    *([PS("shard")] * 6 + [PS()] * 3)),
+                    *([PS(axes)] * 6 + [PS()] * 3)),
             ))
 
     def run(self, inputs: StressInputs, pool_addr, blockhash, period,

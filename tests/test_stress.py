@@ -57,3 +57,24 @@ def test_mesh_matches_single_device_with_padding(workload):
     assert int(single.total_votes) == int(sharded.total_votes)
     assert int(single.total_elected) == int(sharded.total_elected)
     assert int(single.total_txs) == int(sharded.total_txs)
+
+
+def test_hierarchical_mesh_matches_single_device(workload):
+    """The DCN tier: the same stress step over the 2-D ("dcn", "ici")
+    multi-host layout (4 virtual hosts x 2 devices) is bit-identical
+    with the single-device run — sampling sees global shard ids, tallies
+    reduce ICI-first then DCN."""
+    from gethsharding_tpu.parallel.mesh import make_multihost_mesh
+
+    single = _run(None, workload)
+    mesh2 = make_multihost_mesh(n_hosts=4, devices_per_host=2)
+    sharded = _run(mesh2, workload)
+    for name in ("accepted", "vote_count", "is_elected", "agg_ok",
+                 "tx_status", "roots"):
+        a = np.asarray(getattr(single, name))
+        b = np.asarray(getattr(sharded, name))
+        assert a.shape == b.shape, name
+        assert (a == b).all(), name
+    assert int(single.total_votes) == int(sharded.total_votes)
+    assert int(single.total_elected) == int(sharded.total_elected)
+    assert int(single.total_txs) == int(sharded.total_txs)
