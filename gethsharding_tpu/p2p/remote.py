@@ -103,7 +103,8 @@ class RemoteHub:
         self._server = server
         self._listener = direct.PeerListener(
             deliver=self._deliver, resolve=self.peer_info,
-            network_id=self.network_id)
+            network_id=self.network_id, sign=self._sign,
+            account_hex=self.account_hex)
         self._listener.start()
         challenge = bytes.fromhex(self.rpc.call("shard_p2pChallenge"))
         handshake = {
@@ -159,7 +160,8 @@ class RemoteHub:
         if (info is not None and info.get("endpoint")
                 and self._dialer is not None):
             if self._dialer.send(tuple(info["endpoint"]), sender.peer_id,
-                                 kind, payload):
+                                 kind, payload,
+                                 expect_account=info.get("account")):
                 return True
             log.warning("direct send to peer %d failed; relay fallback",
                         target.peer_id)

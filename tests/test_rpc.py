@@ -317,6 +317,10 @@ def test_directed_messages_flow_peer_to_peer():
             assert msg.peer == a.self_peer  # reply routing intact
             # ...and the relay never carried it
             assert server.p2p_relayed_sends == 0
+            # the connection negotiated AEAD frames (ECDH + AES-256-GCM:
+            # the RLPx encrypted-transport parity), not plaintext
+            conn = next(iter(hub_a._dialer._conns.values()))
+            assert conn[3] is not None
             # reply back over B's own direct connection to A
             sub_a = a.subscribe(CollationBodyRequest)
             assert b.send(req, msg.peer) is True
@@ -339,6 +343,7 @@ def test_directed_messages_flow_peer_to_peer():
                 wfile.write((json.dumps({
                     "peer_id": a.self_peer.peer_id,  # claims to be A
                     "account": bytes(addr_a).hex(),
+                    "challenge2": bytes(32).hex(),
                     "sig": sig.hex()}) + "\n").encode())
                 wfile.flush()
                 reply = json.loads(rfile.readline())
