@@ -165,6 +165,12 @@ class SMCClient:
 
         return assemble_snapshot(self)
 
+    @property
+    def reorg_generation(self) -> int:
+        """Proxied so locally-assembled mirror snapshots carry the
+        chain's rollback generation."""
+        return getattr(self.backend, "reorg_generation", 0)
+
     def audit_data(self, period: int) -> dict:
         """Bulk period-audit data (records + vote sigs + voter pubkeys) —
         one round trip against backends that serve it in bulk."""

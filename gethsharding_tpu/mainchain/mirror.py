@@ -118,10 +118,15 @@ class StateMirror(Service):
         with self._lock:
             held = self._snapshot
             if (held is not None
+                    and (snapshot.get("reorg_gen", 0)
+                         <= held.get("reorg_gen", 0))
                     and (held["block_number"] or 0)
                     > (snapshot["block_number"] or 0)):
                 # a concurrent refresh already stored something NEWER
-                # (head callback vs the on_start refresh): never regress
+                # (head callback vs the on_start refresh): never regress —
+                # unless the lower number comes from a LATER reorg
+                # generation (a rolled-back head is genuinely the new
+                # truth, not a stale read)
                 return held
             self._snapshot = snapshot
             self._gen += 1
@@ -220,6 +225,9 @@ def assemble_snapshot(source) -> dict:
         "block_number": block_number,
         "period": period,
         "shard_count": shard_count,
+        # bumps on every chain rollback (smc/chain.py set_head): lets the
+        # regression guard tell a reorg from a racing stale refresh
+        "reorg_gen": getattr(source, "reorg_generation", 0),
         "committee_context": _ctx_jsonable(source.committee_context()),
         "last_submitted": submitted,
         "last_approved": approved,

@@ -343,6 +343,10 @@ class RemoteMainchain:
     def fast_forward(self, periods: int) -> int:
         return self.rpc.call("shard_fastForward", periods)
 
+    def set_head(self, number: int):
+        """Dev-mode chain rollback (smc/chain.py set_head)."""
+        return _dec_block(self.rpc.call("shard_setHead", number))
+
     @staticmethod
     def _receipt(obj: dict) -> RemoteReceipt:
         return RemoteReceipt(tx_hash=Hash32(codec.dec_bytes(obj["txHash"])),

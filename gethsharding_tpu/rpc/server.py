@@ -439,3 +439,7 @@ class RPCServer:
     def rpc_fastForward(self, periods):
         self.backend.fast_forward(periods)
         return self.backend.block_number
+
+    def rpc_setHead(self, number):
+        """Dev-mode rollback (debug_setHead parity)."""
+        return codec.enc_block(self.backend.set_head(number))

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import threading
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Sequence
 
 from gethsharding_tpu.crypto.keccak import keccak256
 from gethsharding_tpu.params import Config, DEFAULT_CONFIG, ETHER
@@ -61,6 +61,16 @@ class SimulatedMainchain:
         # (ops/smc_jax.submit_votes_batch vs the scalar machine): accepted
         # attempts + the sampling context snapshot + end-of-period state
         self._vote_audit: Dict[int, dict] = {}
+        # chain rollback / reorg support (core/blockchain.go SetHead,
+        # reorg): bounded ring of per-block state snapshots; heads beyond
+        # the horizon cannot be rolled back to (the same limitation as a
+        # non-archive geth node's pruned states). reorg_generation bumps
+        # on every head rollback so downstream caches (the state mirror)
+        # can tell a reorg from a racing stale refresh.
+        self.SNAPSHOT_HORIZON = 32
+        self._state_snaps: Dict[int, tuple] = {}
+        self.reorg_generation = 0
+        self._snapshot_state(0)
 
     # -- chain mechanics ---------------------------------------------------
 
@@ -109,10 +119,109 @@ class SimulatedMainchain:
             plen = self.config.period_length
             if (old_pending + 1) // plen > old_pending // plen:
                 self._finalize_vote_audit(old_pending // plen)
+            self._snapshot_state(block.number)
             subscribers = list(self._head_subscribers)
         for callback in subscribers:
             callback(block)
         return block
+
+    # -- rollback / reorg (core/blockchain.go SetHead + reorg) -------------
+
+    def _snapshot_state(self, number: int) -> None:
+        import copy
+
+        fn = self.smc.blockhash_fn
+        self.smc.blockhash_fn = None  # bound method: not copyable state
+        # the audit log grows with chain age: snapshot only the rollback
+        # window's worth (older periods' logs survive a rollback anyway —
+        # a head inside the horizon can't reach them)
+        period_floor = (number // self.config.period_length
+                        - self.SNAPSHOT_HORIZON // self.config.period_length
+                        - 1)
+        audit = {p: v for p, v in self._vote_audit.items()
+                 if p >= period_floor}
+        try:
+            snap = copy.deepcopy((self.smc, self.balances, audit))
+        finally:
+            self.smc.blockhash_fn = fn
+        self._state_snaps[number] = snap
+        stale = number - self.SNAPSHOT_HORIZON
+        if stale in self._state_snaps:
+            del self._state_snaps[stale]
+
+    def set_head(self, number: int) -> Block:
+        """Roll the chain back to `number` (SetHead parity): truncate the
+        header chain, restore that block's state snapshot, notify head
+        subscribers with the new head. Raises for future heads and for
+        heads whose state has been pruned past the snapshot horizon."""
+        import copy
+
+        with self._lock:
+            if not 0 <= number <= self.block_number:
+                raise ValueError(f"set_head({number}): head is "
+                                 f"{self.block_number}")
+            snap = self._state_snaps.get(number)
+            if snap is None:
+                raise ValueError(
+                    f"state for block {number} pruned (horizon "
+                    f"{self.SNAPSHOT_HORIZON})")
+            smc, balances, vote_audit = copy.deepcopy(snap)
+            smc.blockhash_fn = self.blockhash
+            self.smc = smc
+            self.balances = balances
+            # audit logs for periods finalized BEFORE the target head are
+            # identical on both branches — keep them (the snapshot only
+            # carries the rollback window's worth); anything later comes
+            # from the snapshot or is gone with the rolled-back blocks
+            plen = self.config.period_length
+            keep = {p: v for p, v in self._vote_audit.items()
+                    if (p + 1) * plen <= number}
+            keep.update(vote_audit)
+            self._vote_audit = keep
+            del self.blocks[number + 1:]
+            for n in list(self._state_snaps):
+                if n > number:
+                    del self._state_snaps[n]
+            self.reorg_generation += 1
+            head = self.blocks[-1]
+            subscribers = list(self._head_subscribers)
+        for callback in subscribers:
+            callback(head)
+        return head
+
+    def import_chain(self, blocks: Sequence[Block]) -> int:
+        """Import a competing branch (core/blockchain.go:1002 InsertChain
+        + reorg, scoped to the dev chain's empty blocks): the branch must
+        link to a known block; it wins only if strictly longer than the
+        current chain (the dev analog of higher total difficulty — ties
+        keep the incumbent). Returns the number of blocks adopted."""
+        if not blocks:
+            return 0
+        with self._lock:
+            first = blocks[0]
+            attach = first.number - 1
+            if (not 0 <= attach <= self.block_number
+                    or bytes(first.parent_hash)
+                    != bytes(self.blocks[attach].hash)):
+                raise ValueError("branch does not link to a known block")
+            parent = self.blocks[attach]
+            for block in blocks:  # internal linkage + numbering
+                if (block.number != parent.number + 1
+                        or bytes(block.parent_hash) != bytes(parent.hash)):
+                    raise ValueError("broken branch linkage")
+                parent = block
+            if blocks[-1].number <= self.block_number:
+                return 0  # not longer: incumbent chain stays canonical
+        self.set_head(attach)  # rolls state back + bumps the generation
+        with self._lock:
+            self.blocks.extend(blocks)
+            for block in blocks:
+                self._snapshot_state(block.number)
+            head = self.blocks[-1]
+            subscribers = list(self._head_subscribers)
+        for callback in subscribers:
+            callback(head)
+        return len(blocks)
 
     def fast_forward(self, periods: int) -> None:
         """Mine `periods` full periods of blocks (client_helper.go:93)."""

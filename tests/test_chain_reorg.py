@@ -1,0 +1,146 @@
+"""Chain rollback + reorg tests (core/blockchain.go SetHead / reorg
+parity, scoped to the dev chain): state restores to the rolled-back
+head, competing branches win only by length, and the state mirror
+follows a reorg instead of treating it as a stale read."""
+
+import pytest
+
+from gethsharding_tpu.crypto.keccak import keccak256
+from gethsharding_tpu.mainchain.accounts import AccountManager
+from gethsharding_tpu.params import Config, ETHER
+from gethsharding_tpu.smc.chain import Block, SimulatedMainchain
+from gethsharding_tpu.utils.hexbytes import Hash32
+
+
+def _chain(**kw):
+    return SimulatedMainchain(config=Config(shard_count=4, **kw))
+
+
+def _accounts(n):
+    manager = AccountManager()
+    return manager, [manager.new_account(seed=b"reorg-%d" % i)
+                     for i in range(n)]
+
+
+def test_set_head_rolls_back_state_and_notifies():
+    chain = _chain()
+    manager, (a, b) = _accounts(2)
+    chain.fund(a.address, 2000 * ETHER)
+    chain.fund(b.address, 2000 * ETHER)
+
+    chain.register_notary(a.address)
+    for _ in range(4):
+        chain.commit()
+    mark = chain.block_number  # a registered, b not yet
+    balance_mark = chain.balance_of(b.address)
+    chain.register_notary(b.address)
+    for _ in range(4):
+        chain.commit()
+    assert chain.notary_registry(b.address) is not None
+
+    heads = []
+    chain.subscribe_new_head(lambda blk: heads.append(blk.number))
+    head = chain.set_head(mark)
+    assert head.number == mark == chain.block_number
+    assert heads == [mark]  # subscribers saw the rollback head
+    # state restored: b's registration (and its deposit debit) undone
+    assert chain.notary_registry(a.address) is not None
+    assert chain.notary_registry(b.address) is None
+    assert chain.balance_of(b.address) == balance_mark
+    assert chain.current_period() == mark // chain.config.period_length
+    assert chain.reorg_generation == 1
+    # the chain keeps working after a rollback
+    chain.register_notary(b.address)
+    chain.commit()
+    assert chain.notary_registry(b.address) is not None
+
+
+def test_set_head_bounds_and_pruning():
+    chain = _chain()
+    with pytest.raises(ValueError, match="head is"):
+        chain.set_head(5)
+    chain.SNAPSHOT_HORIZON = 4
+    for _ in range(8):
+        chain.commit()
+    with pytest.raises(ValueError, match="pruned"):
+        chain.set_head(1)  # beyond the snapshot horizon
+    chain.set_head(chain.block_number - 2)  # inside: fine
+
+
+def _fork(chain, attach: int, length: int):
+    """A foreign branch of empty blocks linked at `attach`."""
+    parent = chain.block_by_number(attach)
+    out = []
+    for i in range(length):
+        block = Block(number=parent.number + 1,
+                      hash=Hash32(keccak256(b"fork-%d-%d" % (attach, i))),
+                      parent_hash=parent.hash)
+        out.append(block)
+        parent = block
+    return out
+
+
+def test_import_chain_reorg_longest_wins():
+    chain = _chain()
+    manager, (a,) = _accounts(1)
+    chain.fund(a.address, 2000 * ETHER)
+    for _ in range(3):
+        chain.commit()
+    chain.register_notary(a.address)  # executes in pending block 4
+    for _ in range(3):
+        chain.commit()
+    assert chain.block_number == 6
+
+    # an equal-length branch from block 3 loses (incumbent stays)
+    assert chain.import_chain(_fork(chain, 3, 3)) == 0
+    assert chain.notary_registry(a.address) is not None
+
+    # a LONGER branch from block 3 reorgs: the registration (sealed in
+    # block 4 of the old branch) is rolled away
+    branch = _fork(chain, 3, 5)
+    assert chain.import_chain(branch) == 5
+    assert chain.block_number == 8
+    assert bytes(chain.block_by_number(8).hash) == bytes(branch[-1].hash)
+    assert chain.notary_registry(a.address) is None
+    assert chain.reorg_generation >= 1
+
+    # rejected branches: unknown attach point, broken linkage
+    orphan = _fork(chain, 2, 2)
+    orphan[0] = Block(number=3, hash=orphan[0].hash,
+                      parent_hash=Hash32(b"\xee" * 32))
+    with pytest.raises(ValueError, match="link"):
+        chain.import_chain(orphan)
+    broken = _fork(chain, 2, 3)
+    broken[2] = Block(number=9, hash=broken[2].hash,
+                      parent_hash=broken[1].hash)
+    with pytest.raises(ValueError, match="linkage"):
+        chain.import_chain(broken)
+
+
+def test_mirror_follows_reorg():
+    """The state mirror's never-regress guard must accept a LOWER head
+    from a later reorg generation (a rollback is new truth, not a stale
+    racing refresh)."""
+    from gethsharding_tpu.mainchain.client import SMCClient
+    from gethsharding_tpu.mainchain.mirror import StateMirror
+
+    chain = _chain()
+    manager, (a,) = _accounts(1)
+    chain.fund(a.address, 2000 * ETHER)
+    client = SMCClient(backend=chain, accounts=manager, account=a,
+                       config=chain.config)
+    mirror = StateMirror(client=client)
+    mirror.start()
+    try:
+        for _ in range(8):
+            chain.commit()
+        assert mirror.snapshot()["block_number"] == 8
+        chain.set_head(4)  # head event -> mirror refresh
+        snap = mirror.snapshot()
+        assert snap["block_number"] == 4
+        assert snap["reorg_gen"] == 1
+        # ...and the chain keeps advancing from the rolled-back head
+        chain.commit()
+        assert mirror.refresh()["block_number"] == 5
+    finally:
+        mirror.stop()
