@@ -454,3 +454,43 @@ def test_remote_notary_hot_loop_is_o1_per_head():
         if node is not None:
             node.stop()
         server.stop()
+
+
+def test_bootnode_introduction_without_a_chain():
+    """cmd/bootnode parity: a chainless introduction node serves the
+    authenticated peer table and the direct data plane works through it,
+    while every chain/SMC method is refused."""
+    from gethsharding_tpu.p2p.messages import CollationBodyRequest
+    from gethsharding_tpu.p2p.remote import RemoteHub
+    from gethsharding_tpu.p2p.service import P2PServer
+    from gethsharding_tpu.rpc.bootnode import make_bootnode
+    from gethsharding_tpu.utils.hexbytes import Hash32
+
+    server = make_bootnode(network_id=12)
+    server.start()
+    try:
+        host, port = server.address
+        mgr_a, addr_a = _hub_identity(b"boot-a")
+        mgr_b, addr_b = _hub_identity(b"boot-b")
+        hub_a = RemoteHub.dial(host, port, accounts=mgr_a, account=addr_a)
+        hub_b = RemoteHub.dial(host, port, accounts=mgr_b, account=addr_b)
+        a, b = P2PServer(hub=hub_a), P2PServer(hub=hub_b)
+        a.start()
+        b.start()
+        try:
+            assert hub_a.rpc.call("shard_networkId") == 12
+            sub = b.subscribe(CollationBodyRequest)
+            req = CollationBodyRequest(shard_id=0, period=1,
+                                       chunk_root=Hash32(b"\x22" * 32),
+                                       proposer=addr_a)
+            assert a.send(req, b.self_peer) is True
+            assert sub.get(timeout=5.0).data == req
+            assert server.p2p_relayed_sends == 0  # payload went direct
+            # chain methods are refused, not silently faked
+            with pytest.raises(Exception, match="chain process"):
+                hub_a.rpc.call("shard_blockNumber")
+        finally:
+            a.stop()
+            b.stop()
+    finally:
+        server.stop()
