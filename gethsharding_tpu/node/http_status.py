@@ -9,10 +9,13 @@ metrics exporter (`metrics/exp`). One small stdlib HTTP server exposes:
   GET /metrics  -> the metrics registry snapshot (counters/gauges/timers)
   GET /status   -> node identity + chain view (actor, shard, account,
                    period, restart counts)
+  GET /         -> a single-file live dashboard (no build step, no
+                   bundle: inline JS polling the three JSON endpoints)
 
-JSON over plain HTTP so `curl` replaces the embedded React bundle — the
-data surface is the parity target, not the UI. Runs as a Service on the
-node (started/stopped with it).
+JSON over plain HTTP so `curl` works everywhere; the root page is the
+dashboard role itself, self-contained where the reference embeds a
+38.6k-line generated React bundle. Runs as a Service on the node
+(started/stopped with it).
 """
 
 from __future__ import annotations
@@ -82,12 +85,22 @@ class StatusServer(Service):
                 status.log.debug("http %s", fmt % args)
 
             def do_GET(self):
+                path = self.path.split("?")[0]
+                if path == "/":
+                    body = _DASHBOARD_HTML.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/html; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 routes = {
                     "/healthz": status.health_payload,
                     "/metrics": status.metrics_payload,
                     "/status": status.status_payload,
                 }
-                fn = routes.get(self.path.split("?")[0])
+                fn = routes.get(path)
                 if fn is None:
                     self.send_response(404)
                     self.end_headers()
@@ -117,3 +130,47 @@ class StatusServer(Service):
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+
+
+# The dashboard page (dashboard/dashboard.go role): one self-contained
+# HTML file polling /healthz /status /metrics every 2 s. No build step,
+# no dependencies; the data endpoints above remain the API surface.
+_DASHBOARD_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>tpu-sharding node</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:2rem;background:#101418;
+      color:#e6e6e6}
+ h1{font-size:1.2rem} h2{font-size:1rem;margin:1.2rem 0 .4rem}
+ table{border-collapse:collapse;width:100%;max-width:64rem}
+ td,th{border-bottom:1px solid #2a3138;padding:.25rem .6rem;
+       text-align:left;font-size:.85rem}
+ .ok{color:#7bd88f}.bad{color:#ff6b6b}
+ code{color:#9ecbff}
+</style></head><body>
+<h1>tpu-sharding node <span id="health"></span></h1>
+<div>actor <code id="actor"></code> · shard <code id="shard"></code> ·
+ account <code id="account"></code> · block <code id="block"></code> ·
+ period <code id="period"></code></div>
+<h2>Services</h2><table id="services"></table>
+<h2>Metrics</h2><table id="metrics"></table>
+<script>
+async function j(p){const r=await fetch(p);return r.json()}
+function rows(el,entries,fmt){el.innerHTML=entries.map(fmt).join("")}
+async function tick(){
+ try{
+  const[h,s,m]=await Promise.all([j("/healthz"),j("/status"),j("/metrics")]);
+  const ok=h.status==="ok";
+  health.innerHTML=`<span class="${ok?"ok":"bad"}">[${h.status}]</span>`;
+  actor.textContent=s.actor;shard.textContent=s.shard_id;
+  account.textContent=(s.account||"").slice(0,18)+"…";
+  block.textContent=s.block_number;period.textContent=s.period;
+  rows(services,Object.entries(h.services),([n,st])=>
+   `<tr><td>${n}</td><td class="${st==="running"?"ok":"bad"}">${st}</td></tr>`);
+  rows(metrics,Object.entries(m),([n,snap])=>
+   `<tr><td>${n}</td><td>${Object.entries(snap).map(([k,v])=>
+     `${k}=${typeof v==="number"?+v.toPrecision(5):v}`).join(" ")}</td></tr>`);
+ }catch(e){health.innerHTML='<span class="bad">[unreachable]</span>'}
+}
+tick();setInterval(tick,2000);
+</script></body></html>
+"""

@@ -187,3 +187,24 @@ def test_rlpdump_tool(capsys):
     assert '"cat"' in out and '"dog"' in out and "0x0102" in out
     assert run_cli(["rlpdump", "zz-not-hex"]) == 1
     assert run_cli(["rlpdump", "c1"]) == 1  # truncated list payload
+
+
+def test_dashboard_page_served_at_root():
+    """The dashboard role (dashboard/dashboard.go): GET / returns the
+    self-contained live page wired to the three JSON endpoints."""
+    from gethsharding_tpu.node.http_status import StatusServer
+
+    node = ShardNode(actor="observer", backend=SimulatedMainchain(),
+                     txpool_interval=None, http_port=0)
+    node.start()
+    try:
+        port = node.service(StatusServer).port
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/", timeout=5) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/html")
+            page = resp.read().decode()
+        for needle in ("/healthz", "/status", "/metrics", "<script>"):
+            assert needle in page
+    finally:
+        node.stop()
