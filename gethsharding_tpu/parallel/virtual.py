@@ -51,14 +51,32 @@ def default_cache_dir() -> str:
                / f".jax_cache-{host_fingerprint()}")
 
 
-def configure_compile_cache(cache_dir=None, enabled: bool = True) -> None:
+_cache_off_sticky = False
+
+
+def configure_compile_cache(cache_dir=None, enabled: bool = True,
+                            force: bool = False) -> None:
     """Point JAX's persistent compile cache at the host-keyed dir — the
     ONE definition shared by tests/dryrun (`force_virtual_cpu_devices`)
     and `bench.py`, so they can never drift onto different caches.
-    `enabled=False` turns the cache off through the same seam (used by
-    multi-file pytest runs, where XLA's executable (de)serialization
-    segfaults after ~150 live programs)."""
+
+    `enabled=False` turns the cache off through the same seam AND makes
+    the off-state STICKY: later default-enables (e.g. a test invoking
+    `force_virtual_cpu_devices` mid-suite — the r3 full-suite segfault:
+    the dryrun re-enabled the cache and a later cache READ crashed in
+    XLA's executable deserializer) are ignored unless `force=True`.
+    Multi-file pytest runs rely on this staying off for the whole
+    process lifetime."""
+    global _cache_off_sticky
+
     import jax
+
+    if not enabled:
+        _cache_off_sticky = True
+    elif _cache_off_sticky and not force:
+        return  # a multi-file run pinned the cache off: stay off
+    elif force:
+        _cache_off_sticky = False
 
     try:
         jax.config.update("jax_compilation_cache_dir",

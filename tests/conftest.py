@@ -18,12 +18,18 @@ from gethsharding_tpu.parallel.virtual import force_virtual_cpu_devices
 
 force_virtual_cpu_devices(8)
 
-# The persistent compile cache is DISABLED for MULTI-file pytest runs:
-# XLA:CPU deterministically segfaults (de)serializing one of the big
-# mesh executables once the process holds ~150 compiled programs
-# (observed r2 on a 1-core host, both on cache write and on read of an
-# entry this same host wrote). Short-lived processes are safe, so
-# single-file invocations keep the cache automatically (decided at
+# The persistent compile cache is DISABLED (stickily) for MULTI-file
+# pytest runs: XLA:CPU deterministically segfaults DESERIALIZING a large
+# cached executable once the process holds many compiled programs.
+# Pinpointed r3 (faulthandler): the crash is inside
+# jax/_src/compilation_cache.py:get_executable_and_time — a cache READ
+# of an entry this same host wrote and that loads fine in a short-lived
+# process (run_suite.sh runs the exact same file green) — i.e. an
+# XLA-side deserializer bug triggered by executable-count pressure, not
+# by our programs. The off-state must be STICKY because tests that call
+# force_virtual_cpu_devices (the dryrun) would otherwise re-enable the
+# cache mid-suite — exactly how the r3 repro crashed at test_replay.
+# Single-file invocations keep the cache automatically (decided at
 # collection time below), GETHSHARDING_CACHE_WRITES=1 forces it on, and
 # `scripts/run_suite.sh` runs the complete suite one process per file —
 # full cache speedup, identical coverage, no crash.
@@ -61,5 +67,6 @@ def pytest_collection_modifyitems(config, items):
     if len(modules) == 1:
         # a single-module run is a short-lived process — the safe case;
         # re-enable the cache (nothing has compiled yet at collection
-        # time, so the config change takes full effect)
-        configure_compile_cache()
+        # time, so the config change takes full effect). force=True
+        # overrides the sticky off-state set at import above.
+        configure_compile_cache(force=True)
