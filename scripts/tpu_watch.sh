@@ -13,9 +13,14 @@ while true; do
   ts=$(date +%F\ %T)
   if [ -n "$plat" ] && [ "$plat" != "cpu" ]; then
     echo "$ts tunnel UP ($plat) - running bench sweep" >>"$LOG"
+    # the TPU window is precious: pause CPU-hogging suite runs so the
+    # sweep's compiles and probes aren't starved on the 1-core host
+    pids=$(pgrep -f "pytest tests/" || true)
+    [ -n "$pids" ] && kill -STOP $pids 2>/dev/null
     out=".tpu_results/bench_$(date +%s)"
     timeout 7200 python bench.py >"$out.json" 2>"$out.log"
     rc=$?
+    [ -n "$pids" ] && kill -CONT $pids 2>/dev/null
     tail -c 400 "$out.json" >>"$LOG"
     if [ $rc -eq 0 ] && grep -q '"platform": "tpu' "$out.json"; then
       echo "$ts CAPTURED TPU BENCH -> $out.json" >>"$LOG"
