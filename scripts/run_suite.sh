@@ -1,18 +1,17 @@
 #!/bin/bash
-# The canonical full-suite run: one short-lived pytest process per test
-# file, each with the host-keyed persistent compile cache enabled.
+# Maximally isolated full-suite run: one short-lived pytest process per
+# test file, each with the host-keyed persistent compile cache enabled.
 #
-# Why not one big `pytest tests/`? XLA:CPU deterministically segfaults
-# (de)serializing one of the large mesh executables once a process holds
-# ~150 compiled programs (see tests/conftest.py) — and without the cache
-# a monolithic run pays every heavyweight kernel compile cold. Per-file
-# processes sidestep the crash AND keep the cache speedup. Coverage is
-# identical; a failing file fails the script.
+# Since r3 a plain one-process `pytest tests/` is ALSO green (conftest
+# bounds XLA:CPU's executable-count pressure with jax.clear_caches()
+# per module — the root cause of the old segfault); this script remains
+# as the fully isolated equivalent (one crash cannot take out the whole
+# run). Coverage is identical; a failing file fails the script.
 set -u
 cd "$(dirname "$0")/.."
 fail=0
 for f in tests/test_*.py; do
     echo "== $f"
-    GETHSHARDING_CACHE_WRITES=1 python -m pytest "$f" -q --no-header || fail=1
+    python -m pytest "$f" -q --no-header || fail=1
 done
 exit $fail
