@@ -221,6 +221,8 @@ class TrieSync:
         if root == EMPTY_ROOT:
             return []
         out: List[bytes] = []
+        seen = {bytes(root)}  # dedup: a node can have several parents,
+        # and double-fetching it would double-incref its children
         queue = [bytes(root)]
         while queue and len(out) < limit:
             node_hash = queue.pop(0)
@@ -228,7 +230,10 @@ class TrieSync:
             if blob is None:
                 out.append(node_hash)
                 continue
-            queue.extend(_child_hashes(rlp_decode(blob)))
+            for child in _child_hashes(rlp_decode(blob)):
+                if child not in seen:
+                    seen.add(child)
+                    queue.append(child)
         return out
 
     def run(self, root: bytes, fetch: Callable[[bytes], Optional[bytes]],

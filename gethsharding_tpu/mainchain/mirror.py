@@ -117,17 +117,19 @@ class StateMirror(Service):
         snapshot = self.client.mirror_snapshot()
         with self._lock:
             held = self._snapshot
-            if (held is not None
-                    and (snapshot.get("reorg_gen", 0)
-                         <= held.get("reorg_gen", 0))
-                    and (held["block_number"] or 0)
-                    > (snapshot["block_number"] or 0)):
-                # a concurrent refresh already stored something NEWER
-                # (head callback vs the on_start refresh): never regress —
-                # unless the lower number comes from a LATER reorg
-                # generation (a rolled-back head is genuinely the new
-                # truth, not a stale read)
-                return held
+            if held is not None:
+                held_gen = held.get("reorg_gen", 0)
+                new_gen = snapshot.get("reorg_gen", 0)
+                # ordering is (reorg generation, block number): a stale
+                # refresh from BEFORE a rollback must never overwrite the
+                # post-reorg truth regardless of its higher block number,
+                # and within one generation the head never regresses
+                # (the head-callback vs on_start refresh race)
+                if new_gen < held_gen or (
+                        new_gen == held_gen
+                        and (held["block_number"] or 0)
+                        > (snapshot["block_number"] or 0)):
+                    return held
             self._snapshot = snapshot
             self._gen += 1
             gen = self._gen

@@ -60,9 +60,17 @@ log = logging.getLogger("p2p.direct")
 HANDSHAKE_TIMEOUT = 10.0
 
 try:  # AEAD frames need the host's cryptography package; gate, don't require
+    from cryptography.exceptions import InvalidTag
     from cryptography.hazmat.primitives.ciphers.aead import AESGCM
 except Exception:  # pragma: no cover - AEAD-less host
     AESGCM = None
+
+    class InvalidTag(Exception):  # noqa: N818 - mirror cryptography's name
+        pass
+
+
+SEND_TIMEOUT = 30.0  # post-handshake socket timeout: a peer that stops
+# draining must stall only its own connection, never the caller forever
 
 
 def attach_digest(network_id: int, challenge: bytes) -> bytes:
@@ -219,13 +227,17 @@ class PeerListener:
             challenge2 = bytes.fromhex(hello["challenge2"])
             dialer_eph = bytes.fromhex(hello.get("eph_pub", ""))
             encrypt = bool(eph_pub) and bool(dialer_eph)
-            listener_eph = eph_pub if encrypt else b""
-            d_eph = dialer_eph if encrypt else b""
 
+            # downgrade protection: each side's digests use the keys it
+            # SENT for its own slot and the keys it RECEIVED for the
+            # peer's — so a middle man stripping either eph_pub breaks
+            # one of the two signatures instead of silently forcing
+            # plaintext (the dialer's sig commits to the listener key it
+            # saw; our sig2 commits to the key we actually offered)
             err = self._verify(peer_id, account, sig, challenge,
-                               d_eph, listener_eph)
+                               dialer_eph, eph_pub)
             sig2 = self.sign(accept_digest(
-                self.network_id, challenge2, d_eph, listener_eph))
+                self.network_id, challenge2, dialer_eph, eph_pub))
             reply = ({"ok": True, "account": self.account_hex,
                       "sig2": sig2.hex()}
                      if err is None else {"error": err})
@@ -239,7 +251,7 @@ class PeerListener:
             if err is not None:
                 log.warning("refused direct peer %s: %s", account, err)
                 return
-        except (OSError, ValueError, KeyError, TypeError,
+        except (OSError, ValueError, KeyError, TypeError, InvalidTag,
                 json.JSONDecodeError):
             return
         handler.connection.settimeout(None)
@@ -259,7 +271,8 @@ class PeerListener:
                 frame = json.loads(raw)
                 data = codec.dec_p2p(frame["type"], frame["payload"])
                 self.deliver(Message(peer=Peer(peer_id), data=data))
-        except (OSError, ValueError, KeyError, json.JSONDecodeError):
+        except (OSError, ValueError, KeyError, InvalidTag,
+                json.JSONDecodeError):
             log.debug("direct peer %d connection ended", peer_id)
 
     def _verify(self, peer_id: int, account: str, sig: bytes,
@@ -314,11 +327,13 @@ class DirectDialer:
             conn = self._get(tuple(endpoint), self_peer_id, expect_account)
             if conn is None:
                 return False
-            sock, _, wfile, channel = conn
+            sock, _, wfile, channel, wlock = conn
             try:
                 wire = (channel.seal(frame) if channel is not None
                         else frame + b"\n")
-                with self._lock:
+                # per-connection lock: one hung peer must never wedge
+                # sends to every other peer
+                with wlock:
                     wfile.write(wire)
                     wfile.flush()
                 return True
@@ -343,10 +358,13 @@ class DirectDialer:
             encrypt = AESGCM is not None and bool(listener_eph)
             eph_priv, eph_pub = (_ephemeral_keypair() if encrypt
                                  else (None, b""))
-            l_eph = listener_eph if encrypt else b""
             challenge2 = secrets.token_bytes(32)
+            # downgrade protection: sign over OUR sent key (possibly
+            # empty) and the listener key AS RECEIVED — a stripped
+            # greeting makes the listener's verification fail, a
+            # stripped hello makes our sig2 check below fail
             sig = self.sign(direct_digest(
-                self.network_id, challenge, eph_pub, l_eph))
+                self.network_id, challenge, eph_pub, listener_eph))
             hello = {"peer_id": self_peer_id, "account": self.account_hex,
                      "sig": sig.hex(), "challenge2": challenge2.hex()}
             if eph_pub:
@@ -368,11 +386,12 @@ class DirectDialer:
                 sock.close()
                 return None
             # mutual authentication: the listener must prove the account
-            # the relay's table advertises for this endpoint
+            # the relay's table advertises for this endpoint, committing
+            # to the same (sent, received) ephemeral-key view
             sig2 = bytes.fromhex(reply.get("sig2", ""))
             listed = reply.get("account", "")
             digest2 = accept_digest(self.network_id, challenge2,
-                                    eph_pub, l_eph)
+                                    eph_pub, listener_eph)
             if not prove(digest2, sig2, listed) or (
                     expect_account is not None
                     and listed.lower().removeprefix("0x")
@@ -381,12 +400,22 @@ class DirectDialer:
                             endpoint)
                 sock.close()
                 return None
-            sock.settimeout(None)
-        except (OSError, ValueError, KeyError, json.JSONDecodeError) as exc:
+            sock.settimeout(SEND_TIMEOUT)
+        except (OSError, ValueError, KeyError, InvalidTag,
+                json.JSONDecodeError) as exc:
             log.debug("direct dial to %s failed: %s", endpoint, exc)
             return None
-        conn = (sock, rfile, wfile, send)
+        conn = (sock, rfile, wfile, send, threading.Lock())
         with self._lock:
+            existing = self._conns.get(endpoint)
+            if existing is not None:
+                # a racing first-send finished its handshake before us:
+                # keep theirs, close ours (no leaked socket/handler)
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return existing
             self._conns[endpoint] = conn
         return conn
 

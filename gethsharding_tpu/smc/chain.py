@@ -149,40 +149,44 @@ class SimulatedMainchain:
         if stale in self._state_snaps:
             del self._state_snaps[stale]
 
+    def _rollback_locked(self, number: int) -> None:
+        """Restore block `number`'s state + truncate (lock held)."""
+        import copy
+
+        if not 0 <= number <= self.block_number:
+            raise ValueError(f"set_head({number}): head is "
+                             f"{self.block_number}")
+        snap = self._state_snaps.get(number)
+        if snap is None:
+            raise ValueError(
+                f"state for block {number} pruned (horizon "
+                f"{self.SNAPSHOT_HORIZON})")
+        smc, balances, vote_audit = copy.deepcopy(snap)
+        smc.blockhash_fn = self.blockhash
+        self.smc = smc
+        self.balances = balances
+        # audit logs for periods finalized BEFORE the target head are
+        # identical on both branches — keep them (the snapshot only
+        # carries the rollback window's worth); anything later comes
+        # from the snapshot or is gone with the rolled-back blocks
+        plen = self.config.period_length
+        keep = {p: v for p, v in self._vote_audit.items()
+                if (p + 1) * plen <= number}
+        keep.update(vote_audit)
+        self._vote_audit = keep
+        del self.blocks[number + 1:]
+        for n in list(self._state_snaps):
+            if n > number:
+                del self._state_snaps[n]
+        self.reorg_generation += 1
+
     def set_head(self, number: int) -> Block:
         """Roll the chain back to `number` (SetHead parity): truncate the
         header chain, restore that block's state snapshot, notify head
         subscribers with the new head. Raises for future heads and for
         heads whose state has been pruned past the snapshot horizon."""
-        import copy
-
         with self._lock:
-            if not 0 <= number <= self.block_number:
-                raise ValueError(f"set_head({number}): head is "
-                                 f"{self.block_number}")
-            snap = self._state_snaps.get(number)
-            if snap is None:
-                raise ValueError(
-                    f"state for block {number} pruned (horizon "
-                    f"{self.SNAPSHOT_HORIZON})")
-            smc, balances, vote_audit = copy.deepcopy(snap)
-            smc.blockhash_fn = self.blockhash
-            self.smc = smc
-            self.balances = balances
-            # audit logs for periods finalized BEFORE the target head are
-            # identical on both branches — keep them (the snapshot only
-            # carries the rollback window's worth); anything later comes
-            # from the snapshot or is gone with the rolled-back blocks
-            plen = self.config.period_length
-            keep = {p: v for p, v in self._vote_audit.items()
-                    if (p + 1) * plen <= number}
-            keep.update(vote_audit)
-            self._vote_audit = keep
-            del self.blocks[number + 1:]
-            for n in list(self._state_snaps):
-                if n > number:
-                    del self._state_snaps[n]
-            self.reorg_generation += 1
+            self._rollback_locked(number)
             head = self.blocks[-1]
             subscribers = list(self._head_subscribers)
         for callback in subscribers:
@@ -194,7 +198,10 @@ class SimulatedMainchain:
         + reorg, scoped to the dev chain's empty blocks): the branch must
         link to a known block; it wins only if strictly longer than the
         current chain (the dev analog of higher total difficulty — ties
-        keep the incumbent). Returns the number of blocks adopted."""
+        keep the incumbent). Validation, rollback and adoption happen
+        under ONE lock hold, so a concurrent commit() can neither
+        interleave a block into the adopted branch nor invalidate the
+        longest-wins decision. Returns the number of blocks adopted."""
         if not blocks:
             return 0
         with self._lock:
@@ -212,8 +219,7 @@ class SimulatedMainchain:
                 parent = block
             if blocks[-1].number <= self.block_number:
                 return 0  # not longer: incumbent chain stays canonical
-        self.set_head(attach)  # rolls state back + bumps the generation
-        with self._lock:
+            self._rollback_locked(attach)
             self.blocks.extend(blocks)
             for block in blocks:
                 self._snapshot_state(block.number)

@@ -144,3 +144,32 @@ def test_mirror_follows_reorg():
         assert mirror.refresh()["block_number"] == 5
     finally:
         mirror.stop()
+
+
+def test_mirror_rejects_stale_pre_reorg_snapshot():
+    """The race the reorg generation exists for: a refresh assembled
+    BEFORE a rollback (older generation, higher block number) lands
+    late — it must NOT overwrite the post-reorg truth."""
+    from gethsharding_tpu.mainchain.client import SMCClient
+    from gethsharding_tpu.mainchain.mirror import StateMirror
+
+    chain = _chain()
+    manager, (a,) = _accounts(1)
+    client = SMCClient(backend=chain, accounts=manager, account=a,
+                       config=chain.config)
+    mirror = StateMirror(client=client)
+    for _ in range(8):
+        chain.commit()
+    stale = mirror.refresh()  # gen 0, block 8
+    assert (stale["reorg_gen"], stale["block_number"]) == (0, 8)
+    chain.set_head(4)
+    fresh = mirror.refresh()  # gen 1, block 4
+    assert (fresh["reorg_gen"], fresh["block_number"]) == (1, 4)
+
+    real_pull = client.mirror_snapshot
+    client.mirror_snapshot = lambda: dict(stale)  # the late delivery
+    try:
+        assert mirror.refresh() is fresh  # held; stale gen rejected
+    finally:
+        client.mirror_snapshot = real_pull
+    assert mirror.snapshot()["reorg_gen"] == 1
