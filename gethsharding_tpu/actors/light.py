@@ -1,0 +1,164 @@
+"""Light client: SMC-anchored on-demand chunk retrieval with proofs.
+
+The `les/` + `light/` role (ODR — on-demand retrieval, `les/odr.go`,
+`light/odr.go`) mapped to the sharding domain: a light client holds NO
+shard data. Its root of trust is the SMC (exactly as the reference's
+light client trusts the header chain): it reads the canonical
+(shard, period) chunk root from the contract, then samples body bytes
+from peers over shardp2p — each response carries a merkle proof that is
+verified locally against the anchored root (`trie/proof.go
+VerifyProof`), so a lying peer cannot forge content and a peer that
+cannot prove availability of sampled indices fails the check.
+
+This is also the data-availability-sampling intent behind the 32-byte
+chunk design (SURVEY.md §5.7): `availability_check` samples K
+pseudorandom indices; all proofs verifying == the body is available at
+those points without downloading it.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Sequence
+
+from gethsharding_tpu import metrics
+from gethsharding_tpu.actors.base import Service
+from gethsharding_tpu.core.derive_sha import verify_chunk
+from gethsharding_tpu.core.trie import EMPTY_ROOT
+from gethsharding_tpu.crypto.keccak import keccak256
+from gethsharding_tpu.mainchain.client import SMCClient
+from gethsharding_tpu.p2p.messages import ChunkProofRequest, ChunkProofResponse
+from gethsharding_tpu.p2p.service import P2PServer
+from gethsharding_tpu.utils.hexbytes import Hash32
+
+
+class LightClient(Service):
+    """Proof-verified byte sampling against SMC-anchored chunk roots."""
+
+    name = "light"
+    supervisable = True
+
+    def __init__(self, client: SMCClient, p2p: P2PServer):
+        super().__init__()
+        self.client = client
+        self.p2p = p2p
+        self.samples_verified = 0
+        self.proofs_rejected = 0
+        self._sub = None
+        self._len_claims: Dict[bytes, int] = {}
+        self.m_sample_latency = metrics.timer("light/sample_latency")
+
+    def on_start(self) -> None:
+        self._sub = self.p2p.subscribe(ChunkProofResponse)
+
+    def on_stop(self) -> None:
+        if self._sub is not None:
+            self._sub.unsubscribe()
+
+    # -- anchoring ---------------------------------------------------------
+
+    def canonical_chunk_root(self, shard_id: int,
+                             period: int) -> Optional[Hash32]:
+        """The root of trust: the SMC's collation record for the pair."""
+        record = self.client.collation_record(shard_id, period)
+        return None if record is None else record.chunk_root
+
+    # -- sampling ----------------------------------------------------------
+
+    def sample(self, shard_id: int, period: int, indices: Sequence[int],
+               timeout: float = 5.0) -> Dict[int, Optional[int]]:
+        """Retrieve + verify body bytes at `indices` from peers.
+
+        Broadcasts one ChunkProofRequest per index, collects responses,
+        and verifies every proof against the SMC-anchored chunk root.
+        Returns an entry per RESOLVED index: the proven byte value, or
+        None for a PROVEN absence (index outside the body). Missing
+        entries = no peer answered in time. A response with an invalid
+        proof is counted, logged and discarded — never returned."""
+        root = self.canonical_chunk_root(shard_id, period)
+        if root is None:
+            raise ValueError(
+                f"no canonical collation for shard {shard_id} "
+                f"period {period}")
+        if self._sub is None:
+            raise RuntimeError("light client is not started")
+        pending = set(indices)
+        for index in sorted(pending):
+            self.p2p.broadcast(ChunkProofRequest(
+                chunk_root=root, shard_id=shard_id, period=period,
+                index=index))
+        out: Dict[int, Optional[int]] = {}
+        deadline = time.monotonic() + timeout
+        with self.m_sample_latency.time():
+            while pending and time.monotonic() < deadline:
+                msg = self._sub.try_get()
+                if msg is None:
+                    if self.wait(0.01):
+                        break
+                    continue
+                response: ChunkProofResponse = msg.data
+                if (bytes(response.chunk_root) != bytes(root)
+                        or response.index not in pending):
+                    continue
+                try:
+                    value = verify_chunk(bytes(root), response.index,
+                                         response.proof)
+                except ValueError as exc:
+                    self.proofs_rejected += 1
+                    self.record_error(
+                        f"peer {msg.peer.peer_id} sent an invalid proof "
+                        f"for index {response.index}: {exc}")
+                    continue
+                out[response.index] = value
+                self._len_claims[bytes(root)] = response.body_len
+                pending.discard(response.index)
+                self.samples_verified += 1
+        return out
+
+    def proven_length(self, shard_id: int, period: int,
+                      timeout: float = 5.0) -> Optional[int]:
+        """PROVE the body length: take a peer's length claim L, then
+        verify a presence proof at L-1 and an absence proof at L. A
+        lying claim fails one of the two. None = could not prove
+        (no peers, or dishonest claims)."""
+        root = self.canonical_chunk_root(shard_id, period)
+        if root is None:
+            return None
+        if bytes(root) == EMPTY_ROOT:
+            return 0  # the empty body's DeriveSha root
+        first = self.sample(shard_id, period, [0], timeout=timeout)
+        if first.get(0) is None:  # unanswered, or 'absent' for index 0
+            return None
+        claim = self._len_claims.get(bytes(root))
+        if not claim or claim <= 0:
+            return None
+        boundary = self.sample(shard_id, period, [claim - 1, claim],
+                               timeout=timeout)
+        present = boundary.get(claim - 1)
+        if (present is not None and claim in boundary
+                and boundary[claim] is None):
+            return claim
+        return None
+
+    def availability_check(self, shard_id: int, period: int, k: int = 16,
+                           timeout: float = 5.0, seed: bytes = b"") -> bool:
+        """Data-availability sampling (the intent of the 32-byte chunk
+        design): prove the body length, then sample K pseudorandom
+        in-range indices derived from the root (deterministic given
+        `seed` — auditable, like the committee sampling rule). True iff
+        the length is proven and EVERY sampled index verifies."""
+        length = self.proven_length(shard_id, period, timeout=timeout)
+        if length is None:
+            return False
+        if length == 0:
+            return True  # empty body: trivially available
+        root = self.canonical_chunk_root(shard_id, period)
+        digest = keccak256(bytes(root) + seed)
+        indices, counter = set(), 0
+        while len(indices) < min(k, length) and counter < 8 * k:
+            digest = keccak256(digest + counter.to_bytes(4, "big"))
+            indices.add(int.from_bytes(digest[:4], "big") % length)
+            counter += 1
+        got = self.sample(shard_id, period, sorted(indices),
+                          timeout=timeout)
+        return all(got.get(i) is not None for i in indices)

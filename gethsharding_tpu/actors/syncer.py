@@ -17,7 +17,9 @@ from gethsharding_tpu.actors.base import Service
 from gethsharding_tpu.core.shard import Shard, ShardError
 from gethsharding_tpu.core.types import CollationHeader
 from gethsharding_tpu.mainchain.client import SMCClient
-from gethsharding_tpu.p2p.messages import CollationBodyRequest, CollationBodyResponse
+from gethsharding_tpu.p2p.messages import (
+    ChunkProofRequest, ChunkProofResponse, CollationBodyRequest,
+    CollationBodyResponse)
 from gethsharding_tpu.p2p.service import Message, P2PServer
 
 
@@ -48,17 +50,21 @@ class Syncer(Service):
         self.poll_interval = poll_interval
         self.responses_sent = 0
         self.bodies_stored = 0
+        self.proofs_served = 0
         self._req_sub = None
         self._resp_sub = None
+        self._proof_sub = None
 
     def on_start(self) -> None:
         self._req_sub = self.p2p.subscribe(CollationBodyRequest)
         self._resp_sub = self.p2p.subscribe(CollationBodyResponse)
+        self._proof_sub = self.p2p.subscribe(ChunkProofRequest)
         self.spawn(self._handle_requests, name="syncer-requests")
         self.spawn(self._handle_responses, name="syncer-responses")
+        self.spawn(self._handle_proof_requests, name="syncer-proofs")
 
     def on_stop(self) -> None:
-        for sub in (self._req_sub, self._resp_sub):
+        for sub in (self._req_sub, self._resp_sub, self._proof_sub):
             if sub is not None:
                 sub.unsubscribe()
 
@@ -106,6 +112,41 @@ class Syncer(Service):
         )
         self.p2p.send(response, msg.peer)
         self.responses_sent += 1
+
+    # -- on-demand chunk proofs (the les/light ODR serving side) -----------
+
+    def _handle_proof_requests(self) -> None:
+        while not self.stopped():
+            msg = self._proof_sub.try_get()
+            if msg is None:
+                if self.wait(self.poll_interval):
+                    return
+                continue
+            try:
+                self.respond_chunk_proof(msg)
+            except Exception as exc:
+                self.record_error(f"could not construct proof: {exc}")
+
+    def respond_chunk_proof(self, msg: Message) -> None:
+        """Serve a merkle proof for one body byte under its chunk root —
+        what an les/light server's ODR handler does for trie nodes
+        (`les/odr_requests.go` role). The per-body proof trie is
+        LRU-cached in core/derive_sha, so a light client sampling many
+        indices of one root builds it once."""
+        from gethsharding_tpu.core.derive_sha import chunk_proof
+
+        request: ChunkProofRequest = msg.data
+        try:
+            body = self.shard.body_by_chunk_root(request.chunk_root)
+        except ShardError:
+            return  # we don't have the body; another peer may
+        if request.index < 0:
+            return
+        self.p2p.send(ChunkProofResponse(
+            chunk_root=request.chunk_root, index=request.index,
+            proof=tuple(chunk_proof(body, request.index)),
+            body_len=len(body)), msg.peer)
+        self.proofs_served += 1
 
     # -- response side -----------------------------------------------------
 

@@ -64,3 +64,60 @@ def poc_root(body: bytes, salt: bytes) -> bytes:
             out.append(b)
         salted = bytes(out)
     return chunk_root(bytes(salted))
+
+
+# -- on-demand chunk proofs (the les/light ODR building block) -------------
+
+_PROOF_TRIE_CACHE: "OrderedDict" = None  # built lazily
+
+
+def _body_trie(body: bytes):
+    """The per-byte DeriveSha trie for a body, LRU-cached by content
+    hash: a light client samples MANY indices of the SAME root, so the
+    (potentially 1 MiB = 2^20-entry) trie builds once per body."""
+    global _PROOF_TRIE_CACHE
+    from collections import OrderedDict
+
+    from gethsharding_tpu.core.trie import Trie
+    from gethsharding_tpu.crypto.keccak import keccak256
+
+    if _PROOF_TRIE_CACHE is None:
+        _PROOF_TRIE_CACHE = OrderedDict()
+    key = keccak256(body)
+    cached = _PROOF_TRIE_CACHE.get(key)
+    if cached is not None:
+        _PROOF_TRIE_CACHE.move_to_end(key)
+        return cached
+    trie = Trie()
+    for index, byte in enumerate(body):
+        trie.update(rlp_encode(int_to_big_endian(index)),
+                    rlp_encode(int(byte)))
+    _PROOF_TRIE_CACHE[key] = trie
+    while len(_PROOF_TRIE_CACHE) > 4:
+        _PROOF_TRIE_CACHE.popitem(last=False)
+    return trie
+
+
+def chunk_proof(body: bytes, index: int) -> list:
+    """Merkle proof for byte `index` of `body` under its chunk root
+    (`trie/proof.go Prove` over the DeriveSha trie). Indices >= len
+    yield a proof of ABSENCE — how a light client pins the body
+    length without downloading the body."""
+    if index < 0:
+        raise ValueError(f"negative index {index}")
+    return _body_trie(body).prove(rlp_encode(int_to_big_endian(index)))
+
+
+def verify_chunk(root: bytes, index: int, proof):
+    """Check a chunk proof against an SMC-anchored chunk root; returns
+    the proven byte value, or None for a PROVEN absence (index outside
+    the body). Raises ValueError on an invalid proof
+    (`trie/proof.go VerifyProof`)."""
+    from gethsharding_tpu.core.trie import verify_proof
+    from gethsharding_tpu.utils.rlp import big_endian_to_int, rlp_decode
+
+    value = verify_proof(bytes(root), rlp_encode(int_to_big_endian(index)),
+                         list(proof))
+    if value is None:
+        return None
+    return big_endian_to_int(rlp_decode(value))

@@ -34,7 +34,7 @@ S = TypeVar("S")
 class ShardNode:
     """One sharding node: an actor plus its support services."""
 
-    ACTORS = ("notary", "proposer", "observer")
+    ACTORS = ("notary", "proposer", "observer", "light")
 
     def __init__(self, actor: str = "observer", shard_id: int = 0,
                  config: Config = DEFAULT_CONFIG,
@@ -117,21 +117,29 @@ class ShardNode:
                                config=config, deposit_flag=deposit,
                                sig_backend=get_backend(sig_backend),
                                mirror=self.service(StateMirror)))
+        elif actor == "light":
+            # the les/light role: no shard data, SMC-anchored proof-
+            # verified sampling over shardp2p (actors/light.py)
+            from gethsharding_tpu.actors.light import LightClient
+
+            self._register_factory(
+                lambda: LightClient(client=client, p2p=p2p))
         else:
             self._register_factory(
                 lambda: Observer(client=client, shard=shard,
                                  replay_engine=("jax" if sig_backend == "jax"
                                                 else "python")))
 
-        if actor != "notary":
+        if actor not in ("notary", "light"):
             # non-notary nodes run the simulator (backend.go:303)
             self._register_factory(
                 lambda: Simulator(client=client, p2p=p2p,
                                   shard_id=shard_id,
                                   tick_interval=simulator_interval))
 
-        self._register_factory(
-            lambda: Syncer(client=client, shard=shard, p2p=p2p))
+        if actor != "light":  # light nodes hold no bodies to serve
+            self._register_factory(
+                lambda: Syncer(client=client, shard=shard, p2p=p2p))
 
         if http_port is not None:
             # observability endpoint (dashboard/ethstats/expvar analog)

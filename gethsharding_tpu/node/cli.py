@@ -1,7 +1,7 @@
 """`tpu-sharding sharding` — the CLI entry point.
 
 Parity: `cmd/geth/shardingcmd.go` (+ flags `cmd/utils/flags.go:536-549`):
-`sharding --actor {notary,proposer,observer} --shardid N --deposit
+`sharding --actor {notary,proposer,observer,light} --shardid N --deposit
 --datadir PATH`. Additional dev-mode flags run an in-process simulated
 mainchain with automatic block production, so a single command demonstrates
 the full period pipeline (the reference needs a separate geth process).
@@ -31,7 +31,7 @@ def build_parser() -> argparse.ArgumentParser:
         "sharding", help="run a sharding actor node"
     )
     sharding.add_argument("--actor", default="observer",
-                          choices=("notary", "proposer", "observer"),
+                          choices=("notary", "proposer", "observer", "light"),
                           help="what role to run (flags.go:542 ActorFlag)")
     sharding.add_argument("--shardid", type=int, default=0,
                           help="shard to operate on (flags.go:546)")

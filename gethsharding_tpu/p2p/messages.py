@@ -26,3 +26,28 @@ class CollationBodyRequest:
 class CollationBodyResponse:
     header_hash: Hash32
     body: bytes
+
+
+@dataclass(frozen=True)
+class ChunkProofRequest:
+    """On-demand-retrieval request (the les/light ODR analog): prove
+    body byte `index` against a collation's chunk root."""
+
+    chunk_root: Hash32
+    shard_id: int
+    period: int
+    index: int
+
+
+@dataclass(frozen=True)
+class ChunkProofResponse:
+    """Merkle proof for one body byte in the per-byte DeriveSha trie;
+    `proof` is the root-to-leaf node-blob list (`trie/proof.go` shape).
+    Out-of-range indices get a proof of ABSENCE. `body_len` is the
+    serving peer's length claim — a light client PROVES it by checking
+    a presence proof at body_len-1 and an absence proof at body_len."""
+
+    chunk_root: Hash32
+    index: int
+    proof: tuple  # tuple[bytes, ...]
+    body_len: int = 0

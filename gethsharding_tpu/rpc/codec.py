@@ -127,6 +127,20 @@ def enc_p2p(data) -> tuple:
             "headerHash": enc_bytes(data.header_hash),
             "body": enc_bytes(data.body),
         }
+    if isinstance(data, m.ChunkProofRequest):
+        return "ChunkProofRequest", {
+            "chunkRoot": enc_bytes(data.chunk_root),
+            "shardId": data.shard_id,
+            "period": data.period,
+            "index": data.index,
+        }
+    if isinstance(data, m.ChunkProofResponse):
+        return "ChunkProofResponse", {
+            "chunkRoot": enc_bytes(data.chunk_root),
+            "index": data.index,
+            "proof": [enc_bytes(node) for node in data.proof],
+            "bodyLen": data.body_len,
+        }
     raise TypeError(f"no p2p wire codec for {type(data).__name__}")
 
 
@@ -147,6 +161,20 @@ def dec_p2p(kind: str, payload: dict):
         return m.CollationBodyResponse(
             header_hash=Hash32(dec_bytes(payload["headerHash"])),
             body=dec_bytes(payload["body"]),
+        )
+    if kind == "ChunkProofRequest":
+        return m.ChunkProofRequest(
+            chunk_root=Hash32(dec_bytes(payload["chunkRoot"])),
+            shard_id=payload["shardId"],
+            period=payload["period"],
+            index=payload["index"],
+        )
+    if kind == "ChunkProofResponse":
+        return m.ChunkProofResponse(
+            chunk_root=Hash32(dec_bytes(payload["chunkRoot"])),
+            index=payload["index"],
+            proof=tuple(dec_bytes(node) for node in payload["proof"]),
+            body_len=payload.get("bodyLen", 0),
         )
     raise ValueError(f"unknown p2p message type {kind!r}")
 

@@ -1,0 +1,182 @@
+"""Light-client / ODR tests (the les + light role): proof-verified byte
+sampling against SMC-anchored chunk roots over shardp2p, proven body
+lengths via boundary absence proofs, forged proofs rejected."""
+
+import dataclasses
+
+import pytest
+
+from gethsharding_tpu.actors.light import LightClient
+from gethsharding_tpu.actors.syncer import Syncer
+from gethsharding_tpu.core.derive_sha import chunk_proof, chunk_root, verify_chunk
+from gethsharding_tpu.core.shard import Shard
+from gethsharding_tpu.core.types import Collation, CollationHeader
+from gethsharding_tpu.db.kv import MemoryKV
+from gethsharding_tpu.mainchain.client import SMCClient
+from gethsharding_tpu.p2p.messages import ChunkProofRequest, ChunkProofResponse
+from gethsharding_tpu.p2p.service import Hub, P2PServer
+from gethsharding_tpu.params import Config, ETHER
+from gethsharding_tpu.smc.chain import SimulatedMainchain
+from gethsharding_tpu.utils.hexbytes import Hash32
+
+BODY = bytes(range(256)) * 3 + b"tail-of-the-collation"
+
+
+def test_chunk_proof_round_trip_and_absence():
+    root = chunk_root(BODY)
+    for index in (0, 1, 127, len(BODY) - 1):
+        value = verify_chunk(root, index, chunk_proof(BODY, index))
+        assert value == BODY[index], index
+    # absence proof at the boundary pins the length
+    assert verify_chunk(root, len(BODY), chunk_proof(BODY, len(BODY))) is None
+    # a tampered proof raises, never returns a value
+    proof = chunk_proof(BODY, 5)
+    bad = [b"\x00" + proof[0][1:]] + proof[1:]
+    with pytest.raises(ValueError):
+        verify_chunk(root, 5, bad)
+
+
+def _light_setup():
+    """One full node (syncer holding a canonical body) + one light node
+    on a shared in-process hub, both anchored on the same chain."""
+    config = Config(shard_count=4, quorum_size=1)
+    chain = SimulatedMainchain(config=config)
+    hub = Hub()
+
+    full_p2p = P2PServer(hub=hub)
+    full_client = SMCClient(backend=chain, config=config)
+    chain.fund(full_client.account(), 2000 * ETHER)
+    shard = Shard(shard_id=2, shard_db=MemoryKV())
+    collation = Collation(
+        header=CollationHeader(shard_id=2, period=1), body=BODY)
+    root = Hash32(collation.calculate_chunk_root())
+    shard.save_collation(collation)
+    syncer = Syncer(client=full_client, shard=shard, p2p=full_p2p,
+                    poll_interval=0.01)
+
+    chain.fast_forward(1)
+    chain.add_header(full_client.account(), 2, 1, root)
+
+    light_p2p = P2PServer(hub=hub)
+    light = LightClient(client=SMCClient(backend=chain, config=config),
+                        p2p=light_p2p)
+    return chain, syncer, light, root
+
+
+def test_light_client_samples_and_proves_length():
+    chain, syncer, light, root = _light_setup()
+    syncer.p2p.start()
+    light.p2p.start()
+    syncer.start()
+    light.start()
+    try:
+        assert bytes(light.canonical_chunk_root(2, 1)) == bytes(root)
+        got = light.sample(2, 1, [0, 7, 100, len(BODY) - 1], timeout=5.0)
+        assert got == {0: BODY[0], 7: BODY[7], 100: BODY[100],
+                       len(BODY) - 1: BODY[-1]}
+        assert light.samples_verified >= 4
+        assert syncer.proofs_served >= 4
+
+        # the length is PROVEN, not trusted
+        assert light.proven_length(2, 1, timeout=5.0) == len(BODY)
+
+        # full availability sampling
+        assert light.availability_check(2, 1, k=8, timeout=5.0) is True
+        assert light.proofs_rejected == 0
+    finally:
+        light.stop()
+        syncer.stop()
+        light.p2p.stop()
+        syncer.p2p.stop()
+
+
+def test_light_client_rejects_forged_proofs():
+    """A lying server cannot make the light client accept wrong bytes:
+    proofs for a DIFFERENT body fail against the anchored root."""
+    chain, syncer, light, root = _light_setup()
+    fake = b"forged body that the SMC never anchored"
+
+    class LyingServer:
+        def __init__(self, p2p):
+            self.p2p = p2p
+            self.sub = p2p.subscribe(ChunkProofRequest)
+
+        def answer(self):
+            msg = self.sub.get(timeout=5.0)
+            request = msg.data
+            self.p2p.send(ChunkProofResponse(
+                chunk_root=request.chunk_root, index=request.index,
+                proof=tuple(chunk_proof(fake, request.index)),
+                body_len=len(fake)), msg.peer)
+
+    liar_p2p = P2PServer(hub=light.p2p.hub)
+    liar_p2p.start()
+    light.p2p.start()
+    liar = LyingServer(liar_p2p)
+    light.start()
+    try:
+        import threading
+
+        answering = threading.Thread(target=liar.answer, daemon=True)
+        answering.start()
+        got = light.sample(2, 1, [3], timeout=2.0)
+        answering.join(timeout=5.0)
+        assert got == {}  # nothing verified
+        assert light.proofs_rejected >= 1
+        assert light.availability_check(2, 1, k=4, timeout=1.0) is False
+    finally:
+        light.stop()
+        light.p2p.stop()
+        liar_p2p.stop()
+
+
+def test_light_client_empty_body_is_trivially_available():
+    config = Config(shard_count=4, quorum_size=1)
+    chain = SimulatedMainchain(config=config)
+    client = SMCClient(backend=chain, config=config)
+    chain.fund(client.account(), 2000 * ETHER)
+    chain.fast_forward(1)
+    empty_root = Hash32(chunk_root(b""))
+    chain.add_header(client.account(), 1, 1, empty_root)
+    light = LightClient(client=client, p2p=P2PServer(hub=Hub()))
+    light.start()
+    try:
+        assert light.proven_length(1, 1) == 0
+        assert light.availability_check(1, 1) is True
+    finally:
+        light.stop()
+
+
+def test_light_node_end_to_end_over_node_containers():
+    """`--actor light` as a ShardNode: a full observer node (syncer owns
+    the body) and a LIGHT node sharing a hub; the light node verifies
+    availability of the canonical collation without holding any shard
+    data."""
+    from gethsharding_tpu.node.backend import ShardNode
+
+    config = Config(shard_count=4, quorum_size=1)
+    chain = SimulatedMainchain(config=config)
+    hub = Hub()
+    full = ShardNode(actor="observer", shard_id=2, config=config,
+                     backend=chain, hub=hub, txpool_interval=None)
+    light_node = ShardNode(actor="light", shard_id=2, config=config,
+                           backend=chain, hub=hub, txpool_interval=None)
+    full.start()
+    light_node.start()
+    try:
+        body = b"node-level light client drive " * 9
+        collation = Collation(
+            header=CollationHeader(shard_id=2, period=1), body=body)
+        root = Hash32(collation.calculate_chunk_root())
+        full.shard.save_collation(collation)
+        chain.fast_forward(1)
+        chain.add_header(full.client.account(), 2, 1, root)
+
+        light = light_node.service(LightClient)
+        assert light.proven_length(2, 1, timeout=5.0) == len(body)
+        assert light.availability_check(2, 1, k=6, timeout=5.0) is True
+        got = light.sample(2, 1, [11], timeout=5.0)
+        assert got == {11: body[11]}
+    finally:
+        light_node.stop()
+        full.stop()
