@@ -45,7 +45,6 @@ class LightClient(Service):
         self.samples_verified = 0
         self.proofs_rejected = 0
         self._sub = None
-        self._len_claims: Dict[bytes, int] = {}
         self.m_sample_latency = metrics.timer("light/sample_latency")
 
     def on_start(self) -> None:
@@ -82,12 +81,20 @@ class LightClient(Service):
                 f"period {period}")
         if self._sub is None:
             raise RuntimeError("light client is not started")
+        out, _ = self._sample(root, shard_id, period, indices, timeout)
+        return out
+
+    def _sample(self, root: Hash32, shard_id: int, period: int,
+                indices: Sequence[int], timeout: float):
+        """Request + verify against an already-resolved root; returns
+        (resolved dict, last verified responder's body-length claim)."""
         pending = set(indices)
         for index in sorted(pending):
             self.p2p.broadcast(ChunkProofRequest(
                 chunk_root=root, shard_id=shard_id, period=period,
                 index=index))
         out: Dict[int, Optional[int]] = {}
+        len_claim: Optional[int] = None
         deadline = time.monotonic() + timeout
         with self.m_sample_latency.time():
             while pending and time.monotonic() < deadline:
@@ -110,10 +117,10 @@ class LightClient(Service):
                         f"for index {response.index}: {exc}")
                     continue
                 out[response.index] = value
-                self._len_claims[bytes(root)] = response.body_len
+                len_claim = response.body_len
                 pending.discard(response.index)
                 self.samples_verified += 1
-        return out
+        return out, len_claim
 
     def proven_length(self, shard_id: int, period: int,
                       timeout: float = 5.0) -> Optional[int]:
@@ -124,41 +131,52 @@ class LightClient(Service):
         root = self.canonical_chunk_root(shard_id, period)
         if root is None:
             return None
+        return self._proven_length(root, shard_id, period, timeout)
+
+    def _proven_length(self, root: Hash32, shard_id: int, period: int,
+                       timeout: float) -> Optional[int]:
         if bytes(root) == EMPTY_ROOT:
             return 0  # the empty body's DeriveSha root
-        first = self.sample(shard_id, period, [0], timeout=timeout)
+        first, claim = self._sample(root, shard_id, period, [0], timeout)
         if first.get(0) is None:  # unanswered, or 'absent' for index 0
             return None
-        claim = self._len_claims.get(bytes(root))
         if not claim or claim <= 0:
             return None
-        boundary = self.sample(shard_id, period, [claim - 1, claim],
-                               timeout=timeout)
-        present = boundary.get(claim - 1)
-        if (present is not None and claim in boundary
+        boundary, _ = self._sample(root, shard_id, period,
+                                   [claim - 1, claim], timeout)
+        if (boundary.get(claim - 1) is not None and claim in boundary
                 and boundary[claim] is None):
             return claim
         return None
 
     def availability_check(self, shard_id: int, period: int, k: int = 16,
-                           timeout: float = 5.0, seed: bytes = b"") -> bool:
+                           timeout: float = 5.0,
+                           seed: Optional[bytes] = None) -> bool:
         """Data-availability sampling (the intent of the 32-byte chunk
         design): prove the body length, then sample K pseudorandom
-        in-range indices derived from the root (deterministic given
-        `seed` — auditable, like the committee sampling rule). True iff
+        in-range indices. `seed` defaults to a FRESH random value — a
+        withholding peer must not be able to precompute which indices
+        every checker will ask for (DAS soundness); pass an explicit
+        seed only for auditable replay of a specific check. True iff
         the length is proven and EVERY sampled index verifies."""
-        length = self.proven_length(shard_id, period, timeout=timeout)
+        import secrets
+
+        root = self.canonical_chunk_root(shard_id, period)
+        if root is None:
+            return False
+        length = self._proven_length(root, shard_id, period, timeout)
         if length is None:
             return False
         if length == 0:
             return True  # empty body: trivially available
-        root = self.canonical_chunk_root(shard_id, period)
+        if seed is None:
+            seed = secrets.token_bytes(32)
         digest = keccak256(bytes(root) + seed)
         indices, counter = set(), 0
         while len(indices) < min(k, length) and counter < 8 * k:
             digest = keccak256(digest + counter.to_bytes(4, "big"))
             indices.add(int.from_bytes(digest[:4], "big") % length)
             counter += 1
-        got = self.sample(shard_id, period, sorted(indices),
-                          timeout=timeout)
+        got, _ = self._sample(root, shard_id, period, sorted(indices),
+                              timeout)
         return all(got.get(i) is not None for i in indices)

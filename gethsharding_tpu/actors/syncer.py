@@ -41,6 +41,13 @@ class Syncer(Service):
     name = "syncer"
     supervisable = True
 
+    # chunk proofs are served from a Python-built per-body trie; an
+    # UNTRUSTED request stream cycling distinct large roots could pin
+    # the proof thread rebuilding O(body) tries (cache thrash DoS), so
+    # proof serving is capped — light clients needing bigger bodies use
+    # the full CollationBodyRequest path instead
+    PROOF_BODY_CAP = 1 << 16
+
     def __init__(self, client: SMCClient, shard: Shard, p2p: P2PServer,
                  poll_interval: float = 0.05):
         super().__init__()
@@ -140,7 +147,7 @@ class Syncer(Service):
             body = self.shard.body_by_chunk_root(request.chunk_root)
         except ShardError:
             return  # we don't have the body; another peer may
-        if request.index < 0:
+        if request.index < 0 or len(body) > self.PROOF_BODY_CAP:
             return
         self.p2p.send(ChunkProofResponse(
             chunk_root=request.chunk_root, index=request.index,

@@ -68,33 +68,36 @@ def poc_root(body: bytes, salt: bytes) -> bytes:
 
 # -- on-demand chunk proofs (the les/light ODR building block) -------------
 
-_PROOF_TRIE_CACHE: "OrderedDict" = None  # built lazily
+from collections import OrderedDict as _OrderedDict
+from threading import Lock as _Lock
+
+_PROOF_TRIE_CACHE: "_OrderedDict" = _OrderedDict()
+_PROOF_TRIE_LOCK = _Lock()  # serving threads of several nodes share this
 
 
 def _body_trie(body: bytes):
     """The per-byte DeriveSha trie for a body, LRU-cached by content
     hash: a light client samples MANY indices of the SAME root, so the
-    (potentially 1 MiB = 2^20-entry) trie builds once per body."""
-    global _PROOF_TRIE_CACHE
-    from collections import OrderedDict
-
+    trie builds once per body. Callers that serve UNTRUSTED requests
+    must bound body size (Syncer.PROOF_BODY_CAP) — a Python trie build
+    is O(len(body)) and the LRU can be thrashed across roots."""
     from gethsharding_tpu.core.trie import Trie
     from gethsharding_tpu.crypto.keccak import keccak256
 
-    if _PROOF_TRIE_CACHE is None:
-        _PROOF_TRIE_CACHE = OrderedDict()
     key = keccak256(body)
-    cached = _PROOF_TRIE_CACHE.get(key)
-    if cached is not None:
-        _PROOF_TRIE_CACHE.move_to_end(key)
-        return cached
+    with _PROOF_TRIE_LOCK:
+        cached = _PROOF_TRIE_CACHE.get(key)
+        if cached is not None:
+            _PROOF_TRIE_CACHE.move_to_end(key)
+            return cached
     trie = Trie()
     for index, byte in enumerate(body):
         trie.update(rlp_encode(int_to_big_endian(index)),
                     rlp_encode(int(byte)))
-    _PROOF_TRIE_CACHE[key] = trie
-    while len(_PROOF_TRIE_CACHE) > 4:
-        _PROOF_TRIE_CACHE.popitem(last=False)
+    with _PROOF_TRIE_LOCK:
+        _PROOF_TRIE_CACHE[key] = trie
+        while len(_PROOF_TRIE_CACHE) > 4:
+            _PROOF_TRIE_CACHE.popitem(last=False)
     return trie
 
 

@@ -180,3 +180,23 @@ def test_light_node_end_to_end_over_node_containers():
     finally:
         light_node.stop()
         full.stop()
+
+
+def test_proof_serving_capped_for_untrusted_large_bodies():
+    """DoS guard: proofs are refused above Syncer.PROOF_BODY_CAP (the
+    full-body path still serves such collations)."""
+    chain, syncer, light, root = _light_setup()
+    syncer.p2p.start()
+    light.p2p.start()
+    syncer.start()
+    light.start()
+    try:
+        syncer.PROOF_BODY_CAP = len(BODY) - 1  # force the refusal path
+        got = light.sample(2, 1, [0], timeout=0.5)
+        assert got == {}
+        assert syncer.proofs_served == 0
+    finally:
+        light.stop()
+        syncer.stop()
+        light.p2p.stop()
+        syncer.p2p.stop()
