@@ -952,11 +952,35 @@ def _proj_add_impl(x1, y1, z1, x2, y2, z2, mul_many, add, sub, mul_b3):
     return sub(p1, p2), add(p3, p4), add(p5, p6)
 
 
+_MUL_MANY_COMBS: dict = {}
+
+
+def _mul_many_comb(n: int) -> np.ndarray:
+    """Identity combine (n,1,1,n,1): n independent Fp products through
+    the fused pair-conv kernel in ONE call."""
+    comb = _MUL_MANY_COMBS.get(n)
+    if comb is None:
+        comb = np.zeros((n, 1, 1, n, 1), np.int32)
+        for i in range(n):
+            comb[i, 0, 0, i, 0] = 1
+        _MUL_MANY_COMBS[n] = comb
+    return comb
+
+
 def _g1_proj_add(p1, p2):
     def mul_many(pairs):
         xs = jnp.stack([a for a, _ in pairs], axis=-2)
         ys = jnp.stack([b for _, b in pairs], axis=-2)
-        out = FP.mul(xs, ys)
+        if _use_pallas_conv():
+            # the G1 aggregation tree is the committee pipeline's
+            # bandwidth hot spot: its stacked products ride the fused
+            # kernel too (identity combine), one normalize for all n
+            acc = _pair_conv_combine(xs[..., :, None, :],
+                                     ys[..., :, None, :],
+                                     _mul_many_comb(len(pairs)))
+            out = FP.normalize(acc[..., 0, :])
+        else:
+            out = FP.mul(xs, ys)
         return [out[..., i, :] for i in range(len(pairs))]
 
     return _proj_add_impl(*p1, *p2, mul_many=mul_many, add=FP.add,

@@ -93,3 +93,25 @@ def test_pair_conv_combine_partial_block_and_leading_dims():
         jnp.asarray(x), jnp.asarray(y), k._COMB, interpret=True))
     assert want.shape == got.shape
     assert (want == got).all()
+
+
+def test_pair_conv_combine_identity_comb_mul_many():
+    """The identity combine (n independent products in one kernel call)
+    matches n separate schoolbook products bit-for-bit — the G1
+    aggregation tree's mul_many shape."""
+    from gethsharding_tpu.ops import bn256_jax as k
+    from gethsharding_tpu.ops.pallas_conv import pair_conv_combine
+
+    rng = np.random.default_rng(31)
+    n = 6
+    x = rng.integers(0, 1 << 12, (7, n, 1, limb.NLIMBS)).astype(np.int32)
+    y = rng.integers(0, 1 << 12, (7, n, 1, limb.NLIMBS)).astype(np.int32)
+    comb = k._mul_many_comb(n)
+    want = np.asarray(_xla_pair_conv(jnp.asarray(x), jnp.asarray(y), comb))
+    got = np.asarray(pair_conv_combine(
+        jnp.asarray(x), jnp.asarray(y), comb, interpret=True))
+    assert (want == got).all()
+    # and each lane equals the plain schoolbook product columns
+    single = np.asarray(limb.conv_cols(
+        jnp.asarray(x[:, :, 0, :, None] * y[:, :, 0, None, :])))
+    assert (np.asarray(got)[..., 0, :] == single).all()
