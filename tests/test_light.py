@@ -2,8 +2,6 @@
 sampling against SMC-anchored chunk roots over shardp2p, proven body
 lengths via boundary absence proofs, forged proofs rejected."""
 
-import dataclasses
-
 import pytest
 
 from gethsharding_tpu.actors.light import LightClient
