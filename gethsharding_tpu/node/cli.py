@@ -108,6 +108,18 @@ def build_parser() -> argparse.ArgumentParser:
     key.add_argument("--verbosity", default="warning",
                      choices=("debug", "info", "warning", "error"))
 
+    faucet = sub.add_parser(
+        "faucet", help="drip dev-chain funds to an address "
+                       "(the cmd/faucet analog)")
+    faucet.add_argument("--host", default="127.0.0.1")
+    faucet.add_argument("--port", type=int, required=True,
+                        help="chain process RPC port")
+    faucet.add_argument("--address", required=True)
+    faucet.add_argument("--amount", type=float, default=1000.0,
+                        help="ETH to drip (default 1000)")
+    faucet.add_argument("--verbosity", default="warning",
+                        choices=("debug", "info", "warning", "error"))
+
     rlp = sub.add_parser("rlpdump",
                          help="pretty-print an RLP blob (rlpdump analog)")
     rlp.add_argument("data", help="hex string, or - for stdin")
@@ -139,6 +151,10 @@ def run_cli(argv: Optional[List[str]] = None) -> int:
         from gethsharding_tpu.tools import run_rlpdump
 
         return run_rlpdump(args)
+    if args.command == "faucet":
+        from gethsharding_tpu.tools import run_faucet
+
+        return run_faucet(args)
     return 2
 
 

@@ -110,3 +110,26 @@ def _print_item(item, depth: int) -> None:
     for sub in item:
         _print_item(sub, depth + 1)
     print(f"{pad}]")
+
+
+def run_faucet(args) -> int:
+    """`faucet`: drip dev-chain funds to an address (the cmd/faucet
+    role, scoped to the dev chain's fund surface instead of a web UI)."""
+    from gethsharding_tpu.params import ETHER
+    from gethsharding_tpu.rpc.client import RemoteMainchain
+    from gethsharding_tpu.utils.hexbytes import Address20
+
+    try:
+        raw = bytes.fromhex(args.address.removeprefix("0x"))
+        address = Address20(raw)
+    except (ValueError, TypeError):
+        print(f"invalid address {args.address!r}", file=sys.stderr)
+        return 1
+    chain = RemoteMainchain.dial(args.host, args.port)
+    try:
+        chain.fund(address, int(args.amount * ETHER))
+        balance = chain.balance_of(address)
+    finally:
+        chain.close()
+    print(f"funded {args.address}: balance {balance / ETHER:g} ETH")
+    return 0

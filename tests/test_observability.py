@@ -208,3 +208,28 @@ def test_dashboard_page_served_at_root():
             assert needle in page
     finally:
         node.stop()
+
+
+def test_faucet_tool_drips_funds():
+    """cmd/faucet analog: the CLI faucet funds an address on a running
+    chain process over RPC."""
+    from gethsharding_tpu.node.cli import run_cli
+    from gethsharding_tpu.rpc.server import RPCServer
+
+    backend = SimulatedMainchain()
+    server = RPCServer(backend, port=0)
+    server.start()
+    try:
+        addr = "0x" + "ab" * 20
+        rc = run_cli(["faucet", "--port", str(server.address[1]),
+                      "--address", addr, "--amount", "7"])
+        assert rc == 0
+        from gethsharding_tpu.params import ETHER
+        from gethsharding_tpu.utils.hexbytes import Address20
+
+        assert backend.balance_of(Address20(bytes.fromhex("ab" * 20))) \
+            == 7 * ETHER
+        assert run_cli(["faucet", "--port", str(server.address[1]),
+                        "--address", "nonsense"]) == 1
+    finally:
+        server.stop()
