@@ -30,6 +30,10 @@ class Block:
     number: int
     hash: Hash32
     parent_hash: Hash32
+    # engine seal payload (consensus/consensus.go role): empty for the
+    # fake engine, 8-byte nonce for dev PoW, vanity+65-byte signature
+    # for clique — see smc/engine.py
+    extra: bytes = b""
 
 
 @dataclass
@@ -46,8 +50,16 @@ class SimulatedMainchain:
     """Deterministic dev chain hosting the SMC state machine."""
 
     def __init__(self, config: Config = DEFAULT_CONFIG,
-                 genesis_balances: Optional[Dict[Address20, int]] = None):
+                 genesis_balances: Optional[Dict[Address20, int]] = None,
+                 engine=None):
+        from gethsharding_tpu.smc.engine import FakeEngine
+
         self.config = config
+        # consensus engine seam (consensus/consensus.go): decides the
+        # seal payload + hash rule for produced blocks and the
+        # verification rule for imported ones. The default FakeEngine
+        # is byte-compatible with the pre-engine chain.
+        self.engine = engine if engine is not None else FakeEngine()
         genesis = Block(number=0, hash=self._block_hash(0, Hash32()),
                         parent_hash=Hash32())
         self.blocks: List[Block] = [genesis]
@@ -106,12 +118,16 @@ class SimulatedMainchain:
         """Seal the pending block and notify head subscribers."""
         with self._lock:
             parent = self.blocks[-1]
+            block_hash, extra = self.engine.seal(parent.number + 1,
+                                                 parent.hash)
             block = Block(
                 number=parent.number + 1,
-                hash=self._block_hash(parent.number + 1, parent.hash),
+                hash=block_hash,
                 parent_hash=parent.hash,
+                extra=extra,
             )
             self.blocks.append(block)
+            self.engine.finalize(block.number, block.parent_hash, extra)
             # a period ends when the pending block number crosses into the
             # next period: snapshot its end-of-period vote state for the
             # batched replay audit before any next-period tx can clear it
@@ -141,7 +157,8 @@ class SimulatedMainchain:
         audit = {p: v for p, v in self._vote_audit.items()
                  if p >= period_floor}
         try:
-            snap = copy.deepcopy((self.smc, self.balances, audit))
+            snap = copy.deepcopy((self.smc, self.balances, audit,
+                                  self.engine.snapshot()))
         finally:
             self.smc.blockhash_fn = fn
         self._state_snaps[number] = snap
@@ -161,10 +178,12 @@ class SimulatedMainchain:
             raise ValueError(
                 f"state for block {number} pruned (horizon "
                 f"{self.SNAPSHOT_HORIZON})")
-        smc, balances, vote_audit = copy.deepcopy(snap)
+        smc, balances, vote_audit, engine_state = copy.deepcopy(snap)
         smc.blockhash_fn = self.blockhash
         self.smc = smc
         self.balances = balances
+        if engine_state is not None:
+            self.engine.restore(engine_state)
         # audit logs for periods finalized BEFORE the target head are
         # identical on both branches — keep them (the snapshot only
         # carries the rollback window's worth); anything later comes
@@ -204,6 +223,8 @@ class SimulatedMainchain:
         longest-wins decision. Returns the number of blocks adopted."""
         if not blocks:
             return 0
+        import copy
+
         with self._lock:
             first = blocks[0]
             attach = first.number - 1
@@ -218,10 +239,41 @@ class SimulatedMainchain:
                     raise ValueError("broken branch linkage")
                 parent = block
             if blocks[-1].number <= self.block_number:
-                return 0  # not longer: incumbent chain stays canonical
-            self._rollback_locked(attach)
+                return 0  # not longer: incumbent stays canonical, and a
+                # branch that cannot win needs no engine verification
+                # (stale forks may attach beyond the snapshot horizon)
+            # seal verification runs against the ATTACH POINT's engine
+            # state, with finalize interleaved, so mid-branch
+            # authorization changes rotate the expected signer exactly
+            # as geth's per-block clique snapshots do
+            # (clique.go snapshot()). The walked state is throwaway:
+            # failure restores the incumbent's, adoption re-derives it
+            # block by block below.
+            attach_snap = self._state_snaps.get(attach)
+            if attach_snap is None:
+                raise ValueError(
+                    f"state for block {attach} pruned (horizon "
+                    f"{self.SNAPSHOT_HORIZON})")
+            incumbent_engine = self.engine.snapshot()
+            attach_engine = copy.deepcopy(attach_snap[3])
+            if attach_engine is not None:
+                self.engine.restore(attach_engine)
+            try:
+                for block in blocks:
+                    self.engine.verify_header(block.number,
+                                              block.parent_hash,
+                                              block.extra, block.hash)
+                    self.engine.finalize(block.number, block.parent_hash,
+                                         block.extra)
+            except BaseException:
+                if incumbent_engine is not None:
+                    self.engine.restore(incumbent_engine)
+                raise
+            self._rollback_locked(attach)  # also re-restores attach state
             self.blocks.extend(blocks)
             for block in blocks:
+                self.engine.finalize(block.number, block.parent_hash,
+                                     block.extra)
                 self._snapshot_state(block.number)
             head = self.blocks[-1]
             subscribers = list(self._head_subscribers)

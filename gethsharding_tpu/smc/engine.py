@@ -1,0 +1,353 @@
+"""Consensus engines for the dev mainchain (`consensus/consensus.go` role).
+
+The reference pluggs a `consensus.Engine` into its blockchain — ethash
+PoW (`consensus/ethash/sealer.go`: nonce-space search), clique PoA
+(`consensus/clique/clique.go`: signer rotation + in-extra signatures +
+signer voting), and the "fake" engine every dev/simulated chain runs on
+(`consensus/ethash/ethash.go` ModeFake). The sharding layer itself never
+consumes an engine (consensus lives in the SMC), but the mainchain the
+actors talk to does; this module gives `smc/chain.py` the same seam.
+
+Engines here follow the same split the reference's interface draws
+(`consensus/consensus.go:47-80`): `seal` produces the next sealed block
+from a parent, `verify_header` checks a block received from elsewhere
+(the `import_chain` path), and `finalize`/`snapshot`/`restore` carry any
+engine-held state (clique's vote tallies) across the chain's rollback
+machinery. Blocks stay the dev chain's empty-body headers: an engine
+decides only the `extra` payload and the hash rule.
+
+Design note (TPU-first repo): sealing is a host-side concern — a few
+keccaks per block — and stays scalar Python; nothing here runs on
+device. The engines exist for capability parity and for exercising the
+import/reorg path with real verification rules.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from gethsharding_tpu.crypto import secp256k1
+from gethsharding_tpu.crypto.keccak import keccak256
+from gethsharding_tpu.utils.hexbytes import Address20, Hash32
+from gethsharding_tpu.utils.rlp import int_to_big_endian, rlp_encode
+
+
+class InvalidHeader(Exception):
+    """A block failed engine verification (consensus.ErrInvalidHeader)."""
+
+
+def _header_rlp(number: int, parent_hash: Hash32, extra: bytes) -> bytes:
+    return rlp_encode([int_to_big_endian(number), bytes(parent_hash), extra])
+
+
+class FakeEngine:
+    """ModeFake: no seal work, hash over [number, parent] only.
+
+    Byte-compatible with the pre-engine dev chain (`smc/chain.py`
+    `_block_hash`): the empty-extra hash omits the extra field entirely,
+    so every existing frozen block-hash vector still holds.
+    """
+
+    name = "fake"
+
+    def seal(self, number: int, parent_hash: Hash32) -> Tuple[Hash32, bytes]:
+        return self.hash_header(number, parent_hash, b""), b""
+
+    def hash_header(self, number: int, parent_hash: Hash32,
+                    extra: bytes) -> Hash32:
+        if extra:
+            return Hash32(keccak256(_header_rlp(number, parent_hash, extra)))
+        return Hash32(keccak256(rlp_encode([int_to_big_endian(number),
+                                            bytes(parent_hash)])))
+
+    def verify_header(self, number: int, parent_hash: Hash32, extra: bytes,
+                      block_hash: Hash32) -> None:
+        if bytes(self.hash_header(number, parent_hash, extra)) != bytes(block_hash):
+            raise InvalidHeader(f"block {number}: hash mismatch")
+
+    def finalize(self, number: int, parent_hash: Hash32, extra: bytes) -> None:
+        pass
+
+    def snapshot(self):
+        return None
+
+    def restore(self, state) -> None:
+        pass
+
+
+class DevPoWEngine(FakeEngine):
+    """A DAG-less dev PoW: nonce search until keccak(header) clears a
+    difficulty target (the `consensus/ethash/sealer.go:113` nonce loop
+    with hashimoto replaced by plain keccak — the DAG is a memory-hard
+    anti-ASIC artifact with no behavioral role for a dev chain, and is
+    descoped per SURVEY.md §2.3)."""
+
+    name = "devpow"
+
+    def __init__(self, difficulty_bits: int = 8):
+        if not 0 <= difficulty_bits <= 64:
+            raise ValueError("difficulty_bits out of range")
+        self.difficulty_bits = difficulty_bits
+
+    def _meets_target(self, digest: bytes) -> bool:
+        work = int.from_bytes(digest[:8], "big")
+        return work >> (64 - self.difficulty_bits) == 0 \
+            if self.difficulty_bits else True
+
+    def seal(self, number: int, parent_hash: Hash32) -> Tuple[Hash32, bytes]:
+        nonce = 0
+        while True:
+            extra = nonce.to_bytes(8, "big")
+            digest = keccak256(_header_rlp(number, parent_hash, extra))
+            if self._meets_target(digest):
+                return Hash32(digest), extra
+            nonce += 1
+
+    def hash_header(self, number: int, parent_hash: Hash32,
+                    extra: bytes) -> Hash32:
+        return Hash32(keccak256(_header_rlp(number, parent_hash, extra)))
+
+    def verify_header(self, number: int, parent_hash: Hash32, extra: bytes,
+                      block_hash: Hash32) -> None:
+        if len(extra) != 8:
+            raise InvalidHeader(f"block {number}: PoW nonce must be 8 bytes")
+        digest = keccak256(_header_rlp(number, parent_hash, extra))
+        if bytes(digest) != bytes(block_hash):
+            raise InvalidHeader(f"block {number}: hash mismatch")
+        if not self._meets_target(digest):
+            raise InvalidHeader(f"block {number}: insufficient work")
+
+
+@dataclass
+class _Vote:
+    """One pending authorization vote (clique.Vote)."""
+
+    signer: Address20
+    target: Address20
+    authorize: bool
+
+
+class CliqueEngine:
+    """Proof-of-authority with signer rotation, in-extra seals and
+    majority signer voting (`consensus/clique/clique.go`).
+
+    Kept rules:
+      - the seal is a 65-byte secp256k1 signature over the header with
+        the signature itself excluded (clique.go sigHash / SealHash);
+      - the sealer must be an authorized signer, and must be IN TURN
+        (`signers[number % len(signers)]` over the sorted set) — the dev
+        chain seals on demand, so the out-of-turn/wiggle path
+        (clique.go:581) would never be exercised and is rejected
+        outright rather than merely de-prioritized;
+      - a seal may carry one authorization proposal (20-byte target +
+        0x00/0xff drop/add, the coinbase+nonce encoding of
+        clique.go:283 collapsed into the extra field); a strict majority
+        of current signers adopts it, clearing that target's tally;
+      - every `epoch` blocks all pending votes reset (clique.go:416).
+
+    Engine state (signer set + tallies) is chain state in geth
+    (recomputed from headers via snapshots); here the chain's own
+    snapshot ring carries it through rollbacks via snapshot()/restore().
+    """
+
+    name = "clique"
+    EPOCH = 30
+
+    def __init__(self, signers: Sequence[Address20], epoch: int = EPOCH):
+        if not signers:
+            raise ValueError("clique needs at least one signer")
+        self._signers: List[bytes] = sorted({bytes(s) for s in signers})
+        self._votes: List[_Vote] = []
+        self.epoch = epoch
+        self._lock = threading.RLock()
+        self._sign_fn = None
+        self._bound_signer: Optional[Address20] = None
+        self._pending_proposal: Optional[Tuple[Address20, bool]] = None
+        self._recover_memo: Dict[tuple, Address20] = {}
+
+    def bind_sealer(self, sign_fn, signer: Address20) -> None:
+        """Attach this node's keystore signer (clique.Authorize,
+        clique.go:590). Required before the chain can seal blocks."""
+        self._sign_fn = sign_fn
+        self._bound_signer = signer
+
+    def propose(self, target: Address20, authorize: bool) -> None:
+        """Queue an authorization proposal for the next sealed block
+        (the `clique_propose` RPC, api.go:66)."""
+        self._pending_proposal = (target, authorize)
+
+    # -- signer set --------------------------------------------------------
+
+    def signers(self) -> List[Address20]:
+        with self._lock:
+            return [Address20(s) for s in self._signers]
+
+    def in_turn_signer(self, number: int) -> Address20:
+        with self._lock:
+            return Address20(self._signers[number % len(self._signers)])
+
+    # -- sealing -----------------------------------------------------------
+
+    @staticmethod
+    def _encode_proposal(proposal: Optional[Tuple[Address20, bool]]) -> bytes:
+        if proposal is None:
+            return b""
+        target, authorize = proposal
+        return bytes(target) + (b"\xff" if authorize else b"\x00")
+
+    def seal_hash(self, number: int, parent_hash: Hash32,
+                  vanity: bytes) -> Hash32:
+        """Digest the seal signs: header with the signature excluded
+        (clique.go SealHash)."""
+        return Hash32(keccak256(_header_rlp(number, parent_hash, vanity)))
+
+    def seal(self, number: int, parent_hash: Hash32) -> Tuple[Hash32, bytes]:
+        """Seal with the bound keystore signer, consuming any queued
+        proposal (the uniform engine interface `smc/chain.py` drives)."""
+        with self._lock:
+            sign_fn, signer = self._sign_fn, self._bound_signer
+            proposal = self._pending_proposal
+        if sign_fn is None or signer is None:
+            raise InvalidHeader("clique engine has no bound sealer "
+                                "(call bind_sealer first)")
+        result = self.seal_as(number, parent_hash, sign_fn=sign_fn,
+                              signer=signer, proposal=proposal)
+        with self._lock:
+            # consume only on success: a failed seal (e.g. out of turn)
+            # keeps the queued clique_propose for the next block
+            if self._pending_proposal == proposal:
+                self._pending_proposal = None
+        return result
+
+    def seal_as(self, number: int, parent_hash: Hash32, *,
+                sign_fn, signer: Address20,
+                proposal: Optional[Tuple[Address20, bool]] = None,
+                ) -> Tuple[Hash32, bytes]:
+        """Produce (hash, extra). `sign_fn(digest) -> 65-byte [R||S||V]`
+        is the keystore seam (accounts.AccountManager.sign_hash)."""
+        with self._lock:
+            if bytes(signer) not in self._signers:
+                raise InvalidHeader("unauthorized signer")
+            if bytes(signer) != bytes(self.in_turn_signer(number)):
+                raise InvalidHeader(
+                    f"signer not in turn for block {number}")
+        vanity = self._encode_proposal(proposal)
+        sig = sign_fn(bytes(self.seal_hash(number, parent_hash, vanity)))
+        if len(sig) != 65:
+            raise InvalidHeader("seal signature must be 65 bytes")
+        extra = vanity + sig
+        return self.hash_header(number, parent_hash, extra), extra
+
+    def hash_header(self, number: int, parent_hash: Hash32,
+                    extra: bytes) -> Hash32:
+        return Hash32(keccak256(_header_rlp(number, parent_hash, extra)))
+
+    # -- verification ------------------------------------------------------
+
+    def _split_extra(self, number: int, extra: bytes
+                     ) -> Tuple[bytes, bytes]:
+        if len(extra) == 65:
+            return b"", extra
+        if len(extra) == 21 + 65:
+            if extra[20] not in (0x00, 0xFF):
+                # only the two flag values the encoder emits are valid
+                # votes (clique.go errInvalidVote)
+                raise InvalidHeader(
+                    f"block {number}: invalid vote flag 0x{extra[20]:02x}")
+            return extra[:21], extra[21:]
+        raise InvalidHeader(f"block {number}: malformed clique extra "
+                            f"({len(extra)} bytes)")
+
+    def recover_signer(self, number: int, parent_hash: Hash32,
+                       extra: bytes) -> Address20:
+        # verify_header and finalize both need the sealer of the same
+        # block back to back (import path: verify then finalize; seal
+        # path: the chain finalizes a seal it just produced) — memoize
+        # the last few recoveries so adoption costs ONE ecrecover
+        key = (number, bytes(parent_hash), extra)
+        with self._lock:
+            cached = self._recover_memo.get(key)
+        if cached is not None:
+            return cached
+        vanity, sig = self._split_extra(number, extra)
+        digest = bytes(self.seal_hash(number, parent_hash, vanity))
+        try:
+            signature = secp256k1.Signature.from_bytes65(sig)
+            sealer = secp256k1.ecrecover_address(digest, signature)
+        except (ValueError, ArithmeticError) as exc:
+            raise InvalidHeader(f"block {number}: bad seal: {exc}") from exc
+        with self._lock:
+            self._recover_memo[key] = sealer
+            # big enough that an import's verify walk still covers its
+            # finalize replay (branches re-verify then re-finalize)
+            while len(self._recover_memo) > 256:
+                self._recover_memo.pop(next(iter(self._recover_memo)))
+        return sealer
+
+    def verify_header(self, number: int, parent_hash: Hash32, extra: bytes,
+                      block_hash: Hash32) -> None:
+        if bytes(self.hash_header(number, parent_hash, extra)) \
+                != bytes(block_hash):
+            raise InvalidHeader(f"block {number}: hash mismatch")
+        sealer = self.recover_signer(number, parent_hash, extra)
+        with self._lock:
+            if bytes(sealer) not in self._signers:
+                raise InvalidHeader(
+                    f"block {number}: unauthorized signer "
+                    f"{sealer.hex_str}")
+            if bytes(sealer) != bytes(self.in_turn_signer(number)):
+                raise InvalidHeader(f"block {number}: signer out of turn")
+
+    # -- state transitions (applied on adoption, seal AND import) ----------
+
+    def finalize(self, number: int, parent_hash: Hash32,
+                 extra: bytes) -> None:
+        """Apply an adopted block's authorization vote, if any, and the
+        epoch reset (clique.go snapshot.apply)."""
+        with self._lock:
+            if self.epoch and number % self.epoch == 0:
+                self._votes.clear()
+            vanity, _ = self._split_extra(number, extra)
+            if not vanity:
+                return
+            sealer = self.recover_signer(number, parent_hash, extra)
+            target = Address20(vanity[:20])
+            authorize = vanity[20] == 0xFF
+            already = bytes(target) in self._signers
+            if authorize == already:
+                return  # no-op proposal (clique.go validVote)
+            # one live vote per (signer, target): latest wins
+            self._votes = [v for v in self._votes
+                           if not (bytes(v.signer) == bytes(sealer)
+                                   and bytes(v.target) == bytes(target))]
+            self._votes.append(_Vote(sealer, target, authorize))
+            tally = sum(1 for v in self._votes
+                        if bytes(v.target) == bytes(target)
+                        and v.authorize == authorize)
+            if tally > len(self._signers) // 2:
+                if authorize:
+                    self._signers = sorted(self._signers + [bytes(target)])
+                else:
+                    self._signers.remove(bytes(target))
+                    # a dropped signer's outstanding votes die with it
+                    self._votes = [v for v in self._votes
+                                   if bytes(v.signer) != bytes(target)]
+                self._votes = [v for v in self._votes
+                               if bytes(v.target) != bytes(target)]
+
+    # -- rollback support --------------------------------------------------
+
+    def snapshot(self):
+        with self._lock:
+            return (list(self._signers),
+                    [(bytes(v.signer), bytes(v.target), v.authorize)
+                     for v in self._votes])
+
+    def restore(self, state) -> None:
+        signers, votes = state
+        with self._lock:
+            self._signers = list(signers)
+            self._votes = [_Vote(Address20(s), Address20(t), a)
+                           for s, t, a in votes]

@@ -68,13 +68,19 @@ def test_set_head_bounds_and_pruning():
 
 
 def _fork(chain, attach: int, length: int):
-    """A foreign branch of empty blocks linked at `attach`."""
+    """A foreign branch of empty blocks linked at `attach`. A distinct
+    `extra` keeps the branch's hashes different from the incumbent's
+    (the fake engine hashes extra when present), so reorg assertions
+    prove the FOREIGN blocks were adopted — while still carrying valid
+    seals for InsertChain's engine verification."""
     parent = chain.block_by_number(attach)
     out = []
     for i in range(length):
-        block = Block(number=parent.number + 1,
-                      hash=Hash32(keccak256(b"fork-%d-%d" % (attach, i))),
-                      parent_hash=parent.hash)
+        extra = b"fork-%d-%d" % (attach, i)
+        block_hash = chain.engine.hash_header(parent.number + 1,
+                                              parent.hash, extra)
+        block = Block(number=parent.number + 1, hash=block_hash,
+                      parent_hash=parent.hash, extra=extra)
         out.append(block)
         parent = block
     return out

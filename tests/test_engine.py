@@ -1,0 +1,273 @@
+"""Consensus-engine tests (`consensus/consensus.go` seam): fake-engine
+byte compatibility, dev PoW seal/verify, clique authorization rules +
+signer voting, and the chain integration (sealed commits, verified
+imports, engine state through rollbacks)."""
+
+import pytest
+
+from gethsharding_tpu.crypto import secp256k1
+from gethsharding_tpu.crypto.keccak import keccak256
+from gethsharding_tpu.mainchain.accounts import AccountManager
+from gethsharding_tpu.params import Config
+from gethsharding_tpu.smc.chain import Block, SimulatedMainchain
+from gethsharding_tpu.smc.engine import (
+    CliqueEngine, DevPoWEngine, FakeEngine, InvalidHeader)
+from gethsharding_tpu.utils.hexbytes import Hash32
+from gethsharding_tpu.utils.rlp import int_to_big_endian, rlp_encode
+
+
+def _accounts(n, seed=b"engine"):
+    manager = AccountManager()
+    return manager, [manager.new_account(seed=seed + b"-%d" % i)
+                     for i in range(n)]
+
+
+def test_fake_engine_matches_pre_engine_hashes():
+    """The default engine must keep every historical block hash: the
+    empty-extra hash is keccak(rlp([number, parent])) exactly as
+    SimulatedMainchain._block_hash computed it."""
+    engine = FakeEngine()
+    parent = Hash32(keccak256(b"parent"))
+    block_hash, extra = engine.seal(7, parent)
+    assert extra == b""
+    legacy = keccak256(rlp_encode([int_to_big_endian(7), bytes(parent)]))
+    assert bytes(block_hash) == legacy
+    assert bytes(SimulatedMainchain._block_hash(7, parent)) == legacy
+    engine.verify_header(7, parent, b"", block_hash)
+    with pytest.raises(InvalidHeader):
+        engine.verify_header(7, parent, b"", Hash32(b"\x01" * 32))
+
+
+def test_devpow_seal_and_verify():
+    engine = DevPoWEngine(difficulty_bits=6)
+    parent = Hash32(keccak256(b"pow-parent"))
+    block_hash, extra = engine.seal(1, parent)
+    assert len(extra) == 8
+    engine.verify_header(1, parent, extra, block_hash)
+    # a nonce that doesn't clear the target is rejected even with a
+    # consistent hash
+    bad_nonce = (int.from_bytes(extra, "big") + 1).to_bytes(8, "big")
+    bad_hash = engine.hash_header(1, parent, bad_nonce)
+    if engine._meets_target(bytes(bad_hash)):  # pragma: no cover - rare
+        bad_nonce = (int.from_bytes(extra, "big") + 2).to_bytes(8, "big")
+        bad_hash = engine.hash_header(1, parent, bad_nonce)
+    with pytest.raises(InvalidHeader, match="work|hash"):
+        engine.verify_header(1, parent, bad_nonce, bad_hash)
+    with pytest.raises(InvalidHeader, match="8 bytes"):
+        engine.verify_header(1, parent, b"\x00" * 4, block_hash)
+
+
+def test_clique_seal_requires_authorized_in_turn_signer():
+    manager, (a, b) = _accounts(2)
+    engine = CliqueEngine([a.address, b.address])
+    order = [bytes(s) for s in engine.signers()]
+    parent = Hash32(keccak256(b"clique-parent"))
+
+    in_turn = engine.in_turn_signer(1)
+    sealer = a if bytes(a.address) == bytes(in_turn) else b
+    other = b if sealer is a else a
+
+    block_hash, extra = engine.seal_as(
+        1, parent, sign_fn=lambda d: manager.sign_hash(sealer.address, d),
+        signer=sealer.address)
+    assert len(extra) == 65
+    engine.verify_header(1, parent, extra, block_hash)
+    assert bytes(engine.recover_signer(1, parent, extra)) \
+        == bytes(sealer.address)
+
+    # out of turn: refused at seal time AND at verify time
+    with pytest.raises(InvalidHeader, match="turn"):
+        engine.seal_as(1, parent,
+                       sign_fn=lambda d: manager.sign_hash(other.address, d),
+                       signer=other.address)
+    # a seal by a key outside the signer set is unauthorized
+    _, (outsider,) = _accounts(1, seed=b"outsider")
+    forged_sig = secp256k1.sign(
+        bytes(engine.seal_hash(1, parent, b"")), outsider.priv).to_bytes65()
+    forged_hash = engine.hash_header(1, parent, forged_sig)
+    with pytest.raises(InvalidHeader, match="unauthorized"):
+        engine.verify_header(1, parent, forged_sig, forged_hash)
+    assert order == [bytes(s) for s in engine.signers()]  # set unchanged
+
+
+def engine_signer_account(engine, number, accounts):
+    turn = bytes(engine.in_turn_signer(number))
+    return next(acct for acct in accounts if bytes(acct.address) == turn)
+
+
+def test_clique_voting_majority_adds_and_drops_signers():
+    manager, accts = _accounts(3, seed=b"vote")
+    engine = CliqueEngine([a.address for a in accts], epoch=1000)
+    candidate = manager.new_account(seed=b"candidate")
+    parent = Hash32(keccak256(b"genesis"))
+
+    def seal_with_vote(number, parent_hash, proposal):
+        acct = engine_signer_account(engine, number, accts)
+        return engine.seal_as(
+            number, parent_hash,
+            sign_fn=lambda d: manager.sign_hash(acct.address, d),
+            signer=acct.address, proposal=proposal)
+
+    # two of three distinct signers voting "add" reaches majority
+    number, votes_applied = 1, 0
+    seen_signers = set()
+    while votes_applied < 2:
+        acct = engine_signer_account(engine, number, accts)
+        proposal = ((candidate.address, True)
+                    if bytes(acct.address) not in seen_signers else None)
+        block_hash, extra = seal_with_vote(number, parent, proposal)
+        engine.verify_header(number, parent, extra, block_hash)
+        engine.finalize(number, parent, extra)
+        if proposal is not None:
+            seen_signers.add(bytes(acct.address))
+            votes_applied += 1
+        parent = block_hash
+        number += 1
+    assert bytes(candidate.address) in [bytes(s) for s in engine.signers()]
+    assert len(engine.signers()) == 4
+
+    # now drop the candidate: 3 votes needed for majority of 4
+    voted = set()
+    while bytes(candidate.address) in [bytes(s) for s in engine.signers()]:
+        turn = bytes(engine.in_turn_signer(number))
+        all_accts = accts + [candidate]
+        acct = next(x for x in all_accts if bytes(x.address) == turn)
+        proposal = None
+        if acct is not candidate and bytes(acct.address) not in voted:
+            proposal = (candidate.address, False)
+        block_hash, extra = engine.seal_as(
+            number, parent,
+            sign_fn=lambda d: manager.sign_hash(acct.address, d),
+            signer=acct.address, proposal=proposal)
+        engine.finalize(number, parent, extra)
+        if proposal is not None:
+            voted.add(bytes(acct.address))
+        parent = block_hash
+        number += 1
+    assert len(engine.signers()) == 3
+
+
+def test_clique_epoch_clears_pending_votes():
+    manager, accts = _accounts(3, seed=b"epoch")
+    engine = CliqueEngine([a.address for a in accts], epoch=2)
+    _, (candidate,) = _accounts(1, seed=b"cand2")
+    parent = Hash32(keccak256(b"genesis"))
+
+    acct = engine_signer_account(engine, 1, accts)
+    block_hash, extra = engine.seal_as(
+        1, parent, sign_fn=lambda d: manager.sign_hash(acct.address, d),
+        signer=acct.address, proposal=(candidate.address, True))
+    engine.finalize(1, parent, extra)
+    assert engine.snapshot()[1]  # one pending vote
+    # block 2 is an epoch boundary: the tally resets before its vote
+    acct2 = engine_signer_account(engine, 2, accts)
+    h2, e2 = engine.seal_as(
+        2, block_hash, sign_fn=lambda d: manager.sign_hash(acct2.address, d),
+        signer=acct2.address)
+    engine.finalize(2, block_hash, e2)
+    assert not engine.snapshot()[1]
+    assert len(engine.signers()) == 3
+
+
+def test_chain_with_clique_engine_end_to_end():
+    """The dev chain seals through a bound clique sealer (single-signer
+    clique = the `geth --dev` deployment); imports verify seals;
+    rollback carries engine state."""
+    manager, (a,) = _accounts(1, seed=b"chain")
+    engine = CliqueEngine([a.address])
+    engine.bind_sealer(lambda d: manager.sign_hash(a.address, d), a.address)
+
+    chain = SimulatedMainchain(config=Config(shard_count=2), engine=engine)
+
+    for _ in range(4):
+        chain.commit()
+    assert chain.block_number == 4
+    for number in range(1, 5):
+        block = chain.block_by_number(number)
+        engine.verify_header(block.number, block.parent_hash, block.extra,
+                             block.hash)
+
+    # imports with forged seals are refused
+    _, (outsider,) = _accounts(1, seed=b"forger")
+    parent = chain.block_by_number(4)
+    digest = bytes(engine.seal_hash(5, parent.hash, b""))
+    forged_extra = secp256k1.sign(digest, outsider.priv).to_bytes65()
+    forged = Block(number=5,
+                   hash=engine.hash_header(5, parent.hash, forged_extra),
+                   parent_hash=parent.hash, extra=forged_extra)
+    with pytest.raises(InvalidHeader, match="unauthorized"):
+        chain.import_chain([forged])
+
+    # engine state rides the snapshot ring through set_head
+    snap_before = engine.snapshot()
+    chain.set_head(2)
+    assert engine.snapshot() == snap_before  # no votes: set unchanged
+    assert chain.block_number == 2
+
+
+def test_import_verifies_against_attach_point_signer_set():
+    """A competing branch sealed under the signer set AS OF the fork
+    point must verify even after the incumbent chain changed the set —
+    and mid-branch authorization votes must rotate the expected signer
+    during verification (geth recomputes clique snapshots per block)."""
+    manager, (a,) = _accounts(1, seed=b"attach")
+    b_acct = manager.new_account(seed=b"attach-b")
+    engine = CliqueEngine([a.address], epoch=1000)
+    engine.bind_sealer(lambda d: manager.sign_hash(a.address, d), a.address)
+    chain = SimulatedMainchain(config=Config(shard_count=2), engine=engine)
+
+    chain.commit()  # block 1 under {a}
+    fork_parent = chain.block_by_number(1)
+
+    # incumbent: blocks 2-3, block 2 votes b in => signer set becomes {a,b}
+    engine.propose(b_acct.address, True)
+    chain.commit()
+    assert len(engine.signers()) == 2
+    turn = engine.in_turn_signer(3)
+    in_turn_acct = a if bytes(a.address) == bytes(turn) else b_acct
+    engine.bind_sealer(
+        lambda d: manager.sign_hash(in_turn_acct.address, d),
+        in_turn_acct.address)
+    chain.commit()
+
+    # foreign branch from block 1, length 3, sealed under {a} ONLY:
+    # every seal is a's (in turn in a single-signer set), which is OUT
+    # of turn at some height under the incumbent's {a,b} rotation
+    branch_engine = CliqueEngine([a.address], epoch=1000)
+    branch = []
+    parent = fork_parent
+    for _ in range(3):
+        h, extra = branch_engine.seal_as(
+            parent.number + 1, parent.hash,
+            sign_fn=lambda d: manager.sign_hash(a.address, d),
+            signer=a.address)
+        branch_engine.finalize(parent.number + 1, parent.hash, extra)
+        block = Block(number=parent.number + 1, hash=h,
+                      parent_hash=parent.hash, extra=extra)
+        branch.append(block)
+        parent = block
+
+    assert chain.import_chain(branch) == 3
+    assert chain.block_number == 4
+    # adoption replayed the branch's (vote-free) history: set is {a}
+    assert [bytes(s) for s in engine.signers()] == [bytes(a.address)]
+
+
+def test_failed_seal_keeps_pending_proposal():
+    manager, (a, b) = _accounts(2, seed=b"keepvote")
+    engine = CliqueEngine([a.address, b.address], epoch=1000)
+    engine.bind_sealer(lambda d: manager.sign_hash(a.address, d), a.address)
+    candidate = manager.new_account(seed=b"keepvote-c")
+    engine.propose(candidate.address, True)
+
+    # find a height where the bound signer is OUT of turn: seal fails
+    # and the proposal must survive for the next attempt
+    parent = Hash32(keccak256(b"keepvote-parent"))
+    out_of_turn = next(n for n in range(1, 4)
+                       if bytes(engine.in_turn_signer(n)) != bytes(a.address))
+    in_turn = next(n for n in range(1, 4)
+                   if bytes(engine.in_turn_signer(n)) == bytes(a.address))
+    with pytest.raises(InvalidHeader, match="turn"):
+        engine.seal(out_of_turn, parent)
+    _, extra = engine.seal(in_turn, parent)
+    assert len(extra) == 21 + 65  # the preserved proposal rode along
