@@ -127,6 +127,24 @@ def build_parser() -> argparse.ArgumentParser:
                      help="treat DATA as a file path of raw bytes")
     rlp.add_argument("--verbosity", default="warning",
                      choices=("debug", "info", "warning", "error"))
+
+    evm = sub.add_parser(
+        "evm", help="run a JSON op scenario through the standalone SMC "
+                    "engine (the cmd/evm analog)")
+    evm.add_argument("scenario", help="scenario JSON (tests/testdata/"
+                                      "smc.json format)")
+    evm.add_argument("--trace", action="store_true",
+                     help="print each op's outcome as it executes")
+    evm.add_argument("--verbosity", default="warning",
+                     choices=("debug", "info", "warning", "error"))
+
+    bindgen = sub.add_parser(
+        "bindgen", help="generate typed Python bindings from the chain "
+                        "RPC method table (the abigen analog)")
+    bindgen.add_argument("-o", "--out", default=None,
+                         help="output file (default: stdout)")
+    bindgen.add_argument("--verbosity", default="warning",
+                         choices=("debug", "info", "warning", "error"))
     return parser
 
 
@@ -155,6 +173,14 @@ def run_cli(argv: Optional[List[str]] = None) -> int:
         from gethsharding_tpu.tools import run_faucet
 
         return run_faucet(args)
+    if args.command == "evm":
+        from gethsharding_tpu.tools import run_evm
+
+        return run_evm(args)
+    if args.command == "bindgen":
+        from gethsharding_tpu.tools import run_bindgen
+
+        return run_bindgen(args)
     return 2
 
 

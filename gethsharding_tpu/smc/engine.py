@@ -329,6 +329,13 @@ class CliqueEngine:
             if tally > len(self._signers) // 2:
                 if authorize:
                     self._signers = sorted(self._signers + [bytes(target)])
+                elif len(self._signers) == 1:
+                    # a drop that would empty the signer set would wedge
+                    # the chain (nobody could ever seal again); discard
+                    # the tally instead
+                    self._votes = [v for v in self._votes
+                                   if bytes(v.target) != bytes(target)]
+                    return
                 else:
                     self._signers.remove(bytes(target))
                     # a dropped signer's outstanding votes die with it

@@ -1,14 +1,29 @@
-"""Small operator tools: the `ethkey` and `rlpdump` analogs.
+"""Small operator tools: the `ethkey`, `rlpdump`, `faucet`, `evm` and
+`abigen` analogs.
 
 The reference ships standalone helper binaries under `cmd/` — `ethkey`
-(generate/inspect/changepassword on keystore files) and `rlpdump`
-(pretty-print any RLP blob). Here they are CLI subcommands over the same
-library code the node uses (`mainchain/keystore.py`, `utils/rlp.py`):
+(generate/inspect/changepassword on keystore files), `rlpdump`
+(pretty-print any RLP blob), `evm` (standalone bytecode/state-test
+runner) and `abigen` (ABI -> typed Go bindings). Here they are CLI
+subcommands over the same library code the node uses:
 
   tpu-sharding key new --keystore DIR [--password PW]
   tpu-sharding key list --keystore DIR
   tpu-sharding key inspect --keystore DIR --address 0x.. --password PW
   tpu-sharding rlpdump HEX (or --file PATH, or - for stdin)
+  tpu-sharding evm SCENARIO.json [--trace]   # standalone SMC runner
+  tpu-sharding bindgen [-o FILE]             # typed RPC bindings
+
+The `evm` analog runs the framework's execution engine — the native SMC
+transition system that replaces the reference's EVM-resident contract
+(SURVEY.md §2.4 #25) — over a JSON op script, the way `cmd/evm` runs
+bytecode or a GeneralStateTests fixture standalone, printing a per-op
+trace and the final state. `bindgen` plays abigen's role with this
+framework's canonical interface: where abigen turns a solc ABI into
+typed Go bindings (`sharding/contracts/sharding_manager.go` is its
+output), bindgen turns the chain RPC server's method table into a typed
+Python client class, so the generated binding can never drift from the
+server surface it was generated from.
 """
 
 from __future__ import annotations
@@ -110,6 +125,206 @@ def _print_item(item, depth: int) -> None:
     for sub in item:
         _print_item(sub, depth + 1)
     print(f"{pad}]")
+
+
+def run_evm(args) -> int:
+    """`evm`: execute a JSON op scenario against a fresh SMC chain and
+    print the outcome (the cmd/evm standalone-runner role; the fixture
+    format is the one tests/testdata/smc.json freezes).
+
+    Script ops: register / deregister / release / fund / fast_forward /
+    commit / add_header / submit_vote / vote_eligible. Accounts are
+    derived from `account_seeds`; submit_vote and vote_eligible BLS-sign
+    with the voter's derived vote key automatically."""
+    import json
+
+    from gethsharding_tpu.mainchain.accounts import AccountManager
+    from gethsharding_tpu.params import Config, ETHER
+    from gethsharding_tpu.smc.chain import SimulatedMainchain
+    from gethsharding_tpu.smc.state_machine import SMCRevert, vote_digest
+    from gethsharding_tpu.utils.hexbytes import Address20, Hash32
+
+    try:
+        with open(args.scenario) as fh:
+            fx = json.load(fh)
+    except (OSError, json.JSONDecodeError) as exc:
+        print(f"cannot load scenario: {exc}", file=sys.stderr)
+        return 1
+
+    cfg = fx.get("config", {})
+    config = Config(**{k: cfg[k] for k in
+                       ("shard_count", "committee_size", "quorum_size",
+                        "period_length", "notary_deposit")
+                       if k in cfg})
+    chain = SimulatedMainchain(config=config)
+    manager = AccountManager()
+    accounts = {}
+    for seed in fx.get("account_seeds", []):
+        acct = manager.new_account(seed=seed.encode())
+        accounts[bytes(acct.address).hex()] = acct
+
+    def resolve(hex_addr):
+        acct = accounts.get(hex_addr.removeprefix("0x").lower())
+        if acct is None:
+            raise SMCRevert(f"unknown account {hex_addr} "
+                            "(not derived from account_seeds)")
+        return acct
+
+    def eligible_vote(acct, shard, period, root):
+        entry = chain.smc.notary_registry.get(acct.address)
+        if entry is None:
+            raise SMCRevert(
+                f"{bytes(acct.address).hex()} is not a registered notary")
+        sig = manager.bls_sign(acct.address,
+                               bytes(vote_digest(shard, period, root)))
+        chain.submit_vote(acct.address, shard, period, entry.pool_index,
+                          root, bls_sig=sig)
+
+    trace = []
+    failures = 0
+    for i, step in enumerate(fx.get("script", [])):
+        op = step.get("op", "?")
+        line = {"step": i, "op": op}
+        try:
+            if op == "register":
+                acct = resolve(step["addr"])
+                chain.fund(acct.address, 2 * config.notary_deposit)
+                chain.register_notary(
+                    acct.address, bls_pubkey=acct.bls_pubkey,
+                    bls_pop=manager.bls_proof_of_possession(acct.address))
+            elif op == "deregister":
+                chain.deregister_notary(resolve(step["addr"]).address)
+            elif op == "release":
+                chain.release_notary(resolve(step["addr"]).address)
+            elif op == "fund":
+                chain.fund(Address20(bytes.fromhex(
+                    step["addr"].removeprefix("0x"))),
+                    int(step.get("ether", 1000)) * ETHER)
+            elif op == "fast_forward":
+                chain.fast_forward(int(step.get("periods", 1)))
+            elif op == "commit":
+                chain.commit()
+            elif op == "add_header":
+                root = Hash32(bytes.fromhex(step["chunk_root"]))
+                if "addr" not in step and not accounts:
+                    raise SMCRevert("add_header needs account_seeds "
+                                    "(or an explicit addr)")
+                sender = (resolve(step["addr"]).address if "addr" in step
+                          else next(iter(accounts.values())).address)
+                chain.add_header(sender, int(step["shard"]),
+                                 int(step.get("period",
+                                              chain.current_period())),
+                                 root)
+            elif op == "submit_vote":
+                acct = resolve(step["addr"])
+                eligible_vote(acct, int(step["shard"]),
+                              int(step.get("period",
+                                           chain.current_period())),
+                              Hash32(bytes.fromhex(step["chunk_root"])))
+            elif op == "vote_eligible":
+                shard = int(step["shard"])
+                period = int(step.get("period", chain.current_period()))
+                root = Hash32(bytes.fromhex(step["chunk_root"]))
+                voters = []
+                for acct in accounts.values():
+                    member = chain.get_notary_in_committee(acct.address,
+                                                           shard)
+                    if member == acct.address:
+                        eligible_vote(acct, shard, period, root)
+                        voters.append(bytes(acct.address).hex())
+                line["voters"] = voters
+            else:
+                raise SMCRevert(f"unknown op {op!r}")
+            line["status"] = "ok"
+        except SMCRevert as exc:
+            line["status"] = "revert"
+            line["reason"] = str(exc)
+            failures += 1
+        trace.append(line)
+        if args.trace:
+            print(json.dumps(line))
+
+    state = {
+        "block_number": chain.block_number,
+        "period": chain.current_period(),
+        "pool": [None if a is None else bytes(a).hex()
+                 for a in chain.smc.notary_pool],
+        "registry": {
+            bytes(addr).hex(): {"deposited": entry.deposited,
+                                "pool_index": entry.pool_index}
+            for addr, entry in chain.smc.notary_registry.items()},
+        "records": {
+            f"{s},{p}": {"chunk_root": bytes(rec.chunk_root).hex(),
+                         "proposer": bytes(rec.proposer).hex(),
+                         "vote_count": rec.vote_count,
+                         "is_elected": rec.is_elected}
+            for (s, p), rec in sorted(chain.smc.collation_records.items())},
+        "vote_counts": {str(s): chain.get_vote_count(s)
+                        for s in range(config.shard_count)
+                        if chain.get_vote_count(s)},
+        "last_approved": {str(s): p for s, p
+                          in sorted(chain.smc.last_approved_collation.items())
+                          if p},
+        "reverts": failures,
+    }
+    print(json.dumps({"trace": None if args.trace else trace,
+                      "state": state}, indent=1))
+    return 0
+
+
+_BINDING_HEADER = '''"""Typed chain-RPC bindings — GENERATED by `tpu-sharding bindgen`.
+
+Do not edit: regenerate from the server's method table (the abigen
+pattern, `accounts/abi/bind`; the reference's generated artifact is
+`sharding/contracts/sharding_manager.go`). Each method forwards to the
+wire method `shard_<name>` over any client exposing
+`call(method, *params)` (e.g. `gethsharding_tpu.rpc.client.RPCClient`).
+"""
+
+
+class ChainBinding:
+    """Generated 1:1 surface of gethsharding_tpu.rpc.server.RPCServer."""
+
+    def __init__(self, conn):
+        self._conn = conn
+'''
+
+
+def generate_bindings() -> str:
+    """Emit a typed Python binding class from the RPC server's canonical
+    rpc_* method table (abigen role: interface spec -> typed client)."""
+    import inspect
+
+    from gethsharding_tpu.rpc.server import RPCServer
+
+    out = [_BINDING_HEADER]
+    for name in sorted(n for n in dir(RPCServer) if n.startswith("rpc_")):
+        wire = name[len("rpc_"):]
+        sig = inspect.signature(getattr(RPCServer, name))
+        params = [p for p in sig.parameters.values() if p.name != "self"]
+        arglist, callargs = [], []
+        for p in params:
+            if p.default is inspect.Parameter.empty:
+                arglist.append(p.name)
+            else:
+                arglist.append(f"{p.name}={p.default!r}")
+            callargs.append(p.name)
+        head = ", ".join(["self"] + arglist)
+        tail = ", ".join([f'"shard_{wire}"'] + callargs)
+        out.append(f"    def {wire}({head}):\n"
+                   f"        return self._conn.call({tail})\n")
+    return "\n".join(out)
+
+
+def run_bindgen(args) -> int:
+    code = generate_bindings()
+    if args.out in (None, "-"):
+        sys.stdout.write(code)
+        return 0
+    with open(args.out, "w") as fh:
+        fh.write(code)
+    print(f"wrote {args.out}")
+    return 0
 
 
 def run_faucet(args) -> int:

@@ -147,6 +147,23 @@ def test_clique_voting_majority_adds_and_drops_signers():
     assert len(engine.signers()) == 3
 
 
+def test_clique_refuses_dropping_last_signer():
+    """A majority drop of the final signer would wedge the chain; the
+    tally is discarded instead (the set can never become empty)."""
+    manager, (a,) = _accounts(1, seed=b"lastdrop")
+    engine = CliqueEngine([a.address], epoch=1000)
+    parent = Hash32(keccak256(b"lastdrop-parent"))
+    block_hash, extra = engine.seal_as(
+        1, parent, sign_fn=lambda d: manager.sign_hash(a.address, d),
+        signer=a.address, proposal=(a.address, False))
+    engine.finalize(1, parent, extra)
+    assert [bytes(s) for s in engine.signers()] == [bytes(a.address)]
+    # the chain still seals: no ZeroDivisionError, no empty rotation
+    engine.in_turn_signer(2)
+    _, votes = engine.snapshot()
+    assert votes == []  # discarded tally leaves no dangling votes
+
+
 def test_clique_epoch_clears_pending_votes():
     manager, accts = _accounts(3, seed=b"epoch")
     engine = CliqueEngine([a.address for a in accts], epoch=2)

@@ -1,0 +1,177 @@
+"""Tests for the `evm` standalone SMC runner and the `bindgen` typed
+binding generator (the cmd/evm and abigen analogs, tools.py)."""
+
+import inspect
+import json
+import os
+
+from gethsharding_tpu.node.cli import build_parser, run_cli
+from gethsharding_tpu.tools import generate_bindings
+
+TESTDATA = os.path.join(os.path.dirname(__file__), "testdata")
+
+
+def test_evm_runs_frozen_scenario(capsys):
+    """The runner replays the conformance scenario fixture and reports
+    the header record the script added."""
+    rc = run_cli(["evm", os.path.join(TESTDATA, "smc.json")])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    state = out["state"]
+    assert state["reverts"] == 0
+    assert state["period"] == 1
+    assert len(state["pool"]) == 4
+    record = state["records"]["1,1"]
+    assert record["chunk_root"].startswith("a48ffb9a")
+    assert record["vote_count"] == 0  # the frozen script adds, not votes
+    # every scripted op appears in the trace with ok status
+    assert all(line["status"] == "ok" for line in out["trace"])
+
+
+def test_evm_vote_eligible_and_trace(tmp_path, capsys):
+    """A scenario exercising voting: eligible committee members vote and
+    the approval registers once quorum is met."""
+    scenario = {
+        "config": {"shard_count": 3, "committee_size": 4, "quorum_size": 1},
+        "account_seeds": ["conform-smc-%d" % i for i in range(4)],
+        "script": [
+            {"op": "register", "addr": a} for a in json.load(
+                open(os.path.join(TESTDATA, "smc.json")))["addresses"]
+        ] + [
+            {"op": "fast_forward", "periods": 1},
+            {"op": "add_header", "shard": 1, "period": 1,
+             "chunk_root": "11" * 32},
+            {"op": "vote_eligible", "shard": 1, "period": 1,
+             "chunk_root": "11" * 32},
+        ],
+    }
+    path = tmp_path / "scenario.json"
+    path.write_text(json.dumps(scenario))
+    rc = run_cli(["evm", str(path), "--trace"])
+    assert rc == 0
+    # --trace prints one line per op, then the indented final object
+    joined = capsys.readouterr().out
+    final = json.loads(joined[joined.index('{\n "trace"'):])
+    state = final["state"]
+    assert state["last_approved"].get("1") == 1
+    assert state["records"]["1,1"]["vote_count"] >= 1
+
+
+def test_evm_revert_is_reported_not_fatal(tmp_path, capsys):
+    scenario = {
+        "config": {"shard_count": 2, "committee_size": 2, "quorum_size": 2},
+        "account_seeds": ["rev-0"],
+        "script": [
+            {"op": "add_header", "shard": 5, "period": 0,
+             "chunk_root": "22" * 32},  # shard out of range -> revert
+        ],
+    }
+    path = tmp_path / "bad.json"
+    path.write_text(json.dumps(scenario))
+    rc = run_cli(["evm", str(path)])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["state"]["reverts"] == 1
+    assert out["trace"][0]["status"] == "revert"
+
+
+def test_evm_bad_ops_report_reverts_not_tracebacks(tmp_path, capsys):
+    """Unregistered voter, checksummed addresses and missing accounts
+    all land in the trace as reverts/oks — never an uncaught crash."""
+    fx = json.load(open(os.path.join(TESTDATA, "smc.json")))
+    checksummed = "0x" + fx["addresses"][0].upper()
+    scenario = {
+        "config": {"shard_count": 2, "committee_size": 2, "quorum_size": 2},
+        "account_seeds": fx["account_seeds"][:1],
+        "script": [
+            {"op": "register", "addr": checksummed},  # case-insensitive
+            {"op": "submit_vote", "addr": fx["addresses"][1],
+             "shard": 0, "chunk_root": "33" * 32},  # unknown account
+        ],
+    }
+    path = tmp_path / "edge.json"
+    path.write_text(json.dumps(scenario))
+    assert run_cli(["evm", str(path)]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["trace"][0]["status"] == "ok"
+    assert out["trace"][1]["status"] == "revert"
+
+    empty = {"script": [{"op": "add_header", "shard": 0,
+                         "chunk_root": "44" * 32}]}
+    path2 = tmp_path / "empty.json"
+    path2.write_text(json.dumps(empty))
+    assert run_cli(["evm", str(path2)]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["trace"][0]["status"] == "revert"
+    assert "account_seeds" in out["trace"][0]["reason"]
+
+
+def test_bindgen_matches_server_surface(tmp_path):
+    """The generated class has one method per rpc_* server method, each
+    forwarding to the shard_-namespaced wire name with the same
+    signature."""
+    from gethsharding_tpu.rpc.server import RPCServer
+
+    code = generate_bindings()
+    namespace = {}
+    exec(compile(code, "<bindgen>", "exec"), namespace)
+    binding_cls = namespace["ChainBinding"]
+
+    server_methods = {n[len("rpc_"):] for n in dir(RPCServer)
+                      if n.startswith("rpc_")}
+    bound_methods = {n for n in vars(binding_cls)
+                     if not n.startswith("_")}
+    assert bound_methods == server_methods
+
+    class RecordingConn:
+        def __init__(self):
+            self.calls = []
+
+        def call(self, method, *params):
+            self.calls.append((method, params))
+            return {"ok": True}
+
+    conn = RecordingConn()
+    binding = binding_cls(conn)
+    assert binding.blockNumber() == {"ok": True}
+    binding.collationRecord(3, 7)
+    assert conn.calls == [("shard_blockNumber", ()),
+                          ("shard_collationRecord", (3, 7))]
+
+    # defaults are preserved (blockByNumber's number=None)
+    sig = inspect.signature(binding_cls.blockByNumber)
+    assert sig.parameters["number"].default is None
+
+
+def test_bindgen_cli_writes_file(tmp_path, capsys):
+    out = tmp_path / "binding.py"
+    rc = run_cli(["bindgen", "-o", str(out)])
+    assert rc == 0
+    assert "class ChainBinding" in out.read_text()
+
+
+def test_bindgen_binding_works_against_live_server():
+    """End-to-end: generated bindings drive a real chain server over the
+    real RPC client."""
+    from gethsharding_tpu.params import Config
+    from gethsharding_tpu.rpc.client import RPCClient
+    from gethsharding_tpu.rpc.server import RPCServer
+    from gethsharding_tpu.smc.chain import SimulatedMainchain
+
+    backend = SimulatedMainchain(config=Config(shard_count=3))
+    server = RPCServer(backend)
+    server.start()
+    try:
+        client = RPCClient(*server.address)
+        try:
+            namespace = {}
+            exec(compile(generate_bindings(), "<bindgen>", "exec"), namespace)
+            binding = namespace["ChainBinding"](client)
+            assert binding.blockNumber() == 0
+            backend.commit()
+            assert binding.blockNumber() == 1
+            assert binding.shardCount() == 3
+        finally:
+            client.close()
+    finally:
+        server.stop()
