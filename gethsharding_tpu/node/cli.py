@@ -145,6 +145,34 @@ def build_parser() -> argparse.ArgumentParser:
                          help="output file (default: stdout)")
     bindgen.add_argument("--verbosity", default="warning",
                          choices=("debug", "info", "warning", "error"))
+
+    devnet = sub.add_parser(
+        "devnet", help="spin up a whole network as OS processes: one "
+                       "chain + N supervised actors (the puppeth / "
+                       "ExecAdapter role)")
+    devnet.add_argument("--notaries", type=int, default=1)
+    devnet.add_argument("--proposers", type=int, default=1)
+    devnet.add_argument("--observers", type=int, default=0)
+    devnet.add_argument("--lights", type=int, default=0)
+    devnet.add_argument("--datadir", default="",
+                        help="base dir for per-actor datadirs + logs "
+                             "(empty = auto temp dir, kept after exit "
+                             "for post-mortems)")
+    devnet.add_argument("--blocktime", type=float, default=0.5)
+    devnet.add_argument("--quorum", type=int, default=None)
+    devnet.add_argument("--shardcount", type=int, default=None)
+    devnet.add_argument("--sigbackend", default="python",
+                        choices=("python", "jax"))
+    devnet.add_argument("--http-base", type=int, default=0,
+                        help="first actor status port (0 = no status "
+                             "servers); successive actors count up")
+    devnet.add_argument("--runtime", type=float, default=0.0,
+                        help="seconds before automatic shutdown "
+                             "(0 = until SIGINT)")
+    devnet.add_argument("--interval", type=float, default=2.0,
+                        help="supervision/status cadence")
+    devnet.add_argument("--verbosity", default="warning",
+                        choices=("debug", "info", "warning", "error"))
     return parser
 
 
@@ -181,6 +209,10 @@ def run_cli(argv: Optional[List[str]] = None) -> int:
         from gethsharding_tpu.tools import run_bindgen
 
         return run_bindgen(args)
+    if args.command == "devnet":
+        from gethsharding_tpu.devnet import run_devnet
+
+        return run_devnet(args)
     return 2
 
 
