@@ -1,0 +1,300 @@
+"""Whisper analog: PoW-gated, encrypted, topic-addressed messaging on
+the shardp2p bus (`whisper/whisperv6` role).
+
+The reference ships whisper as an orthogonal capability stack: darkness-
+preserving messaging where envelopes carry a 4-byte topic, a TTL, a
+proof-of-work nonce (spam deterrent: required work scales with size x
+ttl, `whisperv6/envelope.go` PoW()) and an AES/ECIES-encrypted payload,
+flooded to every peer and opened only by nodes holding a matching key
+(`whisperv6/whisper.go`, `filter.go`). This module re-expresses that
+capability over this framework's transports instead of devp2p: envelopes
+are typed bus messages (`p2p/service.py` feeds in-process, the
+authenticated relay/direct tier across processes via `rpc/codec.py`).
+
+Kept semantics:
+  - envelope = {expiry, ttl, topic, nonce, ciphertext}; its identity is
+    keccak256 of the RLP (envelope.go Hash());
+  - PoW value = 2^(leading zero bits of hash) / (size * ttl)
+    (envelope.go:120 PoW) — minting searches the nonce, relays drop
+    envelopes below their threshold (wh.MinPow);
+  - symmetric mode: a shared 32-byte topic key (AES-GCM here, matching
+    the framework's AEAD baseline rather than v6's AES-GCM too);
+  - asymmetric mode: ephemeral secp256k1 ECDH against the recipient's
+    public key (the ECIES role, reusing `p2p/direct.py` primitives);
+  - filters: subscribe by topic + key; only matching, decryptable,
+    unexpired envelopes are delivered (filter.go MatchEnvelope).
+
+Scalar host code by design: messaging is a control-plane capability; the
+TPU path stays reserved for the consensus kernels.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from gethsharding_tpu.crypto import secp256k1
+from gethsharding_tpu.crypto.keccak import keccak256
+from gethsharding_tpu.p2p.direct import (
+    AESGCM, _ecdh_secret, _ephemeral_keypair)
+from gethsharding_tpu.utils.rlp import int_to_big_endian, rlp_encode
+
+TOPIC_LEN = 4
+DEFAULT_TTL = 60
+DEFAULT_MIN_POW = 4.0  # ~2^8 hash attempts for a tiny envelope
+_MAX_MINT_ATTEMPTS = 1 << 22
+
+
+class WhisperError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class Envelope:
+    """The flooded unit. Only ciphertext travels; topic is the routing
+    hint (4 bytes of darkness, not a cleartext subject)."""
+
+    expiry: int
+    ttl: int
+    topic: bytes
+    ciphertext: bytes
+    nonce: int
+
+    def _rlp(self) -> bytes:
+        return rlp_encode([
+            int_to_big_endian(self.expiry),
+            int_to_big_endian(self.ttl),
+            self.topic,
+            self.ciphertext,
+            int_to_big_endian(self.nonce),
+        ])
+
+    def hash(self) -> bytes:
+        return keccak256(self._rlp())
+
+    def pow(self) -> float:
+        """2^(leading zero bits) / (size * ttl) (envelope.go PoW)."""
+        digest = self.hash()
+        bits = 0
+        for byte in digest:
+            if byte == 0:
+                bits += 8
+                continue
+            bits += 8 - byte.bit_length()
+            break
+        return (2.0 ** bits) / (len(self._rlp()) * max(self.ttl, 1))
+
+
+@dataclass(frozen=True)
+class ReceivedMessage:
+    payload: bytes
+    topic: bytes
+    envelope_hash: bytes
+
+
+def _seal_sym(payload: bytes, key: bytes, topic: bytes) -> bytes:
+    if AESGCM is None:  # pragma: no cover - cryptography is baked in
+        raise WhisperError("AESGCM unavailable")
+    if len(key) != 32:
+        raise WhisperError("symmetric key must be 32 bytes")
+    iv = os.urandom(12)
+    return iv + AESGCM(key).encrypt(iv, payload, topic)
+
+
+def _open_sym(ciphertext: bytes, key: bytes, topic: bytes) -> bytes:
+    from cryptography.exceptions import InvalidTag
+
+    if len(ciphertext) < 13:
+        raise WhisperError("ciphertext too short")
+    try:
+        return AESGCM(key).decrypt(ciphertext[:12], ciphertext[12:], topic)
+    except InvalidTag as exc:
+        raise WhisperError("wrong key") from exc
+
+
+def _seal_asym(payload: bytes, recipient_pub64: bytes,
+               topic: bytes) -> bytes:
+    eph_priv, eph_pub = _ephemeral_keypair()
+    secret = _ecdh_secret(eph_priv, recipient_pub64)
+    return eph_pub + _seal_sym(payload, secret, topic)
+
+
+def _open_asym(ciphertext: bytes, priv: int, topic: bytes) -> bytes:
+    if len(ciphertext) < 64:
+        raise WhisperError("ciphertext too short")
+    secret = _ecdh_secret(priv, ciphertext[:64])
+    return _open_sym(ciphertext[64:], secret, topic)
+
+
+def seal(payload: bytes, topic: bytes, *, sym_key: Optional[bytes] = None,
+         to_pub: Optional[bytes] = None, ttl: int = DEFAULT_TTL,
+         min_pow: float = DEFAULT_MIN_POW,
+         now: Optional[float] = None) -> Envelope:
+    """Encrypt + PoW-mint an envelope (exactly one key mode)."""
+    if len(topic) != TOPIC_LEN:
+        raise WhisperError(f"topic must be {TOPIC_LEN} bytes")
+    if (sym_key is None) == (to_pub is None):
+        raise WhisperError("exactly one of sym_key/to_pub required")
+    if sym_key is not None:
+        ciphertext = _seal_sym(payload, sym_key, topic)
+    else:
+        ciphertext = _seal_asym(payload, to_pub, topic)
+    expiry = int(now if now is not None else time.time()) + ttl
+    for nonce in range(_MAX_MINT_ATTEMPTS):
+        env = Envelope(expiry=expiry, ttl=ttl, topic=topic,
+                       ciphertext=ciphertext, nonce=nonce)
+        if env.pow() >= min_pow:
+            return env
+    raise WhisperError("PoW target unreachable")  # pragma: no cover
+
+
+class Filter:
+    """One subscription: topic + key, with a bounded delivery queue."""
+
+    def __init__(self, topic: bytes, sym_key: Optional[bytes],
+                 priv: Optional[int], maxsize: int):
+        self.topic = topic
+        self.sym_key = sym_key
+        self.priv = priv
+        self.queue: "queue.Queue[ReceivedMessage]" = queue.Queue(maxsize)
+
+    def try_open(self, env: Envelope) -> Optional[ReceivedMessage]:
+        if env.topic != self.topic:
+            return None
+        try:
+            if self.sym_key is not None:
+                payload = _open_sym(env.ciphertext, self.sym_key, env.topic)
+            elif self.priv is not None:
+                payload = _open_asym(env.ciphertext, self.priv, env.topic)
+            else:
+                return None
+        except WhisperError:
+            return None
+        return ReceivedMessage(payload=payload, topic=env.topic,
+                               envelope_hash=env.hash())
+
+    def get(self, timeout: Optional[float] = None) -> ReceivedMessage:
+        return self.queue.get(timeout=timeout)
+
+
+class Whisper:
+    """The node-side service: posts envelopes to the bus, matches
+    incoming ones against local filters, drops spam (low PoW) and
+    expired traffic (whisper.go Send/processQueue)."""
+
+    def __init__(self, p2p, min_pow: float = DEFAULT_MIN_POW):
+        self.p2p = p2p
+        self.min_pow = min_pow
+        self._filters: List[Filter] = []
+        self._seen: Dict[bytes, int] = {}  # envelope hash -> expiry
+        self._lock = threading.Lock()
+        self._sub = None
+        self.stats = {"posted": 0, "delivered": 0, "dropped_pow": 0,
+                      "dropped_expired": 0, "dropped_dup": 0}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self.p2p.start()  # attach to the hub before envelopes can flow
+        self._sub = self.p2p.subscribe(Envelope)
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="whisper")
+        self._running = True
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        if self._sub is not None:
+            self._sub.unsubscribe()
+        self._thread.join(timeout=5)
+
+    def _loop(self) -> None:
+        while self._running:
+            try:
+                message = self._sub.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            env = getattr(message, "data", message)
+            if isinstance(env, Envelope):
+                try:
+                    self._ingest(env)
+                except Exception:  # noqa: BLE001 - daemon must survive
+                    # a malformed envelope (hostile peer) must not kill
+                    # the delivery loop: that would be a permanent DoS
+                    # from one message
+                    import logging
+
+                    logging.getLogger("sharding.whisper").exception(
+                        "dropping malformed envelope")
+
+    # -- posting -----------------------------------------------------------
+
+    def post(self, payload: bytes, topic: bytes, *,
+             sym_key: Optional[bytes] = None,
+             to_pub: Optional[bytes] = None,
+             ttl: int = DEFAULT_TTL,
+             pow_target: Optional[float] = None) -> Envelope:
+        """Seal, mint and flood an envelope; also delivered locally so a
+        node can message itself (whisper.go Send -> postEvent)."""
+        env = seal(payload, topic, sym_key=sym_key, to_pub=to_pub,
+                   ttl=ttl,
+                   min_pow=self.min_pow if pow_target is None
+                   else pow_target)
+        self.stats["posted"] += 1
+        self.p2p.broadcast(env)
+        self._ingest(env)
+        return env
+
+    # -- receiving ---------------------------------------------------------
+
+    def subscribe(self, topic: bytes, *, sym_key: Optional[bytes] = None,
+                  priv: Optional[int] = None,
+                  maxsize: int = 256) -> Filter:
+        if (sym_key is None) == (priv is None):
+            raise WhisperError("exactly one of sym_key/priv required")
+        flt = Filter(topic, sym_key, priv, maxsize)
+        with self._lock:
+            self._filters.append(flt)
+        return flt
+
+    def unsubscribe(self, flt: Filter) -> None:
+        with self._lock:
+            if flt in self._filters:
+                self._filters.remove(flt)
+
+    def _ingest(self, env: Envelope) -> None:
+        now = int(time.time())
+        if env.expiry < now:
+            self.stats["dropped_expired"] += 1
+            return
+        if env.pow() < self.min_pow:
+            self.stats["dropped_pow"] += 1
+            return
+        digest = env.hash()
+        with self._lock:
+            if digest in self._seen:
+                self.stats["dropped_dup"] += 1
+                return
+            self._seen[digest] = env.expiry
+            if len(self._seen) > 4096:  # expiry sweep, amortized
+                self._seen = {h: e for h, e in self._seen.items()
+                              if e >= now}
+            filters = list(self._filters)
+        for flt in filters:
+            message = flt.try_open(env)
+            if message is not None:
+                try:
+                    flt.queue.put_nowait(message)
+                    self.stats["delivered"] += 1
+                except queue.Full:
+                    pass
+
+
+def public_key_bytes(priv: int) -> bytes:
+    """64-byte uncompressed public key for asymmetric addressing."""
+    pub = secp256k1.pubkey_from_priv(priv)
+    return pub[0].to_bytes(32, "big") + pub[1].to_bytes(32, "big")

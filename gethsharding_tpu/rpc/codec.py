@@ -141,6 +141,16 @@ def enc_p2p(data) -> tuple:
             "proof": [enc_bytes(node) for node in data.proof],
             "bodyLen": data.body_len,
         }
+    from gethsharding_tpu.p2p.whisper import Envelope
+
+    if isinstance(data, Envelope):
+        return "WhisperEnvelope", {
+            "expiry": data.expiry,
+            "ttl": data.ttl,
+            "topic": enc_bytes(data.topic),
+            "ciphertext": enc_bytes(data.ciphertext),
+            "nonce": data.nonce,
+        }
     raise TypeError(f"no p2p wire codec for {type(data).__name__}")
 
 
@@ -175,6 +185,19 @@ def dec_p2p(kind: str, payload: dict):
             index=payload["index"],
             proof=tuple(dec_bytes(node) for node in payload["proof"]),
             body_len=payload.get("bodyLen", 0),
+        )
+    if kind == "WhisperEnvelope":
+        from gethsharding_tpu.p2p.whisper import Envelope
+
+        # coerce the int fields: a peer-supplied non-int would otherwise
+        # detonate later inside the whisper daemon thread, not here at
+        # the wire boundary where the caller's guard catches it
+        return Envelope(
+            expiry=int(payload["expiry"]),
+            ttl=int(payload["ttl"]),
+            topic=dec_bytes(payload["topic"]),
+            ciphertext=dec_bytes(payload["ciphertext"]),
+            nonce=int(payload["nonce"]),
         )
     raise ValueError(f"unknown p2p message type {kind!r}")
 

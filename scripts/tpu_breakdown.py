@@ -1,0 +1,128 @@
+"""Stage-level cost breakdown of the notary audit kernel on the live
+backend (the `--profile` companion: where jax.profiler gives a trace,
+this prints attackable numbers per pipeline stage).
+
+Stages of `bls_aggregate_verify_committee_batch` at the bench shape
+(100 shards x 135 committee slots):
+  aggregate  - masked projective tree reduction of committee G1 sigs
+               + G2 pubkeys
+  miller     - shared-accumulator optimal-ate Miller loop on the
+               aggregates
+  final_exp  - inversion-free final-exponentiation check
+  full       - the production single-dispatch kernel (all of the above
+               fused by XLA)
+
+Timing uses random in-range limb data: every stage is integer-only with
+static shapes and no data-dependent control flow, so wall-clock does not
+depend on the values. Prints ONE JSON line.
+
+Usage: python scripts/tpu_breakdown.py [--shards N] [--committee C]
+Honors the same GETHSHARDING_TPU_* kernel knobs as bench.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _time(fn, args, repeats=5):
+    """Median seconds per call, post-compile."""
+    out = fn(*args)
+    jax_tree_block(out)
+    samples = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax_tree_block(out)
+        samples.append(time.perf_counter() - t0)
+    return float(np.median(samples))
+
+
+def jax_tree_block(out):
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(out):
+        if hasattr(leaf, "block_until_ready"):
+            leaf.block_until_ready()
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--shards", type=int, default=100)
+    parser.add_argument("--committee", type=int, default=135)
+    parser.add_argument("--repeats", type=int, default=5)
+    args = parser.parse_args()
+
+    from gethsharding_tpu.parallel.virtual import configure_compile_cache
+
+    configure_compile_cache()
+
+    import jax
+    import jax.numpy as jnp
+
+    from gethsharding_tpu.ops import bn256_jax as k
+
+    platform = jax.devices()[0].platform
+    B, C = args.shards, args.committee
+    rng = np.random.default_rng(7)
+
+    def limbs(*shape):
+        return jnp.asarray(rng.integers(0, 1 << 12, shape + (22,),
+                                        dtype=np.int32))
+
+    hx, hy = limbs(B), limbs(B)
+    sigx, sigy = limbs(B, C), limbs(B, C)
+    pkx, pky = limbs(B, C, 2), limbs(B, C, 2)
+    sig_mask = jnp.ones((B, C), bool)
+    pk_mask = jnp.ones((B, C), bool)
+    valid = jnp.ones((B,), bool)
+
+    agg = jax.jit(lambda sx, sy, sm, px, py, pm: (
+        k.aggregate_g1_proj(sx, sy, sm), k.aggregate_g2_proj(px, py, pm)))
+    (sX, sY, sZ), (pX, pY, pZ) = agg(sigx, sigy, sig_mask, pkx, pky, pk_mask)
+
+    miller = jax.jit(lambda a, b, c, x, y, d, e, f:
+                     k._bls_miller_opt((a, b, c), x, y, (d, e, f)))
+    f12 = miller(sX, sY, sZ, hx, hy, pX, pY, pZ)
+
+    finalexp = jax.jit(k.pairing_is_one)
+    full = jax.jit(k.bls_aggregate_verify_committee_batch)
+
+    timings = {
+        "aggregate": _time(agg, (sigx, sigy, sig_mask, pkx, pky, pk_mask),
+                           args.repeats),
+        "miller": _time(miller, (sX, sY, sZ, hx, hy, pX, pY, pZ),
+                        args.repeats),
+        "final_exp": _time(finalexp, (f12,), args.repeats),
+        "full": _time(full, (hx, hy, sigx, sigy, sig_mask,
+                             pkx, pky, pk_mask, valid), args.repeats),
+    }
+    sigs = B * C
+    knobs = {key: os.environ.get(key, "") for key in (
+        "GETHSHARDING_TPU_LIMB_FORM", "GETHSHARDING_TPU_CARRY",
+        "GETHSHARDING_TPU_CONV", "GETHSHARDING_TPU_PAIRCONV",
+        "GETHSHARDING_TPU_PALLAS")}
+    print(json.dumps({
+        "platform": platform,
+        "shards": B,
+        "committee": C,
+        "stage_seconds": timings,
+        "stage_pct_of_full": {
+            name: round(100 * sec / timings["full"], 1)
+            for name, sec in timings.items()},
+        "sigs_per_sec_full": round(sigs / timings["full"], 1),
+        "knobs": knobs,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

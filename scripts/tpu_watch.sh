@@ -18,14 +18,40 @@ while true; do
     pids=$(pgrep -f "pytest tests/" || true)
     [ -n "$pids" ] && kill -STOP $pids 2>/dev/null
     out=".tpu_results/bench_$(date +%s)"
+    bench_start=$(date +%s)
     timeout 7200 python bench.py >"$out.json" 2>"$out.log"
     rc=$?
-    [ -n "$pids" ] && kill -CONT $pids 2>/dev/null
     tail -c 400 "$out.json" >>"$LOG"
     if [ $rc -eq 0 ] && grep -q '"platform": "tpu' "$out.json"; then
       echo "$ts CAPTURED TPU BENCH -> $out.json" >>"$LOG"
+      # while the window is open (and the suite is still paused — the
+      # breakdown compiles four kernels on the 1-core host): a stage
+      # breakdown so a <100k number comes with attackable per-stage
+      # costs. Knobs come from the autotune cache ONLY if this bench
+      # run wrote it (the in-process fallback path leaves a stale
+      # cache whose config wouldn't match the number just captured).
+      knobs=""
+      cache_mtime=$(stat -c %Y .bench_autotune.json 2>/dev/null || echo 0)
+      if [ "$cache_mtime" -ge "$bench_start" ]; then
+        knobs=$(python - <<'PYEOF'
+import json
+try:
+    cache = json.load(open(".bench_autotune.json"))
+    if cache.get("platform") not in (None, "cpu"):
+        print(" ".join(f"{k}={v}"
+                       for k, v in cache.get("config", {}).items()))
+except Exception:
+    pass
+PYEOF
+)
+      fi
+      env $knobs timeout 1800 python scripts/tpu_breakdown.py \
+        >"$out.breakdown.json" 2>>"$LOG" \
+        && echo "$ts breakdown -> $out.breakdown.json" >>"$LOG"
+      [ -n "$pids" ] && kill -CONT $pids 2>/dev/null
       exit 0
     fi
+    [ -n "$pids" ] && kill -CONT $pids 2>/dev/null
     echo "$ts bench rc=$rc but no TPU result; looping" >>"$LOG"
   else
     echo "$ts tunnel down" >>"$LOG"
