@@ -146,6 +146,21 @@ def build_parser() -> argparse.ArgumentParser:
     bindgen.add_argument("--verbosity", default="warning",
                          choices=("debug", "info", "warning", "error"))
 
+    signer = sub.add_parser(
+        "signer", help="external key-custody process with rules + audit "
+                       "(the clef analog)")
+    signer.add_argument("--keystore", required=True)
+    signer.add_argument("--password", default=None,
+                        help="password or password-file for the keystore")
+    signer.add_argument("--port", type=int, default=0)
+    signer.add_argument("--allow", default="",
+                        help="comma-separated address allowlist "
+                             "(empty = all keystore accounts)")
+    signer.add_argument("--new", action="store_true",
+                        help="create one account if the keystore is empty")
+    signer.add_argument("--verbosity", default="warning",
+                        choices=("debug", "info", "warning", "error"))
+
     devnet = sub.add_parser(
         "devnet", help="spin up a whole network as OS processes: one "
                        "chain + N supervised actors (the puppeth / "
@@ -213,6 +228,10 @@ def run_cli(argv: Optional[List[str]] = None) -> int:
         from gethsharding_tpu.devnet import run_devnet
 
         return run_devnet(args)
+    if args.command == "signer":
+        from gethsharding_tpu.signer import run_signer
+
+        return run_signer(args)
     return 2
 
 

@@ -40,7 +40,7 @@ from typing import Dict, List, Optional, Tuple
 from gethsharding_tpu.crypto import secp256k1
 from gethsharding_tpu.crypto.keccak import keccak256
 from gethsharding_tpu.p2p.direct import (
-    AESGCM, _ecdh_secret, _ephemeral_keypair)
+    AESGCM, InvalidTag, _ecdh_secret, _ephemeral_keypair)
 from gethsharding_tpu.utils.rlp import int_to_big_endian, rlp_encode
 
 TOPIC_LEN = 4
@@ -78,15 +78,19 @@ class Envelope:
 
     def pow(self) -> float:
         """2^(leading zero bits) / (size * ttl) (envelope.go PoW)."""
-        digest = self.hash()
-        bits = 0
-        for byte in digest:
-            if byte == 0:
-                bits += 8
-                continue
-            bits += 8 - byte.bit_length()
-            break
-        return (2.0 ** bits) / (len(self._rlp()) * max(self.ttl, 1))
+        return _pow_of(self._rlp(), self.ttl)
+
+
+def _pow_of(blob: bytes, ttl: int) -> float:
+    digest = keccak256(blob)
+    bits = 0
+    for byte in digest:
+        if byte == 0:
+            bits += 8
+            continue
+        bits += 8 - byte.bit_length()
+        break
+    return (2.0 ** bits) / (len(blob) * max(ttl, 1))
 
 
 @dataclass(frozen=True)
@@ -106,8 +110,8 @@ def _seal_sym(payload: bytes, key: bytes, topic: bytes) -> bytes:
 
 
 def _open_sym(ciphertext: bytes, key: bytes, topic: bytes) -> bytes:
-    from cryptography.exceptions import InvalidTag
-
+    if AESGCM is None:  # pragma: no cover - cryptography is baked in
+        raise WhisperError("AESGCM unavailable")
     if len(ciphertext) < 13:
         raise WhisperError("ciphertext too short")
     try:
@@ -144,10 +148,21 @@ def seal(payload: bytes, topic: bytes, *, sym_key: Optional[bytes] = None,
     else:
         ciphertext = _seal_asym(payload, to_pub, topic)
     expiry = int(now if now is not None else time.time()) + ttl
+    # mint without re-encoding the (large, nonce-independent) body every
+    # attempt: pre-encode the stable items, vary only the nonce suffix
+    # and the list header
+    from gethsharding_tpu.utils.rlp import _encode_length
+
+    stable = b"".join(rlp_encode(item) for item in (
+        int_to_big_endian(expiry), int_to_big_endian(ttl), topic,
+        ciphertext))
     for nonce in range(_MAX_MINT_ATTEMPTS):
-        env = Envelope(expiry=expiry, ttl=ttl, topic=topic,
-                       ciphertext=ciphertext, nonce=nonce)
-        if env.pow() >= min_pow:
+        payload = stable + rlp_encode(int_to_big_endian(nonce))
+        blob = _encode_length(len(payload), 0xC0) + payload
+        if _pow_of(blob, ttl) >= min_pow:
+            env = Envelope(expiry=expiry, ttl=ttl, topic=topic,
+                           ciphertext=ciphertext, nonce=nonce)
+            assert env._rlp() == blob  # one-time self-check per mint
             return env
     raise WhisperError("PoW target unreachable")  # pragma: no cover
 
@@ -193,8 +208,11 @@ class Whisper:
         self._seen: Dict[bytes, int] = {}  # envelope hash -> expiry
         self._lock = threading.Lock()
         self._sub = None
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
         self.stats = {"posted": 0, "delivered": 0, "dropped_pow": 0,
-                      "dropped_expired": 0, "dropped_dup": 0}
+                      "dropped_expired": 0, "dropped_future": 0,
+                      "dropped_dup": 0}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -210,7 +228,8 @@ class Whisper:
         self._running = False
         if self._sub is not None:
             self._sub.unsubscribe()
-        self._thread.join(timeout=5)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
 
     def _loop(self) -> None:
         while self._running:
@@ -246,7 +265,9 @@ class Whisper:
                    else pow_target)
         self.stats["posted"] += 1
         self.p2p.broadcast(env)
-        self._ingest(env)
+        # local delivery is unconditional: a node's own post reaches its
+        # own filters even when minted below the node's relay threshold
+        self._ingest(env, local=True)
         return env
 
     # -- receiving ---------------------------------------------------------
@@ -266,12 +287,18 @@ class Whisper:
             if flt in self._filters:
                 self._filters.remove(flt)
 
-    def _ingest(self, env: Envelope) -> None:
+    def _ingest(self, env: Envelope, local: bool = False) -> None:
         now = int(time.time())
         if env.expiry < now:
             self.stats["dropped_expired"] += 1
             return
-        if env.pow() < self.min_pow:
+        # an expiry inconsistent with the TTL would pin the dedup cache
+        # entry (and duck the PoW-per-ttl economics) — reject it the way
+        # the reference bounds expiry to now+ttl (whisper.go add())
+        if env.expiry > now + env.ttl + 60:
+            self.stats["dropped_future"] += 1
+            return
+        if not local and env.pow() < self.min_pow:
             self.stats["dropped_pow"] += 1
             return
         digest = env.hash()
@@ -283,6 +310,8 @@ class Whisper:
             if len(self._seen) > 4096:  # expiry sweep, amortized
                 self._seen = {h: e for h, e in self._seen.items()
                               if e >= now}
+                while len(self._seen) > 8192:  # hard bound: oldest out
+                    self._seen.pop(next(iter(self._seen)))
             filters = list(self._filters)
         for flt in filters:
             message = flt.try_open(env)

@@ -1,0 +1,358 @@
+"""External signer process: the clef (`cmd/clef` + `signer/`) analog.
+
+The reference's clef moves key custody OUT of the node: geth asks a
+separate signer process for every signature over an RPC boundary, the
+signer applies rules (auto-approve lists, per-request review) and keeps
+a tamper-evident audit trail (`signer/core/api.go` SignerAPI,
+`signer/rules/rules.go`, `signer/core/auditlog.go`). Here the same
+custody split runs over the framework's newline JSON-RPC codec:
+
+  SignerServer  - owns the keystore (Web3 Secret Storage files), derives
+                  the BLS vote keys, enforces an address allowlist + an
+                  approval hook, records every decision in an audit log;
+  RemoteSigner  - the node-side stand-in for `mainchain.AccountManager`:
+                  implements the exact signing surface `SMCClient`
+                  consumes (unlock / sign_hash / bls_sign /
+                  bls_proof_of_possession / new_account), so a node can
+                  run with its keys in another process and NO private
+                  key material in its own address space.
+
+CLI: `tpu-sharding signer --keystore DIR --password PW [--port N]`.
+Wire methods (signer_* namespace): accounts, newAccount, signHash,
+blsSign, blsPubkey, blsPop, audit.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import socketserver
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from gethsharding_tpu.utils.hexbytes import Address20
+
+log = logging.getLogger("sharding.signer")
+
+APPROVED, REJECTED = "approved", "rejected"
+
+
+class SignerRefused(Exception):
+    """The signer's rules refused the request (clef's deny path)."""
+
+
+def _enc_g1(point) -> Optional[list]:
+    return None if point is None else [hex(point[0]), hex(point[1])]
+
+
+def _dec_g1(obj):
+    return None if obj is None else (int(obj[0], 16), int(obj[1], 16))
+
+
+def _enc_g2(point) -> Optional[list]:
+    if point is None:
+        return None
+    x, y = point  # G2Point = (Fp2, Fp2); Fp2 carries .a/.b
+    return [hex(x.a), hex(x.b), hex(y.a), hex(y.b)]
+
+
+def _dec_g2(obj):
+    from gethsharding_tpu.crypto.bn256 import Fp2
+
+    if obj is None:
+        return None
+    xa, xb, ya, yb = (int(v, 16) for v in obj)
+    return (Fp2(xa, xb), Fp2(ya, yb))
+
+
+class SignerServer:
+    """Key custody + rules + audit, behind a TCP JSON-line boundary."""
+
+    def __init__(self, keystore_dir: str, password: str,
+                 host: str = "127.0.0.1", port: int = 0,
+                 allow: Optional[List[Address20]] = None,
+                 approve: Optional[Callable[[str, Address20, bytes],
+                                            bool]] = None):
+        from gethsharding_tpu.mainchain.accounts import AccountManager
+        from gethsharding_tpu.mainchain.keystore import Keystore
+
+        self.keystore = Keystore(keystore_dir)
+        self.password = password
+        self.manager = AccountManager()
+        for stored in self.keystore.accounts():
+            priv = self.keystore.unlock(stored.address, password)
+            self.manager.import_key(priv)
+        self._allow = (None if allow is None
+                       else {bytes(a) for a in allow})
+        # the rules hook (signer/rules): method, address, payload -> bool
+        self._approve = approve
+        self.audit: List[dict] = []
+        self._lock = threading.Lock()
+        self._host, self._port = host, port
+        self._server: Optional[socketserver.ThreadingTCPServer] = None
+
+    # -- rules + audit -----------------------------------------------------
+
+    def _gate(self, method: str, address: Address20,
+              payload: bytes) -> None:
+        verdict = APPROVED
+        reason = ""
+        if self.manager.get(address) is None:
+            verdict, reason = REJECTED, "unknown account"
+        elif self._allow is not None and bytes(address) not in self._allow:
+            verdict, reason = REJECTED, "address not in allowlist"
+        elif self._approve is not None and not self._approve(
+                method, address, payload):
+            verdict, reason = REJECTED, "approval hook refused"
+        with self._lock:
+            self.audit.append({
+                "ts": time.time(),
+                "method": method,
+                "address": address.hex_str,
+                "payload": payload.hex()[:128],
+                "verdict": verdict,
+                **({"reason": reason} if reason else {}),
+            })
+        if verdict == REJECTED:
+            raise SignerRefused(f"{method} for {address.hex_str}: {reason}")
+
+    # -- method surface ----------------------------------------------------
+
+    def _handle(self, method: str, params: dict):
+        if method == "signer_accounts":
+            return [{"address": a.address.hex_str,
+                     "blsPubkey": _enc_g2(a.bls_pubkey)}
+                    for a in self.manager._accounts.values()]
+        if method == "signer_newAccount":
+            seed = bytes.fromhex(params.get("seed", ""))
+            # account creation goes through the SAME rules layer as
+            # signing: a pinned allowlist means a pinned account set,
+            # and the approval hook reviews creation too (clef gates
+            # account_new behind approval, signer/core/api.go New)
+            verdict, reason = APPROVED, ""
+            if self._allow is not None:
+                verdict, reason = REJECTED, ("account set pinned by "
+                                             "allowlist")
+            elif self._approve is not None and not self._approve(
+                    method, Address20(), seed):
+                verdict, reason = REJECTED, "approval hook refused"
+            entry = {"ts": time.time(), "method": method,
+                     "verdict": verdict,
+                     **({"reason": reason} if reason else {})}
+            if verdict == REJECTED:
+                with self._lock:
+                    self.audit.append(entry)
+                raise SignerRefused(f"{method}: {reason}")
+            acct = self.manager.new_account(seed=seed)
+            self.keystore.store(acct.priv, self.password)
+            entry["address"] = acct.address.hex_str
+            with self._lock:
+                self.audit.append(entry)
+            return {"address": acct.address.hex_str,
+                    "blsPubkey": _enc_g2(acct.bls_pubkey)}
+        if method == "signer_audit":
+            with self._lock:
+                return list(self.audit)
+
+        address = Address20(bytes.fromhex(
+            params["address"].removeprefix("0x")))
+        if method == "signer_signHash":
+            digest = bytes.fromhex(params["digest"])
+            self._gate(method, address, digest)
+            return self.manager.sign_hash(address, digest).hex()
+        if method == "signer_blsSign":
+            message = bytes.fromhex(params["message"])
+            self._gate(method, address, message)
+            return _enc_g1(self.manager.bls_sign(address, message))
+        if method == "signer_blsPubkey":
+            acct = self.manager.get(address)
+            if acct is None:
+                raise SignerRefused("unknown account")
+            return _enc_g2(acct.bls_pubkey)
+        if method == "signer_blsPop":
+            self._gate(method, address, b"proof-of-possession")
+            return _enc_g1(self.manager.bls_proof_of_possession(address))
+        raise ValueError(f"unknown method {method!r}")
+
+    # -- transport ---------------------------------------------------------
+
+    def start(self) -> None:
+        outer = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self) -> None:
+                for raw in self.rfile:
+                    rid = None
+                    try:
+                        req = json.loads(raw)
+                        rid = req.get("id")
+                        result = outer._handle(req.get("method", ""),
+                                               req.get("params") or {})
+                        resp = {"jsonrpc": "2.0", "id": rid,
+                                "result": result}
+                    except SignerRefused as exc:
+                        resp = {"jsonrpc": "2.0", "id": rid,
+                                "error": {"code": -32000,
+                                          "message": str(exc),
+                                          "data": "SignerRefused"}}
+                    except Exception as exc:  # noqa: BLE001 - boundary
+                        resp = {"jsonrpc": "2.0", "id": rid,
+                                "error": {"code": -32603,
+                                          "message": str(exc)}}
+                    try:
+                        self.wfile.write(
+                            (json.dumps(resp) + "\n").encode())
+                        self.wfile.flush()
+                    except OSError:
+                        return
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((self._host, self._port), Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="signer-server")
+        self._thread.start()
+        log.info("signer listening on %s:%d", *self.address)
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._server.server_address
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+
+
+class RemoteAccount:
+    """The node-visible face of a remotely-held key (no priv member —
+    there is nothing to leak)."""
+
+    def __init__(self, address: Address20, bls_pubkey):
+        self.address = address
+        self.bls_pubkey = bls_pubkey
+
+
+class RemoteSigner:
+    """AccountManager-compatible signing surface over the signer RPC.
+
+    Drop-in for `SMCClient(accounts=...)`: every signature round-trips
+    to the custody process; key material never enters this process.
+    """
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._file = self._sock.makefile("rwb")
+        self._lock = threading.Lock()
+        self._ids = iter(range(1, 1 << 62))
+
+    @classmethod
+    def dial(cls, host: str, port: int) -> "RemoteSigner":
+        return cls(host, port)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _call(self, method: str, params: dict):
+        with self._lock:
+            rid = next(self._ids)
+            self._file.write((json.dumps(
+                {"jsonrpc": "2.0", "id": rid, "method": method,
+                 "params": params}) + "\n").encode())
+            self._file.flush()
+            raw = self._file.readline()
+        if not raw:
+            raise ConnectionError("signer closed the connection")
+        resp = json.loads(raw)
+        if "error" in resp:
+            err = resp["error"]
+            if err.get("data") == "SignerRefused":
+                raise SignerRefused(err.get("message", ""))
+            raise RuntimeError(f"signer error: {err.get('message')}")
+        return resp["result"]
+
+    # -- AccountManager surface (what SMCClient consumes) ------------------
+
+    def accounts(self) -> List[RemoteAccount]:
+        return [RemoteAccount(
+            Address20(bytes.fromhex(e["address"].removeprefix("0x"))),
+            _dec_g2(e["blsPubkey"]))
+            for e in self._call("signer_accounts", {})]
+
+    def new_account(self, seed: bytes = b"",
+                    unlock: bool = True) -> RemoteAccount:
+        entry = self._call("signer_newAccount", {"seed": seed.hex()})
+        return RemoteAccount(
+            Address20(bytes.fromhex(entry["address"].removeprefix("0x"))),
+            _dec_g2(entry["blsPubkey"]))
+
+    def unlock(self, address: Address20) -> None:
+        # custody lives with the signer; reachability is the unlock check
+        self._call("signer_blsPubkey", {"address": address.hex_str})
+
+    def lock(self, address: Address20) -> None:
+        pass
+
+    def get(self, address: Address20) -> Optional[RemoteAccount]:
+        for acct in self.accounts():
+            if bytes(acct.address) == bytes(address):
+                return acct
+        return None
+
+    def sign_hash(self, address: Address20, digest: bytes) -> bytes:
+        return bytes.fromhex(self._call(
+            "signer_signHash",
+            {"address": address.hex_str, "digest": digest.hex()}))
+
+    def bls_sign(self, address: Address20, message: bytes):
+        return _dec_g1(self._call(
+            "signer_blsSign",
+            {"address": address.hex_str, "message": message.hex()}))
+
+    def bls_proof_of_possession(self, address: Address20):
+        return _dec_g1(self._call("signer_blsPop",
+                                  {"address": address.hex_str}))
+
+    def audit_log(self) -> List[dict]:
+        return self._call("signer_audit", {})
+
+
+def run_signer(args) -> int:
+    """CLI: host a signer over a keystore directory."""
+    import sys
+
+    password = args.password
+    if password is not None:
+        try:
+            with open(password) as fh:
+                password = fh.read().strip()
+        except OSError:
+            pass
+    allow = None
+    if args.allow:
+        allow = [Address20(bytes.fromhex(a.removeprefix("0x")))
+                 for a in args.allow.split(",")]
+    server = SignerServer(args.keystore, password or "", port=args.port,
+                          allow=allow)
+    if args.new and not server.manager._accounts:
+        server._handle("signer_newAccount", {})
+    server.start()
+    print(json.dumps({"host": server.address[0],
+                      "port": server.address[1],
+                      "accounts": len(server.manager._accounts)}),
+          flush=True)
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+    return 0

@@ -73,9 +73,12 @@ def main() -> int:
     platform = jax.devices()[0].platform
     B, C = args.shards, args.committee
     rng = np.random.default_rng(7)
+    # the limb count depends on the active form knob (22 exact/25 wide):
+    # read it off the engine instead of assuming
+    n_limbs = int(np.asarray(k.FP.one).shape[-1])
 
     def limbs(*shape):
-        return jnp.asarray(rng.integers(0, 1 << 12, shape + (22,),
+        return jnp.asarray(rng.integers(0, 1 << 12, shape + (n_limbs,),
                                         dtype=np.int32))
 
     hx, hy = limbs(B), limbs(B)

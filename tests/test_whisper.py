@@ -148,6 +148,27 @@ def test_whisper_crosses_the_authenticated_relay():
         server.stop()
 
 
+def test_ingest_bounds_and_local_delivery():
+    """TTL-inconsistent expiry is refused (dedup-cache pinning defense);
+    a node's own sub-threshold post still reaches its own filters; and
+    stop() before start() is harmless."""
+    w = Whisper(P2PServer(hub=Hub()), min_pow=8.0)
+    flt = w.subscribe(TOPIC, sym_key=KEY)
+
+    pinned = Envelope(expiry=2 ** 40, ttl=1, topic=TOPIC,
+                      ciphertext=b"\x00" * 13, nonce=0)
+    w._ingest(pinned)
+    assert w.stats["dropped_future"] == 1
+    assert not w._seen  # nothing cached for the hostile envelope
+
+    # a local post below the relay threshold still self-delivers
+    w.p2p.start()
+    w.post(b"quiet note", TOPIC, sym_key=KEY, pow_target=0.0001)
+    assert flt.get(timeout=1).payload == b"quiet note"
+
+    Whisper(P2PServer(hub=Hub())).stop()  # no start(): no AttributeError
+
+
 def test_malformed_envelope_does_not_kill_the_daemon():
     """A hostile peer's garbage must be dropped at the wire boundary
     (codec coercion) and, defense-in-depth, must not kill the delivery
