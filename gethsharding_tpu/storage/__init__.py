@@ -1,0 +1,11 @@
+"""Content-addressed chunk storage (the swarm-role capability stack).
+
+`bmt` — binary-merkle-tree chunk hasher with inclusion proofs
+(`bmt/bmt.go` role); `chunker` — 128-branching tree chunker over a KV
+store (`swarm/storage/chunker.go` role).
+"""
+
+from gethsharding_tpu.storage.bmt import (  # noqa: F401
+    SEGMENT_SIZE, bmt_hash, bmt_proof, bmt_verify)
+from gethsharding_tpu.storage.chunker import (  # noqa: F401
+    CHUNK_SIZE, ChunkStore)

@@ -1,0 +1,125 @@
+"""Tree chunker: content-addressed storage of arbitrary-size data
+(`swarm/storage/chunker.go` role).
+
+The reference's TreeChunker splits content into 4096-byte chunks,
+prefixes every stored chunk with its 8-byte little-endian subtree size
+(`chunker.go:197,220`), hashes each chunk to its key, and builds a
+128-branching tree of keys bottom-up until one root key addresses the
+whole blob; retrieval walks keys back down and joins leaves. That
+shape — span-prefixed chunks, hash = address, fixed branching — is what
+this module keeps. The chunk hash is the BMT root of the payload bound
+to the span (`key = keccak256(span_le8 || bmt_root)`), giving every
+chunk the compact-inclusion-proof property of `storage/bmt.py`.
+
+Integrity is verified on retrieval: every chunk fetched by key is
+re-hashed, so a corrupted store surfaces as an error, not silent data.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Optional
+
+from gethsharding_tpu.crypto.keccak import keccak256
+from gethsharding_tpu.db.kv import KVStore, MemoryKV
+from gethsharding_tpu.storage.bmt import MAX_CHUNK, bmt_hash
+
+CHUNK_SIZE = MAX_CHUNK  # 4096
+BRANCHES = 128
+KEY_SIZE = 32
+
+
+class ChunkStoreError(Exception):
+    pass
+
+
+def chunk_key(span: int, payload: bytes) -> bytes:
+    """Address of one stored chunk: the BMT root bound to the subtree
+    size it spans (the span prefix of chunker.go:220)."""
+    return keccak256(struct.pack("<Q", span) + bmt_hash(payload))
+
+
+class ChunkStore:
+    """Split / join over a KV seam (`db/kv.py`: memory or SQLite)."""
+
+    def __init__(self, kv: Optional[KVStore] = None):
+        self.kv = kv if kv is not None else MemoryKV()
+
+    # -- split (store) -----------------------------------------------------
+
+    def _put(self, span: int, payload: bytes) -> bytes:
+        prefix = struct.pack("<Q", span)
+        key = keccak256(prefix + bmt_hash(payload))
+        self.kv.put(b"chunk:" + key, prefix + payload)
+        return key
+
+    def store(self, data: bytes) -> bytes:
+        """Chunk `data` into the store; returns the root key."""
+        if len(data) <= CHUNK_SIZE:
+            return self._put(len(data), data)
+        # leaf level: 4096-byte data chunks
+        keys: List[bytes] = []
+        spans: List[int] = []
+        for start in range(0, len(data), CHUNK_SIZE):
+            piece = data[start:start + CHUNK_SIZE]
+            keys.append(self._put(len(piece), piece))
+            spans.append(len(piece))
+        # interior levels: chunks of up to 128 child keys, spanning the
+        # sum of their subtrees
+        while len(keys) > 1:
+            next_keys: List[bytes] = []
+            next_spans: List[int] = []
+            for start in range(0, len(keys), BRANCHES):
+                group = keys[start:start + BRANCHES]
+                if len(group) == 1:
+                    # never wrap a single child: a 1-ary interior node's
+                    # span can collide with the leaf range, making
+                    # retrieve() misread the key list as user data (the
+                    # reference TreeChunker likewise promotes lone
+                    # subtrees)
+                    next_keys.append(group[0])
+                    next_spans.append(spans[start])
+                    continue
+                span = sum(spans[start:start + BRANCHES])
+                payload = b"".join(group)
+                next_keys.append(self._put(span, payload))
+                next_spans.append(span)
+            keys, spans = next_keys, next_spans
+        return keys[0]
+
+    # -- join (retrieve) ---------------------------------------------------
+
+    def _get(self, key: bytes) -> tuple:
+        raw = self.kv.get(b"chunk:" + key)
+        if raw is None:
+            raise ChunkStoreError(f"missing chunk {key.hex()}")
+        span = struct.unpack("<Q", raw[:8])[0]
+        payload = raw[8:]
+        if chunk_key(span, payload) != key:
+            raise ChunkStoreError(f"corrupted chunk {key.hex()}")
+        return span, payload
+
+    def size(self, root: bytes) -> int:
+        """Total content size under a root key (span of its chunk)."""
+        span, _ = self._get(root)
+        return span
+
+    def retrieve(self, root: bytes) -> bytes:
+        """Reassemble + verify the full content under `root`."""
+        span, payload = self._get(root)
+        if span <= CHUNK_SIZE:
+            if len(payload) != span:
+                raise ChunkStoreError("leaf span does not match payload")
+            return payload
+        if len(payload) % KEY_SIZE:
+            raise ChunkStoreError("interior chunk is not a key list")
+        parts = []
+        for start in range(0, len(payload), KEY_SIZE):
+            parts.append(self.retrieve(payload[start:start + KEY_SIZE]))
+        data = b"".join(parts)
+        if len(data) != span:
+            raise ChunkStoreError("subtree span mismatch")
+        return data
+
+    def has(self, root: bytes) -> bool:
+        return self.kv.has(b"chunk:" + root)

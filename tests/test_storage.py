@@ -1,0 +1,99 @@
+"""Swarm-role storage tests: BMT hashing/proofs and the content-
+addressed tree chunker (split/join, integrity, persistence)."""
+
+import os
+
+import pytest
+
+from gethsharding_tpu.crypto.keccak import keccak256
+from gethsharding_tpu.db.kv import SqliteKV
+from gethsharding_tpu.storage import (
+    CHUNK_SIZE, ChunkStore, SEGMENT_SIZE, bmt_hash, bmt_proof, bmt_verify)
+from gethsharding_tpu.storage.bmt import BMTError, MAX_CHUNK
+from gethsharding_tpu.storage.chunker import ChunkStoreError
+
+
+def test_bmt_structure_matches_the_recursion_rule():
+    # one segment: the raw keccak (no tree)
+    assert bmt_hash(b"abc") == keccak256(b"abc")
+    assert bmt_hash(b"") == keccak256(b"")
+    # two segments: keccak(H(left) || H(right))
+    data = os.urandom(64)
+    expect = keccak256(keccak256(data[:32]) + keccak256(data[32:]))
+    assert bmt_hash(data) == expect
+    # 33 bytes: split at 32, one-byte raw tail hashed as a leaf
+    data = os.urandom(33)
+    assert bmt_hash(data) == keccak256(
+        keccak256(data[:32]) + keccak256(data[32:]))
+    # three segments: split at 64 (largest pow2 < 96)
+    data = os.urandom(96)
+    left = keccak256(keccak256(data[:32]) + keccak256(data[32:64]))
+    assert bmt_hash(data) == keccak256(left + keccak256(data[64:]))
+    with pytest.raises(BMTError):
+        bmt_hash(b"\x00" * (MAX_CHUNK + 1))
+
+
+@pytest.mark.parametrize("size", [32, 33, 64, 96, 1000, MAX_CHUNK])
+def test_bmt_inclusion_proofs(size):
+    data = os.urandom(size)
+    root = bmt_hash(data)
+    n_segments = (size + SEGMENT_SIZE - 1) // SEGMENT_SIZE
+    for index in {0, n_segments // 2, n_segments - 1}:
+        segment, path = bmt_proof(data, index)
+        assert segment == data[index * 32:(index + 1) * 32]
+        assert bmt_verify(root, segment, path)
+        # forged segment fails
+        assert not bmt_verify(root, b"\xee" * len(segment), path) \
+            or segment == b"\xee" * len(segment)
+    with pytest.raises(BMTError):
+        bmt_proof(data, n_segments + 1)
+
+
+@pytest.mark.parametrize("size", [
+    0, 1, CHUNK_SIZE - 1, CHUNK_SIZE, CHUNK_SIZE + 1,
+    3 * CHUNK_SIZE + 7, 130 * CHUNK_SIZE + 5,
+    # trailing-lone-subtree sizes: a level whose last group has exactly
+    # one child (the 1-ary interior regression class)
+    128 * CHUNK_SIZE + 32, 128 * CHUNK_SIZE + 100, 129 * CHUNK_SIZE])
+def test_chunker_roundtrip(size):
+    store = ChunkStore()
+    data = os.urandom(size)
+    root = store.store(data)
+    assert len(root) == 32
+    assert store.retrieve(root) == data
+    assert store.size(root) == size
+    assert store.has(root)
+    # storing the same content is idempotent: same address
+    assert store.store(data) == root
+    # different content, different address
+    if size:
+        assert store.store(data[:-1] + b"\x00") != root or data[-1:] == b"\x00"
+
+
+def test_chunker_detects_corruption_and_missing_chunks():
+    store = ChunkStore()
+    data = os.urandom(2 * CHUNK_SIZE + 100)
+    root = store.store(data)
+
+    # corrupt one stored leaf: retrieval must fail loudly
+    victim = next(k for k, v in store.kv.items()
+                  if k.startswith(b"chunk:") and len(v) == 8 + CHUNK_SIZE)
+    store.kv.put(victim, b"\x00" * len(store.kv.get(victim)))
+    with pytest.raises(ChunkStoreError, match="corrupt|missing"):
+        store.retrieve(root)
+
+    store2 = ChunkStore()
+    with pytest.raises(ChunkStoreError, match="missing"):
+        store2.retrieve(root)
+
+
+def test_chunker_persists_over_sqlite(tmp_path):
+    path = str(tmp_path / "chunks.db")
+    data = os.urandom(CHUNK_SIZE * 2 + 17)
+    store = ChunkStore(kv=SqliteKV(path))
+    root = store.store(data)
+    store.kv.close()
+
+    reopened = ChunkStore(kv=SqliteKV(path))
+    assert reopened.retrieve(root) == data
+    reopened.kv.close()
