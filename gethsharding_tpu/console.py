@@ -102,6 +102,50 @@ class ShardingConsole(cmd.Cmd):
         """approved <shard> — last period with an approved collation"""
         self.emit(self.chain.last_approved_collation(int(arg.strip())))
 
+    def do_trace(self, arg):
+        """trace <txhash> — event-level execution trace of a sealed tx
+        (debug_traceTransaction analog)"""
+        from gethsharding_tpu.utils.hexbytes import Hash32
+
+        raw = arg.strip().removeprefix("0x")
+        trace = self.chain.trace_transaction(Hash32(bytes.fromhex(raw)))
+        if trace is None:
+            self.emit("unknown transaction")
+            return
+        self.emit(f"status={trace['status']} block={trace['blockNumber']}")
+        for frame in trace["trace"]:
+            args = " ".join(f"{k}={v}" for k, v in frame["args"].items())
+            self.emit(f"  {frame['event']}: {args}")
+
+    def do_py(self, arg):
+        """py — drop into a Python REPL with `chain` bound (the JS-REPL
+        scripting role of console/console.go; exit() returns here)"""
+        import code
+
+        from gethsharding_tpu.tools import generate_bindings
+
+        def _leave(*_a):
+            # the site-builtin exit() CLOSES sys.stdin before raising
+            # SystemExit, which would wedge the outer cmd loop; shadow
+            # it with a plain SystemExit so `py` really returns here
+            raise SystemExit
+
+        namespace = {"chain": self.chain, "exit": _leave, "quit": _leave}
+        try:  # the generated typed binding too, when the conn allows it
+            scope: dict = {}
+            exec(compile(generate_bindings(), "<bindgen>", "exec"), scope)
+            namespace["binding"] = scope["ChainBinding"](self.chain.rpc)
+        except Exception:  # pragma: no cover - binding is best-effort
+            pass
+        try:
+            code.interact(
+                banner="python console - `chain` (RemoteMainchain) and "
+                       "`binding` (generated) are bound; exit() or "
+                       "Ctrl-D to return",
+                local=namespace)
+        except SystemExit:
+            pass  # exit()/quit() return to the sharding prompt
+
     def do_peers(self, arg):
         """peers — shardp2p relay peer table"""
         peers = self.chain.p2p_peers()

@@ -273,6 +273,12 @@ class RemoteMainchain:
             tx_hash=Hash32(codec.dec_bytes(obj["txHash"])),
             status=obj["status"], block_number=obj["blockNumber"])
 
+    def trace_transaction(self, tx_hash: Hash32):
+        """Event-level execution trace of a sealed tx (the
+        debug_traceTransaction analog); None for unknown hashes."""
+        return self.rpc.call("shard_traceTransaction",
+                             codec.enc_bytes(tx_hash))
+
     def verify_period_batch(self, period: int):
         return self.rpc.call("shard_verifyPeriodBatch", period)
 

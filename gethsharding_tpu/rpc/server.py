@@ -254,6 +254,30 @@ class RPCServer:
             Hash32(codec.dec_bytes(tx_hash)))
         return None if receipt is None else codec.enc_receipt(receipt)
 
+    def rpc_traceTransaction(self, tx_hash):
+        """The debug_traceTransaction role (`eth/api_tracer.go`) for the
+        native engine: the SMC's emitted events ARE the execution trace
+        (one entry per state-machine effect), returned with the receipt
+        frame. None for unknown hashes."""
+        receipt = self.backend.transaction_receipt(
+            Hash32(codec.dec_bytes(tx_hash)))
+        if receipt is None:
+            return None
+        def enc_arg(value):
+            if isinstance(value, (bytes, bytearray)) \
+                    or hasattr(value, "__bytes__"):  # Address20 / Hash32
+                return codec.enc_bytes(bytes(value))
+            return value
+
+        return {
+            "txHash": codec.enc_bytes(receipt.tx_hash),
+            "status": receipt.status,
+            "blockNumber": receipt.block_number,
+            "trace": [{"event": e.name,
+                       "args": {k: enc_arg(v) for k, v in e.args.items()}}
+                      for e in receipt.events],
+        }
+
     def rpc_verifyPeriodBatch(self, period):
         return self.backend.verify_period_batch(period)
 

@@ -96,7 +96,15 @@ def bmt_proof(data: bytes, segment_index: int
 
 def bmt_verify(root: bytes, segment: bytes,
                path: List[Tuple[bool, bytes]]) -> bool:
-    """Re-derive the root from a segment + sibling path."""
+    """Re-derive the root from a segment + sibling path.
+
+    The segment must fit ONE leaf: leaf preimages are <= 32 bytes while
+    interior preimages are exactly 64 (two node hashes), so the length
+    bound is the leaf/interior domain separation — without it, an
+    attacker could present an interior node's preimage as a fake
+    64-byte "segment" with a truncated path and it would verify."""
+    if len(segment) > SEGMENT_SIZE:
+        return False
     node = keccak256(segment)
     for is_right, sibling in path:
         node = keccak256(node + sibling if is_right

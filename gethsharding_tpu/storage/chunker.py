@@ -48,9 +48,8 @@ class ChunkStore:
     # -- split (store) -----------------------------------------------------
 
     def _put(self, span: int, payload: bytes) -> bytes:
-        prefix = struct.pack("<Q", span)
-        key = keccak256(prefix + bmt_hash(payload))
-        self.kv.put(b"chunk:" + key, prefix + payload)
+        key = chunk_key(span, payload)
+        self.kv.put(b"chunk:" + key, struct.pack("<Q", span) + payload)
         return key
 
     def store(self, data: bytes) -> bytes:
@@ -93,6 +92,9 @@ class ChunkStore:
         raw = self.kv.get(b"chunk:" + key)
         if raw is None:
             raise ChunkStoreError(f"missing chunk {key.hex()}")
+        if len(raw) < 8:
+            raise ChunkStoreError(f"corrupted chunk {key.hex()} "
+                                  "(truncated span)")
         span = struct.unpack("<Q", raw[:8])[0]
         payload = raw[8:]
         if chunk_key(span, payload) != key:

@@ -233,3 +233,57 @@ def test_faucet_tool_drips_funds():
                         "--address", "nonsense"]) == 1
     finally:
         server.stop()
+
+
+def test_console_trace_and_python_mode():
+    """The trace command prints a tx's event-level execution trace, and
+    `py` drops into a scriptable Python REPL with the chain bound (the
+    JS-REPL scripting role) — across two real OS processes."""
+    chain_proc = subprocess.Popen(
+        [sys.executable, "-m", "gethsharding_tpu.rpc.chain_server",
+         "--port", "0", "--runtime", "60"],
+        stdout=subprocess.PIPE, text=True)
+    try:
+        info = json.loads(chain_proc.stdout.readline())
+
+        # produce a traceable tx through the remote surface
+        from gethsharding_tpu.mainchain.accounts import AccountManager
+        from gethsharding_tpu.params import ETHER
+        from gethsharding_tpu.rpc.client import RemoteMainchain
+
+        manager = AccountManager()
+        acct = manager.new_account(seed=b"trace-console")
+        remote = RemoteMainchain.dial("127.0.0.1", info["port"])
+        remote.fund(acct.address, 2000 * ETHER)
+        receipt = remote.register_notary(acct.address)
+        tx_hex = "0x" + bytes(receipt.tx_hash).hex()
+        trace = remote.trace_transaction(receipt.tx_hash)
+        assert trace["status"] == 1
+        assert trace["trace"][0]["event"] == "NotaryRegistered"
+        assert trace["trace"][0]["args"]["notary"] == \
+            "0x" + bytes(acct.address).hex()
+        remote.close()
+
+        script = "\n".join([
+            f"trace {tx_hex}",
+            "trace 0x" + "ee" * 32,
+            "py",
+            "print('PYMODE', chain.block_number, binding.shardCount())",
+            "exit()",
+            "period",  # proves exit() RETURNED to the sharding prompt
+            "quit",
+        ]) + "\n"
+        out = subprocess.run(
+            [sys.executable, "-m", "gethsharding_tpu.node.cli", "attach",
+             "--port", str(info["port"])],
+            input=script, text=True, capture_output=True, timeout=30)
+        assert out.returncode == 0
+        assert "NotaryRegistered" in out.stdout
+        assert "unknown transaction" in out.stdout
+        assert "PYMODE 0 100" in out.stdout
+        # the console survived exit(): the period command ran after it
+        # and printed its value (0) back at the sharding prompt
+        assert "> 0\n" in out.stdout[out.stdout.index("PYMODE"):]
+    finally:
+        chain_proc.terminate()
+        chain_proc.wait(timeout=10)

@@ -70,6 +70,30 @@ def test_chunker_roundtrip(size):
         assert store.store(data[:-1] + b"\x00") != root or data[-1:] == b"\x00"
 
 
+def test_bmt_interior_preimage_forgery_is_rejected():
+    """Leaf/interior domain separation: an interior node's 64-byte
+    preimage presented as a 'segment' with a truncated path must NOT
+    verify (it hashes to the root by construction)."""
+    data = os.urandom(64)
+    root = bmt_hash(data)
+    forged_segment = keccak256(data[:32]) + keccak256(data[32:])
+    assert not bmt_verify(root, forged_segment, [])
+    # deeper variant: present a subtree's preimage one level up
+    data = os.urandom(128)
+    root = bmt_hash(data)
+    left = keccak256(keccak256(data[:32]) + keccak256(data[32:64]))
+    right = keccak256(keccak256(data[64:96]) + keccak256(data[96:]))
+    assert not bmt_verify(root, left + right, [])
+
+
+def test_chunker_truncated_record_is_a_chunk_error():
+    store = ChunkStore()
+    root = store.store(b"hello")
+    store.kv.put(b"chunk:" + root, b"\x01\x02")  # shorter than the span
+    with pytest.raises(ChunkStoreError, match="truncated|corrupt"):
+        store.retrieve(root)
+
+
 def test_chunker_detects_corruption_and_missing_chunks():
     store = ChunkStore()
     data = os.urandom(2 * CHUNK_SIZE + 100)
