@@ -1160,8 +1160,9 @@ def g1_committee_to_limbs(rows: Sequence[Sequence[ref.G1Point]], width: int):
                 flat_x.append(pt[0] % P)
                 flat_y.append(pt[1] % P)
                 mask[b, c] = True
-    xs = ints_to_limbs(flat_x).reshape(B, width, NLIMBS)
-    ys = ints_to_limbs(flat_y).reshape(B, width, NLIMBS)
+    both = ints_to_limbs(flat_x + flat_y)  # one bit-plane pass for x+y
+    xs = both[:B * width].reshape(B, width, NLIMBS)
+    ys = both[B * width:].reshape(B, width, NLIMBS)
     return xs, ys, mask
 
 
@@ -1183,8 +1184,10 @@ def g2_committee_to_limbs(rows: Sequence[Sequence[ref.G2Point]], width: int):
                 flat_x.extend((x.a % P, x.b % P))
                 flat_y.extend((y.a % P, y.b % P))
                 mask[b, c] = True
-    xs = ints_to_limbs(flat_x).reshape(B, width, 2, NLIMBS)
-    ys = ints_to_limbs(flat_y).reshape(B, width, 2, NLIMBS)
+    both = ints_to_limbs(flat_x + flat_y)  # one bit-plane pass for x+y
+    half = B * width * 2
+    xs = both[:half].reshape(B, width, 2, NLIMBS)
+    ys = both[half:].reshape(B, width, 2, NLIMBS)
     return xs, ys, mask
 
 

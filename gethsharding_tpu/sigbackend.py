@@ -110,12 +110,22 @@ class JaxSigBackend(SigBackend):
 
     @staticmethod
     def _bucket(n: int) -> int:
-        """Pad batches to power-of-two buckets so the live node compiles a
-        handful of kernel shapes instead of one per distinct batch size."""
-        size = 1
-        while size < n:
+        """Pad batches to quarter-power-of-two buckets (…, 64, 80, 96,
+        112, 128, …): a handful of compiled shapes per octave instead of
+        one per distinct batch size, with ≤12.5% padded rows — the plain
+        pow2 rule wasted 28% of every kernel launch at the production
+        100-shard audit (100 -> 128)."""
+        if n <= 8:  # pow2 below 8: tiny pads, few compiled shapes
+            size = 1
+            while size < n:
+                size *= 2
+            return size
+        size = 8
+        while size * 2 < n:
             size *= 2
-        return size
+        # quarter steps inside the octave (size, 2*size]
+        quarter = size // 4
+        return -(-n // quarter) * quarter
 
     def ecrecover_addresses(self, digests, sigs65):
         import numpy as np
@@ -188,11 +198,12 @@ class JaxSigBackend(SigBackend):
         pad = self._bucket(n) - n
         # committee axis: the tree reduction takes any width (binary
         # segment decomposition), so bucket only enough to bound the
-        # number of compiled shapes — next multiple of 32 (135 -> 160),
-        # power of two below that
+        # number of compiled shapes — next multiple of 16 (135 -> 144;
+        # the old mult-32 rule padded 18% of the committee work),
+        # power-of-two-ish below 32
         width = max([1] + [len(r) for r in sig_rows]
                     + [len(r) for r in pk_rows])
-        width = self._bucket(width) if width <= 32 else -(-width // 32) * 32
+        width = self._bucket(width) if width <= 32 else -(-width // 16) * 16
         hashes = [bls.hash_to_g1(bytes(m)) for m in messages] + [None] * pad
         hx, hy, hok = self._bn.g1_to_limbs(hashes)
         sx, sy, sm = self._bn.g1_committee_to_limbs(
