@@ -49,13 +49,16 @@ class SigBackend:
             self,
             messages: Sequence[bytes],
             sig_rows: Sequence[Sequence[bls.G1Point]],
-            pk_rows: Sequence[Sequence[bls.G2Point]]) -> List[bool]:
+            pk_rows: Sequence[Sequence[bls.G2Point]],
+            pk_row_keys: Optional[Sequence] = None) -> List[bool]:
         """Aggregate each row's vote signatures + voter pubkeys and verify
         the aggregate against the row's message. The batch form of the
         whole committee check: with the jax backend both the aggregation
         (masked projective tree reduction) and the pairing run in ONE
         device dispatch. Empty rows are rejections (an empty committee
-        proves nothing)."""
+        proves nothing). `pk_row_keys` (optional, one hashable per row,
+        e.g. the wire encoding) lets a backend cache the marshalled
+        pubkey rows — keys MUST uniquely determine the row's points."""
         raise NotImplementedError
 
 
@@ -80,7 +83,8 @@ class PythonSigBackend(SigBackend):
             for m, s, pk in zip(messages, agg_sigs, agg_pks)
         ]
 
-    def bls_verify_committees(self, messages, sig_rows, pk_rows):
+    def bls_verify_committees(self, messages, sig_rows, pk_rows,
+                              pk_row_keys=None):
         return [
             bls.bls_verify_aggregate(
                 bytes(m), bls.bls_aggregate_sigs(sigs), list(pks))
@@ -112,9 +116,9 @@ class JaxSigBackend(SigBackend):
     def _bucket(n: int) -> int:
         """Pad batches to quarter-power-of-two buckets (…, 64, 80, 96,
         112, 128, …): a handful of compiled shapes per octave instead of
-        one per distinct batch size, with ≤12.5% padded rows — the plain
-        pow2 rule wasted 28% of every kernel launch at the production
-        100-shard audit (100 -> 128)."""
+        one per distinct batch size, with <19% padded rows above 8
+        (worst case 65 -> 80) — the plain pow2 rule wasted 28% of every
+        kernel launch at the production 100-shard audit (100 -> 128)."""
         if n <= 8:  # pow2 below 8: tiny pads, few compiled shapes
             size = 1
             while size < n:
@@ -188,7 +192,8 @@ class JaxSigBackend(SigBackend):
             jnp.asarray(valid))
         return [bool(b) for b in np.asarray(out)[:n]]
 
-    def bls_verify_committees(self, messages, sig_rows, pk_rows):
+    def bls_verify_committees(self, messages, sig_rows, pk_rows,
+                              pk_row_keys=None):
         import numpy as np
 
         jnp = self._jnp
@@ -208,13 +213,77 @@ class JaxSigBackend(SigBackend):
         hx, hy, hok = self._bn.g1_to_limbs(hashes)
         sx, sy, sm = self._bn.g1_committee_to_limbs(
             list(sig_rows) + [[]] * pad, width)
-        px, py, pm = self._bn.g2_committee_to_limbs(
-            list(pk_rows) + [[]] * pad, width)
+        px, py, pm = self._pk_rows_to_limbs(
+            list(pk_rows) + [[]] * pad, width,
+            row_keys=(None if pk_row_keys is None
+                      else list(pk_row_keys) + [None] * pad))
         out = self._bls_committee(
             jnp.asarray(hx), jnp.asarray(hy), jnp.asarray(sx),
             jnp.asarray(sy), jnp.asarray(sm), jnp.asarray(px),
             jnp.asarray(py), jnp.asarray(pm), jnp.asarray(hok))
         return [bool(b) for b in np.asarray(out)[:n]]
+
+    # -- pubkey-row limb cache ---------------------------------------------
+    # Committee PUBKEYS recur period after period (registered keys are
+    # stable until release) while signatures are fresh every vote — so
+    # the G2 half of the audit's marshalling cost, the largest, is
+    # cacheable. Caching is per ROW keyed by caller-supplied hashable
+    # keys (the notary passes the wire hex strings, whose hashes python
+    # interns): per-POINT value keys were tried and the 13k bigint-tuple
+    # hashes per audit cost as much as the conversion they saved.
+
+    # rows; an entry holds BOTH coordinate arrays: ~54 KB at 135x(2,25)
+    # int32, so 1024 rows cap the cache near 55 MB (production needs at
+    # most one row per shard in the steady state)
+    _PK_ROW_CACHE_MAX = 1024
+
+    def _pk_rows_to_limbs(self, rows, width: int, row_keys=None):
+        import numpy as np
+
+        if row_keys is None:
+            return self._bn.g2_committee_to_limbs(rows, width)
+        cache = getattr(self, "_pk_row_cache", None)
+        if cache is None:
+            cache = self._pk_row_cache = {}
+        nl = int(np.asarray(self._bn.FP.one).shape[-1])
+        B = len(rows)
+        xs = np.zeros((B, width, 2, nl), np.int32)
+        ys = np.zeros((B, width, 2, nl), np.int32)
+        mask = np.zeros((B, width), bool)
+        misses = []  # (b, key, row) — bulk-converted in ONE pass below
+        for b, row in enumerate(rows):
+            if len(row) > width:
+                raise ValueError(
+                    f"committee of {len(row)} exceeds width {width}")
+            if not row:
+                continue
+            key = row_keys[b] if b < len(row_keys) else None
+            entry = None if key is None else cache.get(key)
+            if entry is None:
+                misses.append((b, key, row))
+                continue
+            k = entry[0].shape[0]
+            xs[b, :k], ys[b, :k], mask[b, :k] = entry
+        if misses:
+            # one bulk bit-plane conversion for every miss row (a cold
+            # audit would otherwise pay the fixed numpy overhead per row)
+            miss_w = max(len(row) for _, _, row in misses)
+            mx, my, mm = self._bn.g2_committee_to_limbs(
+                [row for _, _, row in misses], miss_w)
+            for i, (b, key, row) in enumerate(misses):
+                k = len(row)
+                xs[b, :k] = mx[i, :k]
+                ys[b, :k] = my[i, :k]
+                mask[b, :k] = mm[i, :k]
+                if key is not None:
+                    while len(cache) >= self._PK_ROW_CACHE_MAX:
+                        # FIFO: evict one stale row, not the whole cache
+                        cache.pop(next(iter(cache)))
+                    # copies, not views: a view would pin the whole bulk
+                    # conversion array in memory per cached row
+                    cache[key] = (mx[i, :k].copy(), my[i, :k].copy(),
+                                  mm[i, :k].copy())
+        return xs, ys, mask
 
 
 _BACKENDS = {"python": PythonSigBackend, "jax": JaxSigBackend}

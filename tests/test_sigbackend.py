@@ -144,3 +144,45 @@ def test_bls_committee_backends_agree():
     py = get_backend("python").bls_verify_committees(msgs, sig_rows, pk_rows)
     jx = get_backend("jax").bls_verify_committees(msgs, sig_rows, pk_rows)
     assert py == jx == [True, True, True]
+
+
+def test_bls_committee_pk_row_cache_consistency():
+    """The pubkey-row limb cache (jax backend): warm calls with row keys
+    return byte-identical verdicts to the keyless path, a changed row
+    under a NEW key is marshalled fresh, and the python backend accepts
+    the same signature."""
+    backend = get_backend("jax")
+    msgs, sig_rows, pk_rows = [], [], []
+    for i in range(3):
+        tag = b"rowcache-%d" % i
+        keys = [bls.bls_keygen(tag + bytes([j])) for j in range(2 + i)]
+        sig_rows.append([bls.bls_sign(tag, sk) for sk, _ in keys])
+        pk_rows.append([pk for _, pk in keys])
+        msgs.append(tag)
+    row_keys = [("rc", i) for i in range(3)]
+
+    cold = backend.bls_verify_committees(msgs, sig_rows, pk_rows,
+                                         pk_row_keys=row_keys)
+    warm = backend.bls_verify_committees(msgs, sig_rows, pk_rows,
+                                         pk_row_keys=row_keys)
+    keyless = backend.bls_verify_committees(msgs, sig_rows, pk_rows)
+    assert cold == warm == keyless == [True, True, True]
+
+    # a forged signature still fails on the warm (cached-pk) path
+    forged = [list(r) for r in sig_rows]
+    forged[1][0] = bls.bls_sign(b"forged", bls.bls_keygen(b"evil")[0])
+    got = backend.bls_verify_committees(msgs, forged, pk_rows,
+                                        pk_row_keys=row_keys)
+    assert got == [True, False, True]
+
+    # new committee under a NEW key: marshalled fresh, verdict correct
+    keys2 = [bls.bls_keygen(b"fresh-row" + bytes([j])) for j in range(4)]
+    msgs2 = [b"fresh-msg"]
+    sigs2 = [[bls.bls_sign(b"fresh-msg", sk) for sk, _ in keys2]]
+    pks2 = [[pk for _, pk in keys2]]
+    assert backend.bls_verify_committees(
+        msgs2, sigs2, pks2, pk_row_keys=[("rc", "new")]) == [True]
+
+    # python backend accepts (and ignores) the keys
+    assert get_backend("python").bls_verify_committees(
+        msgs, sig_rows, pk_rows, pk_row_keys=row_keys) == [True, True, True]
