@@ -111,6 +111,13 @@ class JaxSigBackend(SigBackend):
         self._bls = jax.jit(bn256_jax.bls_verify_aggregate_batch)
         self._bls_committee = jax.jit(
             bn256_jax.bls_aggregate_verify_committee_batch)
+        # the backend is a process-wide singleton shared by every actor
+        # thread (get_backend caches instances): the row cache needs a
+        # lock or concurrent audits race the eviction loop
+        import threading
+
+        self._pk_row_cache: dict = {}
+        self._pk_row_lock = threading.Lock()
 
     @staticmethod
     def _bucket(n: int) -> int:
@@ -242,9 +249,7 @@ class JaxSigBackend(SigBackend):
 
         if row_keys is None:
             return self._bn.g2_committee_to_limbs(rows, width)
-        cache = getattr(self, "_pk_row_cache", None)
-        if cache is None:
-            cache = self._pk_row_cache = {}
+        cache = self._pk_row_cache
         nl = int(np.asarray(self._bn.FP.one).shape[-1])
         B = len(rows)
         xs = np.zeros((B, width, 2, nl), np.int32)
@@ -258,7 +263,11 @@ class JaxSigBackend(SigBackend):
             if not row:
                 continue
             key = row_keys[b] if b < len(row_keys) else None
-            entry = None if key is None else cache.get(key)
+            if key is None:
+                entry = None
+            else:
+                with self._pk_row_lock:
+                    entry = cache.get(key)
             if entry is None:
                 misses.append((b, key, row))
                 continue
@@ -276,13 +285,14 @@ class JaxSigBackend(SigBackend):
                 ys[b, :k] = my[i, :k]
                 mask[b, :k] = mm[i, :k]
                 if key is not None:
-                    while len(cache) >= self._PK_ROW_CACHE_MAX:
-                        # FIFO: evict one stale row, not the whole cache
-                        cache.pop(next(iter(cache)))
-                    # copies, not views: a view would pin the whole bulk
-                    # conversion array in memory per cached row
-                    cache[key] = (mx[i, :k].copy(), my[i, :k].copy(),
-                                  mm[i, :k].copy())
+                    with self._pk_row_lock:
+                        while len(cache) >= self._PK_ROW_CACHE_MAX:
+                            # FIFO: evict one stale row, not all of them
+                            cache.pop(next(iter(cache)))
+                        # copies, not views: a view would pin the whole
+                        # bulk conversion array per cached row
+                        cache[key] = (mx[i, :k].copy(), my[i, :k].copy(),
+                                      mm[i, :k].copy())
         return xs, ys, mask
 
 

@@ -271,3 +271,37 @@ def test_external_eip155_example():
     # r/s — the same nonce construction geth's libsecp256k1 uses
     ours = secp256k1.sign(sighash, priv)
     assert (ours.r, ours.s) == (int(ex["r"]), int(ex["s"]))
+
+
+def test_storage_address_vectors():
+    """BMT roots and chunk-store addresses are frozen: drift orphans
+    every previously stored blob."""
+    from gethsharding_tpu.storage import ChunkStore, bmt_hash
+    from gethsharding_tpu.storage.chunker import chunk_key
+
+    fx = _load("storage.json")
+
+    def pattern(n):
+        return bytes(i % 251 for i in range(n))
+
+    for case in fx["bmt_roots"]:
+        assert bmt_hash(pattern(case["size"])).hex() == case["root"], case
+    assert chunk_key(5, pattern(5)).hex() == fx["chunk_key_example"]
+    for case in fx["store_roots"]:
+        store = ChunkStore()
+        assert store.store(pattern(case["size"])).hex() == case["root"], case
+
+
+def test_whisper_envelope_vectors():
+    """Envelope identity hashes and PoW values are frozen: the flood
+    dedup and spam economics hang off these exact numbers."""
+    from gethsharding_tpu.p2p.whisper import Envelope
+
+    fx = _load("whisper.json")
+    for case in fx["envelopes"]:
+        env = Envelope(expiry=case["expiry"], ttl=case["ttl"],
+                       topic=bytes.fromhex(case["topic"]),
+                       ciphertext=bytes.fromhex(case["ciphertext"]),
+                       nonce=case["nonce"])
+        assert env.hash().hex() == case["hash"], case
+        assert env.pow() == case["pow"], case

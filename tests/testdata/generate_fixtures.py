@@ -263,6 +263,55 @@ def gen_smc():
     }
 
 
+def gen_storage():
+    """BMT roots + chunk-store addresses (storage/): deterministic
+    content addresses must never drift — a changed root orphans every
+    stored blob."""
+    from gethsharding_tpu.storage import ChunkStore, bmt_hash
+    from gethsharding_tpu.storage.chunker import CHUNK_SIZE, chunk_key
+
+    def pattern(n: int) -> bytes:
+        return bytes(i % 251 for i in range(n))
+
+    bmt_cases = [
+        {"size": size, "root": _hex(bmt_hash(pattern(size)))}
+        for size in (0, 1, 31, 32, 33, 64, 96, 1000, 4096)
+    ]
+    chunk_cases = []
+    for size in (0, 5, CHUNK_SIZE, CHUNK_SIZE + 1, 3 * CHUNK_SIZE + 7):
+        store = ChunkStore()
+        root = store.store(pattern(size))
+        chunk_cases.append({"size": size, "root": _hex(root)})
+    return {
+        "pattern": "bytes(i % 251 for i in range(n))",
+        "bmt_roots": bmt_cases,
+        "chunk_key_example": _hex(chunk_key(5, pattern(5))),
+        "store_roots": chunk_cases,
+    }
+
+
+def gen_whisper():
+    """Envelope identity + PoW values (p2p/whisper.py): the flood
+    dedup/spam economics hang off these exact numbers."""
+    from gethsharding_tpu.p2p.whisper import Envelope
+
+    cases = []
+    for expiry, ttl, topic, ct, nonce in (
+            (1_700_000_000, 60, b"shrd", b"\x00" * 16, 0),
+            (1_700_000_000, 60, b"shrd", b"\x00" * 16, 12345),
+            (2_000_000_000, 7, b"abcd", bytes(range(64)), 7),
+    ):
+        env = Envelope(expiry=expiry, ttl=ttl, topic=topic,
+                       ciphertext=ct, nonce=nonce)
+        cases.append({
+            "expiry": expiry, "ttl": ttl, "topic": _hex(topic),
+            "ciphertext": _hex(ct), "nonce": nonce,
+            "hash": _hex(env.hash()),
+            "pow": env.pow(),
+        })
+    return {"envelopes": cases}
+
+
 def main():
     suites = {
         "keccak.json": gen_keccak(),
@@ -272,6 +321,8 @@ def main():
         "ecdsa.json": gen_ecdsa(),
         "bls.json": gen_bls(),
         "smc.json": gen_smc(),
+        "storage.json": gen_storage(),
+        "whisper.json": gen_whisper(),
     }
     for name, data in suites.items():
         path = os.path.join(HERE, name)
