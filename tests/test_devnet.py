@@ -22,8 +22,8 @@ def _wait(cond, timeout=30.0, step=0.2):
 
 @pytest.mark.slow
 def test_devnet_progress_and_respawn(tmp_path):
-    net = Devnet(notaries=1, proposers=1, base_dir=str(tmp_path),
-                 blocktime=0.2, quorum=1)
+    net = Devnet(notaries=1, proposers=1, observers=1, lights=1,
+                 base_dir=str(tmp_path), blocktime=0.2, quorum=1)
     try:
         host, port = net.start()
         chain = RemoteMainchain.dial(host, port)
@@ -59,8 +59,9 @@ def test_devnet_progress_and_respawn(tmp_path):
             # ...and stays down on later polls
             assert "down" in net.poll()["actors"]["proposer-0"]
 
-            # the notary kept running through all of it
-            assert net.actors["notary-0"].proc.poll() is None
+            # the notary, observer and light node kept running through it
+            for name in ("notary-0", "observer-0", "light-0"):
+                assert net.actors[name].proc.poll() is None, name
         finally:
             chain.close()
     finally:
