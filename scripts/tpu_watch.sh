@@ -1,60 +1,90 @@
 #!/bin/bash
-# Probe the accelerator tunnel throughout the round; the moment it is up,
-# run the full bench sweep and capture the result. The tunnel dies for
-# hours at a time and any in-process jax init against a dead tunnel hangs
-# forever, so every probe is a bounded subprocess (see bench.py
-# _probe_backend). Exits 0 once a non-CPU bench result is captured.
+# Experiment-queue watcher for the flaky accelerator tunnel.
+#
+# The tunnel dies for hours at a time and any in-process jax init against
+# a dead tunnel hangs forever, so every probe is a bounded subprocess
+# (see bench.py _probe_backend). Whenever the tunnel is up, this runs the
+# next pending experiment from .tpu_queue/*.sh (lexicographic order) and
+# archives it to .tpu_queue/done/. Each experiment script gets the output
+# prefix as $1 and must exit 0 on success; failures are retried on later
+# windows up to 3 times (a mid-experiment tunnel death looks like a
+# failure — the retry gets a fresh window).
+#
+# Drop new experiment scripts into .tpu_queue/ at any time; the watcher
+# never exits on its own.
+#
+# Experiment contract: exit 0 ONLY on evidence of a real TPU result
+# (grep for '"platform": "tpu' in your own output) — the watcher trusts
+# the exit code, and bench.py exits 0 even on its CPU/replay fallbacks.
 cd /root/repo || exit 1
 LOG=.tpu_watch.log
-mkdir -p .tpu_results
-echo "$(date +%F\ %T) watcher start (pid $$)" >>"$LOG"
+QUEUE=.tpu_queue
+mkdir -p "$QUEUE/done" .tpu_results
+echo "$(date +%F\ %T) watcher v2 start (pid $$)" >>"$LOG"
 while true; do
+  if [ -z "$(ls "$QUEUE"/*.sh 2>/dev/null | head -1)" ]; then sleep 60; continue; fi
   plat=$(timeout 120 python -c 'import jax; print(jax.devices()[0].platform)' 2>/dev/null | tail -1)
   ts=$(date +%F\ %T)
   if [ -n "$plat" ] && [ "$plat" != "cpu" ]; then
-    echo "$ts tunnel UP ($plat) - running bench sweep" >>"$LOG"
+    echo "$ts tunnel UP ($plat); running queue pass" >>"$LOG"
     # the TPU window is precious: pause CPU-hogging suite runs so the
-    # sweep's compiles and probes aren't starved on the 1-core host
+    # experiments' compiles aren't starved on the 1-core host
     pids=$(pgrep -f "pytest tests/" || true)
     [ -n "$pids" ] && kill -STOP $pids 2>/dev/null
-    out=".tpu_results/bench_$(date +%s)"
-    bench_start=$(date +%s)
-    timeout 7200 python bench.py >"$out.json" 2>"$out.log"
-    rc=$?
-    tail -c 400 "$out.json" >>"$LOG"
-    if [ $rc -eq 0 ] && grep -q '"platform": "tpu' "$out.json"; then
-      echo "$ts CAPTURED TPU BENCH -> $out.json" >>"$LOG"
-      # while the window is open (and the suite is still paused — the
-      # breakdown compiles four kernels on the 1-core host): a stage
-      # breakdown so a <100k number comes with attackable per-stage
-      # costs. Knobs come from the autotune cache ONLY if this bench
-      # run wrote it (the in-process fallback path leaves a stale
-      # cache whose config wouldn't match the number just captured).
-      knobs=""
-      cache_mtime=$(stat -c %Y .bench_autotune.json 2>/dev/null || echo 0)
-      if [ "$cache_mtime" -ge "$bench_start" ]; then
-        knobs=$(python - <<'PYEOF'
-import json
-try:
-    cache = json.load(open(".bench_autotune.json"))
-    if cache.get("platform") not in (None, "cpu"):
-        print(" ".join(f"{k}={v}"
-                       for k, v in cache.get("config", {}).items()))
-except Exception:
-    pass
-PYEOF
-)
+    # never leave suites frozen if the watcher dies mid-pass
+    trap '[ -n "$pids" ] && kill -CONT $pids 2>/dev/null' EXIT
+    # one pass over the WHOLE pending queue per window: a failing
+    # experiment moves on to the next instead of burning the window
+    for next in "$QUEUE"/*.sh; do
+      [ -e "$next" ] || continue
+      # the tunnel can die mid-pass: re-probe before each experiment so
+      # the rest of the queue doesn't hang to its timeouts and burn
+      # retry strikes on a dead tunnel
+      plat=$(timeout 120 python -c 'import jax; print(jax.devices()[0].platform)' 2>/dev/null | tail -1)
+      if [ -z "$plat" ] || [ "$plat" = "cpu" ]; then
+        echo "$(date +%F\ %T) tunnel died mid-pass; abandoning window" >>"$LOG"
+        break
       fi
-      env $knobs timeout 1800 python scripts/tpu_breakdown.py \
-        >"$out.breakdown.json" 2>>"$LOG" \
-        && echo "$ts breakdown -> $out.breakdown.json" >>"$LOG"
-      [ -n "$pids" ] && kill -CONT $pids 2>/dev/null
-      exit 0
-    fi
+      name=$(basename "$next" .sh)
+      out=".tpu_results/${name}_$(date +%s)"
+      # own process group so a timeout kills the experiment's python
+      # grandchildren too (a hung jax init survives a plain `timeout`)
+      setsid bash "$next" "$out" >>"$out.log" 2>&1 &
+      exp=$!
+      waited=0
+      while kill -0 "$exp" 2>/dev/null && [ $waited -lt 7200 ]; do
+        sleep 30
+        waited=$((waited + 30))
+      done
+      if kill -0 "$exp" 2>/dev/null; then
+        kill -TERM -- "-$exp" 2>/dev/null
+        sleep 10
+        kill -KILL -- "-$exp" 2>/dev/null
+        rc=124
+      else
+        wait "$exp"
+        rc=$?
+      fi
+      echo "$(date +%F\ %T) $name rc=$rc -> $out.log" >>"$LOG"
+      if [ $rc -eq 0 ]; then
+        mv "$next" "$QUEUE/done/${name}_$(date +%s).sh"
+        rm -f "$QUEUE/.retries_$name"
+      else
+        n=$(cat "$QUEUE/.retries_$name" 2>/dev/null || echo 0)
+        n=$((n + 1))
+        echo $n >"$QUEUE/.retries_$name"
+        if [ "$n" -ge 3 ]; then
+          mv "$next" "$QUEUE/done/FAILED_${name}_$(date +%s).sh"
+          rm -f "$QUEUE/.retries_$name"
+          echo "$(date +%F\ %T) $name parked after $n failures" >>"$LOG"
+        fi
+      fi
+    done
     [ -n "$pids" ] && kill -CONT $pids 2>/dev/null
-    echo "$ts bench rc=$rc but no TPU result; looping" >>"$LOG"
+    # retries of still-pending failures wait for the next pass
+    sleep 600
   else
-    echo "$ts tunnel down" >>"$LOG"
+    echo "$ts tunnel down ($(ls "$QUEUE"/*.sh 2>/dev/null | wc -l) pending)" >>"$LOG"
+    sleep 240
   fi
-  sleep 240
 done
