@@ -52,9 +52,15 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 # wins.
 CONFIGS = [
     {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "scan"},
-    # r3 additions, probed right after the champion: the fused Pallas
-    # pair-conv (never materializes the product tensor in HBM), alone,
-    # + fused-normalize, and the int8-plane MXU column contraction
+    # r3 additions, probed right after the champion: the statically
+    # unrolled carry (straight-line fused code instead of an XLA While
+    # per normalize), the fused Pallas pair-conv (never materializes the
+    # product tensor in HBM), alone, + fused-normalize, and the
+    # int8-plane MXU column contraction
+    {"GETHSHARDING_TPU_LIMB_FORM": "exact",
+     "GETHSHARDING_TPU_CARRY": "unroll"},
+    {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "unroll",
+     "GETHSHARDING_TPU_SCAN_UNROLL": "8"},
     {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "scan",
      "GETHSHARDING_TPU_PAIRCONV": "pallas"},
     {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "scan",
@@ -71,6 +77,14 @@ CONFIGS = [
     {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "assoc"},
     {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "scan",
      "GETHSHARDING_TPU_PALLAS": "1"},
+    # LAST on purpose: the fully inlined PAIR_UNROLL kernels compile for
+    # >35 min on XLA:CPU and may not fit the per-config probe timeout on
+    # any backend — the watcher's queue probes them with long timeouts
+    # instead; in a sweep they only run if budget remains
+    {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "unroll",
+     "GETHSHARDING_TPU_PAIR_UNROLL": "1"},
+    {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "scan",
+     "GETHSHARDING_TPU_PAIR_UNROLL": "1"},
 ]
 
 SWEEP_BUDGET_S = float(os.environ.get("GETHSHARDING_BENCH_BUDGET_S", "1200"))
@@ -468,7 +482,13 @@ def _latest_capture() -> dict | None:
 
 def _replay_capture(reason: str) -> bool:
     """Report this round's live TPU capture instead of a meaningless CPU
-    number. Returns False when no (recent) capture exists."""
+    number. Returns False when no (recent) capture exists.
+
+    GETHSHARDING_BENCH_NO_REPLAY=1 disables replay entirely — the tunnel
+    watcher's experiments set it so a mid-run tunnel death reads as
+    failure (retry next window) instead of a replayed 'success'."""
+    if os.environ.get("GETHSHARDING_BENCH_NO_REPLAY") == "1":
+        return False
     captured = _latest_capture()
     if captured is None:
         return False
@@ -537,14 +557,29 @@ def main() -> None:
 
     best_cfg, best = None, None
     cache_key = None
+    failed: list = []
     try:
         cached = json.load(open(_cache_path()))
-        cache_key = cached.get("platform")
-        if (cached.get("sweep") == _sweep_fingerprint()
-                and all(key in cached for key in ("config", "platform"))):
-            best_cfg = cached["config"]
+        if cached.get("sweep") == _sweep_fingerprint():
+            # negative cache: configs that timed out / crashed in an
+            # earlier sweep of THIS config set are not re-probed (a
+            # deterministic too-slow compile would eat the tunnel window
+            # every round)
+            failed = [c for c in cached.get("failed", []) if c in CONFIGS]
+            if all(key in cached for key in ("config", "platform")):
+                cache_key = cached.get("platform")
+                best_cfg = cached["config"]
     except Exception:
         pass
+
+    def _save_cache(winner=None, platform=None):
+        payload = {"sweep": _sweep_fingerprint(), "failed": failed}
+        if winner is not None:
+            payload.update({"config": winner, "platform": platform})
+        try:
+            json.dump(payload, open(_cache_path(), "w"))
+        except OSError:
+            pass
 
     if best_cfg is not None:
         stats = _run_config(best_cfg, extras=True)
@@ -555,8 +590,13 @@ def main() -> None:
 
     if best_cfg is None:
         results = []
+        sweep_failures: list = []
         sweep_start = time.monotonic()
         for i, cfg in enumerate(CONFIGS):
+            if cfg in failed:
+                print(f"# skipping config {cfg} (failed in an earlier "
+                      f"sweep)", file=sys.stderr)
+                continue
             if results and time.monotonic() - sweep_start > SWEEP_BUDGET_S:
                 print(f"# sweep budget exhausted after {i} configs",
                       file=sys.stderr)
@@ -566,6 +606,8 @@ def main() -> None:
                 results.append((cfg, stats))
                 print(f"# config {cfg} -> {stats['sig_rate']:.1f} sigs/sec "
                       f"[{stats['platform']}]", file=sys.stderr)
+            else:
+                sweep_failures.append(cfg)
         if not results:
             # every sweep probe failed; before measuring in-process,
             # re-probe — the tunnel may have died MID-RUN, and an
@@ -582,12 +624,10 @@ def main() -> None:
             best_cfg, best = {}, measure_single()
         else:
             best_cfg, best = max(results, key=lambda r: r[1]["sig_rate"])
-            try:
-                json.dump({"config": best_cfg, "platform": best["platform"],
-                           "sweep": _sweep_fingerprint()},
-                          open(_cache_path(), "w"))
-            except OSError:
-                pass
+            # persist failures only from a sweep where something ELSE
+            # succeeded — a dead-tunnel window must not blacklist configs
+            failed.extend(c for c in sweep_failures if c not in failed)
+            _save_cache(best_cfg, best["platform"])
             # one extra run of the winner for the config 1/2/4/5 numbers
             stats = _run_config(best_cfg, extras=True)
             if stats is not None:
@@ -600,6 +640,10 @@ def main() -> None:
          best_cfg.get("GETHSHARDING_TPU_CONV", "shift")]
         + (["pairconv-pallas"]
            if best_cfg.get("GETHSHARDING_TPU_PAIRCONV") == "pallas" else [])
+        + (["pair-unroll"]
+           if best_cfg.get("GETHSHARDING_TPU_PAIR_UNROLL") == "1" else [])
+        + ([f"scan-unroll{best_cfg['GETHSHARDING_TPU_SCAN_UNROLL']}"]
+           if best_cfg.get("GETHSHARDING_TPU_SCAN_UNROLL") else [])
         + (["pallas-norm"] if best_cfg.get("GETHSHARDING_TPU_PALLAS") == "1"
            else []))
     _print_metric(best["sig_rate"], best, f"{knobs}, {best['platform']}")

@@ -70,6 +70,24 @@ if PAIRCONV not in ("xla", "pallas"):
     raise ValueError(f"GETHSHARDING_TPU_PAIRCONV must be 'xla' or "
                      f"'pallas', got {PAIRCONV!r}")
 
+# GETHSHARDING_TPU_PAIR_UNROLL=1 statically unrolls the three sequential
+# drivers of the pairing check — the Miller loop, x^u square-multiply
+# ladders and the final-exp hard-part register machine — into python
+# loops over their compile-time programs. This removes every lax.scan /
+# lax.cond / lax.switch / dynamic_index from the hot path, letting XLA
+# fuse across steps, and skips the dead work the traced form pays for
+# (both sides of every branchless select; muls on zero exponent bits).
+# The price is HLO size and compile time (~hundreds of fp12-op bodies
+# inlined; >35 min on XLA:CPU), so it is an autotune knob, not the
+# default.
+PAIR_UNROLL = os.environ.get("GETHSHARDING_TPU_PAIR_UNROLL", "0") == "1"
+
+# GETHSHARDING_TPU_SCAN_UNROLL=N is the bounded middle ground: keep the
+# lax.scan drivers but let XLA unroll N steps per While iteration
+# (cross-step fusion with ~N× instead of ~90× HLO growth). Ignored when
+# PAIR_UNROLL=1.
+SCAN_UNROLL = int(os.environ.get("GETHSHARDING_TPU_SCAN_UNROLL", "1"))
+
 
 def _use_pallas_conv() -> bool:
     return PAIRCONV == "pallas" and _limb._pallas_wanted()
@@ -496,6 +514,16 @@ def miller_loop(px, py, qx, qy):
     # normalize broadcasts into concrete arrays for scan carry stability
     f, X, Y, Z = map(FP.normalize, (f, X, Y, Z))
 
+    if PAIR_UNROLL:
+        # static double-and-add: zero bits skip the chord entirely
+        for bit in ATE_BITS:
+            line, X, Y, Z = _dbl_step(X, Y, Z, px, py)
+            f = fp12_mul_line(fp12_sqr(f), line)
+            if bit:
+                line, X, Y, Z = _madd_step(X, Y, Z, qx, qy, px, py)
+                f = fp12_mul_line(f, line)
+        return f
+
     def step(carry, bit):
         f, X, Y, Z = carry
         line, X, Y, Z = _dbl_step(X, Y, Z, px, py)
@@ -507,7 +535,8 @@ def miller_loop(px, py, qx, qy):
         sel = lambda a, b: jnp.where(take[..., None, None], a, b)
         return (f, sel(Xa, X), sel(Ya, Y), sel(Za, Z)), None
 
-    (f, X, Y, Z), _ = lax.scan(step, (f, X, Y, Z), jnp.asarray(ATE_BITS))
+    (f, X, Y, Z), _ = lax.scan(step, (f, X, Y, Z), jnp.asarray(ATE_BITS),
+                               unroll=SCAN_UNROLL)
     return f
 
 
@@ -560,6 +589,18 @@ _U_NAF = np.asarray(ref._naf(U), np.int32)  # little-endian digits of u
 
 def _pow_u(x):
     """x^u (u = BN parameter, 63 static bits) via square-multiply scan."""
+    if PAIR_UNROLL:
+        # static ladder: zero bits cost nothing beyond the squaring, and
+        # the first set bit initializes the accumulator (no select pairs)
+        acc = None
+        base = x
+        for i, bit in enumerate(_U_BITS):
+            if bit:
+                acc = base if acc is None else fp12_mul(acc, base)
+            if i + 1 < len(_U_BITS):
+                base = fp12_sqr(base)
+        return acc  # u > 0, so at least one bit set
+
     def step(carry, bit):
         acc, base = carry
         take = jnp.broadcast_to(bit == 1, acc.shape[:-3])
@@ -568,7 +609,8 @@ def _pow_u(x):
 
     acc0 = FP.normalize(
         jnp.broadcast_to(jnp.asarray(FP12_ONE), x.shape) + x * 0)
-    (acc, _), _ = lax.scan(step, (acc0, x), jnp.asarray(_U_BITS))
+    (acc, _), _ = lax.scan(step, (acc0, x), jnp.asarray(_U_BITS),
+                           unroll=SCAN_UNROLL)
     return acc
 
 
@@ -576,6 +618,25 @@ def _run_hard_part(f, pow_u_fn, inv_fn):
     """The DSD hard-part register machine (see _HARD_PROGRAM), shared by
     the value path (inverse = cyclotomic conjugate) and the fraction path
     (inverse = component swap)."""
+    if PAIR_UNROLL:
+        # static register machine: python list, compile-time indices, the
+        # six ops dispatched at trace time — no switch, no dynamic slots
+        fu = pow_u_fn(f)
+        fu2 = pow_u_fn(fu)
+        slots: list = [f, fu, fu2, pow_u_fn(fu2)] + [None] * (_N_REGS - 4)
+        for op, a, b, d in _HARD_PROGRAM:
+            ra, rb = slots[a], slots[b]
+            if op == 0:
+                out = fp12_mul(ra, rb)
+            elif op == 1:
+                out = fp12_sqr(ra)
+            elif op == 2:
+                out = inv_fn(ra)
+            else:
+                out = fp12_frobenius(ra, int(op) - 2)
+            slots[d] = out
+        return slots[13]
+
     regs = jnp.broadcast_to(
         jnp.asarray(FP12_ONE), (_N_REGS,) + f.shape).astype(jnp.int32) + f * 0
     regs = FP.normalize(regs)
@@ -600,7 +661,8 @@ def _run_hard_part(f, pow_u_fn, inv_fn):
         ], ra, rb)
         return lax.dynamic_update_index_in_dim(regs, out, d, axis=0), None
 
-    regs, _ = lax.scan(step, regs, jnp.asarray(_HARD_PROGRAM))
+    regs, _ = lax.scan(step, regs, jnp.asarray(_HARD_PROGRAM),
+                       unroll=SCAN_UNROLL)
     return regs[13]
 
 
@@ -632,6 +694,17 @@ def _pow_u_fraction(x):
     extra mul, with -1 multiplying by the SWAPPED fraction (free inverse).
     """
     xswap = x[::-1]
+    digits = list(reversed(_U_NAF[:-1]))
+
+    if PAIR_UNROLL:
+        acc = x  # top digit
+        for d in digits:
+            acc = fp12_sqr(acc)
+            if d == 1:
+                acc = fp12_mul(acc, x)
+            elif d == -1:
+                acc = fp12_mul(acc, xswap)
+        return acc
 
     def step(acc, d):
         acc = fp12_sqr(acc)
@@ -642,8 +715,9 @@ def _pow_u_fraction(x):
         ], acc)
         return acc, None
 
-    digits = np.asarray(list(reversed(_U_NAF[:-1])), np.int32)
-    acc, _ = lax.scan(step, x, jnp.asarray(digits))  # top digit: acc = x
+    acc, _ = lax.scan(step, x,
+                      jnp.asarray(np.asarray(digits, np.int32)),
+                      unroll=SCAN_UNROLL)
     return acc
 
 
@@ -893,6 +967,28 @@ def _bls_miller_opt(sig, hx, hy, pk):
         f = fp12_mul_line(f, line1)
         return f, X, Y, Z
 
+    def add_branch_static(f, X, Y, Z, line_c, op):
+        idx = op - 1  # compile-time candidate choice
+        if affine:
+            line1, X, Y, Z = _madd_step(X, Y, Z, cand[0][idx], cand[1][idx],
+                                        hx, hy_neg)
+        else:
+            line1, X, Y, Z = _jadd_step(X, Y, Z,
+                                        tuple(c[idx] for c in cand),
+                                        hx, hy_neg)
+        f = fp12_mul_line(f, gen_line(line_c))
+        f = fp12_mul_line(f, line1)
+        return f, X, Y, Z
+
+    if PAIR_UNROLL:
+        for i, op in enumerate(_OPT_OPS):
+            line_c = jnp.asarray(_GEN_LINES[i])
+            if op == 0:
+                f, X, Y, Z = dbl_branch(f, X, Y, Z, line_c, op)
+            else:
+                f, X, Y, Z = add_branch_static(f, X, Y, Z, line_c, int(op))
+        return f
+
     def step(carry, xs):
         op, line_c = xs
         f, X, Y, Z = carry
@@ -902,7 +998,8 @@ def _bls_miller_opt(sig, hx, hy, pk):
 
     (f, X, Y, Z), _ = lax.scan(
         step, (f, X, Y, Z),
-        (jnp.asarray(_OPT_OPS), jnp.asarray(_GEN_LINES)))
+        (jnp.asarray(_OPT_OPS), jnp.asarray(_GEN_LINES)),
+        unroll=SCAN_UNROLL)
     return f
 
 

@@ -146,9 +146,9 @@ def _relaxed_round(z: jnp.ndarray):
 
 
 CARRY_IMPL = os.environ.get("GETHSHARDING_TPU_CARRY", "scan")
-if CARRY_IMPL not in ("scan", "assoc"):
-    raise ValueError(
-        f"GETHSHARDING_TPU_CARRY must be 'scan' or 'assoc', got {CARRY_IMPL!r}")
+if CARRY_IMPL not in ("scan", "assoc", "unroll"):
+    raise ValueError(f"GETHSHARDING_TPU_CARRY must be 'scan', 'assoc' or "
+                     f"'unroll', got {CARRY_IMPL!r}")
 
 # GETHSHARDING_TPU_PALLAS=1 routes `ModArith.normalize` through the fused
 # Pallas kernel (ops/pallas_norm.py) on non-CPU backends — one VMEM-
@@ -273,14 +273,27 @@ def _carry_scan(z: jnp.ndarray):
     (carry_out, limbs): total carry off the top (callers either know it is
     zero or use its sign as a borrow flag) and canonical limbs.
 
-    Two implementations, selected by $GETHSHARDING_TPU_CARRY:
+    Three implementations, selected by $GETHSHARDING_TPU_CARRY:
     - "scan" (default): sequential lax.scan — compact graph, fastest XLA
       compile for the big pairing kernels.
+    - "unroll": the same sequential ripple as a STATIC python loop. A
+      lax.scan lowers to an XLA While whose body cannot fuse with its
+      neighbours; unrolling turns every normalize's carry into
+      straight-line elementwise code XLA fuses end-to-end. Costs HLO
+      size (L ops per carry) and therefore compile time.
     - "assoc": two relaxed rounds bound limbs to [-1, 2^LIMB_BITS + eps],
       then the residual per-position carries (each in {-1,0,1}, acting as
       monotone maps carry_in -> carry_out) compose via
       `lax.associative_scan` — log-depth flat vector code, no while loops.
     """
+    if CARRY_IMPL == "unroll":
+        c = z[..., 0] * 0
+        outs = []
+        for i in range(z.shape[-1]):
+            t = z[..., i] + c
+            c = t >> LIMB_BITS
+            outs.append(t & LIMB_MASK)
+        return c, jnp.stack(outs, axis=-1)
     if CARRY_IMPL == "scan":
         zs = jnp.moveaxis(z, -1, 0)
 

@@ -59,10 +59,17 @@ def main() -> int:
     parser.add_argument("--shards", type=int, default=100)
     parser.add_argument("--committee", type=int, default=135)
     parser.add_argument("--repeats", type=int, default=5)
+    parser.add_argument("--cpu", action="store_true",
+                        help="force the hermetic CPU backend (a plain "
+                             "JAX_PLATFORMS=cpu still hangs on a dead "
+                             "accelerator tunnel under the axon site hook)")
     args = parser.parse_args()
 
-    from gethsharding_tpu.parallel.virtual import configure_compile_cache
+    from gethsharding_tpu.parallel.virtual import (configure_compile_cache,
+                                                   force_virtual_cpu_devices)
 
+    if args.cpu:
+        force_virtual_cpu_devices(1)
     configure_compile_cache()
 
     import jax

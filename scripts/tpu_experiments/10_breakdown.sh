@@ -1,0 +1,6 @@
+#!/bin/bash
+# Stage breakdown of the audit kernel with the champion knobs ($1 = out prefix).
+cd /root/repo || exit 1
+env GETHSHARDING_TPU_LIMB_FORM=exact GETHSHARDING_TPU_CARRY=scan \
+  timeout 2400 python scripts/tpu_breakdown.py >"$1.json" 2>"$1.err"
+grep -q stage_seconds "$1.json" && grep -q '"platform": "tpu' "$1.json"

@@ -1,0 +1,7 @@
+#!/bin/bash
+# Probe the statically unrolled pairing drivers alone (scan carries).
+cd /root/repo || exit 1
+env GETHSHARDING_TPU_LIMB_FORM=exact GETHSHARDING_TPU_CARRY=scan \
+    GETHSHARDING_TPU_PAIR_UNROLL=1 \
+  timeout 3600 python bench.py --single >"$1.out" 2>"$1.err"
+grep -q sig_rate "$1.out" && grep -q '"platform": "tpu' "$1.out"

@@ -1,0 +1,8 @@
+#!/bin/bash
+# Probe bounded scan unrolling (8 steps per While iteration) + unrolled
+# carries — the compile-cheap approximation of the full static unroll.
+cd /root/repo || exit 1
+env GETHSHARDING_TPU_LIMB_FORM=exact GETHSHARDING_TPU_CARRY=unroll \
+    GETHSHARDING_TPU_SCAN_UNROLL=8 \
+  timeout 2400 python bench.py --single >"$1.out" 2>"$1.err"
+grep -q sig_rate "$1.out" && grep -q '"platform": "tpu' "$1.out"

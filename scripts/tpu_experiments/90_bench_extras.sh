@@ -1,0 +1,9 @@
+#!/bin/bash
+# Refresh the round's canonical capture WITH the config 1/2/4/5 extras
+# (the autotune cache already holds the sweep winner, so bench.py goes
+# straight to the winner + extras run).
+cd /root/repo || exit 1
+env GETHSHARDING_BENCH_NO_REPLAY=1 timeout 7000 python bench.py >"$1.json" 2>"$1.err"
+# success requires a FRESH TPU measurement, not a replayed capture (the
+# mid-run fallback prints the old capture, which also says platform tpu)
+grep '"platform": "tpu' "$1.json" | grep -qv "tunnel unreachable"

@@ -13,13 +13,23 @@
 # Drop new experiment scripts into .tpu_queue/ at any time; the watcher
 # never exits on its own.
 #
-# Experiment contract: exit 0 ONLY on evidence of a real TPU result
-# (grep for '"platform": "tpu' in your own output) — the watcher trusts
-# the exit code, and bench.py exits 0 even on its CPU/replay fallbacks.
+# Experiment contract: exit 0 ONLY on evidence of a real TPU result —
+# the watcher trusts the exit code, and bench.py exits 0 even on its
+# CPU/replay fallbacks. grep your own output for '"platform": "tpu' AND
+# run full `bench.py` with GETHSHARDING_BENCH_NO_REPLAY=1 (a replayed
+# capture also says platform tpu; `bench.py --single` never replays).
+#
+# On first start the queue is seeded from the tracked templates in
+# scripts/tpu_experiments/ (breakdown + kernel-knob probes + the full
+# bench-with-extras refresh).
 cd /root/repo || exit 1
 LOG=.tpu_watch.log
 QUEUE=.tpu_queue
 mkdir -p "$QUEUE/done" .tpu_results
+if [ ! -e "$QUEUE/.seeded" ] && [ -d scripts/tpu_experiments ]; then
+  cp -n scripts/tpu_experiments/*.sh "$QUEUE/" 2>/dev/null
+  touch "$QUEUE/.seeded"
+fi
 echo "$(date +%F\ %T) watcher v2 start (pid $$)" >>"$LOG"
 while true; do
   if [ -z "$(ls "$QUEUE"/*.sh 2>/dev/null | head -1)" ]; then sleep 60; continue; fi

@@ -129,8 +129,9 @@ def test_batch_shapes_nd():
 
 
 def test_assoc_carry_impl_matches_scan(monkeypatch):
-    """Both carry implementations (scan / assoc) must agree exactly; the
-    assoc path is env-selected and would otherwise go untested."""
+    """All carry implementations (scan / assoc / unroll) must agree
+    exactly; the non-default paths are env-selected and would otherwise
+    go untested."""
     p = MODULI["bn256_p"]
     rng = random.Random(11)
     vals_a = [rng.randrange(p) for _ in range(8)]
@@ -143,10 +144,13 @@ def test_assoc_carry_impl_matches_scan(monkeypatch):
     got_scan = fp.to_ints(fp.mul(x, y))
     monkeypatch.setattr(limb, "CARRY_IMPL", "assoc")
     got_assoc = fp.to_ints(fp.sub(fp.mul(x, y), y))
+    monkeypatch.setattr(limb, "CARRY_IMPL", "unroll")
+    got_unroll = fp.to_ints(fp.sub(fp.mul(x, y), y))
     monkeypatch.setattr(limb, "CARRY_IMPL", "scan")
     assert [int(v) for v in got_scan] == expect
-    assert [int(v) for v in got_assoc] == [(a * b - b) % p
-                                           for a, b in zip(vals_a, vals_b)]
+    expect_sub = [(a * b - b) % p for a, b in zip(vals_a, vals_b)]
+    assert [int(v) for v in got_assoc] == expect_sub
+    assert [int(v) for v in got_unroll] == expect_sub
 
 
 def test_conv_impls_agree():
