@@ -1,0 +1,7 @@
+#!/bin/bash
+# Layout micro-bench: limbs-minor vs batch-minor elementwise/carry
+# throughput on the real chip (tiny compiles; answers whether a limb-
+# engine layout refactor is the next 10x).
+cd /root/repo || exit 1
+timeout 1200 python scripts/tpu_layout_micro.py >"$1.json" 2>"$1.err"
+grep -q '"platform": "tpu' "$1.json"

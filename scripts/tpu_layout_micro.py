@@ -1,0 +1,104 @@
+"""Micro-benchmark: int32 elementwise + carry-scan throughput by layout.
+
+The pairing kernels keep the limb axis (22) minor and the batch (100)
+major — on TPU the minor axis maps to the 128 VPU lanes and the
+second-minor to 8 sublanes, so (100, ..., 22) uses ~22/128 lanes x 2/8
+sublanes. This measures the SAME op chains at limbs-minor vs
+batch-minor layouts to quantify what a layout refactor of the limb
+engine would buy on the real chip. Prints ONE JSON line.
+
+Chains modeled on the hot path: (a) a 200-op mul/add/shift/mask chain
+(normalize-ish work), (b) a 22-step sequential carry as lax.scan vs
+statically unrolled.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+LIMB_MASK = 0xFFF
+
+
+def chain200(x):
+    for _ in range(200):
+        x = ((x * 3 + 5) >> 2) & LIMB_MASK
+    return x
+
+
+def carry_scan(z, axis):
+    zs = jnp.moveaxis(z, axis, 0)
+
+    def step(c, v):
+        t = v + c
+        return t >> 12, t & LIMB_MASK
+
+    carry, out = lax.scan(step, zs[0] * 0, zs)
+    return jnp.moveaxis(out, 0, axis)
+
+
+def carry_unroll(z, axis):
+    zs = jnp.moveaxis(z, axis, 0)
+    c = zs[0] * 0
+    outs = []
+    for i in range(zs.shape[0]):
+        t = zs[i] + c
+        c = t >> 12
+        outs.append(t & LIMB_MASK)
+    return jnp.moveaxis(jnp.stack(outs), 0, axis)
+
+
+def _time(fn, x, repeats=20):
+    out = fn(x)
+    out.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        out = fn(x)
+    out.block_until_ready()
+    return (time.perf_counter() - t0) / repeats
+
+
+def main() -> int:
+    if "--cpu" in sys.argv:
+        from gethsharding_tpu.parallel.virtual import force_virtual_cpu_devices
+
+        force_virtual_cpu_devices(1)
+    rng = np.random.default_rng(5)
+    results = {}
+    # pairing-stage shape: B=100 rows of fp12 (12 coeffs x 22 limbs);
+    # aggregate-stage shape: 13500 rows of one Fp element
+    cases = {
+        "pair_limbs_minor": (100, 12, 22),
+        "pair_batch_minor": (12, 22, 100),
+        "pair_batch_minor_pad128": (12, 22, 128),
+        "agg_limbs_minor": (13500, 22),
+        "agg_batch_minor": (22, 13504),
+    }
+    for name, shape in cases.items():
+        x = jnp.asarray(rng.integers(0, LIMB_MASK, shape, dtype=np.int32))
+        limb_axis = -1 if "limbs_minor" in name else (-2 if name.startswith("pair") else 0)
+        results[name] = {
+            "chain200_s": round(_time(jax.jit(chain200), x), 6),
+            "carry_scan_s": round(_time(
+                jax.jit(lambda v, a=limb_axis: carry_scan(v, a)), x), 6),
+            "carry_unroll_s": round(_time(
+                jax.jit(lambda v, a=limb_axis: carry_unroll(v, a)), x), 6),
+            "elements": int(np.prod(shape)),
+        }
+    print(json.dumps({"platform": jax.devices()[0].platform,
+                      "cases": results}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
