@@ -59,6 +59,11 @@ CONFIGS = [
     # int8-plane MXU column contraction
     {"GETHSHARDING_TPU_LIMB_FORM": "exact",
      "GETHSHARDING_TPU_CARRY": "unroll"},
+    # relaxed normalize: no exact carry ripple anywhere in the field ops
+    # (wide form only; quasi-canonical limbs, see ops/limb.py)
+    {"GETHSHARDING_TPU_LIMB_FORM": "wide", "GETHSHARDING_TPU_NORM": "relaxed"},
+    {"GETHSHARDING_TPU_LIMB_FORM": "wide", "GETHSHARDING_TPU_NORM": "relaxed",
+     "GETHSHARDING_TPU_SCAN_UNROLL": "8"},
     {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "unroll",
      "GETHSHARDING_TPU_SCAN_UNROLL": "8"},
     {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "scan",
@@ -84,6 +89,8 @@ CONFIGS = [
     {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "unroll",
      "GETHSHARDING_TPU_PAIR_UNROLL": "1"},
     {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "scan",
+     "GETHSHARDING_TPU_PAIR_UNROLL": "1"},
+    {"GETHSHARDING_TPU_LIMB_FORM": "wide", "GETHSHARDING_TPU_NORM": "relaxed",
      "GETHSHARDING_TPU_PAIR_UNROLL": "1"},
 ]
 
@@ -644,6 +651,8 @@ def main() -> None:
            if best_cfg.get("GETHSHARDING_TPU_PAIR_UNROLL") == "1" else [])
         + ([f"scan-unroll{best_cfg['GETHSHARDING_TPU_SCAN_UNROLL']}"]
            if best_cfg.get("GETHSHARDING_TPU_SCAN_UNROLL") else [])
+        + (["norm-relaxed"]
+           if best_cfg.get("GETHSHARDING_TPU_NORM") == "relaxed" else [])
         + (["pallas-norm"] if best_cfg.get("GETHSHARDING_TPU_PALLAS") == "1"
            else []))
     _print_metric(best["sig_rate"], best, f"{knobs}, {best['platform']}")

@@ -156,6 +156,25 @@ if CARRY_IMPL not in ("scan", "assoc", "unroll"):
 # default; bench.py probes it as an autotune config.
 PALLAS_NORM = os.environ.get("GETHSHARDING_TPU_PALLAS", "0") == "1"
 
+# GETHSHARDING_TPU_NORM=relaxed (wide form only) drops the exact carry
+# from `normalize` entirely: after the fold, FOUR value-preserving
+# relaxed rounds (the top carry is re-fused into the top limb, never
+# dropped) leave QUASI-canonical limbs — range [-1, 2^12 + 64] instead
+# of [0, 2^12). Every consumer's int32 column bound scales by at most
+# (1 + 2^-6)^2 ≈ 3.3%, inside the ≥23% headroom below 2^31 that the
+# canonical-limb proofs leave (4·25·(2^12-1)² < 2^30.7). What it buys:
+# the 25-step sequential ripple — the deepest dependency chain in every
+# field op — becomes ~16 flat vector ops. Incompatible with CONV=mxu8
+# (which requires non-negative product entries).
+NORM_IMPL = os.environ.get("GETHSHARDING_TPU_NORM", "exact")
+if NORM_IMPL not in ("exact", "relaxed"):
+    raise ValueError(f"GETHSHARDING_TPU_NORM must be 'exact' or 'relaxed', "
+                     f"got {NORM_IMPL!r}")
+if NORM_IMPL == "relaxed" and LIMB_FORM != "wide":
+    raise ValueError("GETHSHARDING_TPU_NORM=relaxed requires "
+                     "GETHSHARDING_TPU_LIMB_FORM=wide (the exact 22-limb "
+                     "ladder depends on canonical mid-stage limbs)")
+
 # The schoolbook column sum z[n] = sum_{l+m=n} x_l·y_m has four
 # implementations ($GETHSHARDING_TPU_CONV):
 # - "shift" (default): pad each row with L zeros, flatten, re-view at
@@ -186,6 +205,16 @@ CONV_IMPL = os.environ.get("GETHSHARDING_TPU_CONV", "shift")
 if CONV_IMPL not in ("shift", "slices", "gather", "onehot", "mxu8"):
     raise ValueError(f"GETHSHARDING_TPU_CONV must be 'shift', 'slices', "
                      f"'gather', 'onehot' or 'mxu8', got {CONV_IMPL!r}")
+if CONV_IMPL == "mxu8" and NORM_IMPL == "relaxed":
+    raise ValueError("GETHSHARDING_TPU_CONV=mxu8 requires non-negative "
+                     "product entries; GETHSHARDING_TPU_NORM=relaxed "
+                     "yields limbs that can be -1")
+if PALLAS_NORM and NORM_IMPL == "relaxed":
+    # normalize() routes to the exact-carry Pallas kernel BEFORE the
+    # NORM_IMPL branch; a silent override would mislabel autotune results
+    raise ValueError("GETHSHARDING_TPU_PALLAS=1 and GETHSHARDING_TPU_NORM="
+                     "relaxed are mutually exclusive (the Pallas normalize "
+                     "implements the exact ripple)")
 
 
 def conv_cols(prod: jnp.ndarray, impl: "str | None" = None) -> jnp.ndarray:
@@ -444,7 +473,19 @@ class ModArith:
 
         if LIMB_FORM == "wide":
             z = self._fold_hi(relax3(z)) + self.lift
-            return _carry(jnp.pad(z, pad + [(0, NLIMBS - FOLD_BASE)]))
+            z = jnp.pad(z, pad + [(0, NLIMBS - FOLD_BASE)])
+            if NORM_IMPL == "relaxed":
+                # no exact ripple: four width-preserving relaxed rounds,
+                # each re-fusing its top carry so the value is preserved
+                # EXACTLY even while transient borrows ripple at the top
+                # (a dropped -1 top carry would subtract 2^300). Bound
+                # after round 4: limbs in [-1, 2^12 + 64], value
+                # unchanged < 2^LAZY_BITS.
+                for _ in range(4):
+                    top, z = _relaxed_round(z)
+                    z = z.at[..., -1].add(top << LIMB_BITS)
+                return z
+            return _carry(z)
 
         # "exact" form: the legacy 3-carry ladder producing value < 2^264
         # in exactly 22 canonical limbs.

@@ -1,0 +1,6 @@
+#!/bin/bash
+# Probe the relaxed normalize (wide form, no exact carry ripple anywhere).
+cd /root/repo || exit 1
+env GETHSHARDING_TPU_LIMB_FORM=wide GETHSHARDING_TPU_NORM=relaxed \
+  timeout 2400 python bench.py --single >"$1.out" 2>"$1.err"
+grep -q sig_rate "$1.out" && grep -q '"platform": "tpu' "$1.out"

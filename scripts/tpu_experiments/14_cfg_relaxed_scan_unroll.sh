@@ -1,0 +1,7 @@
+#!/bin/bash
+# Probe relaxed normalize + bounded scan unrolling together.
+cd /root/repo || exit 1
+env GETHSHARDING_TPU_LIMB_FORM=wide GETHSHARDING_TPU_NORM=relaxed \
+    GETHSHARDING_TPU_SCAN_UNROLL=8 \
+  timeout 2400 python bench.py --single >"$1.out" 2>"$1.err"
+grep -q sig_rate "$1.out" && grep -q '"platform": "tpu' "$1.out"

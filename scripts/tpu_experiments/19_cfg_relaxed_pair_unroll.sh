@@ -1,0 +1,8 @@
+#!/bin/bash
+# Probe the no-sequential-anything configuration: relaxed normalize (no
+# carry ripple) + fully unrolled pairing drivers (no scan/cond/switch).
+cd /root/repo || exit 1
+env GETHSHARDING_TPU_LIMB_FORM=wide GETHSHARDING_TPU_NORM=relaxed \
+    GETHSHARDING_TPU_PAIR_UNROLL=1 \
+  timeout 3600 python bench.py --single >"$1.out" 2>"$1.err"
+grep -q sig_rate "$1.out" && grep -q '"platform": "tpu' "$1.out"

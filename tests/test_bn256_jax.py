@@ -370,3 +370,36 @@ def test_pair_unroll_full_e2e(monkeypatch):
                       jnp.asarray(qx[0]), jnp.asarray(qy[0])))
     got = np.asarray(_arr_to_coeffs(f))
     assert (got == _fp12_coeffs(ref.pairing(g1, g2))).all()
+
+
+@slow
+def test_relaxed_norm_pairing_value(monkeypatch):
+    """The whole pairing stack under GETHSHARDING_TPU_NORM=relaxed (no
+    exact carry anywhere in normalize) must reproduce the scalar pairing
+    exactly — canon() re-canonicalizes quasi-canonical limbs at the
+    comparison boundary."""
+    from gethsharding_tpu.ops import limb as _limb
+    if _limb.LIMB_FORM != "wide":
+        pytest.skip("relaxed normalize is wide-form only")
+    if _limb.CONV_IMPL == "mxu8":
+        pytest.skip("mxu8 conv requires non-negative products; "
+                    "incompatible with relaxed limbs")
+    # the fp2/fp12 tower ops are @jax.jit with executables cached by
+    # shape: earlier tests compile them under NORM_IMPL="exact" at these
+    # exact shapes, which would make this test run the exact path
+    # vacuously (and leak relaxed executables to later tests) without a
+    # cache flush on both sides
+    jax.clear_caches()
+    monkeypatch.setattr(_limb, "NORM_IMPL", "relaxed")
+    try:
+        g1 = ref.g1_mul(57, ref.G1_GEN)
+        g2 = ref.g2_mul(61, ref.G2_GEN)
+        px, py, _ = k.g1_to_limbs([g1])
+        qx, qy, _ = k.g2_to_limbs([g2])
+        f = k.final_exponentiation(
+            k.miller_loop(jnp.asarray(px[0]), jnp.asarray(py[0]),
+                          jnp.asarray(qx[0]), jnp.asarray(qy[0])))
+        got = np.asarray(_arr_to_coeffs(f))
+        assert (got == _fp12_coeffs(ref.pairing(g1, g2))).all()
+    finally:
+        jax.clear_caches()

@@ -177,3 +177,41 @@ def test_conv_impls_agree():
         got_pos = limb.conv_cols(jnp.asarray(pos), impl="mxu8")
         assert np.array_equal(np.asarray(got_pos), np.asarray(want_pos)), (
             L, M, "mxu8")
+
+
+def test_relaxed_norm_matches_exact(monkeypatch):
+    """GETHSHARDING_TPU_NORM=relaxed (wide form): same residues as the
+    exact ripple on mul/add/sub chains, and the quasi-canonical limb
+    contract holds (limbs in [-1, 2^12 + 64]) — the range every fused
+    accumulator's int32 proof budgets for."""
+    if limb.LIMB_FORM != "wide":
+        pytest.skip("relaxed normalize is wide-form only")
+    if limb.CONV_IMPL == "mxu8":
+        pytest.skip("mxu8 conv requires non-negative products; "
+                    "incompatible with relaxed limbs")
+    p = MODULI["bn256_p"]
+    fp = limb.ModArith(p)
+    rng = random.Random(99)
+    vals_a = [rng.randrange(p) for _ in range(16)]
+    vals_b = [rng.randrange(p) for _ in range(16)]
+    x = jnp.asarray(limb.ints_to_limbs(vals_a))
+    y = jnp.asarray(limb.ints_to_limbs(vals_b))
+
+    def chain():
+        z = fp.mul(fp.sub(fp.mul(x, y), y), fp.sub(x, fp.mul(y, y)))
+        return fp.sub(z, fp.mul(z, x))
+
+    monkeypatch.setattr(limb, "NORM_IMPL", "relaxed")
+    # sub-heavy chain: borrows exercise the negative-limb transients the
+    # top-carry re-fuse exists for
+    z = chain()
+    got = [int(v) for v in fp.to_ints(z)]
+    arr = np.asarray(z)
+    assert arr.min() >= -1 and arr.max() <= (1 << limb.LIMB_BITS) + 64, (
+        arr.min(), arr.max())
+    monkeypatch.setattr(limb, "NORM_IMPL", "exact")
+    want = [int(v) for v in fp.to_ints(chain())]
+    expect = [(((a * b - b) % p) * ((a - b * b) % p) % p) for a, b
+              in zip(vals_a, vals_b)]
+    expect = [(e - e * a) % p for e, a in zip(expect, vals_a)]
+    assert got == want == expect
