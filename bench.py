@@ -246,6 +246,11 @@ def measure_single() -> dict:
         "sig_rate": round(sig_rate, 1),
         "dispatch_s": round(dispatch, 4),
         "audit_wall_s": round(wall, 4),
+        # the active kernel knobs, so probe outputs are self-describing
+        # (scripts/tpu_pick_winner.py rebuilds the autotune cache from
+        # the best probe)
+        "knobs": {key: val for key, val in os.environ.items()
+                  if key.startswith("GETHSHARDING_TPU_")},
     }
     if os.environ.get("GETHSHARDING_BENCH_EXTRAS") == "1":
         # configs 1/2/4/5 run only for the sweep winner (main() re-invokes

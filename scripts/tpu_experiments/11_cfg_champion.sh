@@ -1,0 +1,7 @@
+#!/bin/bash
+# Re-probe the r3 sweep champion (exact/scan) so every candidate has a
+# comparable --single stats record for scripts/tpu_pick_winner.py.
+cd /root/repo || exit 1
+env GETHSHARDING_TPU_LIMB_FORM=exact GETHSHARDING_TPU_CARRY=scan \
+  timeout 2400 python bench.py --single >"$1.out" 2>"$1.err"
+grep -q sig_rate "$1.out" && grep -q '"platform": "tpu' "$1.out"

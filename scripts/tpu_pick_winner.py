@@ -1,0 +1,63 @@
+"""Rebuild the autotune cache from the watcher's per-config TPU probes.
+
+The tunnel-window experiments (scripts/tpu_experiments/*_cfg_*.sh) each
+run `bench.py --single` under one knob configuration and leave a stats
+JSON (with `knobs` since r3) in .tpu_results/<name>_<ts>.out. This
+picks the fastest TPU-platform probe and writes .bench_autotune.json
+with the CURRENT sweep fingerprint, so the next full `bench.py` run
+(the driver's end-of-round invocation, or 89_finalize's) goes straight
+to the winner + extras instead of re-sweeping.
+
+Prints the chosen config; exits 1 when no TPU probe exists.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def main() -> int:
+    best = None
+    for path in glob.glob(os.path.join(bench.REPO, ".tpu_results",
+                                       "*_cfg_*.out")):
+        try:
+            with open(path) as fh:
+                lines = fh.read().strip().splitlines()
+        except OSError:
+            continue
+        for line in reversed(lines):
+            try:
+                stats = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "sig_rate" in stats:
+                break
+        else:
+            continue
+        if not str(stats.get("platform", "")).startswith(("tpu", "axon")):
+            continue
+        if best is None or stats["sig_rate"] > best[0]["sig_rate"]:
+            best = (stats, path)
+    if best is None:
+        print("no TPU probe results found", file=sys.stderr)
+        return 1
+    stats, path = best
+    config = stats.get("knobs", {})
+    payload = {"config": config, "platform": stats["platform"],
+               "sweep": bench._sweep_fingerprint()}
+    with open(bench._cache_path(), "w") as fh:
+        json.dump(payload, fh)
+    print(json.dumps({"winner": config, "sig_rate": stats["sig_rate"],
+                      "from": os.path.basename(path)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
