@@ -345,6 +345,51 @@ class Notary(Service):
         Returns True (all consistent), False (mismatch), or None (nothing
         auditable this period).
         """
+        return self.audit_periods([period])[period]
+
+    def audit_periods(self, periods) -> dict:
+        """Audit MANY periods in ONE sig-backend dispatch.
+
+        The catch-up form of `audit_period` (an observer or light server
+        re-validating history): rows from every period share a single
+        batched aggregation+pairing call, so K periods cost one
+        SIGNATURE dispatch of K×shards rows instead of K — on a
+        latency-bound kernel nearly the cost of one. (The per-period SMC
+        vote-log replay check remains one `verify_period_batch` call per
+        period; its kernel shapes are period-local.) Returns
+        {period: True/False/None} with `audit_period` semantics.
+        """
+        periods = list(periods)
+        collected = {p: self._collect_audit_rows(p) for p in periods}
+        msgs, sig_rows, pk_rows, pk_keys = [], [], [], []
+        spans = {}
+        for period, rows in collected.items():
+            if rows is None:
+                continue
+            start = len(msgs)
+            msgs.extend(rows["msgs"])
+            sig_rows.extend(rows["sig_rows"])
+            pk_rows.extend(rows["pk_rows"])
+            pk_keys.extend(rows["pk_keys"])
+            spans[period] = (start, len(msgs))
+
+        results: dict = {p: None for p in periods}
+        if not spans:
+            return results
+        # aggregation + verification are ONE backend call: with sigbackend
+        # 'jax' the per-shard point sums AND the batched pairing happen in
+        # a single device dispatch (no host point arithmetic per vote)
+        with self.m_audit_latency.time():
+            ok = self.sig_backend.bls_verify_committees(
+                msgs, sig_rows, pk_rows, pk_row_keys=pk_keys)
+        self.audits_run += len(spans)
+        for period, (start, end) in spans.items():
+            results[period] = self._judge_period(
+                period, collected[period], ok[start:end])
+        return results
+
+    def _collect_audit_rows(self, period: int) -> Optional[dict]:
+        """One bulk pull of a period's auditable rows (or None)."""
         from gethsharding_tpu.rpc import codec
         from gethsharding_tpu.utils.hexbytes import Hash32
 
@@ -386,14 +431,18 @@ class Notary(Service):
             expected.append(bool(rec["is_elected"]))
         if not shards:
             return None
+        return {"shards": shards, "msgs": msgs, "sig_rows": sig_rows,
+                "pk_rows": pk_rows, "pk_keys": pk_keys,
+                "signed_counts": signed_counts,
+                "total_counts": total_counts, "expected": expected}
 
-        # aggregation + verification are ONE backend call: with sigbackend
-        # 'jax' the per-shard point sums AND the batched pairing happen in
-        # a single device dispatch (no host point arithmetic per vote)
-        with self.m_audit_latency.time():
-            ok = self.sig_backend.bls_verify_committees(
-                msgs, sig_rows, pk_rows, pk_row_keys=pk_keys)
-        self.audits_run += 1
+    def _judge_period(self, period: int, rows: dict, ok) -> bool:
+        """Outcome checks for one period's verified rows (`ok` aligns
+        with rows["shards"])."""
+        shards = rows["shards"]
+        signed_counts = rows["signed_counts"]
+        total_counts = rows["total_counts"]
+        expected = rows["expected"]
         verified = sum(n for n, good in zip(signed_counts, ok) if good)
         self.aggregate_sigs_verified += verified
         self.m_sigs_verified.inc(verified)

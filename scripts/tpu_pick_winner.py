@@ -17,6 +17,7 @@ import glob
 import json
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -30,31 +31,43 @@ def main() -> int:
         try:
             with open(path) as fh:
                 lines = fh.read().strip().splitlines()
+            mtime = os.path.getmtime(path)
         except OSError:
             continue
+        if time.time() - mtime > 24 * 3600:
+            continue  # stale probe from an earlier round / older code
         for line in reversed(lines):
             try:
                 stats = json.loads(line)
             except json.JSONDecodeError:
                 continue
-            if "sig_rate" in stats:
+            if isinstance(stats, dict) and "sig_rate" in stats:
                 break
         else:
             continue
         if not str(stats.get("platform", "")).startswith(("tpu", "axon")):
             continue
+        if "knobs" not in stats:
+            continue  # an empty config would masquerade as the champion
         if best is None or stats["sig_rate"] > best[0]["sig_rate"]:
             best = (stats, path)
     if best is None:
         print("no TPU probe results found", file=sys.stderr)
         return 1
     stats, path = best
-    config = stats.get("knobs", {})
-    payload = {"config": config, "platform": stats["platform"],
+    payload = {"config": stats["knobs"], "platform": stats["platform"],
                "sweep": bench._sweep_fingerprint()}
+    try:
+        with open(bench._cache_path()) as fh:
+            cached = json.load(fh)
+        if cached.get("sweep") == payload["sweep"]:
+            # keep bench.py's negative cache of known-fatal configs
+            payload["failed"] = cached.get("failed", [])
+    except (OSError, ValueError):
+        pass
     with open(bench._cache_path(), "w") as fh:
         json.dump(payload, fh)
-    print(json.dumps({"winner": config, "sig_rate": stats["sig_rate"],
+    print(json.dumps({"winner": stats["knobs"], "sig_rate": stats["sig_rate"],
                       "from": os.path.basename(path)}))
     return 0
 

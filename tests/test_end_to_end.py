@@ -296,3 +296,61 @@ def test_multi_notary_quorum_aggregate_audit(warm_jax_backend):
     finally:
         for node in notary_nodes:
             node.stop()
+
+
+def test_multi_period_catchup_audit_single_dispatch(warm_jax_backend):
+    """audit_periods: TWO voted periods + one empty period audited in ONE
+    sig-backend dispatch (the observer catch-up path), with per-period
+    outcomes identical to audit_period's."""
+    n_shards = 2
+    config = Config(quorum_size=1)
+    backend = SimulatedMainchain(config=config)
+    hub = Hub()
+    proposers = [
+        ShardNode(actor="proposer", shard_id=s, config=config,
+                  backend=backend, hub=hub, txpool_interval=None)
+        for s in range(n_shards)
+    ]
+    notary_node = ShardNode(actor="notary", shard_id=0, config=config,
+                            backend=backend, hub=hub, deposit=True,
+                            sig_backend="jax")
+    backend.fund(notary_node.client.account(), 2000 * ETHER)
+    for node in proposers:
+        node.start()
+    notary_node.start()
+    try:
+        notary = notary_node.service(Notary)
+        voted = []
+        for _ in range(2):
+            backend.fast_forward(1)
+            period = backend.current_period()
+            for s, node in enumerate(proposers):
+                node.service(TXPool).submit(
+                    Transaction(nonce=period, payload=bytes([s])))
+            assert wait_until(
+                lambda: all(backend.last_submitted_collation(s) == period
+                            for s in range(n_shards)))
+            for _ in range(config.period_length - 1):
+                backend.commit()
+                if all(backend.last_approved_collation(s) == period
+                       for s in range(n_shards)):
+                    break
+                time.sleep(0.05)
+            assert all(backend.last_approved_collation(s) == period
+                       for s in range(n_shards)), notary_node.errors()
+            voted.append(period)
+
+        backend.fast_forward(2)
+        empty = backend.current_period()  # no records in this period
+        before = notary.m_audit_latency.count
+        results = notary.audit_periods(voted + [empty])
+        assert notary.m_audit_latency.count == before + 1  # ONE dispatch
+        assert results == {voted[0]: True, voted[1]: True, empty: None}
+        # per-period equivalence with the single-period form
+        assert notary.audit_period(voted[0]) is True
+        assert notary.audit_period(empty) is None
+        assert notary.audit_mismatches == 0
+    finally:
+        notary_node.stop()
+        for node in proposers:
+            node.stop()
