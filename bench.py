@@ -86,6 +86,8 @@ CONFIGS = [
     # >35 min on XLA:CPU and may not fit the per-config probe timeout on
     # any backend — the watcher's queue probes them with long timeouts
     # instead; in a sweep they only run if budget remains
+    {"GETHSHARDING_TPU_LIMB_FORM": "wide", "GETHSHARDING_TPU_NORM": "relaxed",
+     "GETHSHARDING_TPU_PAIR_UNROLL": "finalexp"},
     {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "unroll",
      "GETHSHARDING_TPU_PAIR_UNROLL": "1"},
     {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "scan",
@@ -652,8 +654,9 @@ def main() -> None:
          best_cfg.get("GETHSHARDING_TPU_CONV", "shift")]
         + (["pairconv-pallas"]
            if best_cfg.get("GETHSHARDING_TPU_PAIRCONV") == "pallas" else [])
-        + (["pair-unroll"]
-           if best_cfg.get("GETHSHARDING_TPU_PAIR_UNROLL") == "1" else [])
+        + ([f"pair-unroll-{best_cfg['GETHSHARDING_TPU_PAIR_UNROLL']}"]
+           if best_cfg.get("GETHSHARDING_TPU_PAIR_UNROLL", "0") != "0"
+           else [])
         + ([f"scan-unroll{best_cfg['GETHSHARDING_TPU_SCAN_UNROLL']}"]
            if best_cfg.get("GETHSHARDING_TPU_SCAN_UNROLL") else [])
         + (["norm-relaxed"]

@@ -79,8 +79,15 @@ if PAIRCONV not in ("xla", "pallas"):
 # (both sides of every branchless select; muls on zero exponent bits).
 # The price is HLO size and compile time (~hundreds of fp12-op bodies
 # inlined; >35 min on XLA:CPU), so it is an autotune knob, not the
-# default.
-PAIR_UNROLL = os.environ.get("GETHSHARDING_TPU_PAIR_UNROLL", "0") == "1"
+# default. =finalexp unrolls ONLY the final-exponentiation drivers (the
+# ladders + hard part: ~66% of the dispatch, ~half the inlined HLO) and
+# keeps the Miller scan — the compile-cost hedge.
+_PAIR_UNROLL_RAW = os.environ.get("GETHSHARDING_TPU_PAIR_UNROLL", "0")
+if _PAIR_UNROLL_RAW not in ("0", "1", "finalexp"):
+    raise ValueError(f"GETHSHARDING_TPU_PAIR_UNROLL must be '0', '1' or "
+                     f"'finalexp', got {_PAIR_UNROLL_RAW!r}")
+PAIR_UNROLL = _PAIR_UNROLL_RAW == "1"            # miller drivers
+FE_UNROLL = _PAIR_UNROLL_RAW in ("1", "finalexp")  # ladders + hard part
 
 # GETHSHARDING_TPU_SCAN_UNROLL=N is the bounded middle ground: keep the
 # lax.scan drivers but let XLA unroll N steps per While iteration
@@ -589,7 +596,7 @@ _U_NAF = np.asarray(ref._naf(U), np.int32)  # little-endian digits of u
 
 def _pow_u(x):
     """x^u (u = BN parameter, 63 static bits) via square-multiply scan."""
-    if PAIR_UNROLL:
+    if FE_UNROLL:
         # static ladder: zero bits cost nothing beyond the squaring, and
         # the first set bit initializes the accumulator (no select pairs)
         acc = None
@@ -618,7 +625,7 @@ def _run_hard_part(f, pow_u_fn, inv_fn):
     """The DSD hard-part register machine (see _HARD_PROGRAM), shared by
     the value path (inverse = cyclotomic conjugate) and the fraction path
     (inverse = component swap)."""
-    if PAIR_UNROLL:
+    if FE_UNROLL:
         # static register machine: python list, compile-time indices, the
         # six ops dispatched at trace time — no switch, no dynamic slots
         fu = pow_u_fn(f)
@@ -696,7 +703,7 @@ def _pow_u_fraction(x):
     xswap = x[::-1]
     digits = list(reversed(_U_NAF[:-1]))
 
-    if PAIR_UNROLL:
+    if FE_UNROLL:
         acc = x  # top digit
         for d in digits:
             acc = fp12_sqr(acc)

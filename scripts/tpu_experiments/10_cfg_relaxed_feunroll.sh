@@ -1,0 +1,8 @@
+#!/bin/bash
+# Probe relaxed normalize + final-exp-only static unroll (the 66%-of-
+# dispatch driver unrolled at ~half the full-unroll compile cost).
+cd /root/repo || exit 1
+env GETHSHARDING_TPU_LIMB_FORM=wide GETHSHARDING_TPU_NORM=relaxed \
+    GETHSHARDING_TPU_PAIR_UNROLL=finalexp \
+  timeout 3000 python bench.py --single >"$1.out" 2>"$1.err"
+grep -q sig_rate "$1.out" && grep -q '"platform": "tpu' "$1.out"

@@ -1,0 +1,7 @@
+#!/bin/bash
+# Probe unrolled carries + final-exp-only static unroll (exact form).
+cd /root/repo || exit 1
+env GETHSHARDING_TPU_LIMB_FORM=exact GETHSHARDING_TPU_CARRY=unroll \
+    GETHSHARDING_TPU_PAIR_UNROLL=finalexp \
+  timeout 3000 python bench.py --single >"$1.out" 2>"$1.err"
+grep -q sig_rate "$1.out" && grep -q '"platform": "tpu' "$1.out"

@@ -284,7 +284,7 @@ def test_pair_unroll_pow_u_matches_scan(monkeypatch):
     rng = np.random.default_rng(17)
     x = jnp.asarray(_fp12_to_arr(_rand_fp12(rng)))
     want = _canon12(k._pow_u(x))
-    monkeypatch.setattr(k, "PAIR_UNROLL", True)
+    monkeypatch.setattr(k, "FE_UNROLL", True)
     assert (_canon12(k._pow_u(x)) == want).all()
 
 
@@ -293,7 +293,7 @@ def test_pair_unroll_pow_u_fraction_matches_scan(monkeypatch):
     x = jnp.asarray(np.stack([_fp12_to_arr(_rand_fp12(rng)),
                               _fp12_to_arr(_rand_fp12(rng))]))
     want = _canon12(k._pow_u_fraction(x))
-    monkeypatch.setattr(k, "PAIR_UNROLL", True)
+    monkeypatch.setattr(k, "FE_UNROLL", True)
     assert (_canon12(k._pow_u_fraction(x)) == want).all()
 
 
@@ -304,7 +304,7 @@ def test_pair_unroll_hard_part_matches_scan(monkeypatch):
     rng = np.random.default_rng(23)
     f = jnp.asarray(_fp12_to_arr(_rand_fp12(rng)))
     want = _canon12(k._run_hard_part(f, k.fp12_sqr, k.fp12_conj))
-    monkeypatch.setattr(k, "PAIR_UNROLL", True)
+    monkeypatch.setattr(k, "FE_UNROLL", True)
     assert (_canon12(k._run_hard_part(f, k.fp12_sqr, k.fp12_conj))
             == want).all()
 
@@ -360,7 +360,9 @@ def test_pair_unroll_miller_matches_scan(monkeypatch):
 def test_pair_unroll_full_e2e(monkeypatch):
     """Full-fidelity end-to-end: unrolled pairing value vs the scalar
     reference. On-demand only (see skip reason)."""
+    # the production GETHSHARDING_TPU_PAIR_UNROLL=1 sets BOTH flags
     monkeypatch.setattr(k, "PAIR_UNROLL", True)
+    monkeypatch.setattr(k, "FE_UNROLL", True)
     g1 = ref.g1_mul(29, ref.G1_GEN)
     g2 = ref.g2_mul(31, ref.G2_GEN)
     px, py, _ = k.g1_to_limbs([g1])
