@@ -409,6 +409,24 @@ class ModArith:
         # borrows leave -1 limbs below FOLD_BASE (lo value >= -2^253) or
         # fold rows act on -1 high limbs (>= -FOLD_ROWS*2^12*p > -2^260).
         self.lift = int_to_limbs(-(-(1 << 261) // p) * p, FOLD_BASE)
+        # The relaxed normalize folds on limbs that can reach -113 (two
+        # pre-fold rounds instead of three), so its folded value can go
+        # as low as -FOLD_ROWS·113·p, plus a lo part down to -113·2^252
+        # — beyond what a FOLD_BASE-wide lift can cover (< 2^264), and
+        # p-DEPENDENT (a fixed 2^266 covers the 254-bit bn256 fields but
+        # NOT a 256-bit modulus like secp256k1's, where ceil(2^266/p) is
+        # only ~2^10 multiples). Derive it from the worst case; it is
+        # NLIMBS wide and added after the pad. Total value stays
+        # < 2^264 + FOLD_ROWS·4208·p + lift < 2^274 — this can exceed
+        # 2^LAZY_BITS by a hair for 256-bit p, which every consumer
+        # absorbs (sub_pad >= 2^300; the fused-accumulator pads cover
+        # 2·LAZY_BITS+1 = 547 bits). Only constructible in the wide form.
+        if NLIMBS * LIMB_BITS >= 272:
+            # fold term + lo term (113 · sum_{i<22} 2^(12i) < 113·2^253)
+            deficit = FOLD_ROWS * 113 * p + (113 << 253)
+            self.lift_relaxed = int_to_limbs(-(-deficit // p) * p, NLIMBS)
+        else:
+            self.lift_relaxed = None
         # Shifted moduli for canonicalization: p << k >= RADIX at k_max;
         # descending conditional subtraction brings any canonical-limb
         # value < p.
@@ -464,28 +482,40 @@ class ModArith:
 
         pad = [(0, 0)] * (z.ndim - 1)
 
-        def relax3(v):
-            for _ in range(3):
+        def relax(v, rounds):
+            for _ in range(rounds):
                 top, v = _relaxed_round(jnp.pad(v, pad + [(0, 1)]))
                 # width grew by 1 so the round's own top carry is the new
                 # top limb's whole content; `top` here is always 0
             return v
 
+        def relax3(v):
+            return relax(v, 3)
+
         if LIMB_FORM == "wide":
-            z = self._fold_hi(relax3(z)) + self.lift
-            z = jnp.pad(z, pad + [(0, NLIMBS - FOLD_BASE)])
             if NORM_IMPL == "relaxed":
-                # no exact ripple: four width-preserving relaxed rounds,
-                # each re-fusing its top carry so the value is preserved
+                # round-count-minimal variant. Pre-fold TWO rounds
+                # suffice for the int32 fold bound: |limb| < 2^30.7 ->
+                # r1 < 2^18.8 -> r2 in [-113, 4095 + 2^6.8], so the fold
+                # matmul stays < 33·4210·4095 < 2^30 per column; the
+                # NLIMBS-wide lift_relaxed (>= 2^266) keeps the value
+                # non-negative even against the -113-limb folds.
+                # Post-fold THREE width-preserving rounds (start < 2^29.1:
+                # r1 < 4095+2^17.1, r2 < 4095+2^5.1, r3 <= 4097), each
+                # re-fusing its top carry so the value is preserved
                 # EXACTLY even while transient borrows ripple at the top
-                # (a dropped -1 top carry would subtract 2^300). Bound
-                # after round 4: limbs in [-1, 2^12 + 64], value
-                # unchanged < 2^LAZY_BITS.
-                for _ in range(4):
+                # (a dropped -1 top carry would subtract 2^300). Output:
+                # limbs in [-1, 2^12 + 64], value unchanged < 2^LAZY_BITS
+                # — no exact ripple anywhere.
+                z = self._fold_hi(relax(z, 2))
+                z = jnp.pad(z, pad + [(0, NLIMBS - FOLD_BASE)])
+                z = z + self.lift_relaxed
+                for _ in range(3):
                     top, z = _relaxed_round(z)
                     z = z.at[..., -1].add(top << LIMB_BITS)
                 return z
-            return _carry(z)
+            z = self._fold_hi(relax3(z)) + self.lift
+            return _carry(jnp.pad(z, pad + [(0, NLIMBS - FOLD_BASE)]))
 
         # "exact" form: the legacy 3-carry ladder producing value < 2^264
         # in exactly 22 canonical limbs.

@@ -189,7 +189,11 @@ def test_relaxed_norm_matches_exact(monkeypatch):
     if limb.CONV_IMPL == "mxu8":
         pytest.skip("mxu8 conv requires non-negative products; "
                     "incompatible with relaxed limbs")
-    p = MODULI["bn256_p"]
+    for name in ("bn256_p", "secp_p", "secp_n", "bn256_n"):
+        _relaxed_norm_case(monkeypatch, MODULI[name])
+
+
+def _relaxed_norm_case(monkeypatch, p):
     fp = limb.ModArith(p)
     rng = random.Random(99)
     vals_a = [rng.randrange(p) for _ in range(16)]
