@@ -65,6 +65,7 @@ _SLOW_MODULES = {
     "test_replay",
     "test_stress",
     "test_pallas",  # interpreter-mode kernels are slow per element
+    "test_knob_combos",  # one cold kernel compile per subprocess
 }
 
 
