@@ -102,6 +102,49 @@ class ShardingConsole(cmd.Cmd):
         """approved <shard> — last period with an approved collation"""
         self.emit(self.chain.last_approved_collation(int(arg.strip())))
 
+    def do_audit(self, arg):
+        """audit [period] [to_period] — tally audit over a period range,
+        for every shard with signature-carrying votes (the auditData
+        contract): vote counts, BLS-signed vote counts, elected flags
+        and quorum consistency. (The cryptographic half — batched
+        aggregate-signature verification — runs in the notary's device
+        audit, `Notary.audit_periods`; this is the operator's instant
+        tally view over the same bulk auditData pull.)"""
+        parts = shlex.split(arg)
+        start = int(parts[0]) if parts else self.chain.current_period()
+        end = int(parts[1]) if len(parts) > 1 else start
+        if end < start:
+            self.emit(f"error: empty range {start}..{end}")
+            return
+        config = getattr(self.chain, "config", None)
+        quorum = (config.quorum_size if config is not None
+                  else self.chain.chain_config().quorum_size)
+        pull = getattr(self.chain, "audit_data", None)
+        if pull is None:  # raw in-proc chain: the pull the server serves
+            from gethsharding_tpu.mainchain.mirror import assemble_audit_data
+
+            def pull(period):
+                return assemble_audit_data(self.chain, period)
+        for period in range(start, end + 1):
+            data = pull(period)
+            shards = data["shards"]
+            if not shards:
+                self.emit(f"period {period}: no records")
+                continue
+            drift = 0
+            for shard_id in sorted(shards):
+                rec = shards[shard_id]
+                ok = (rec["vote_count"] >= quorum) == bool(rec["is_elected"])
+                if not ok:
+                    drift += 1
+                self.emit(
+                    f"period {period} shard {shard_id}: "
+                    f"votes={rec['vote_count']} signed={len(rec['votes'])} "
+                    f"elected={rec['is_elected']}"
+                    f"{'' if ok else '  <-- TALLY DRIFT'}")
+            self.emit(f"period {period}: {len(shards)} shards audited, "
+                      f"{'consistent' if not drift else str(drift) + ' DRIFTS'}")
+
     def do_trace(self, arg):
         """trace <txhash> — event-level execution trace of a sealed tx
         (debug_traceTransaction analog)"""

@@ -95,7 +95,15 @@ def test_console_drives_a_chain_over_rpc():
             bls_pop=manager.bls_proof_of_possession(acct.address))
         backend.fast_forward(1)
         root = Hash32(keccak256(b"console-root"))
-        backend.add_header(acct.address, 3, backend.current_period(), root)
+        period = backend.current_period()
+        backend.add_header(acct.address, 3, period, root)
+        # one signed vote so the audit command has an auditable shard
+        from gethsharding_tpu.smc.state_machine import vote_digest
+
+        backend.submit_vote(
+            acct.address, 3, period, 0, root,
+            bls_sig=manager.bls_sign(acct.address,
+                                     bytes(vote_digest(3, period, root))))
 
         chain = RemoteMainchain.dial(*server.address)
         addr_hex = "0x" + bytes(acct.address).hex()
@@ -107,6 +115,7 @@ def test_console_drives_a_chain_over_rpc():
             "record 99",
             "votes 3",
             "submitted 3",
+            "audit 1",
             "commit",
             "fastforward 2",
             "bogus-command",
@@ -123,6 +132,9 @@ def test_console_drives_a_chain_over_rpc():
         assert "pool_index=0" in text
         assert "chunk_root=0x" + bytes(root).hex() in text
         assert "no record" in text
+        # the tally audit over the bulk auditData pull
+        assert "period 1 shard 3: votes=1 signed=1 elected=False" in text
+        assert "1 shards audited, consistent" in text
         assert "block 6" in text      # commit mined block 6 (period 1 + 1)
         assert "error:" in text       # bad args answered, session survived
         # the two dev commands really advanced the remote chain
