@@ -159,6 +159,10 @@ class RemoteHub:
         info = self.peer_info(target.peer_id)
         if (info is not None and info.get("endpoint")
                 and self._dialer is not None):
+            # DirectDialer.send retries a stale cached connection once
+            # internally; a dial/handshake failure falls straight back to
+            # the relay (retrying here would stack HANDSHAKE_TIMEOUT
+            # stalls on the data-plane hot path)
             if self._dialer.send(tuple(info["endpoint"]), sender.peer_id,
                                  kind, payload,
                                  expect_account=info.get("account")):

@@ -124,7 +124,12 @@ def test_full_period_pipeline_cross_process(tmp_path):
             assert wait_until(
                 lambda: proposer_node.service(Proposer).collations_proposed >= 1
             ), notary_node.errors() + proposer_node.errors()
-            assert chain_ctl.last_submitted_collation(shard_id) == period
+            # the local counter leads the SMC tx: wait for the chain-side
+            # submission too (the bare equality flaked under CPU
+            # starvation in full-suite runs)
+            assert wait_until(
+                lambda: chain_ctl.last_submitted_collation(shard_id) == period,
+                timeout=15.0), notary_node.errors() + proposer_node.errors()
 
             approved = False
             for _ in range(config.period_length - 1):
