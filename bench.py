@@ -52,6 +52,15 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 # wins.
 CONFIGS = [
     {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "scan"},
+    # r4: the final-exponentiation mega-kernel (ops/pallas_finalexp.py) —
+    # the whole ~250-op final exp as ONE pallas_call; the lever sized to
+    # the latency-bound gap (VERDICT r3 #1). Probed right after the
+    # champion, composed with the champion's ambient knobs and with
+    # relaxed normalize for the Miller side.
+    {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "scan",
+     "GETHSHARDING_TPU_FINALEXP": "mega"},
+    {"GETHSHARDING_TPU_LIMB_FORM": "wide", "GETHSHARDING_TPU_NORM": "relaxed",
+     "GETHSHARDING_TPU_FINALEXP": "mega"},
     # r3 additions, probed right after the champion: the statically
     # unrolled carry (straight-line fused code instead of an XLA While
     # per normalize), the fused Pallas pair-conv (never materializes the
@@ -97,6 +106,17 @@ CONFIGS = [
 ]
 
 SWEEP_BUDGET_S = float(os.environ.get("GETHSHARDING_BENCH_BUDGET_S", "1200"))
+
+# Optional ABSOLUTE wall-clock deadline (epoch seconds). Callers running
+# under an outer `timeout` (scripts/tpu_experiments/89_finalize_winner.sh)
+# set it so every stage's subprocess timeout derives from the REMAINING
+# wall clock — the extras pass, retry, and sweep can then never cascade
+# past the window and get SIGTERMed mid-write.
+_DEADLINE_TS = float(os.environ.get("GETHSHARDING_BENCH_DEADLINE_TS", "0"))
+
+
+def _remaining() -> "float | None":
+    return None if not _DEADLINE_TS else _DEADLINE_TS - time.time()
 
 
 def _enable_compile_cache() -> None:
@@ -359,14 +379,27 @@ def _measure_extras(dispatch_s: float) -> dict:
 
 
 def _run_config(cfg: dict, extras: bool = False) -> dict | None:
-    env = dict(os.environ)
+    # the probe must measure cfg and ONLY cfg: ambient exported
+    # GETHSHARDING_TPU_* knobs would leak into every subprocess, trip the
+    # mutually-exclusive knob validations (ValueError at import), and get
+    # the clean cfg permanently negative-cached under the wrong label
+    env = {key: val for key, val in os.environ.items()
+           if not key.startswith("GETHSHARDING_TPU_")}
     env.update(cfg)
     # the winner's extras pass (configs 1/2/4/5) compiles several extra
     # kernels — the r1 run lost its extras to the sweep-probe timeout, so
     # it gets a budget of its own, scaled with the run's overall budget
     # knob so a capped hermetic run stays capped
-    timeout = min(1500, 1.25 * SWEEP_BUDGET_S) if extras else min(
+    # extras cap scales with the budget knob (the TPU finalize run sets a
+    # big budget so the config-5 stress compile can't eat the extras
+    # pass); a capped hermetic run stays capped
+    timeout = min(4200, max(560, 1.25 * SWEEP_BUDGET_S)) if extras else min(
         560, SWEEP_BUDGET_S)
+    rem = _remaining()
+    if rem is not None:
+        if rem < 120:
+            return None  # not enough window left to learn anything
+        timeout = min(timeout, max(90, rem - 45))
     if extras:
         env["GETHSHARDING_BENCH_EXTRAS"] = "1"
     try:
@@ -422,6 +455,11 @@ def _print_metric(sig_rate: float, stats: dict, knobs: str) -> None:
     if extra.get("platform") == "axon":
         # the axon PJRT plugin IS the TPU chip behind the tunnel
         extra["platform"] = "tpu (axon)"
+    # replayable provenance: _latest_capture refuses git-tracked captures
+    # without an embedded stamp (checkout resets mtime), so every fresh
+    # report carries its own capture time
+    extra.setdefault("captured_at",
+                     time.strftime("%Y-%m-%d %H:%M:%S", time.localtime()))
     print(json.dumps({
         "metric": "notary_sig_verifications_per_sec",
         "value": sig_rate,
@@ -600,7 +638,18 @@ def main() -> None:
         if stats is not None and stats.get("platform") == cache_key:
             best = stats
         else:
-            best_cfg = None
+            # the extras pass compiles several extra kernels and can time
+            # out on its own; before abandoning the cached winner for a
+            # full re-sweep (which may not fit the caller's window —
+            # 89_finalize's outer timeout), retry the winner WITHOUT
+            # extras: a capture missing configs 1/2/4/5 beats no capture
+            stats = _run_config(best_cfg)
+            if stats is not None and stats.get("platform") == cache_key:
+                print("# winner extras pass failed; reporting winner "
+                      "without extras", file=sys.stderr)
+                best = stats
+            else:
+                best_cfg = None
 
     if best_cfg is None:
         results = []
@@ -611,7 +660,22 @@ def main() -> None:
                 print(f"# skipping config {cfg} (failed in an earlier "
                       f"sweep)", file=sys.stderr)
                 continue
-            if results and time.monotonic() - sweep_start > SWEEP_BUDGET_S:
+            elapsed = time.monotonic() - sweep_start
+            rem = _remaining()
+            if rem is not None and rem < 660:
+                # break BEFORE starting a config the deadline would clamp:
+                # a deadline-truncated probe failure must never be
+                # negative-cached as a deterministic config failure
+                print(f"# wall-clock deadline near; sweep stops after {i} "
+                      f"configs", file=sys.stderr)
+                break
+            if elapsed > SWEEP_BUDGET_S and (
+                    results or elapsed > SWEEP_BUDGET_S + 2 * 560):
+                # past budget stop once something succeeded; with NOTHING
+                # succeeded allow limited overtime (a couple of probe
+                # timeouts) — an unbounded empty-results sweep against a
+                # dead tunnel would run every config to its timeout and
+                # blow the caller's window
                 print(f"# sweep budget exhausted after {i} configs",
                       file=sys.stderr)
                 break
@@ -638,9 +702,15 @@ def main() -> None:
             best_cfg, best = {}, measure_single()
         else:
             best_cfg, best = max(results, key=lambda r: r[1]["sig_rate"])
-            # persist failures only from a sweep where something ELSE
-            # succeeded — a dead-tunnel window must not blacklist configs
-            failed.extend(c for c in sweep_failures if c not in failed)
+            # persist failures only when the accelerator is STILL
+            # reachable after the sweep — "something else succeeded" does
+            # not make later failures deterministic (config 1 can succeed
+            # and the tunnel die mid-sweep, which is this environment's
+            # normal operating mode), so re-probe before blacklisting
+            if sweep_failures and (
+                    os.environ.get("GETHSHARDING_BENCH_CPU") == "1"
+                    or _probe_backend() is not None):
+                failed.extend(c for c in sweep_failures if c not in failed)
             _save_cache(best_cfg, best["platform"])
             # one extra run of the winner for the config 1/2/4/5 numbers
             stats = _run_config(best_cfg, extras=True)
@@ -662,7 +732,9 @@ def main() -> None:
         + (["norm-relaxed"]
            if best_cfg.get("GETHSHARDING_TPU_NORM") == "relaxed" else [])
         + (["pallas-norm"] if best_cfg.get("GETHSHARDING_TPU_PALLAS") == "1"
-           else []))
+           else [])
+        + (["finalexp-mega"]
+           if best_cfg.get("GETHSHARDING_TPU_FINALEXP") == "mega" else []))
     _print_metric(best["sig_rate"], best, f"{knobs}, {best['platform']}")
 
 

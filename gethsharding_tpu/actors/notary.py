@@ -513,12 +513,28 @@ class Notary(Service):
         """Enforced windback: verify availability of the last
         `config.windback_depth` periods' collations on this shard chain
         (fetching missing bodies over shardp2p), refusing to vote while
-        any of them is unavailable."""
+        any of them is unavailable.
+
+        Prior-period records come from the mirror snapshot's
+        `prior_records` (closed periods are immutable, so the bulk pull
+        is exact) — a remote notary pays ZERO extra round trips here;
+        only periods outside the snapshot's depth fall back to direct
+        `collation_record` reads."""
         depth = self.config.windback_depth
         if depth <= 0:
             return True
+        from gethsharding_tpu.mainchain.mirror import decode_record
+
+        snap = self.mirror.snapshot() if self.mirror is not None else None
+        prior_records = (snap or {}).get("prior_records") or {}
+        if snap is not None and (snap.get("period") or 0) != period:
+            prior_records = {}  # stale snapshot: its window may not align
         for prior in range(max(1, period - depth), period):
-            record = self.client.collation_record(shard_id, prior)
+            if prior in prior_records:
+                rec = prior_records[prior].get(shard_id)
+                record = None if rec is None else decode_record(rec)
+            else:
+                record = self.client.collation_record(shard_id, prior)
             if record is None:
                 continue  # no collation that period: nothing to hold
             self.m_windback_checks.inc()

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import json
 import threading
+import weakref
 from typing import Dict, NamedTuple, Optional
 
 from gethsharding_tpu.actors.base import Service
@@ -216,13 +217,37 @@ def assemble_snapshot(source) -> dict:
         if last_sub == period:
             record = source.collation_record(shard_id, period)
             if record is not None:
-                records[shard_id] = {
-                    "chunk_root": bytes(record.chunk_root).hex(),
-                    "proposer": bytes(record.proposer).hex(),
-                    "vote_count": record.vote_count,
-                    "is_elected": bool(record.is_elected),
-                    "signature": bytes(record.signature or b"").hex(),
-                }
+                records[shard_id] = _rec_jsonable(record)
+    # windback context: the last windback_depth CLOSED periods' records
+    # (immutable once their period ends — votes only land in the current
+    # period), so a remote notary's windback availability checks read
+    # them from the snapshot instead of O(depth) collationRecord round
+    # trips per vote (the r3 gap: actors/notary.py _check_windback).
+    # Immutability also makes each closed period's walk cacheable: the
+    # per-source memo avoids re-reading depth×shards records every head
+    # (reorg_gen-keyed — a rollback can rewrite "closed" periods).
+    depth = getattr(getattr(source, "config", None), "windback_depth", 0)
+    reorg_gen = getattr(source, "reorg_generation", 0)
+    prior: Dict[int, Dict[int, dict]] = {}
+    # the cache is shared across the RPC server's handler threads and the
+    # local mirror: guard every read/write/evict (an unlocked eviction
+    # loop racing an insert raises 'dict changed size during iteration')
+    with _PRIOR_LOCK:
+        cache = _PRIOR_CACHE.setdefault(source, {})
+        for pp in range(max(1, period - (depth or 0)), period):
+            cached = cache.get((reorg_gen, pp))
+            if cached is None:
+                shard_recs: Dict[int, dict] = {}
+                for shard_id in range(shard_count):
+                    record = source.collation_record(shard_id, pp)
+                    if record is not None:
+                        shard_recs[shard_id] = _rec_jsonable(record)
+                cached = cache[(reorg_gen, pp)] = shard_recs
+            prior[pp] = cached
+        # evict stale generations / periods that left the window
+        for key in [k for k in cache
+                    if k[0] != reorg_gen or k[1] < period - (depth or 0) - 2]:
+            del cache[key]
     return {
         "block_number": block_number,
         "period": period,
@@ -234,6 +259,23 @@ def assemble_snapshot(source) -> dict:
         "last_submitted": submitted,
         "last_approved": approved,
         "records": records,
+        "prior_records": prior,
+    }
+
+
+# per-source memo of closed-period record walks (see assemble_snapshot);
+# weak keys so a dropped backend releases its cache
+_PRIOR_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_PRIOR_LOCK = threading.Lock()
+
+
+def _rec_jsonable(record) -> dict:
+    return {
+        "chunk_root": bytes(record.chunk_root).hex(),
+        "proposer": bytes(record.proposer).hex(),
+        "vote_count": record.vote_count,
+        "is_elected": bool(record.is_elected),
+        "signature": bytes(record.signature or b"").hex(),
     }
 
 
@@ -293,6 +335,11 @@ def restore_int_keys(snapshot: dict) -> dict:
     """JSON stringifies int dict keys; restore them in place."""
     for field in ("last_submitted", "last_approved", "records"):
         snapshot[field] = {int(k): v for k, v in snapshot[field].items()}
+    prior = snapshot.get("prior_records")
+    if prior is not None:
+        snapshot["prior_records"] = {
+            int(p): {int(s): rec for s, rec in shards.items()}
+            for p, shards in prior.items()}
     return snapshot
 
 

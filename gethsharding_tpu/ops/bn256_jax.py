@@ -95,6 +95,24 @@ FE_UNROLL = _PAIR_UNROLL_RAW in ("1", "finalexp")  # ladders + hard part
 # PAIR_UNROLL=1.
 SCAN_UNROLL = int(os.environ.get("GETHSHARDING_TPU_SCAN_UNROLL", "1"))
 
+# GETHSHARDING_TPU_FINALEXP=mega routes the ENTIRE fraction-stacked final
+# exponentiation (easy part, x^u ladders, hard part — ~250 sequential
+# fp12 ops) through the single-dispatch Pallas mega-kernel
+# (ops/pallas_finalexp.py): one kernel launch, VMEM-resident register
+# file, zero HBM round-trips between steps. The kernel's arithmetic is
+# self-contained wide/relaxed, so the knob composes with any limb-form
+# config; it conflicts only with PAIR_UNROLL's finalexp unrolls (both
+# claim the same stage — a silent override would mislabel autotune
+# results, same policy as PALLAS×NORM in ops/limb.py).
+FINALEXP = os.environ.get("GETHSHARDING_TPU_FINALEXP", "xla")
+if FINALEXP not in ("xla", "mega"):
+    raise ValueError(f"GETHSHARDING_TPU_FINALEXP must be 'xla' or 'mega', "
+                     f"got {FINALEXP!r}")
+if FINALEXP == "mega" and FE_UNROLL:
+    raise ValueError("GETHSHARDING_TPU_FINALEXP=mega and "
+                     "GETHSHARDING_TPU_PAIR_UNROLL both rewrite the final "
+                     "exponentiation; set one")
+
 
 def _use_pallas_conv() -> bool:
     return PAIRCONV == "pallas" and _limb._pallas_wanted()
@@ -734,6 +752,10 @@ def fp12_eq(x, y):
 
 def pairing_is_one(f):
     """is_one(final_exponentiation(f)) without any field inversion."""
+    if FINALEXP == "mega" and _limb._pallas_wanted():
+        from gethsharding_tpu.ops.pallas_finalexp import finalexp_is_one
+
+        return finalexp_is_one(f)
     nd = jnp.stack([fp12_conj(f), FP.normalize(f)])  # conj(f)/f = f^(p⁶-1)
     nd = fp12_mul(fp12_frobenius(nd, 2), nd)         # ^(p²+1)
     nd = _run_hard_part(nd, _pow_u_fraction, lambda ra: ra[::-1])

@@ -586,6 +586,16 @@ class ModArith:
     def canon(self, x: jnp.ndarray) -> jnp.ndarray:
         """Unique representative < p (binary descent conditional subtract)."""
         z = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, 1)])
+        if NORM_IMPL == "relaxed":
+            # relaxed normalize leaves QUASI-canonical limbs (a limb can be
+            # -1). When the represented value is already < p no conditional
+            # subtract fires, so without this exact pre-carry the output
+            # limbs could keep the -1 — and eq/is_zero compare limb
+            # vectors element-wise, turning two equal field values into a
+            # spurious mismatch. One carry makes the descent's input (and
+            # hence its output) canonical limbs. canon sits only on
+            # equality/export paths, never inside the hot normalize.
+            z = _carry(z)
         for k in range(self.pshift.shape[0]):
             z = _cond_sub(z, self.pshift[k])
         return z[..., :NLIMBS]

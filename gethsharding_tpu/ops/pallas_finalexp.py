@@ -1,0 +1,482 @@
+"""Pallas TPU mega-kernel: the ENTIRE final exponentiation in one kernel.
+
+The audit dispatch is latency-bound, not flops-bound (PERF.md): the
+final exponentiation alone is ~250 sequential fp12 operations, and as
+stock XLA each is a chain of kernels with a serialized carry scan inside
+every normalize — per-op dispatch and HBM round-trips dominate. This
+kernel runs the whole inversion-free fraction-stacked final-exp program
+(`bn256_jax.pairing_is_one`: easy part, three x^u NAF ladders, the
+Devegili–Scott–Dahab hard part) as ONE `pallas_call`:
+
+- a VMEM-resident register file (14 registers × fraction 2 × 12 Fp
+  coefficients × 25 limbs × batch lanes, ~5 MB at the 128-lane block);
+- a `fori_loop` over a ~250-instruction program held in SMEM, each step
+  dispatching mul / swap / frobenius / copy via `pl.when` — the kernel
+  compiles each op ONCE, the loop replays it with zero launch overhead;
+- RELAXED normalization everywhere (value-preserving carry rounds as
+  full-tile vector ops; quasi-canonical limbs in [-1, 2^12+64]) — the
+  kernel contains no sequential carry chain at all;
+- batch on lanes, limbs/planes on sublanes (the `pallas_conv` layout):
+  every shift-MAC of the schoolbook convolution is a full-width vector
+  op across all 288 product planes of an fp12 product at once.
+
+The arithmetic is self-contained wide-form (25 limbs) regardless of the
+ambient GETHSHARDING_TPU_* knobs: inputs arrive as any lazy limb form
+(22 or 25 wide, value < 2^273) and outputs return as 25-limb
+quasi-canonical limbs which the XLA wrapper re-normalizes into the
+ambient form. Bound proofs mirror ops/limb.py's relaxed-normalize
+derivation (same quasi-canonical bound, same fold/lift constants).
+
+Reference parity: this replaces the final-exponentiation half of
+`crypto/bn256/cloudflare/optate.go` (finalExponentiation) whose field
+stack is hand-written assembly (`gfp_amd64.s:39-129`) — the reference's
+answer to the same problem (fuse the whole field stack below the
+dispatch boundary), re-expressed for a systolic/vector machine.
+
+Opt-in: GETHSHARDING_TPU_FINALEXP=mega routes `bn256_jax.pairing_is_one`
+through `finalexp_is_one`; bench.py probes it as an autotune config.
+Differential tests run the kernel in interpreter mode on CPU against the
+XLA path (tests/test_pallas_finalexp.py), and `run_program_xla` executes
+the same instruction stream with the same helpers as plain XLA ops so
+program-logic bugs and Pallas-mechanics bugs isolate cleanly.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from gethsharding_tpu.crypto import bn256 as ref
+from gethsharding_tpu.ops.limb import LIMB_BITS, LIMB_MASK, int_to_limbs
+
+BLOCK_LANES = 128
+
+# == self-contained wide-relaxed limb constants ============================
+# The kernel always computes in the 25-limb wide form with relaxed
+# normalization, independent of the ambient knobs (a 22-limb ambient form
+# converts losslessly on the way in/out). Constants re-derived here with
+# the same formulas as limb.ModArith.__init__ so the bound proofs carry.
+
+P = ref.P
+KNL = 25                      # kernel limb count (wide form)
+KFOLD_BASE = 22
+KFOLD_ROWS = 33
+KNCOLS = 2 * KNL - 1          # schoolbook product columns (49)
+
+_FOLD_J = np.stack(
+    [int_to_limbs(pow(1 << (LIMB_BITS * (KFOLD_BASE + k)), 1, P),
+                  KFOLD_BASE)
+     for k in range(KFOLD_ROWS)]).astype(np.int32)     # (33, 22)
+
+# lift added after the fold (multiple of p covering the worst-case
+# negative fold/lo terms of quasi-canonical inputs — limb.py:412-427)
+_DEFICIT = KFOLD_ROWS * 113 * P + (113 << 253)
+_LIFT_RELAXED = int_to_limbs(-(-_DEFICIT // P) * P, KNL)
+
+
+def _pad_mult(bits: int) -> np.ndarray:
+    value = -(-(1 << bits) // P) * P
+    nlimbs = -(-value.bit_length() // LIMB_BITS)
+    return int_to_limbs(value, nlimbs)
+
+
+_PAD547 = _pad_mult(547)      # >= two subtracted lazy products (46 limbs)
+_PAD274 = _pad_mult(274)      # >= one lazy element (value < 2^273)
+
+# row-vector forms (width, 1) for lane-broadcast adds
+def _rows(vec: np.ndarray, width: int) -> np.ndarray:
+    out = np.zeros((width, 1), np.int32)
+    out[: vec.shape[0], 0] = vec
+    return out
+
+
+# conv-accumulator pad: re component subtracts <= 2 products per group
+# (same structure as bn256_jax._group_pad); im is all-positive
+_MUL_PAD = np.zeros((2, 1, KNCOLS, 1), np.int32)   # (c, g-bcast, cols, 1)
+_MUL_PAD[0, 0] = _rows(_PAD547, KNCOLS)
+_FP2_PAD = np.zeros((2, KNCOLS, 1), np.int32)      # frobenius fp2 mul
+_FP2_PAD[0] = _rows(_PAD547, KNCOLS)
+_NEG_PAD = _rows(_PAD274, KNL)                     # for conj / xi diff
+
+# Frobenius constants gamma_{n,k} = xi^(k(p^n-1)/6), 25-limb form
+def _const_fp2_25(a: int, b: int) -> np.ndarray:
+    return np.stack([int_to_limbs(a % P, KNL), int_to_limbs(b % P, KNL)])
+
+
+_GAMMA = np.stack([
+    np.stack([_const_fp2_25(*(lambda g: (g.a, g.b))(
+        ref._fp2_pow(ref.XI, k * (P ** n - 1) // 6)))
+        for k in range(6)])
+    for n in (1, 2, 3)]).astype(np.int32)          # (3, 6, 2, 25)
+
+# cyclic-convolution index tables (same derivation as bn256_jax)
+_CONV_J = np.array([[(k - i) % 6 for i in range(6)] for k in range(6)])
+_CONV_SEL = np.array([[0 if i + (k - i) % 6 == k else 1 for i in range(6)]
+                      for k in range(6)])
+
+
+class Consts(NamedTuple):
+    """The kernel's numeric constants, threaded explicitly: Pallas
+    forbids captured array constants in kernels, so they enter as kernel
+    inputs (and as plain arrays on the XLA-oracle path)."""
+
+    fold_t: Any   # (22, 33)  transposed fold matrix (column h = fold row)
+    lift: Any     # (25, 1)   relaxed lift (multiple of p)
+    mulpad: Any   # (2, 1, 49, 1) fp12-mul group pad (re rows only)
+    fp2pad: Any   # (2, 49, 1)    frobenius fp2-mul pad
+    negpad: Any   # (25, 1)   negation pad (multiple of p >= 2^274)
+    gamma: Any    # (3, 6, 2, 25, 1) Frobenius gamma_{n,k} limbs
+
+
+_NP_CONSTS = Consts(
+    fold_t=np.ascontiguousarray(_FOLD_J.T),
+    lift=_LIFT_RELAXED[:, None],
+    mulpad=_MUL_PAD,
+    fp2pad=_FP2_PAD,
+    negpad=_NEG_PAD,
+    gamma=_GAMMA[..., None],
+)
+
+
+# == pure-jnp helpers ======================================================
+# All helpers take (..., W, B) blocks — batch on the minor (lane) axis,
+# limb index on the second-minor (sublane) axis, anything broadcastable in
+# front. They run identically as plain XLA ops (differential tests,
+# `run_program_xla`) and inside the Pallas kernel.
+
+
+def _zeros_like_rows(x, rows: int):
+    return jnp.zeros(x.shape[:-2] + (rows, x.shape[-1]), jnp.int32)
+
+
+def _round(z):
+    """One width-preserving relaxed carry round with top-carry refold:
+    value-exact for any width (limb.py `_relaxed_round` + top re-fuse)."""
+    lo = z & LIMB_MASK
+    c = z >> LIMB_BITS
+    shifted = jnp.concatenate(
+        [_zeros_like_rows(c, 1), c[..., :-1, :]], axis=-2)
+    z2 = lo + shifted
+    top_fix = c[..., -1:, :] << LIMB_BITS
+    return jnp.concatenate(
+        [z2[..., :-1, :], z2[..., -1:, :] + top_fix], axis=-2)
+
+
+def _normalize(z, C: Consts):
+    """Relaxed normalize: (..., W, B) accumulator (|limb| < 2^30.7,
+    value >= 0) -> (..., 25, B) quasi-canonical limbs in [-1, 2^12+64],
+    value preserved mod p. Mirrors limb.py's wide/relaxed branch
+    (lines ~495-516): 2 growing rounds, fold, lift, 3 refold rounds —
+    with the growth pre-allocated as zero rows so every round is the
+    width-preserving masked form."""
+    w = z.shape[-2]
+    if w > KFOLD_BASE + KFOLD_ROWS - 2:
+        raise ValueError(f"accumulator too wide: {w}")
+    z = jnp.concatenate([z, _zeros_like_rows(z, 2)], axis=-2)
+    z = _round(_round(z))
+    # fold rows >= KFOLD_BASE through the fold matrix (broadcast MACs)
+    lo = z[..., :KFOLD_BASE, :]
+    hi = z[..., KFOLD_BASE:, :]
+    acc = lo
+    for h in range(hi.shape[-2]):
+        acc = acc + hi[..., h:h + 1, :] * C.fold_t[:, h:h + 1]
+    acc = jnp.concatenate(
+        [acc, _zeros_like_rows(acc, KNL - KFOLD_BASE)], axis=-2)
+    acc = acc + C.lift
+    return _round(_round(_round(acc)))
+
+
+def _conv(u, v):
+    """Schoolbook columns: (..., 25, B) x (..., 25, B) -> (..., 49, B),
+    leading dims broadcast — the stacked-plane form of pallas_conv's
+    shift-MAC loop (25 full-tile MACs for ALL planes at once)."""
+    acc = None
+    for l in range(KNL):
+        term = u[..., l:l + 1, :] * v
+        parts = []
+        if l:
+            parts.append(_zeros_like_rows(term, l))
+        parts.append(term)
+        tail = KNCOLS - KNL - l
+        if tail:
+            parts.append(_zeros_like_rows(term, tail))
+        shifted = parts[0] if len(parts) == 1 else jnp.concatenate(
+            parts, axis=-2)
+        acc = shifted if acc is None else acc + shifted
+    return acc
+
+
+def _mul_xi(y, C: Consts):
+    """xi-multiple of every Fp2 coefficient: y (..., 6, 2, 25, B) ->
+    same shape, value-parity with bn256_jax.fp2_mul_xi."""
+    a = y[..., 0, :, :]
+    b = y[..., 1, :, :]
+    rr = a * 9 - b + C.negpad
+    ii = a + b * 9
+    return _normalize(jnp.stack([rr, ii], axis=-3), C)
+
+
+def _fp12_mul(x, y, C: Consts):
+    """w-basis fp12 product, componentwise over any leading dims.
+
+    x, y: (..., 6, 2, 25, B). Same algorithm as bn256_jax.fp12_mul:
+    cyclic convolution with xi wrap, (component, group) accumulators,
+    one batched normalize, two-level group merge."""
+    xiy = _mul_xi(y, C)
+    # operand stack per (k, i): y or xi*y at plane j — static gather
+    # into (..., 6k, 6i, 2b, 25, B)
+    src = (y, xiy)
+    op_rows = []
+    for k in range(6):
+        op_rows.append(jnp.stack(
+            [src[_CONV_SEL[k][i]][..., _CONV_J[k][i], :, :, :]
+             for i in range(6)], axis=-4))
+    op = jnp.stack(op_rows, axis=-5)
+    # cols[..., k, i, a, b, n, B]
+    xe = x[..., None, :, :, None, :, :]       # (..., 1, 6i, 2a, 1, 25, B)
+    ve = op[..., :, :, None, :, :, :]          # (..., 6k, 6i, 1, 2b, 25, B)
+    cols = _conv(xe, ve)                       # (..., 6, 6, 2, 2, 49, B)
+    re = cols[..., 0, 0, :, :] - cols[..., 1, 1, :, :]   # (..., 6, 6, 49, B)
+    im = cols[..., 0, 1, :, :] + cols[..., 1, 0, :, :]
+    # group pairs of i: g = i // 2  -> (..., 6, 3, 49, B)
+    re_g = re[..., 0::2, :, :] + re[..., 1::2, :, :]
+    im_g = im[..., 0::2, :, :] + im[..., 1::2, :, :]
+    acc = jnp.stack([re_g, im_g], axis=-4)     # (..., 6, 2c, 3g, 49, B)
+    acc = acc + C.mulpad
+    parts = _normalize(acc, C)                 # (..., 6, 2, 3, 25, B)
+    merged = _normalize(parts[..., 0, :, :] + parts[..., 1, :, :], C)
+    return _normalize(merged + parts[..., 2, :, :], C)
+
+
+def _frob(x, n, C: Consts):
+    """f^(p^n) with a TRACED scalar n in {1,2,3}: conjugate (n odd) then
+    multiply each w-coefficient by gamma_{n,k}. x (..., 6, 2, 25, B)."""
+    a = x[..., 0, :, :]
+    b = x[..., 1, :, :]
+    odd = (n % 2) == 1
+    b_in = jnp.where(odd, C.negpad - b, b)
+    coeff = _normalize(jnp.stack([a, b_in], axis=-3), C)  # (..., 6,2,25,B)
+    g = jnp.where(n == 1, C.gamma[0],
+                  jnp.where(n == 2, C.gamma[1], C.gamma[2]))  # (6, 2, 25, 1)
+    ga = g[..., 0, :, :]                               # (6, 25, 1)
+    gb = g[..., 1, :, :]
+    ca = coeff[..., 0, :, :]
+    cb = coeff[..., 1, :, :]
+    rr = _conv(ca, ga)                                 # broadcast over lanes
+    rr2 = _conv(cb, gb)
+    ii = _conv(ca, gb)
+    ii2 = _conv(cb, ga)
+    acc = jnp.stack([rr - rr2, ii + ii2], axis=-3)     # (..., 6, 2, 49, B)
+    acc = acc + C.fp2pad
+    return _normalize(acc, C)
+
+
+def _swap(x):
+    """Fraction inverse: exchange numerator and denominator (axis 0)."""
+    return jnp.concatenate([x[1:2], x[0:1]], axis=0)
+
+
+# == the instruction stream ================================================
+# ops: 0 = mul(ra, rb) -> rd; 1 = swap(ra) -> rd; 2 = frob_b(ra) -> rd
+# (n in the b field); 3 = copy(ra) -> rd. Registers: 14 fraction-stacked
+# fp12 values; r0 holds the easy-part output, r1..r3 the x^u ladder
+# results, r4.. the DSD hard-part temps (bn256_jax._HARD_PROGRAM's plan).
+
+
+def _build_program() -> np.ndarray:
+    from gethsharding_tpu.ops.bn256_jax import _HARD_PROGRAM, _U_NAF
+
+    prog = [
+        (2, 0, 2, 4),   # r4 = frob2(nd)
+        (0, 4, 0, 0),   # nd = frob2(nd) * nd   (easy part, p^2+1)
+    ]
+    digits = list(reversed(np.asarray(_U_NAF)[:-1].tolist()))
+    for s, d in ((0, 1), (1, 2), (2, 3)):   # fu, fu2, fu3
+        prog.append((1, s, 0, 4))           # r4 = swap(x): x^-1 for NAF
+        prog.append((3, s, 0, d))           # acc = x  (top NAF digit = 1)
+        for dig in digits:
+            prog.append((0, d, d, d))       # acc = acc^2
+            if dig == 1:
+                prog.append((0, d, s, d))
+            elif dig == -1:
+                prog.append((0, d, 4, d))
+    for op, a, b, dst in np.asarray(_HARD_PROGRAM).tolist():
+        if op == 0:
+            prog.append((0, a, b, dst))
+        elif op == 1:
+            prog.append((0, a, a, dst))     # sqr = mul(a, a)
+        elif op == 2:
+            prog.append((1, a, 0, dst))     # cyclotomic inverse = swap
+        else:
+            prog.append((2, a, op - 2, dst))
+    return np.asarray(prog, np.int32)
+
+
+_N_REGS = 14
+_RESULT_REG = 13
+
+
+def _apply_op(regs, op, a, b, d, C: Consts):
+    """One instruction on a register list (trace-time dispatch) — the
+    XLA twin of the kernel's pl.when dispatch, for differential tests."""
+    ra = regs[a]
+    if op == 0:
+        out = _fp12_mul(ra, regs[b], C)
+    elif op == 1:
+        out = _swap(ra)
+    elif op == 2:
+        out = _frob(ra, jnp.int32(b), C)
+    else:
+        out = ra
+    regs[d] = out
+    return regs
+
+
+def run_program_xla(nd):
+    """Execute the full program as plain (unrolled) XLA ops.
+
+    nd: (2, n, 6, 2, 25) int32 lazy limbs — the fraction-stacked easy-part
+    input conj(f)/f. Returns the result register in the same layout. The
+    oracle for the Pallas kernel AND a self-check of the program against
+    bn256_jax.pairing_is_one."""
+    C = Consts(*(jnp.asarray(c) for c in _NP_CONSTS))
+    x = jnp.moveaxis(nd, 1, -1)              # (2, 6, 2, 25, n)
+    regs = [x] + [jnp.zeros_like(x) for _ in range(_N_REGS - 1)]
+    for op, a, b, d in _build_program().tolist():
+        regs = _apply_op(regs, op, a, b, d, C)
+    return jnp.moveaxis(regs[_RESULT_REG], -1, 1)
+
+
+# == the Pallas kernel =====================================================
+
+
+def _kernel(prog_ref, nd_ref, fold_ref, lift_ref, mulpad_ref, fp2pad_ref,
+            negpad_ref, gamma_ref, out_ref, regs_ref, *, n_steps: int):
+    C = Consts(fold_t=fold_ref[:], lift=lift_ref[:], mulpad=mulpad_ref[:],
+               fp2pad=fp2pad_ref[:], negpad=negpad_ref[:],
+               gamma=gamma_ref[:])
+    regs_ref[0] = _unpack(nd_ref[:])
+
+    def body(step, carry):
+        op = prog_ref[step, 0]
+        a = prog_ref[step, 1]
+        b = prog_ref[step, 2]
+        d = prog_ref[step, 3]
+        ra = regs_ref[a]
+
+        @pl.when(op == 0)
+        def _mul():
+            regs_ref[d] = _fp12_mul(ra, regs_ref[b], C)
+
+        @pl.when(op == 1)
+        def _sw():
+            regs_ref[d] = _swap(ra)
+
+        @pl.when(op == 2)
+        def _fr():
+            regs_ref[d] = _frob(ra, b, C)
+
+        @pl.when(op == 3)
+        def _cp():
+            regs_ref[d] = ra
+
+        return carry
+
+    lax.fori_loop(0, n_steps, body, 0)
+    out_ref[:] = _pack(regs_ref[_RESULT_REG])
+
+
+def _unpack(flat):
+    """(2, 12, 25, B) -> (2, 6, 2, 25, B): split the plane axis (leading
+    dims only — no minor-dim reshape, free in Mosaic)."""
+    return flat.reshape((2, 6, 2) + flat.shape[-2:])
+
+
+def _pack(x):
+    return x.reshape((2, 12) + x.shape[-2:])
+
+
+@functools.lru_cache(maxsize=8)
+def _compiled(n_steps: int, interpret: bool):
+    kernel = functools.partial(_kernel, n_steps=n_steps)
+
+    @jax.jit
+    def run(prog, nd):
+        n = nd.shape[-1]
+        grid = (n // BLOCK_LANES,)
+        from jax.experimental.pallas import tpu as pltpu
+
+        def whole(shape):
+            rank = len(shape)
+            return pl.BlockSpec(shape, lambda i, _r=rank: (0,) * _r)
+
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec((2, 12, KNL, BLOCK_LANES),
+                             lambda i: (0, 0, 0, i)),
+                whole(_NP_CONSTS.fold_t.shape),
+                whole(_NP_CONSTS.lift.shape),
+                whole(_NP_CONSTS.mulpad.shape),
+                whole(_NP_CONSTS.fp2pad.shape),
+                whole(_NP_CONSTS.negpad.shape),
+                whole(_NP_CONSTS.gamma.shape),
+            ],
+            out_specs=pl.BlockSpec((2, 12, KNL, BLOCK_LANES),
+                                   lambda i: (0, 0, 0, i)),
+            out_shape=jax.ShapeDtypeStruct((2, 12, KNL, n), jnp.int32),
+            scratch_shapes=[
+                pltpu.VMEM((_N_REGS, 2, 6, 2, KNL, BLOCK_LANES),
+                           jnp.int32)],
+            interpret=interpret,
+        )(prog, nd, *(jnp.asarray(c) for c in _NP_CONSTS))
+
+    return run
+
+
+def finalexp_is_one(f, *, interpret: bool = False):
+    """Fraction-stacked final exponentiation == 1?, via the mega-kernel.
+
+    f: (..., 6, 2, NL) int32 lazy limbs (ambient form, 22 or 25 wide) —
+    the Miller-product to check, exactly `pairing_is_one`'s input.
+    Returns bool (...,). Drop-in boolean twin of
+    bn256_jax.pairing_is_one (the XLA easy-part stack and final
+    canonical compare bracket the kernel)."""
+    from gethsharding_tpu.ops import bn256_jax as k
+    from gethsharding_tpu.ops.limb import NLIMBS
+
+    lead = f.shape[:-3]
+    nd = jnp.stack([k.fp12_conj(f), k.FP.normalize(f)])  # (2, ..., 6,2,NL)
+    if NLIMBS < KNL:   # ambient exact form: widen losslessly
+        nd = jnp.concatenate(
+            [nd, jnp.zeros(nd.shape[:-1] + (KNL - NLIMBS,), jnp.int32)],
+            axis=-1)
+    n = 1
+    for dim in lead:
+        n *= dim
+    nd = nd.reshape((2, n, 6, 2, KNL))
+    ndT = jnp.moveaxis(nd, 1, -1)                       # (2, 6, 2, 25, n)
+    ndT = ndT.reshape((2, 12, KNL, n))
+    pad = (-n) % BLOCK_LANES
+    if pad:
+        ndT = jnp.concatenate(
+            [ndT, jnp.zeros(ndT.shape[:-1] + (pad,), jnp.int32)], axis=-1)
+    prog = jnp.asarray(_build_program())
+    out = _compiled(int(prog.shape[0]), interpret)(prog, ndT)
+    if pad:
+        out = out[..., :n]
+    out = jnp.moveaxis(out.reshape((2, 6, 2, KNL, n)), -1, 1)  # (2,n,6,2,25)
+    # back to the ambient lazy form: one exact normalize per component
+    # (handles the quasi-canonical -1 limbs; value < 2^LAZY_BITS)
+    num = k.FP.normalize(out[0])
+    den = k.FP.normalize(out[1])
+    return k.fp12_eq(num, den).reshape(lead)

@@ -1,0 +1,8 @@
+#!/bin/bash
+# FIRST in the window: does Mosaic compile + run the Pallas kernels
+# correctly on this backend? Tiny shapes, minutes — answers the
+# mega-kernel plan's blocking question before any big probe runs.
+cd /root/repo || exit 1
+timeout 1800 python scripts/tpu_pallas_smoke.py >"$1.json" 2>"$1.err"
+rc=$?
+[ $rc -eq 0 ] && grep -Eq '"platform": "(tpu|axon)' "$1.json"

@@ -1,0 +1,8 @@
+#!/bin/bash
+# Does Mosaic compile + run the final-exp mega-kernel correctly on this
+# backend? Tiny batch, isolated from the full bench probe so a compile
+# failure is learned cheaply ($1 = out prefix).
+cd /root/repo || exit 1
+timeout 3600 python scripts/tpu_megakernel_smoke.py >"$1.json" 2>"$1.err"
+rc=$?
+[ $rc -eq 0 ] && grep -Eq '"platform": "(tpu|axon)' "$1.json"

@@ -1,0 +1,9 @@
+#!/bin/bash
+# Probe the final-exp mega-kernel on the production audit dispatch
+# (champion ambient knobs + FINALEXP=mega). On success, re-queue the
+# finalize experiment so the canonical capture reflects the new winner.
+cd /root/repo || exit 1
+env GETHSHARDING_TPU_LIMB_FORM=exact GETHSHARDING_TPU_CARRY=scan \
+    GETHSHARDING_TPU_FINALEXP=mega \
+  timeout 4800 python bench.py --single >"$1.out" 2>"$1.err"
+grep -q sig_rate "$1.out" && grep -q '"platform": "tpu' "$1.out"

@@ -1,0 +1,8 @@
+#!/bin/bash
+# Mega-kernel + relaxed ambient normalize (the no-sequential-carry
+# Miller side): the other mega composition bench.py sweeps.
+cd /root/repo || exit 1
+env GETHSHARDING_TPU_LIMB_FORM=wide GETHSHARDING_TPU_NORM=relaxed \
+    GETHSHARDING_TPU_FINALEXP=mega \
+  timeout 4800 python bench.py --single >"$1.out" 2>"$1.err"
+grep -q sig_rate "$1.out" && grep -q '"platform": "tpu' "$1.out"

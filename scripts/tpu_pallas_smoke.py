@@ -1,0 +1,99 @@
+"""Minute-one Mosaic validation on the live backend.
+
+The Pallas kernels (ops/pallas_norm, ops/pallas_conv) are fully
+differentially tested in interpreter mode, but whether Mosaic compiles
+and runs them CORRECTLY on this backend (TPU v5 lite behind the axon
+tunnel) has never been witnessed — and the round-4 mega-kernel plan
+stands on them. This probe runs each kernel COMPILED (interpret=False)
+on tiny shapes against the XLA path and prints ONE JSON line with a
+per-kernel ok/error so a single short tunnel window settles the
+question (VERDICT r3 "What's weak" #4).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import traceback
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+P_BN = 21888242871839275222246405745257275088696311157297823662689037894645226208583
+
+
+def main() -> int:
+    from gethsharding_tpu.parallel.virtual import configure_compile_cache
+
+    configure_compile_cache()
+
+    import jax
+    import jax.numpy as jnp
+
+    from gethsharding_tpu.ops import limb
+
+    platform = jax.devices()[0].platform
+    rng = np.random.default_rng(41)
+    out = {"platform": platform, "kernels": {}}
+
+    def run(name, fn):
+        t0 = time.perf_counter()
+        try:
+            ok = bool(fn())
+            out["kernels"][name] = {"ok": ok,
+                                    "wall_s": round(time.perf_counter() - t0,
+                                                    2)}
+        except Exception:
+            out["kernels"][name] = {
+                "ok": False,
+                "wall_s": round(time.perf_counter() - t0, 2),
+                "error": traceback.format_exc()[-800:]}
+
+    def norm_probe():
+        from gethsharding_tpu.ops.pallas_norm import (BLOCK_ROWS,
+                                                      normalize_pallas)
+
+        arith = limb.ModArith(P_BN)
+        z = rng.integers(0, 1 << 28, (BLOCK_ROWS, 49)).astype(np.int32)
+        want = np.asarray(arith.normalize(jnp.asarray(z)))
+        got = np.asarray(normalize_pallas(arith, jnp.asarray(z)))
+        return (want == got).all()
+
+    def conv_probe():
+        from gethsharding_tpu.ops import bn256_jax as k
+        from gethsharding_tpu.ops.pallas_conv import pair_conv_combine
+
+        def xla_ref(x, y, comb):
+            prod = x[..., :, :, None, :, None] * y[..., :, None, :, None, :]
+            cols = limb.conv_cols(prod)
+            return jnp.einsum("...iabn,iabcg->...cgn", cols,
+                              jnp.asarray(comb))
+
+        ok = True
+        for comb in (k._COMB, k._LCOMB):
+            G, A, B, _, _ = comb.shape
+            x = rng.integers(0, 1 << 12,
+                             (8, G, A, limb.NLIMBS)).astype(np.int32)
+            y = rng.integers(0, 1 << 12,
+                             (8, G, B, limb.NLIMBS)).astype(np.int32)
+            want = np.asarray(xla_ref(jnp.asarray(x), jnp.asarray(y), comb))
+            got = np.asarray(pair_conv_combine(
+                jnp.asarray(x), jnp.asarray(y), comb))
+            ok = ok and (want == got).all()
+        return ok
+
+    run("pallas_norm", norm_probe)
+    run("pallas_conv", conv_probe)
+    print(json.dumps(out))
+    # exit 0 whenever the question was ANSWERED on a real accelerator —
+    # a Mosaic failure is exactly the evidence this probe exists to
+    # collect, so it must not be retried as if the run were lost; only a
+    # CPU fallback (dead tunnel) counts as "no result"
+    return 1 if platform == "cpu" else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -84,13 +84,38 @@ while true; do
         n=$((n + 1))
         echo $n >"$QUEUE/.retries_$name"
         if [ "$n" -ge 3 ]; then
-          mv "$next" "$QUEUE/done/FAILED_${name}_$(date +%s).sh"
+          park="$QUEUE/done/FAILED_${name}_$(date +%s).sh"
+          mv "$next" "$park"
+          # mv preserves the script's old edit mtime; the finalize
+          # re-queue guard compares park-file mtimes, so stamp NOW
+          touch "$park"
           rm -f "$QUEUE/.retries_$name"
           echo "$(date +%F\ %T) $name parked after $n failures" >>"$LOG"
         fi
       fi
     done
     [ -n "$pids" ] && kill -CONT $pids 2>/dev/null
+    # a cfg probe that SUCCEEDED after the finalize capture may change
+    # the winner: re-queue the finalize experiment so the canonical
+    # capture (winner + extras) is refreshed on a later pass. Only
+    # platform-tpu results count (a failed probe leaves an empty .out),
+    # and a parked (3-strike) finalize is only revived by cfg evidence
+    # NEWER than its last failure — never in an unconditional loop.
+    if [ ! -e "$QUEUE"/89_finalize_winner.sh ] \
+        && [ -e scripts/tpu_experiments/89_finalize_winner.sh ]; then
+      newest_cfg=$(grep -l '"platform": "tpu' .tpu_results/*_cfg_*.out \
+        2>/dev/null | xargs -r ls -t 2>/dev/null | head -1)
+      newest_cap=$(ls -t bench_results/tpu_capture_*.json 2>/dev/null | head -1)
+      newest_park=$(ls -t "$QUEUE"/done/FAILED_89_finalize_winner_*.sh \
+        2>/dev/null | head -1)
+      if [ -n "$newest_cfg" ] \
+          && { [ -z "$newest_cap" ] || [ "$newest_cfg" -nt "$newest_cap" ]; } \
+          && { [ -z "$newest_park" ] || [ "$newest_cfg" -nt "$newest_park" ]; }
+      then
+        cp -p scripts/tpu_experiments/89_finalize_winner.sh "$QUEUE/"
+        echo "$(date +%F\ %T) re-queued 89_finalize (newer cfg result)" >>"$LOG"
+      fi
+    fi
     # retries of still-pending failures wait for the next pass
     sleep 600
   else

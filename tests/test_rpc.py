@@ -461,6 +461,60 @@ def test_remote_notary_hot_loop_is_o1_per_head():
         server.stop()
 
 
+def test_remote_windback_reads_come_from_the_snapshot():
+    """Enforced windback over RPC: prior-period records ride the mirror
+    snapshot's `prior_records` (closed periods are immutable), so a
+    remote notary's windback availability checks cost ZERO extra
+    `shard_collationRecord` round trips (r3's O(depth)-RPC gap)."""
+    from gethsharding_tpu.actors.notary import Notary
+    from gethsharding_tpu.actors.proposer import create_collation
+    from gethsharding_tpu.core.types import Transaction
+    from gethsharding_tpu.mainchain.mirror import StateMirror
+
+    config = Config(shard_count=2, quorum_size=1, windback_depth=3)
+    backend = SimulatedMainchain(config=config)
+    server = RPCServer(backend, port=0)
+    server.start()
+    node = None
+    try:
+        remote = RemoteMainchain.dial(*server.address)
+        node = ShardNode(actor="notary", backend=remote, config=config,
+                         deposit=False, txpool_interval=None)
+        backend.fund(node.client.account(), 2000 * ETHER)
+        node.client.register_notary()
+        node.start()
+        notary = node.service(Notary)
+        shard_id = notary.shard.shard_id
+        for period in (1, 2, 3):
+            backend.fast_forward(1)
+            coll = create_collation(node.client, shard_id, period,
+                                    [Transaction(nonce=period)])
+            notary.shard.save_collation(coll)
+            node.client.add_header(shard_id, period, coll.header.chunk_root,
+                                   coll.header.proposer_signature)
+        backend.commit()
+        assert wait_until(
+            lambda: (node.service(StateMirror).snapshot() or {}).get(
+                "period") == 3)
+        snap = node.service(StateMirror).snapshot()
+        assert set(snap["prior_records"]) == {1, 2}, snap["prior_records"]
+
+        baseline = dict(server.method_calls)
+        checks_before = notary.m_windback_checks.value
+        notary.notarize_collations()
+        calls = {m: n - baseline.get(m, 0)
+                 for m, n in server.method_calls.items()}
+        # windback DID run (periods 1-2 were checked for availability)...
+        assert notary.m_windback_checks.value >= checks_before + 2
+        # ...and no per-period record read crossed the wire for it
+        assert calls.get("shard_collationRecord", 0) == 0, calls
+        assert notary.votes_submitted >= 1
+    finally:
+        if node is not None:
+            node.stop()
+        server.stop()
+
+
 def test_bootnode_introduction_without_a_chain():
     """cmd/bootnode parity: a chainless introduction node serves the
     authenticated peer table and the direct data plane works through it,
