@@ -230,21 +230,27 @@ def assemble_snapshot(source) -> dict:
     reorg_gen = getattr(source, "reorg_generation", 0)
     prior: Dict[int, Dict[int, dict]] = {}
     # the cache is shared across the RPC server's handler threads and the
-    # local mirror: guard every read/write/evict (an unlocked eviction
-    # loop racing an insert raises 'dict changed size during iteration')
+    # local mirror: all reads/writes/evictions happen under the lock (an
+    # unlocked eviction racing an insert raises 'dict changed size during
+    # iteration') — but the record WALK itself runs outside it, so a
+    # slow source (remote client fallback) never serializes every other
+    # snapshot assembly in the process behind one cold cache fill
     with _PRIOR_LOCK:
         cache = _PRIOR_CACHE.setdefault(source, {})
-        for pp in range(max(1, period - (depth or 0)), period):
-            cached = cache.get((reorg_gen, pp))
-            if cached is None:
-                shard_recs: Dict[int, dict] = {}
-                for shard_id in range(shard_count):
-                    record = source.collation_record(shard_id, pp)
-                    if record is not None:
-                        shard_recs[shard_id] = _rec_jsonable(record)
-                cached = cache[(reorg_gen, pp)] = shard_recs
-            prior[pp] = cached
-        # evict stale generations / periods that left the window
+        have = {pp: cache.get((reorg_gen, pp))
+                for pp in range(max(1, period - (depth or 0)), period)}
+    for pp, cached in have.items():
+        if cached is None:
+            shard_recs: Dict[int, dict] = {}
+            for shard_id in range(shard_count):
+                record = source.collation_record(shard_id, pp)
+                if record is not None:
+                    shard_recs[shard_id] = _rec_jsonable(record)
+            cached = shard_recs  # racing fills compute identical data
+        prior[pp] = cached
+    with _PRIOR_LOCK:
+        for pp, recs in prior.items():
+            cache[(reorg_gen, pp)] = recs
         for key in [k for k in cache
                     if k[0] != reorg_gen or k[1] < period - (depth or 0) - 2]:
             del cache[key]

@@ -151,7 +151,38 @@ def enc_p2p(data) -> tuple:
             "ciphertext": enc_bytes(data.ciphertext),
             "nonce": data.nonce,
         }
+    from gethsharding_tpu.p2p import discovery as disc
+
+    if isinstance(data, disc.PeerTableRequest):
+        return "PeerTableRequest", {}
+    if isinstance(data, disc.PeerTableResponse):
+        return "PeerTableResponse", {
+            "announces": [_enc_announce(a) for a in data.announces],
+        }
+    from gethsharding_tpu.storage import netstore as ns
+
+    if isinstance(data, ns.ChunkRequest):
+        return "ChunkRequest", {"key": enc_bytes(data.key)}
+    if isinstance(data, ns.ChunkDelivery):
+        return "ChunkDelivery", {"key": enc_bytes(data.key),
+                                 "span": data.span,
+                                 "payload": enc_bytes(data.payload)}
     raise TypeError(f"no p2p wire codec for {type(data).__name__}")
+
+
+def _enc_announce(ann) -> dict:
+    return {"peerId": ann.peer_id, "account": ann.account,
+            "host": ann.host, "port": ann.port, "seq": ann.seq,
+            "sig": enc_bytes(ann.sig)}
+
+
+def _dec_announce(obj: dict):
+    from gethsharding_tpu.p2p import discovery as disc
+
+    return disc.PeerAnnounce(
+        peer_id=int(obj["peerId"]), account=str(obj["account"]),
+        host=str(obj["host"]), port=int(obj["port"]), seq=int(obj["seq"]),
+        sig=dec_bytes(obj["sig"]))
 
 
 def dec_p2p(kind: str, payload: dict):
@@ -199,6 +230,26 @@ def dec_p2p(kind: str, payload: dict):
             ciphertext=dec_bytes(payload["ciphertext"]),
             nonce=int(payload["nonce"]),
         )
+    if kind == "ChunkRequest":
+        from gethsharding_tpu.storage import netstore as ns
+
+        return ns.ChunkRequest(key=dec_bytes(payload["key"]))
+    if kind == "ChunkDelivery":
+        from gethsharding_tpu.storage import netstore as ns
+
+        return ns.ChunkDelivery(key=dec_bytes(payload["key"]),
+                                span=int(payload["span"]),
+                                payload=dec_bytes(payload["payload"]))
+    if kind == "PeerTableRequest":
+        from gethsharding_tpu.p2p import discovery as disc
+
+        return disc.PeerTableRequest()
+    if kind == "PeerTableResponse":
+        from gethsharding_tpu.p2p import discovery as disc
+
+        return disc.PeerTableResponse(
+            announces=tuple(_dec_announce(a)
+                            for a in payload.get("announces", [])))
     raise ValueError(f"unknown p2p message type {kind!r}")
 
 

@@ -106,9 +106,25 @@ class ChunkStore:
         span, _ = self._get(root)
         return span
 
-    def retrieve(self, root: bytes) -> bytes:
-        """Reassemble + verify the full content under `root`."""
-        span, payload = self._get(root)
+    def chunk(self, key: bytes) -> tuple:
+        """(span, payload) of one stored chunk, integrity-verified —
+        the raw-chunk read surface the network tier (netstore) serves."""
+        return self._get(key)
+
+    def put_chunk(self, span: int, payload: bytes) -> bytes:
+        """Store one raw chunk (netstore's delivery sink); returns its
+        key. The caller verifies the key matches what it requested."""
+        return self._put(span, payload)
+
+    def retrieve(self, root: bytes, fetch=None) -> bytes:
+        """Reassemble + verify the full content under `root`.
+
+        `fetch(key) -> (span, payload)` overrides how chunks are read —
+        the ONE tree walk shared with the network tier (netstore passes
+        its network-faulting reader), so the 1-ary-promotion and span
+        invariants live in exactly one place."""
+        fetch = fetch or self._get
+        span, payload = fetch(root)
         if span <= CHUNK_SIZE:
             if len(payload) != span:
                 raise ChunkStoreError("leaf span does not match payload")
@@ -117,7 +133,8 @@ class ChunkStore:
             raise ChunkStoreError("interior chunk is not a key list")
         parts = []
         for start in range(0, len(payload), KEY_SIZE):
-            parts.append(self.retrieve(payload[start:start + KEY_SIZE]))
+            parts.append(self.retrieve(payload[start:start + KEY_SIZE],
+                                       fetch=fetch))
         data = b"".join(parts)
         if len(data) != span:
             raise ChunkStoreError("subtree span mismatch")

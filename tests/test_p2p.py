@@ -79,3 +79,35 @@ def test_detach_stops_delivery():
     target = b.self_peer
     b.stop()
     assert not a.send("gone", target)
+
+
+def test_peer_directory_merge_is_sybil_bounded():
+    """One verified announce per account (freshest wins) and a hard
+    table cap — a single key cannot mint unbounded peer_ids into the
+    directory (p2p/discovery.py merge rules)."""
+    from gethsharding_tpu.mainchain.accounts import AccountManager
+    from gethsharding_tpu.p2p import discovery as disc
+
+    mgr = AccountManager()
+    addr = mgr.new_account(seed=b"sybil").address
+    acct = bytes(addr).hex()
+    d = disc.PeerDirectory(network_id=5)
+
+    def ann(pid, seq, port=4000):
+        digest = disc.announce_digest(5, pid, acct, "127.0.0.1", port, seq)
+        return disc.PeerAnnounce(peer_id=pid, account=acct,
+                                 host="127.0.0.1", port=port, seq=seq,
+                                 sig=mgr.sign_hash(addr, digest))
+
+    # many peer_ids signed by ONE account: only the freshest survives
+    assert d.merge([ann(pid, seq=pid) for pid in range(1, 40)]) >= 1
+    table = d.gossip_set()
+    assert len(table) == 1 and table[0].peer_id == 39
+    # a stale announce for the same account does not resurrect
+    assert d.merge([ann(7, seq=7)]) == 0
+    assert len(d.gossip_set()) == 1
+    # forged signature never enters
+    fake = disc.PeerAnnounce(peer_id=99, account=acct, host="127.0.0.1",
+                             port=4000, seq=10 ** 6, sig=b"\x00" * 65)
+    assert d.merge([fake]) == 0
+    assert len(d.gossip_set()) == 1
