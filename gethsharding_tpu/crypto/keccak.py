@@ -95,22 +95,48 @@ def keccak256(data: bytes) -> bytes:
 
 def keccak256_py(data: bytes) -> bytes:
     """Pure-Python keccak256 (the portable reference path)."""
-    # multi-rate padding: append 0x01, zero-fill, set MSB of final byte
+    return _sponge(data, RATE_BYTES, 32, 0x01)
+
+
+def _sponge(data: bytes, rate: int, out_len: int, domain: int) -> bytes:
+    """The Keccak sponge over keccak_f1600: absorb `data` at `rate`
+    bytes per block with `domain` padding (0x01 = original Keccak /
+    Ethereum, 0x06 = NIST SHA3), squeeze `out_len` bytes."""
     padded = bytearray(data)
-    pad_len = RATE_BYTES - (len(padded) % RATE_BYTES)
-    padded += b"\x01" + b"\x00" * (pad_len - 1)
+    pad_len = rate - (len(padded) % rate)
+    padded += bytes([domain]) + b"\x00" * (pad_len - 1)
     padded[-1] |= 0x80
 
     state = [0] * 25
-    for block_start in range(0, len(padded), RATE_BYTES):
-        block = padded[block_start : block_start + RATE_BYTES]
-        for lane_idx in range(RATE_BYTES // 8):
+    for block_start in range(0, len(padded), rate):
+        block = padded[block_start: block_start + rate]
+        for lane_idx in range(rate // 8):
             state[lane_idx] ^= int.from_bytes(
-                block[lane_idx * 8 : lane_idx * 8 + 8], "little"
+                block[lane_idx * 8: lane_idx * 8 + 8], "little"
             )
         state = keccak_f1600(state)
 
     out = bytearray()
-    for lane_idx in range(4):  # 32 bytes = 4 lanes
-        out += state[lane_idx].to_bytes(8, "little")
-    return bytes(out)
+    while len(out) < out_len:
+        for lane_idx in range(rate // 8):
+            out += state[lane_idx].to_bytes(8, "little")
+            if len(out) >= out_len:
+                break
+        else:
+            state = keccak_f1600(state)
+    return bytes(out[:out_len])
+
+
+def sha3_digest(data: bytes, bits: int) -> bytes:
+    """NIST SHA3-{224,256,384,512} (0x06 domain padding) over the SAME
+    keccak_f1600 permutation as keccak256.
+
+    Exists for conformance: the official Keccak known-answer tests the
+    reference vendors (`crypto/sha3/testdata/keccakKats.json.deflate`,
+    go-ethereum 1.8.9) are FIPS-202 vectors — running them through this
+    path externally pins the permutation and sponge shared with the
+    consensus keccak256."""
+    if bits not in (224, 256, 384, 512):
+        raise ValueError(f"unsupported SHA3 width {bits}")
+    rate = 200 - 2 * (bits // 8)
+    return _sponge(data, rate, bits // 8, 0x06)

@@ -40,6 +40,34 @@ class KeystoreError(Exception):
     pass
 
 
+def scrypt_kdf(password: bytes, salt: bytes, n: int, r: int, p: int,
+               dklen: int) -> bytes:
+    """scrypt that accepts EVERY parameter set geth's Go scrypt does.
+
+    OpenSSL (hashlib.scrypt) enforces the RFC's N < 2^(128*r/8) bound,
+    rejecting the Web3 Secret Storage wiki/light profile (n=262144, r=1,
+    p=8) — real key files use it, and geth reads them. For those
+    parameter sets the outer PBKDF2-SHA256 layers run here and the
+    memory-hard ROMix runs in native C (native/scrypt.c), differentially
+    tested against hashlib on the parameters both accept."""
+    import hashlib
+
+    try:
+        return scrypt(password, salt=salt, n=n, r=r, p=p, dklen=dklen,
+                      maxmem=2**31 - 1)
+    except ValueError:
+        pass  # OpenSSL parameter bound: take the RFC 7914 composition
+    from gethsharding_tpu import native
+
+    blocks = hashlib.pbkdf2_hmac("sha256", password, salt, 1, p * 128 * r)
+    mixed = native.scrypt_romix(blocks, p, n, r)
+    if mixed is None:
+        raise KeystoreError(
+            "scrypt parameters unsupported by OpenSSL and the native "
+            "ROMix is unavailable (GETHSHARDING_NO_NATIVE?)")
+    return hashlib.pbkdf2_hmac("sha256", password, mixed, 1, dklen)
+
+
 def _aes128_ctr(key16: bytes, iv16: bytes, data: bytes) -> bytes:
     from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
 
@@ -92,9 +120,10 @@ def decrypt_key(obj: dict, password: str) -> int:
     kdf = crypto.get("kdf")
     params = crypto["kdfparams"]
     if kdf == "scrypt":
-        derived = scrypt(password.encode(), salt=bytes.fromhex(params["salt"]),
-                         n=params["n"], r=params["r"], p=params["p"],
-                         dklen=params["dklen"], maxmem=2**31 - 1)
+        derived = scrypt_kdf(password.encode(),
+                             salt=bytes.fromhex(params["salt"]),
+                             n=params["n"], r=params["r"], p=params["p"],
+                             dklen=params["dklen"])
     elif kdf == "pbkdf2":
         import hashlib
 

@@ -28,7 +28,7 @@ from typing import List, Optional, Sequence
 
 log = logging.getLogger("native")
 
-_SOURCES = ["keccak.c", "mpt.c"]
+_SOURCES = ["keccak.c", "mpt.c", "scrypt.c"]
 _KEY_CAP = 32
 _VAL_CAP = 128
 
@@ -90,6 +90,9 @@ def _load():
             u8p, ctypes.c_uint64, u8p, u8p, ctypes.c_uint64, u8p,
             ctypes.c_uint64, u8p]
         lib.gs_mpt_root.restype = ctypes.c_int
+        lib.gs_scrypt_romix.argtypes = [
+            u8p, ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint32]
+        lib.gs_scrypt_romix.restype = ctypes.c_int
         _lib = lib
         return _lib
 
@@ -123,6 +126,23 @@ def keccak256_batch(messages) -> Optional["np.ndarray"]:
         arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n, length,
         length, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
     return out
+
+
+def scrypt_romix(blocks: bytes, p: int, n: int, r: int) -> Optional[bytes]:
+    """RFC 7914 ROMix over `p` consecutive 128*r-byte blocks; None when
+    the native library is unavailable or allocation fails. The caller
+    (keystore `scrypt_kdf`) wraps it in the PBKDF2 outer layers."""
+    lib = _load()
+    if lib is None:
+        return None
+    if len(blocks) != p * 128 * r or n <= 0 or n & (n - 1):
+        raise ValueError("scrypt_romix: bad block length or non-pow2 N")
+    buf = (ctypes.c_uint8 * len(blocks)).from_buffer_copy(blocks)
+    rc = lib.gs_scrypt_romix(buf, p, n, r)
+    if rc != 0:
+        log.warning("gs_scrypt_romix failed rc=%d", rc)
+        return None
+    return bytes(buf)
 
 
 def mpt_root(keys: Sequence[bytes], values: Sequence[bytes]

@@ -13,6 +13,8 @@ PROTOCOL changes); any implementation drift fails here first.
 import json
 import os
 
+import pytest
+
 
 TESTDATA = os.path.join(os.path.dirname(__file__), "testdata")
 
@@ -305,3 +307,118 @@ def test_whisper_envelope_vectors():
                        nonce=case["nonce"])
         assert env.hash().hex() == case["hash"], case
         assert env.pow() == case["pow"], case
+
+
+# == bulk external suites (r4) =============================================
+# tests/testdata/keccak_kats_sha3.json: the official Keccak team
+# known-answer tests (FIPS 202), as vendored by go-ethereum 1.8.9
+# (crypto/sha3/testdata/keccakKats.json.deflate) — 1024 byte-aligned
+# cases across SHA3-224/256/384/512, all through the SAME keccak_f1600
+# permutation + sponge as consensus keccak256.
+# tests/testdata/keystore_v3_vectors.json: the Web3 Secret Storage v3
+# specification vectors (Ethereum wiki; accounts/keystore/testdata/
+# v3_test_vector.json in the reference).
+
+
+def test_external_sha3_kats_pin_the_permutation():
+    from gethsharding_tpu.crypto.keccak import sha3_digest
+
+    kats = _load("keccak_kats_sha3.json")
+    total = 0
+    for variant in ("SHA3-224", "SHA3-256", "SHA3-384", "SHA3-512"):
+        bits = int(variant.split("-")[1])
+        for case in kats[variant]:
+            msg = bytes.fromhex(case["message"])[: case["len"]]
+            assert sha3_digest(msg, bits).hex() == case["digest"], (
+                variant, case["len"])
+            total += 1
+    assert total == 1024
+
+
+def test_external_keystore_v3_light_vectors():
+    """The spec's 30/31-byte-key scrypt vectors (cheap KDF params)."""
+    from gethsharding_tpu.mainchain.keystore import decrypt_key
+
+    vectors = _load("keystore_v3_vectors.json")
+    for name in ("31_byte_key", "30_byte_key"):
+        case = vectors[name]
+        priv = decrypt_key(case["json"], case["password"])
+        assert priv == int(case["priv"], 16), name
+
+
+@pytest.mark.skipif(os.environ.get("GETHSHARDING_SKIP_SLOW") == "1",
+                    reason="GETHSHARDING_SKIP_SLOW=1")
+def test_external_keystore_v3_wiki_vectors():
+    """The canonical wikipage scrypt + pbkdf2 vectors (n=c=262144)."""
+    from gethsharding_tpu.mainchain.keystore import decrypt_key
+
+    vectors = _load("keystore_v3_vectors.json")
+    for name in ("wikipage_test_vector_scrypt", "wikipage_test_vector_pbkdf2"):
+        case = vectors[name]
+        priv = decrypt_key(case["json"], case["password"])
+        assert priv == int(case["priv"], 16), name
+    # wrong password -> rejected via MAC, never a wrong key
+    from gethsharding_tpu.mainchain.keystore import KeystoreError
+
+    with pytest.raises(KeystoreError):
+        decrypt_key(vectors["31_byte_key"]["json"], "not-the-password")
+
+
+# invalid-RLP rejection cases (the ethereum/tests invalidRLPTest.json
+# class: the EXPECTATION is the spec's — a canonical decoder must refuse
+# each stream; there is no output to publish)
+_INVALID_RLP = [
+    ("emptyEncoding", ""),
+    ("singleByteWrapped00", "8100"),
+    ("singleByteWrapped7f", "817f"),
+    ("truncatedShortString", "83646f"),
+    ("truncatedLongString", "b83c0102"),
+    ("truncatedLengthByte", "b8"),
+    ("truncatedLongLength", "b90102"),
+    ("longFormShortString", "b801ff"),
+    ("longLengthLeadingZero", "b900000102"),
+    ("longStringNoContent", "b800"),
+    ("truncatedList", "c3010203ff"[:6] + ""),  # c30102: 3-len, 2 present
+    ("listExtendsPastEnd", "c40102"),
+    ("longFormShortList", "f803aabbcc"),
+    ("listLengthLeadingZero", "f90000"),
+    ("truncatedLongList", "f83b0102"),
+    ("elementPastListEnd", "c382ffff"[:6]),  # c382ff: elem needs 2, has 1
+    ("trailingBytesTop", "c0c0"),
+    ("trailingByteAfterString", "83646f6700"),
+    ("hugeLengthOverflow", "bbffffffff"),
+    ("hugeListLengthOverflow", "fbffffffff"),
+    ("lengthBytesPastEnd", "ba0102"),
+]
+
+
+def test_invalid_rlp_streams_are_rejected():
+    from gethsharding_tpu.utils.rlp import DecodingError, rlp_decode
+
+    for name, stream in _INVALID_RLP:
+        with pytest.raises(DecodingError):
+            rlp_decode(bytes.fromhex(stream))
+        assert True, name
+
+
+def test_external_trie_vectors_any_insertion_order():
+    """trieanyorder semantics: the published roots must be reached from
+    EVERY insertion order (the trie is a pure function of the map)."""
+    import itertools
+
+    from gethsharding_tpu.core.trie import Trie
+
+    for case in _ext()["trie"]:
+        pairs = case["pairs"]
+        orders = list(itertools.permutations(range(len(pairs)))) \
+            if len(pairs) <= 4 else [
+                tuple(range(len(pairs))),
+                tuple(reversed(range(len(pairs)))),
+                tuple(sorted(range(len(pairs)), key=lambda i: pairs[i][1]))]
+        for order in orders:
+            trie = Trie()
+            for i in order:
+                key, value = pairs[i]
+                trie.update(key.encode(), value.encode())
+            assert trie.root_hash().hex() == case["root"], (
+                case["name"], order)

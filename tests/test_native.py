@@ -104,3 +104,26 @@ def test_chunk_root_one_mebibyte_body():
     items = [rlp_encode(int(b)) for b in prefix]
     keys = [rlp_encode(int_to_big_endian(i)) for i in range(len(prefix))]
     assert chunk_root(prefix) == _python_trie_root(list(zip(keys, items)))
+
+
+def test_native_scrypt_romix_matches_openssl():
+    """The native ROMix composed with PBKDF2 outer layers must equal
+    hashlib.scrypt wherever OpenSSL accepts the parameters — the
+    differential that licenses it for the parameter sets OpenSSL
+    rejects (keystore.scrypt_kdf's wiki/light profile)."""
+    import hashlib
+
+    import pytest
+
+    from gethsharding_tpu import native
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    for (n, r, p) in ((1024, 8, 1), (16, 1, 1), (256, 4, 2), (64, 2, 4)):
+        want = hashlib.scrypt(b"pw", salt=b"salt123", n=n, r=r, p=p,
+                              dklen=64, maxmem=2**31 - 1)
+        blocks = hashlib.pbkdf2_hmac("sha256", b"pw", b"salt123", 1,
+                                     p * 128 * r)
+        mixed = native.scrypt_romix(blocks, p, n, r)
+        got = hashlib.pbkdf2_hmac("sha256", b"pw", mixed, 1, 64)
+        assert got == want, (n, r, p)
