@@ -1,0 +1,130 @@
+// Sharding-domain golden-vector generator — run on a Go-equipped host
+// against go-ethereum 1.8.9 + sharding (the reference this framework
+// re-implements) to produce cross-implementation vectors for
+// tests/testdata/go_sharding_vectors.json.
+//
+// THIS ENVIRONMENT HAS NO GO TOOLCHAIN (see README.md in this
+// directory): the byte-identity demanded by BASELINE.md ("byte-identical
+// vote outcomes vs. the pure-Go path") is closed today by the
+// conformance suites (RLP / keccak / trie / EIP-155 / FIPS-202 KATs /
+// Web3 keystore v3) plus self-generated drift pins; THIS program closes
+// the remaining sharding-domain leg (collation-header hash, blob codec,
+// POC) the moment someone runs it where Go exists.
+//
+// Usage (GOPATH layout, as 1.8.9 predates modules):
+//   mkdir -p $GOPATH/src/github.com/ethereum
+//   ln -s /path/to/reference $GOPATH/src/github.com/ethereum/go-ethereum
+//   go run main.go > go_sharding_vectors.json
+//
+// Output schema (consumed by tests/test_conformance.py once present):
+//   {"collation_headers": [{shardID, period, chunkRoot, proposer,
+//                           sig, hash}],
+//    "blob_codec": [{payloads: [hex], serialized: hex}],
+//    "poc": [{body: hex, salt: hex, poc: hex}]}
+package main
+
+import (
+	"encoding/hex"
+	"encoding/json"
+	"fmt"
+	"math/big"
+	"os"
+
+	"github.com/ethereum/go-ethereum/common"
+	"github.com/ethereum/go-ethereum/sharding"
+	"github.com/ethereum/go-ethereum/sharding/utils"
+)
+
+func hexb(b []byte) string { return hex.EncodeToString(b) }
+
+func main() {
+	out := map[string]interface{}{}
+
+	// 1. collation-header hashes: the consensus identity of a collation
+	//    (sharding/collation.go:66 Hash = keccak256(rlp(header data)))
+	headers := []map[string]string{}
+	for i := 0; i < 8; i++ {
+		shard := big.NewInt(int64(i))
+		period := big.NewInt(int64(100 + i))
+		var root common.Hash
+		for j := range root {
+			root[j] = byte(i*31 + j)
+		}
+		addr := common.BytesToAddress([]byte{byte(i), 0xAA, 0xBB})
+		sig := []byte{}
+		if i%2 == 1 {
+			sig = make([]byte, 65)
+			for j := range sig {
+				sig[j] = byte(i + j)
+			}
+		}
+		h := sharding.NewCollationHeader(shard, &root, period, &addr, sig)
+		headers = append(headers, map[string]string{
+			"shardID":   shard.String(),
+			"period":    period.String(),
+			"chunkRoot": hexb(root[:]),
+			"proposer":  hexb(addr[:]),
+			"sig":       hexb(sig),
+			"hash":      hexb(h.Hash().Bytes()),
+		})
+	}
+	out["collation_headers"] = headers
+
+	// 2. blob codec at the RawBlob layer (sharding/utils/marshal.go:71
+	//    Serialize): NewRawBlob RLP-wraps the payload, so the Python
+	//    twin is RawBlob(data=rlp_encode(payload), skip_evm=flag)
+	blobs := []map[string]interface{}{}
+	for _, spec := range []struct {
+		payloads [][]byte
+		skips    []bool
+	}{
+		{[][]byte{{0x01}}, []bool{false}},
+		{[][]byte{{0xFF, 0xFE}, make([]byte, 31)}, []bool{true, false}},
+		{[][]byte{make([]byte, 62), {0xAB}}, []bool{false, true}},
+	} {
+		raw := []*utils.RawBlob{}
+		pl := []map[string]interface{}{}
+		for n, p := range spec.payloads {
+			blob, err := utils.NewRawBlob(p, spec.skips[n])
+			if err != nil {
+				fmt.Fprintln(os.Stderr, "rawblob:", err)
+				os.Exit(1)
+			}
+			raw = append(raw, blob)
+			pl = append(pl, map[string]interface{}{
+				"payload": hexb(p), "skip_evm": spec.skips[n]})
+		}
+		serialized, err := utils.Serialize(raw)
+		if err != nil {
+			fmt.Fprintln(os.Stderr, "serialize:", err)
+			os.Exit(1)
+		}
+		blobs = append(blobs, map[string]interface{}{
+			"blobs": pl, "serialized": hexb(serialized)})
+	}
+	out["blob_codec"] = blobs
+
+	// 3. proof-of-custody values over fixed bodies + salts
+	//    (sharding/collation.go:124 CalculatePOC)
+	pocs := []map[string]string{}
+	for i, body := range [][]byte{
+		{0x01, 0x02, 0x03},
+		make([]byte, 100),
+	} {
+		salt := []byte{byte(i), 0x55}
+		header := sharding.NewCollationHeader(
+			big.NewInt(0), nil, big.NewInt(1), nil, nil)
+		c := sharding.NewCollation(header, body, nil)
+		poc := c.CalculatePOC(salt)
+		pocs = append(pocs, map[string]string{
+			"body": hexb(body), "salt": hexb(salt),
+			"poc": hexb(poc.Bytes())})
+	}
+	out["poc"] = pocs
+
+	enc := json.NewEncoder(os.Stdout)
+	enc.SetIndent("", " ")
+	if err := enc.Encode(out); err != nil {
+		os.Exit(1)
+	}
+}
