@@ -59,6 +59,9 @@ CONFIGS = [
     # relaxed normalize for the Miller side.
     {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "scan",
      "GETHSHARDING_TPU_FINALEXP": "mega"},
+    # the two-launch pairing check: Miller AND final exp each one kernel
+    {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "scan",
+     "GETHSHARDING_TPU_FINALEXP": "mega", "GETHSHARDING_TPU_MILLER": "mega"},
     {"GETHSHARDING_TPU_LIMB_FORM": "wide", "GETHSHARDING_TPU_NORM": "relaxed",
      "GETHSHARDING_TPU_FINALEXP": "mega"},
     # r3 additions, probed right after the champion: the statically
@@ -734,7 +737,9 @@ def main() -> None:
         + (["pallas-norm"] if best_cfg.get("GETHSHARDING_TPU_PALLAS") == "1"
            else [])
         + (["finalexp-mega"]
-           if best_cfg.get("GETHSHARDING_TPU_FINALEXP") == "mega" else []))
+           if best_cfg.get("GETHSHARDING_TPU_FINALEXP") == "mega" else [])
+        + (["miller-mega"]
+           if best_cfg.get("GETHSHARDING_TPU_MILLER") == "mega" else []))
     _print_metric(best["sig_rate"], best, f"{knobs}, {best['platform']}")
 
 

@@ -113,6 +113,21 @@ if FINALEXP == "mega" and FE_UNROLL:
                      "GETHSHARDING_TPU_PAIR_UNROLL both rewrite the final "
                      "exponentiation; set one")
 
+# GETHSHARDING_TPU_MILLER=mega routes the PROJECTIVE shared-accumulator
+# Miller walk (the BLS committee-verify hot path) through its own
+# single-launch Pallas register machine (ops/pallas_finalexp.miller_f).
+# With both knobs mega, the whole post-aggregation pairing check runs in
+# TWO kernel launches. Same conflict rule vs PAIR_UNROLL (which inlines
+# the Miller drivers).
+MILLER = os.environ.get("GETHSHARDING_TPU_MILLER", "xla")
+if MILLER not in ("xla", "mega"):
+    raise ValueError(f"GETHSHARDING_TPU_MILLER must be 'xla' or 'mega', "
+                     f"got {MILLER!r}")
+if MILLER == "mega" and PAIR_UNROLL:
+    raise ValueError("GETHSHARDING_TPU_MILLER=mega and "
+                     "GETHSHARDING_TPU_PAIR_UNROLL=1 both rewrite the "
+                     "Miller loop; set one")
+
 
 def _use_pallas_conv() -> bool:
     return PAIRCONV == "pallas" and _limb._pallas_wanted()
@@ -923,6 +938,11 @@ def _bls_miller_opt(sig, hx, hy, pk):
     sx, sy, sz = sig
     pkx, pky, pkz = pk
     affine = pkz is None
+    if (MILLER == "mega" and not affine and sz is not None
+            and _limb._pallas_wanted()):
+        from gethsharding_tpu.ops.pallas_finalexp import miller_f
+
+        return miller_f(sig, hx, hy, pk)
     shape = sx.shape[:-1]
     hy_neg = FP.neg(hy)
 

@@ -439,6 +439,7 @@ class ModArith:
         self.zero = np.zeros(NLIMBS, np.int32)
         self.one = int_to_limbs(1)
         self._pad_cache: dict = {}
+        self._canon_jit = None  # lazily-jitted canon (see canon())
 
     # -- normalization ------------------------------------------------------
 
@@ -584,7 +585,19 @@ class ModArith:
     # -- canonical form & predicates ---------------------------------------
 
     def canon(self, x: jnp.ndarray) -> jnp.ndarray:
-        """Unique representative < p (binary descent conditional subtract)."""
+        """Unique representative < p (binary descent conditional subtract).
+
+        Jitted: the descent is ~46 conditional-subtract steps, each with
+        an exact carry scan — run EAGERLY (host export paths: to_ints,
+        eq on concrete arrays) that is thousands of per-op dispatches
+        per call and dominated the e2e suites' wall clock. Under an
+        outer jit the wrapper inlines; called eagerly it compiles once
+        per shape."""
+        if self._canon_jit is None:
+            self._canon_jit = jax.jit(self._canon_impl)
+        return self._canon_jit(x)
+
+    def _canon_impl(self, x: jnp.ndarray) -> jnp.ndarray:
         z = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, 1)])
         if NORM_IMPL == "relaxed":
             # relaxed normalize leaves QUASI-canonical limbs (a limb can be

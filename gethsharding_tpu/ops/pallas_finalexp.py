@@ -133,16 +133,22 @@ class Consts(NamedTuple):
     fp2pad: Any   # (2, 49, 1)    frobenius fp2-mul pad
     negpad: Any   # (25, 1)   negation pad (multiple of p >= 2^274)
     gamma: Any    # (3, 6, 2, 25, 1) Frobenius gamma_{n,k} limbs
+    linepad: Any  # (2, 2, 49, 1) sparse line-mul group pad (re rows)
+    one12: Any    # (6, 2, 25, 1) the fp12 multiplicative identity
 
 
-_NP_CONSTS = Consts(
-    fold_t=np.ascontiguousarray(_FOLD_J.T),
-    lift=_LIFT_RELAXED[:, None],
-    mulpad=_MUL_PAD,
-    fp2pad=_FP2_PAD,
-    negpad=_NEG_PAD,
-    gamma=_GAMMA[..., None],
-)
+# _LINE_PAD is defined with the Miller helpers below; populated after
+def _np_consts() -> "Consts":
+    return Consts(
+        fold_t=np.ascontiguousarray(_FOLD_J.T),
+        lift=_LIFT_RELAXED[:, None],
+        mulpad=_MUL_PAD,
+        fp2pad=_FP2_PAD,
+        negpad=_NEG_PAD,
+        gamma=_GAMMA[..., None],
+        linepad=_LINE_PAD,
+        one12=_ONE12,
+    )
 
 
 # == pure-jnp helpers ======================================================
@@ -357,11 +363,11 @@ def run_program_xla(nd):
 # == the Pallas kernel =====================================================
 
 
-def _kernel(prog_ref, nd_ref, fold_ref, lift_ref, mulpad_ref, fp2pad_ref,
-            negpad_ref, gamma_ref, out_ref, regs_ref, *, n_steps: int):
-    C = Consts(fold_t=fold_ref[:], lift=lift_ref[:], mulpad=mulpad_ref[:],
-               fp2pad=fp2pad_ref[:], negpad=negpad_ref[:],
-               gamma=gamma_ref[:])
+def _kernel(prog_ref, nd_ref, *rest, n_steps: int):
+    # rest = one ref per Consts field (in field order), out_ref, regs_ref
+    nfields = len(Consts._fields)
+    C = Consts(*(r[:] for r in rest[:nfields]))
+    out_ref, regs_ref = rest[nfields], rest[nfields + 1]
     regs_ref[0] = _unpack(nd_ref[:])
 
     def body(step, carry):
@@ -424,13 +430,7 @@ def _compiled(n_steps: int, interpret: bool):
                 pl.BlockSpec(memory_space=pltpu.SMEM),
                 pl.BlockSpec((2, 12, KNL, BLOCK_LANES),
                              lambda i: (0, 0, 0, i)),
-                whole(_NP_CONSTS.fold_t.shape),
-                whole(_NP_CONSTS.lift.shape),
-                whole(_NP_CONSTS.mulpad.shape),
-                whole(_NP_CONSTS.fp2pad.shape),
-                whole(_NP_CONSTS.negpad.shape),
-                whole(_NP_CONSTS.gamma.shape),
-            ],
+            ] + [whole(np.asarray(c).shape) for c in _NP_CONSTS],
             out_specs=pl.BlockSpec((2, 12, KNL, BLOCK_LANES),
                                    lambda i: (0, 0, 0, i)),
             out_shape=jax.ShapeDtypeStruct((2, 12, KNL, n), jnp.int32),
@@ -480,3 +480,398 @@ def finalexp_is_one(f, *, interpret: bool = False):
     num = k.FP.normalize(out[0])
     den = k.FP.normalize(out[1])
     return k.fp12_eq(num, den).reshape(lead)
+
+
+# == the Miller-loop mega-kernel ===========================================
+# The other 21% of the dispatch (PERF.md stage shares): the 90-step
+# shared-accumulator optimal-ate Miller product of the BLS committee
+# check (`bn256_jax._bls_miller_opt`, projective flavor) as ONE
+# pallas_call, same design as the final-exp kernel — an SMEM op stream
+# (DBL / ADD(candidate)) drives a fori_loop whose body updates
+# VMEM-resident (f, X, Y, Z) state; the per-step generator-line
+# constants are a VMEM table indexed by step. Output is the
+# fraction-stacked nd = conj(f)/f, i.e. exactly `finalexp_is_one`'s
+# kernel input — the whole pairing check then runs in TWO kernel
+# launches instead of ~600 XLA While dispatches.
+
+
+def _fp2_add(x, y, C: Consts):
+    return _normalize(x + y, C)
+
+
+def _fp2_sub(x, y, C: Consts):
+    return _normalize(x - y + C.negpad, C)
+
+
+def _fp2_neg(x, C: Consts):
+    return _normalize(C.negpad - x, C)
+
+
+def _fp2_scalar(x, k: int, C: Consts):
+    return _normalize(x * jnp.int32(k), C)
+
+
+def _fp2_mul(x, y, C: Consts):
+    """Full Fp2 product on row blocks: x, y (..., 2, 25, B).
+    (a+bi)(c+di) = (ac - bd) + (ad + bc)i — one 4-plane conv."""
+    a = x[..., 0:1, :, :]
+    b = x[..., 1:2, :, :]
+    c = y[..., 0:1, :, :]
+    d = y[..., 1:2, :, :]
+    u = jnp.concatenate([a, b, a, b], axis=-3)   # (..., 4, 25, B)
+    v = jnp.concatenate([c, d, d, c], axis=-3)
+    cols = _conv(u, v)                           # (..., 4, 49, B)
+    rr = cols[..., 0, :, :] - cols[..., 1, :, :] + C.fp2pad[0]
+    ii = cols[..., 2, :, :] + cols[..., 3, :, :]
+    return _normalize(jnp.stack([rr, ii], axis=-3), C)
+
+
+def _fp2_sqr(x, C: Consts):
+    return _fp2_mul(x, x, C)
+
+
+def _fp2_mul_fp(x, s, C: Consts):
+    """Fp2 x (..., 2, 25, B) times Fp s (..., 25, B)."""
+    cols = _conv(x, s[..., None, :, :])          # (..., 2, 49, B)
+    return _normalize(cols, C)
+
+
+def _fp2_conj_rows(x, C: Consts):
+    a = x[..., 0, :, :]
+    b = x[..., 1, :, :]
+    return _normalize(jnp.stack([a, C.negpad - b], axis=-3), C)
+
+
+# sparse line-mul tables (same derivation as bn256_jax._LINE_*)
+_KLINE_POS = np.array([0, 1, 3])
+_KLINE_J = np.array([[(k - d) % 6 for d in _KLINE_POS] for k in range(6)])
+_KLINE_SEL = np.array([[0 if k - d >= 0 else 1 for d in _KLINE_POS]
+                       for k in range(6)])
+# line-mul group pad: group 0 accumulates terms A,B (re subtracts 2
+# products), group 1 term C (re subtracts 1) — pad547 covers both
+_LINE_PAD = np.zeros((2, 2, KNCOLS, 1), np.int32)  # (c, g, cols, 1)
+_LINE_PAD[0, 0] = _rows(_PAD547, KNCOLS)
+_LINE_PAD[0, 1] = _rows(_PAD547, KNCOLS)
+
+
+def _fp12_mul_line(f, A, B, Cc, C: Consts):
+    """f · (A + B·w + C·w³), sparse: 72 plane-pairs instead of 144.
+    f (..., 6, 2, 25, B); A/B/Cc (..., 2, 25, B) Fp2 line terms."""
+    xif = _mul_xi(f, C)
+    src = (f, xif)
+    lstack = jnp.stack([A, B, Cc], axis=-4)      # (..., 3t, 2, 25, B)
+    op_rows = []
+    for k in range(6):
+        op_rows.append(jnp.stack(
+            [src[_KLINE_SEL[k][t]][..., _KLINE_J[k][t], :, :, :]
+             for t in range(3)], axis=-4))       # (..., 3t, 2, 25, B)
+    op = jnp.stack(op_rows, axis=-5)             # (..., 6k, 3t, 2, 25, B)
+    le = lstack[..., None, :, :, None, :, :]     # (..., 1, 3, 2a, 1, 25, B)
+    ve = op[..., :, :, None, :, :, :]            # (..., 6, 3, 1, 2b, 25, B)
+    cols = _conv(le, ve)                         # (..., 6, 3, 2, 2, 49, B)
+    re = cols[..., 0, 0, :, :] - cols[..., 1, 1, :, :]  # (..., 6, 3, 49, B)
+    im = cols[..., 0, 1, :, :] + cols[..., 1, 0, :, :]
+    re_g = jnp.stack([re[..., 0, :, :] + re[..., 1, :, :],
+                      re[..., 2, :, :]], axis=-3)       # (..., 6, 2g, 49, B)
+    im_g = jnp.stack([im[..., 0, :, :] + im[..., 1, :, :],
+                      im[..., 2, :, :]], axis=-3)
+    acc = jnp.stack([re_g, im_g], axis=-4)       # (..., 6, 2c, 2g, 49, B)
+    acc = acc + C.linepad
+    parts = _normalize(acc, C)                   # (..., 6, 2, 2, 25, B)
+    return _normalize(parts[..., 0, :, :] + parts[..., 1, :, :], C)
+
+
+def _kernel_dbl_step(X, Y, Z, px, py, C: Consts):
+    """Tangent step (bn256_jax._dbl_step, row layout). px/py Fp rows."""
+    A = _fp2_sqr(X, C)
+    Bq = _fp2_sqr(Y, C)
+    Cq = _fp2_sqr(Bq, C)
+    t = _fp2_sqr(_fp2_add(X, Bq, C), C)
+    D = _fp2_scalar(_fp2_sub(_fp2_sub(t, A, C), Cq, C), 2, C)
+    E = _fp2_scalar(A, 3, C)
+    F = _fp2_sqr(E, C)
+    X3 = _fp2_sub(F, _fp2_scalar(D, 2, C), C)
+    Y3 = _fp2_sub(_fp2_mul(E, _fp2_sub(D, X3, C), C),
+                  _fp2_scalar(Cq, 8, C), C)
+    ZZ = _fp2_sqr(Z, C)
+    Z3 = _fp2_scalar(_fp2_mul(Y, Z, C), 2, C)
+    c_py = _fp2_mul(Z3, ZZ, C)
+    c_px = _fp2_neg(_fp2_mul(E, ZZ, C), C)
+    c_const = _fp2_sub(_fp2_mul(E, X, C), _fp2_scalar(Bq, 2, C), C)
+    line = (_fp2_mul_fp(c_py, py, C), _fp2_mul_fp(c_px, px, C), c_const)
+    return line, X3, Y3, Z3
+
+
+def _kernel_jadd_step(X1, Y1, Z1, cand, px, py, C: Consts):
+    """Full Jacobian chord step (bn256_jax._jadd_step, row layout).
+    cand = (x2, y2, z2, zz2, zzz2) each (..., 2, 25, B)."""
+    x2, y2, z2, zz2, zzz2 = cand
+    Z1Z1 = _fp2_sqr(Z1, C)
+    U1 = _fp2_mul(X1, zz2, C)
+    U2 = _fp2_mul(x2, Z1Z1, C)
+    S1 = _fp2_mul(Y1, zzz2, C)
+    S2 = _fp2_mul(y2, _fp2_mul(Z1, Z1Z1, C), C)
+    H = _fp2_sub(U2, U1, C)
+    R = _fp2_sub(S2, S1, C)
+    HH = _fp2_sqr(H, C)
+    V = _fp2_mul(U1, HH, C)
+    HHH = _fp2_mul(H, HH, C)
+    X3 = _fp2_sub(_fp2_sub(_fp2_sqr(R, C), HHH, C),
+                  _fp2_scalar(V, 2, C), C)
+    Y3 = _fp2_sub(_fp2_mul(R, _fp2_sub(V, X3, C), C),
+                  _fp2_mul(S1, HHH, C), C)
+    Z3 = _fp2_mul(_fp2_mul(Z1, z2, C), H, C)
+    c_const = _fp2_sub(_fp2_mul(_fp2_mul(X1, y2, C), Z1, C),
+                       _fp2_mul(_fp2_mul(x2, Y1, C), z2, C), C)
+    line = (_fp2_mul_fp(Z3, py, C), _fp2_mul_fp(_fp2_neg(R, C), px, C),
+            c_const)
+    return line, X3, Y3, Z3
+
+
+_ONE12 = np.zeros((6, 2, KNL, 1), np.int32)
+_ONE12[0, 0, 0, 0] = 1
+
+
+def _miller_tables():
+    """(ops, gen_lines, twf): the static optimal-ate schedule, its
+    generator-line constants and the twist-Frobenius constants, all at
+    kernel width (ambient tables zero-pad losslessly from 22 limbs)."""
+    from gethsharding_tpu.ops import bn256_jax as k
+
+    def widen(arr):
+        arr = np.asarray(arr, np.int32)
+        if arr.shape[-1] < KNL:
+            arr = np.concatenate(
+                [arr, np.zeros(arr.shape[:-1] + (KNL - arr.shape[-1],),
+                               np.int32)], axis=-1)
+        return arr
+
+    ops = np.asarray(k._OPT_OPS, np.int32)
+    lines = widen(k._GEN_LINES)                       # (L, 3, 2, 25)
+    twf = np.stack([widen(k._TWF_X), widen(k._TWF_Y),
+                    widen(k._TWF2_X), widen(k._TWF2_Y)])  # (4, 2, 25)
+    return ops, lines, twf
+
+
+def _miller_body(state, op, line_c, ctx, C: Consts):
+    """One optimal-ate step on (f, X, Y, Z) — shared verbatim by the
+    XLA oracle (static op) and the kernel's pl.when branches."""
+    f, X, Y, Z = state
+    sx, sy, sz, hx, hy_neg, cand = ctx
+    gen = (_fp2_mul_fp(line_c[0], sy, C),
+           _fp2_mul_fp(line_c[1], sx, C),
+           _fp2_mul_fp(line_c[2], sz, C))
+    if op == 0:
+        line1, X, Y, Z = _kernel_dbl_step(X, Y, Z, hx, hy_neg, C)
+        f = _fp12_mul(f, f, C)
+    else:
+        line1, X, Y, Z = _kernel_jadd_step(
+            X, Y, Z, tuple(cand[op - 1][k] for k in range(5)),
+            hx, hy_neg, C)
+    f = _fp12_mul_line(f, *gen, C)
+    f = _fp12_mul_line(f, *line1, C)
+    return f, X, Y, Z
+
+
+def _miller_candidates(pkx, pky, pkz, twf, C: Consts):
+    """The four Jacobian add candidates [+Q, -Q, piQ, -pi^2 Q] with
+    their z-power precomputes (bn256_jax._bls_miller_opt preamble)."""
+    q1x = _fp2_mul(_fp2_conj_rows(pkx, C), twf[0], C)
+    q1y = _fp2_mul(_fp2_conj_rows(pky, C), twf[1], C)
+    q2x = _fp2_mul(pkx, twf[2], C)
+    q2ny = _fp2_neg(_fp2_mul(pky, twf[3], C), C)
+    zconj = _fp2_conj_rows(pkz, C)
+    cands = []
+    for cx, cy, cz in ((pkx, pky, pkz),
+                       (pkx, _fp2_neg(pky, C), pkz),
+                       (q1x, q1y, zconj),
+                       (q2x, q2ny, pkz)):
+        zz = _fp2_sqr(cz, C)
+        cands.append((_fp2_mul(cx, cz, C), _fp2_mul(cy, zz, C),
+                      _normalize(cz, C), zz, _fp2_mul(cz, zz, C)))
+    return cands
+
+
+def run_miller_xla(sig, h, pk):
+    """The full Miller program as plain XLA ops — the kernel's oracle.
+
+    sig = (sx, sy, sz) each (n, 25); h = (hx, hy) each (n, 25);
+    pk = (pkx, pky, pkz) each (n, 2, 25): kernel-width limbs. Returns
+    f (n, 6, 2, 25)."""
+    C = Consts(*(jnp.asarray(c) for c in _NP_CONSTS))
+    ops, lines, twf = _miller_tables()
+    sx, sy, sz = (jnp.moveaxis(v, 0, -1) for v in sig)      # (25, n)
+    hx, hy = (jnp.moveaxis(v, 0, -1) for v in h)
+    pkx, pky, pkz = (jnp.moveaxis(v, 0, -1) for v in pk)    # (2, 25, n)
+    hy_neg = _normalize(C.negpad - hy, C)
+    cand = _miller_candidates(pkx, pky, pkz,
+                              jnp.asarray(twf)[..., None], C)
+    n = sx.shape[-1]
+    f = jnp.broadcast_to(C.one12, (6, 2, KNL, n)).astype(jnp.int32)
+    X = _fp2_mul(pkx, pkz, C)
+    Y = _fp2_mul(pky, _fp2_sqr(pkz, C), C)
+    Z = _normalize(pkz, C)
+    ctx = (sx, sy, sz, hx, hy_neg, cand)
+    state = (f, X, Y, Z)
+    for i, op in enumerate(ops.tolist()):
+        line_c = jnp.asarray(lines[i])[..., None]           # (3, 2, 25, 1)
+        state = _miller_body(state, op, line_c, ctx, C)
+    return jnp.moveaxis(state[0], -1, 0)                    # (n, 6, 2, 25)
+
+
+# resolved at module end: every const table above must exist first
+_NP_CONSTS = _np_consts()
+
+
+def _miller_kernel(ops_ref, lines_ref, sx_ref, sy_ref, sz_ref, hx_ref,
+                   hy_ref, pkx_ref, pky_ref, pkz_ref, twf_ref,
+                   c_fold, c_lift, c_mulpad, c_fp2pad, c_negpad, c_gamma,
+                   c_linepad, c_one12, out_ref,
+                   f_ref, X_ref, Y_ref, Z_ref, cand_ref, *, n_steps: int):
+    C = Consts(fold_t=c_fold[:], lift=c_lift[:], mulpad=c_mulpad[:],
+               fp2pad=c_fp2pad[:], negpad=c_negpad[:], gamma=c_gamma[:],
+               linepad=c_linepad[:], one12=c_one12[:])
+    sx = sx_ref[:]
+    sy = sy_ref[:]
+    sz = sz_ref[:]
+    hx = hx_ref[:]
+    hy_neg = _normalize(C.negpad - hy_ref[:], C)
+    pkx = pkx_ref[:]
+    pky = pky_ref[:]
+    pkz = pkz_ref[:]
+    twf = twf_ref[:][..., None]                   # (4, 2, 25, 1)
+
+    for idx, comp in enumerate(
+            _miller_candidates(pkx, pky, pkz, twf, C)):
+        cand_ref[idx] = jnp.stack(comp, axis=0)   # (5, 2, 25, B)
+    lanes = sx.shape[-1]
+    f_ref[:] = jnp.broadcast_to(C.one12,
+                                (6, 2, KNL, lanes)).astype(jnp.int32)
+    X_ref[:] = _fp2_mul(pkx, pkz, C)
+    Y_ref[:] = _fp2_mul(pky, _fp2_sqr(pkz, C), C)
+    Z_ref[:] = _normalize(pkz, C)
+
+    def body(step, carry):
+        op = ops_ref[step]
+        line_c = lines_ref[step][..., None]       # (3, 2, 25, 1)
+        gen = (_fp2_mul_fp(line_c[0], sy, C),
+               _fp2_mul_fp(line_c[1], sx, C),
+               _fp2_mul_fp(line_c[2], sz, C))
+
+        @pl.when(op == 0)
+        def _dbl():
+            line1, X3, Y3, Z3 = _kernel_dbl_step(
+                X_ref[:], Y_ref[:], Z_ref[:], hx, hy_neg, C)
+            f = _fp12_mul(f_ref[:], f_ref[:], C)
+            f = _fp12_mul_line(f, *gen, C)
+            f_ref[:] = _fp12_mul_line(f, *line1, C)
+            X_ref[:] = X3
+            Y_ref[:] = Y3
+            Z_ref[:] = Z3
+
+        @pl.when(op != 0)
+        def _add():
+            cd = cand_ref[op - 1]                 # (5, 2, 25, B)
+            line1, X3, Y3, Z3 = _kernel_jadd_step(
+                X_ref[:], Y_ref[:], Z_ref[:],
+                tuple(cd[i] for i in range(5)), hx, hy_neg, C)
+            f = _fp12_mul_line(f_ref[:], *gen, C)
+            f_ref[:] = _fp12_mul_line(f, *line1, C)
+            X_ref[:] = X3
+            Y_ref[:] = Y3
+            Z_ref[:] = Z3
+
+        return carry
+
+    lax.fori_loop(0, n_steps, body, 0)
+    f = f_ref[:]
+    out_ref[:] = f.reshape((12,) + f.shape[-2:])  # (12, 25, B)
+
+
+@functools.lru_cache(maxsize=8)
+def _miller_compiled(n_steps: int, interpret: bool):
+    kernel = functools.partial(_miller_kernel, n_steps=n_steps)
+
+    @jax.jit
+    def run(ops, lines, sx, sy, sz, hx, hy, pkx, pky, pkz, twf):
+        n = sx.shape[-1]
+        grid = (n // BLOCK_LANES,)
+        from jax.experimental.pallas import tpu as pltpu
+
+        def whole(shape):
+            rank = len(shape)
+            return pl.BlockSpec(shape, lambda i, _r=rank: (0,) * _r)
+
+        def fp_spec():
+            return pl.BlockSpec((KNL, BLOCK_LANES), lambda i: (0, i))
+
+        def fp2_spec():
+            return pl.BlockSpec((2, KNL, BLOCK_LANES), lambda i: (0, 0, i))
+
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),    # ops
+                whole(lines.shape),
+                fp_spec(), fp_spec(), fp_spec(),           # sig
+                fp_spec(), fp_spec(),                      # h
+                fp2_spec(), fp2_spec(), fp2_spec(),        # pk
+                whole(twf.shape),
+            ] + [whole(np.asarray(c).shape) for c in _NP_CONSTS],
+            out_specs=pl.BlockSpec((12, KNL, BLOCK_LANES),
+                                   lambda i: (0, 0, i)),
+            out_shape=jax.ShapeDtypeStruct((12, KNL, n), jnp.int32),
+            scratch_shapes=[
+                pltpu.VMEM((6, 2, KNL, BLOCK_LANES), jnp.int32),
+                pltpu.VMEM((2, KNL, BLOCK_LANES), jnp.int32),
+                pltpu.VMEM((2, KNL, BLOCK_LANES), jnp.int32),
+                pltpu.VMEM((2, KNL, BLOCK_LANES), jnp.int32),
+                pltpu.VMEM((4, 5, 2, KNL, BLOCK_LANES), jnp.int32),
+            ],
+            interpret=interpret,
+        )(ops, lines, sx, sy, sz, hx, hy, pkx, pky, pkz, twf,
+          *(jnp.asarray(c) for c in _NP_CONSTS))
+
+    return run
+
+
+def miller_f(sig, hx, hy, pk, *, interpret: bool = False):
+    """Projective shared-accumulator Miller product via the mega-kernel.
+
+    Drop-in for `bn256_jax._bls_miller_opt`'s projective flavor: sig =
+    (sx, sy, sz) (..., NL) Fp limbs, hx/hy (..., NL), pk = (pkx, pky,
+    pkz) (..., 2, NL) Fp2 limbs — ambient form in, ambient lazy form
+    out (..., 6, 2, NL). The ~90-step walk runs as ONE kernel launch."""
+    from gethsharding_tpu.ops import bn256_jax as k
+
+    ops, lines, twf = _miller_tables()
+    lead = sig[0].shape[:-1]
+    n = 1
+    for dim in lead:
+        n *= dim
+
+    def prep(v, fp2: bool):
+        v = v.reshape((n,) + v.shape[len(lead):])
+        if v.shape[-1] < KNL:
+            v = jnp.concatenate(
+                [v, jnp.zeros(v.shape[:-1] + (KNL - v.shape[-1],),
+                              jnp.int32)], axis=-1)
+        v = jnp.moveaxis(v, 0, -1)                 # (25, n) | (2, 25, n)
+        pad = (-n) % BLOCK_LANES
+        if pad:
+            v = jnp.concatenate(
+                [v, jnp.zeros(v.shape[:-1] + (pad,), jnp.int32)], axis=-1)
+        return v
+
+    args = ([prep(v, False) for v in sig]
+            + [prep(hx, False), prep(hy, False)]
+            + [prep(v, True) for v in pk])
+    out = _miller_compiled(int(ops.shape[0]), interpret)(
+        jnp.asarray(ops), jnp.asarray(lines), *args, jnp.asarray(twf))
+    if (-n) % BLOCK_LANES:
+        out = out[..., :n]
+    f = jnp.moveaxis(out.reshape((6, 2, KNL, n)), -1, 0)
+    f = f.reshape(lead + (6, 2, KNL))
+    # back to the ambient lazy form (exact-width callers fold 25 -> 22)
+    return k.FP.normalize(f)

@@ -37,6 +37,11 @@ def main(argv=None) -> int:
                              "via shard_commit / shard_fastForward)")
     parser.add_argument("--runtime", type=float, default=0.0,
                         help="seconds before exit (0 = forever)")
+    parser.add_argument("--follow", default=None, metavar="HOST:PORT",
+                        help="run as a FOLLOWER replicating the leader "
+                             "chain process at HOST:PORT (headers "
+                             "engine-verified, state via checkpoint "
+                             "pull — smc/sync.py)")
     parser.add_argument("--verbosity", default="warning")
     args = parser.parse_args(argv)
 
@@ -52,13 +57,20 @@ def main(argv=None) -> int:
     backend = SimulatedMainchain(config=config)
     server = RPCServer(backend, host=args.host, port=args.port)
     server.start()
+    follower = None
+    if args.follow:
+        from gethsharding_tpu.smc.sync import ChainFollower
+
+        leader_host, leader_port = args.follow.rsplit(":", 1)
+        follower = ChainFollower(backend, leader_host, int(leader_port))
+        follower.start()
     print(json.dumps({"host": server.address[0], "port": server.address[1]}),
           flush=True)
 
     deadline = time.monotonic() + args.runtime if args.runtime else None
     try:
         while deadline is None or time.monotonic() < deadline:
-            if args.blocktime > 0:
+            if args.blocktime > 0 and follower is None:
                 time.sleep(args.blocktime)
                 backend.commit()
             else:
@@ -66,6 +78,8 @@ def main(argv=None) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        if follower is not None:
+            follower.stop()
         server.stop()
     return 0
 

@@ -37,17 +37,11 @@ class RPCError(Exception):
         self.message = message
 
 
-@dataclass
-class RemoteBlock:
-    number: int
-    hash: Hash32
-    parent_hash: Hash32
-
-
-def _dec_block(obj: dict) -> RemoteBlock:
-    return RemoteBlock(number=obj["number"],
-                       hash=Hash32(codec.dec_bytes(obj["hash"])),
-                       parent_hash=Hash32(codec.dec_bytes(obj["parentHash"])))
+def _dec_block(obj: dict):
+    """ONE decoder for the block wire shape (codec.dec_block) — a local
+    duplicate here silently dropped the `extra` (engine seal) field when
+    enc_block grew it."""
+    return codec.dec_block(obj)
 
 
 @dataclass
@@ -215,7 +209,7 @@ class RemoteMainchain:
     def current_period(self) -> int:
         return self.rpc.call("shard_currentPeriod")
 
-    def block_by_number(self, number: Optional[int] = None) -> RemoteBlock:
+    def block_by_number(self, number: Optional[int] = None):
         return _dec_block(self.rpc.call("shard_blockByNumber", number))
 
     def subscribe_new_head(self, callback) -> Callable[[], None]:
@@ -343,7 +337,7 @@ class RemoteMainchain:
     def fund(self, account: Address20, amount: int) -> None:
         self.rpc.call("shard_fund", codec.enc_bytes(account), amount)
 
-    def commit(self) -> RemoteBlock:
+    def commit(self):
         return _dec_block(self.rpc.call("shard_commit"))
 
     def fast_forward(self, periods: int) -> int:

@@ -255,7 +255,17 @@ def dec_p2p(kind: str, payload: dict):
 
 def enc_block(block) -> dict:
     return {"number": block.number, "hash": enc_bytes(block.hash),
-            "parentHash": enc_bytes(block.parent_hash)}
+            "parentHash": enc_bytes(block.parent_hash),
+            "extra": enc_bytes(getattr(block, "extra", b"") or b"")}
+
+
+def dec_block(obj: dict):
+    from gethsharding_tpu.smc.chain import Block
+
+    return Block(number=int(obj["number"]),
+                 hash=Hash32(dec_bytes(obj["hash"])),
+                 parent_hash=Hash32(dec_bytes(obj["parentHash"])),
+                 extra=dec_bytes(obj.get("extra", "")))
 
 
 def enc_receipt(receipt) -> dict:

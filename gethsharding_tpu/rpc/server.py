@@ -467,3 +467,21 @@ class RPCServer:
     def rpc_setHead(self, number):
         """Dev-mode rollback (debug_setHead parity)."""
         return codec.enc_block(self.backend.set_head(number))
+
+    def rpc_blockRange(self, start, end):
+        """Blocks [start, end] inclusive — the header-download surface a
+        follower chain process syncs from (eth/downloader role)."""
+        start, end = int(start), int(end)
+        if start < 0 or end > self.backend.block_number or end - start > 4096:
+            raise ValueError("bad block range")
+        return [codec.enc_block(self.backend.block_by_number(n))
+                for n in range(start, end + 1)]
+
+    def rpc_stateCheckpoint(self):
+        """Full-state checkpoint at the current head (the fast-sync
+        pivot-state analog) for follower chain processes."""
+        return self.backend.state_checkpoint()
+
+    def rpc_stateSeq(self):
+        """Cheap state identity for followers' steady-state polling."""
+        return self.backend.state_seq()

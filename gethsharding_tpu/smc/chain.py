@@ -281,6 +281,74 @@ class SimulatedMainchain:
             callback(head)
         return len(blocks)
 
+    def state_seq(self) -> list:
+        """Cheap monotonic state identity [reorg_gen, block, tx_count]:
+        a follower skips the heavy checkpoint pull while it is
+        unchanged (every SMC transaction bumps the tx counter)."""
+        with self._lock:
+            return [self.reorg_generation, self.block_number,
+                    self._tx_counter]
+
+    def state_checkpoint(self) -> dict:
+        """Serialized full state at the CURRENT head — what a follower
+        chain process installs after importing our headers (the
+        fast-sync pivot-state pull, `eth/downloader/downloader.go:479`
+        role at dev-chain scale). The blob is a pickle: followers must
+        only install checkpoints from their CONFIGURED leader endpoint
+        (smc/sync.py enforces that by construction), never from
+        untrusted peers. The vote-audit log ships only the rollback
+        window's worth (same pruning as _snapshot_state) so the blob
+        does not grow with chain age."""
+        import pickle
+
+        with self._lock:
+            fn = self.smc.blockhash_fn
+            self.smc.blockhash_fn = None  # bound method: not picklable
+            number = self.block_number
+            period_floor = (number // self.config.period_length
+                            - self.SNAPSHOT_HORIZON
+                            // self.config.period_length - 1)
+            audit = {p: v for p, v in self._vote_audit.items()
+                     if p >= period_floor}
+            try:
+                blob = pickle.dumps((self.smc, self.balances, audit,
+                                     self.engine.snapshot()))
+            finally:
+                self.smc.blockhash_fn = fn
+            head = self.blocks[-1]
+            return {"number": head.number,
+                    "hash": bytes(head.hash).hex(),
+                    "reorg_gen": self.reorg_generation,
+                    "seq": [self.reorg_generation, number,
+                            self._tx_counter],
+                    "state": blob.hex()}
+
+    def install_checkpoint(self, checkpoint: dict) -> bool:
+        """Adopt a leader's state checkpoint. The checkpoint must match
+        OUR current head (number + hash) — headers are imported and
+        engine-verified first via `import_chain`; this only swaps in the
+        state they commit to. Returns False when the head moved since
+        the checkpoint was taken (caller retries next round)."""
+        import pickle
+
+        with self._lock:
+            head = self.blocks[-1]
+            if (checkpoint["number"] != head.number
+                    or checkpoint["hash"] != bytes(head.hash).hex()):
+                return False
+            smc, balances, vote_audit, engine_state = pickle.loads(
+                bytes.fromhex(checkpoint["state"]))
+            smc.blockhash_fn = self.blockhash
+            self.smc = smc
+            self.balances = balances
+            self._vote_audit = vote_audit
+            if engine_state is not None:
+                self.engine.restore(engine_state)
+            # the head snapshot must reflect the synced state, or a later
+            # rollback would resurrect the pre-sync one
+            self._snapshot_state(head.number)
+        return True
+
     def fast_forward(self, periods: int) -> None:
         """Mine `periods` full periods of blocks (client_helper.go:93)."""
         for _ in range(periods * self.config.period_length):
@@ -299,6 +367,9 @@ class SimulatedMainchain:
     # -- accounts ----------------------------------------------------------
 
     def fund(self, account: Address20, amount: int = 10_000 * ETHER) -> None:
+        # counted as a state mutation so followers' seq-gated checkpoint
+        # pulls see dev-faucet changes too
+        self._tx_counter += 1
         self.balances[account] = self.balances.get(account, 0) + amount
 
     def balance_of(self, account: Address20) -> int:

@@ -64,6 +64,40 @@ def main() -> int:
     except Exception:
         out["ok"] = False
         out["error"] = traceback.format_exc()[-1200:]
+
+    # phase 2: the Miller mega-kernel on a real aggregated committee
+    try:
+        import jax.numpy as jnp
+
+        from gethsharding_tpu.crypto import bn256 as ref
+        from gethsharding_tpu.ops import bn256_jax as k
+        from gethsharding_tpu.ops.pallas_finalexp import miller_f
+
+        tag = b"smoke-miller"
+        keys = [ref.bls_keygen(tag + bytes([j])) for j in range(3)]
+        sigs = [ref.bls_sign(tag, sk) for sk, _ in keys]
+        pks = [pk for _, pk in keys]
+        hx, hy, _ = k.g1_to_limbs([ref.hash_to_g1(tag)] * 2)
+        sx, sy, sm = k.g1_committee_to_limbs([sigs, sigs[:2]], 3)
+        gx, gy, gm = k.g2_committee_to_limbs([pks, pks[:2]], 3)
+        sig = k.aggregate_g1_proj(jnp.asarray(sx), jnp.asarray(sy),
+                                  jnp.asarray(sm))
+        pk = k.aggregate_g2_proj(jnp.asarray(gx), jnp.asarray(gy),
+                                 jnp.asarray(gm))
+        t0 = time.perf_counter()
+        fm = np.asarray(miller_f(sig, jnp.asarray(hx), jnp.asarray(hy),
+                                 pk))
+        out["miller_wall_s"] = round(time.perf_counter() - t0, 2)
+        fw = np.asarray(k._bls_miller_opt(sig, jnp.asarray(hx),
+                                          jnp.asarray(hy), pk))
+        same = bool(np.asarray(k.fp12_eq(jnp.asarray(fm),
+                                         jnp.asarray(fw))).all())
+        out["miller_ok"] = same
+        out["ok"] = bool(out.get("ok")) and same
+    except Exception:
+        out["miller_ok"] = False
+        out["ok"] = False
+        out["miller_error"] = traceback.format_exc()[-1200:]
     print(json.dumps(out))
     # evidence contract: exit 0 means "answered on a real accelerator"
     # (a Mosaic failure IS an answer); only a CPU fallback is a non-result

@@ -67,6 +67,10 @@ _SLOW_MODULES = {
     "test_pallas",  # interpreter-mode kernels are slow per element
     "test_knob_combos",  # one cold kernel compile per subprocess
 }
+# test_pallas_finalexp stays in the FAST tier on purpose: its five
+# pure-jnp helper parity tests are the only cheap guard on the
+# mega-kernel module (arity/import regressions); the heavy oracle /
+# interpret / miller differentials carry their own `slow` skip marks.
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,180 @@
+"""Chain-process sync (smc/sync.py): follower replicates leader — the
+eth/handler + downloader leg between chain nodes (SURVEY §1 topology),
+at dev-chain scale: engine-verified header import + checkpoint state."""
+
+import time
+
+import pytest
+
+from gethsharding_tpu.mainchain.accounts import AccountManager
+from gethsharding_tpu.params import Config, ETHER
+from gethsharding_tpu.rpc.server import RPCServer
+from gethsharding_tpu.smc.chain import SimulatedMainchain
+from gethsharding_tpu.smc.sync import ChainFollower
+from gethsharding_tpu.utils.hexbytes import Hash32
+
+
+def _pair(config=None):
+    config = config or Config(shard_count=4, quorum_size=1)
+    leader = SimulatedMainchain(config=config)
+    server = RPCServer(leader, port=0)
+    server.start()
+    follower_chain = SimulatedMainchain(config=config)
+    follower = ChainFollower(follower_chain, *server.address,
+                             poll_interval=0.05)
+    return leader, server, follower_chain, follower
+
+
+def _wait_sync(leader, follower_chain, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if (follower_chain.block_number == leader.block_number
+                and bytes(follower_chain.blocks[-1].hash)
+                == bytes(leader.blocks[-1].hash)):
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_follower_replicates_chain_and_smc_state():
+    leader, server, follower_chain, follower = _pair()
+    manager = AccountManager()
+    acct = manager.new_account(seed=b"sync-notary")
+    try:
+        follower.start()
+        # leader does real SMC work: registration, header, vote
+        leader.fund(acct.address, 2000 * ETHER)
+        from gethsharding_tpu.smc.state_machine import vote_digest
+
+        leader.register_notary(
+            acct.address, bls_pubkey=acct.bls_pubkey,
+            bls_pop=manager.bls_proof_of_possession(acct.address))
+        leader.fast_forward(1)
+        period = leader.current_period()
+        root = Hash32(b"\x42" * 32)
+        leader.add_header(acct.address, 2, period, root)
+        leader.submit_vote(
+            acct.address, 2, period, 0, root,
+            bls_sig=manager.bls_sign(acct.address,
+                                     bytes(vote_digest(2, period, root))))
+        leader.commit()
+
+        assert _wait_sync(leader, follower_chain)
+        # block-level identity
+        assert [bytes(b.hash) for b in follower_chain.blocks] == \
+            [bytes(b.hash) for b in leader.blocks]
+        # SMC state replicated: registry, record, votes, watermarks
+        entry = follower_chain.notary_registry(acct.address)
+        assert entry is not None and entry.deposited
+        record = follower_chain.collation_record(2, period)
+        assert record is not None
+        assert bytes(record.chunk_root) == bytes(root)
+        assert record.vote_count == leader.collation_record(2,
+                                                            period).vote_count
+        assert follower_chain.last_approved_collation(2) == \
+            leader.last_approved_collation(2)
+        assert follower_chain.balance_of(acct.address) == \
+            leader.balance_of(acct.address)
+    finally:
+        follower.stop()
+        server.stop()
+
+
+def test_follower_tracks_leader_reorg():
+    leader, server, follower_chain, follower = _pair()
+    try:
+        follower.start()
+        for _ in range(6):
+            leader.commit()
+        assert _wait_sync(leader, follower_chain)
+
+        # the leader rolls back and grows a DIFFERENT branch: dev blocks
+        # hash only on (number, parent) so we must change the branch
+        # point to fork — roll deeper then regrow longer
+        leader.set_head(3)
+        acct = AccountManager().new_account(seed=b"forker")
+        leader.fund(acct.address, 1 * ETHER)  # state divergence marker
+        for _ in range(5):
+            leader.commit()
+        assert _wait_sync(leader, follower_chain)
+        assert follower.reorgs_followed >= 0  # reorg may resolve as
+        # a pure extension if the follower saw set_head before regrow
+        assert follower_chain.balance_of(acct.address) == 1 * ETHER
+    finally:
+        follower.stop()
+        server.stop()
+
+
+def test_follower_rejects_forged_seals_via_engine():
+    """Imported headers pass through the consensus engine: a block whose
+    seal the engine rejects never enters the follower."""
+    from gethsharding_tpu.smc.chain import Block
+    from gethsharding_tpu.smc.engine import DevPoWEngine
+
+    config = Config(shard_count=2)
+    leader = SimulatedMainchain(config=config, engine=DevPoWEngine())
+    forged = Block(number=1, hash=Hash32(b"\x66" * 32),
+                   parent_hash=leader.blocks[0].hash, extra=b"\x00" * 8)
+    follower_chain = SimulatedMainchain(config=config,
+                                        engine=DevPoWEngine())
+    with pytest.raises(Exception):
+        follower_chain.import_chain([forged, Block(
+            number=2, hash=Hash32(b"\x67" * 32),
+            parent_hash=Hash32(b"\x66" * 32), extra=b"\x00" * 8)])
+    assert follower_chain.block_number == 0
+
+
+def test_checkpoint_refuses_mismatched_head():
+    config = Config(shard_count=2)
+    leader = SimulatedMainchain(config=config)
+    other = SimulatedMainchain(config=config)
+    leader.commit()
+    checkpoint = leader.state_checkpoint()
+    # `other` is still at genesis: the checkpoint must be refused
+    assert other.install_checkpoint(checkpoint) is False
+
+
+def test_follower_over_real_chain_server_process():
+    """Cross-process shape: a follower chain process (--follow) tracks a
+    leader chain process; reads served by the follower match."""
+    import json as _json
+    import subprocess
+    import sys
+
+    from gethsharding_tpu.parallel.virtual import build_virtual_env
+    from gethsharding_tpu.rpc.client import RPCClient
+
+    env = build_virtual_env(1)
+    leader_proc = subprocess.Popen(
+        [sys.executable, "-m", "gethsharding_tpu.rpc.chain_server",
+         "--shardcount", "2", "--runtime", "60"],
+        stdout=subprocess.PIPE, text=True, env=env)
+    follower_proc = None
+    try:
+        lead = _json.loads(leader_proc.stdout.readline())
+        follower_proc = subprocess.Popen(
+            [sys.executable, "-m", "gethsharding_tpu.rpc.chain_server",
+             "--shardcount", "2", "--runtime", "60",
+             "--follow", f"{lead['host']}:{lead['port']}"],
+            stdout=subprocess.PIPE, text=True, env=env)
+        fol = _json.loads(follower_proc.stdout.readline())
+        leader_rpc = RPCClient(lead["host"], lead["port"])
+        follower_rpc = RPCClient(fol["host"], fol["port"])
+        for _ in range(4):
+            leader_rpc.call("shard_commit")
+        want = leader_rpc.call("shard_blockByNumber", 4)
+        deadline = time.time() + 15
+        got = None
+        while time.time() < deadline:
+            if follower_rpc.call("shard_blockNumber") >= 4:
+                got = follower_rpc.call("shard_blockByNumber", 4)
+                break
+            time.sleep(0.1)
+        assert got == want, "follower did not replicate the leader's block"
+        leader_rpc.close()
+        follower_rpc.close()
+    finally:
+        for proc in (leader_proc, follower_proc):
+            if proc is not None:
+                proc.terminate()
+                proc.wait(timeout=10)

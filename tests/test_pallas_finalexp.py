@@ -196,3 +196,61 @@ def test_mega_kernel_interpret_matches_pairing_is_one():
     fs, wants = _miller_products(2, 1)
     got = np.asarray(m.finalexp_is_one(jnp.asarray(fs), interpret=True))
     assert (got == wants).all()
+
+
+# == the Miller mega-kernel (same module) ==================================
+
+
+def _committee_workload():
+    """Real aggregated projective inputs: (sig, h, pk) for two shards —
+    one fully valid, one with a tampered signature set."""
+    tag = b"miller-mega"
+    keys = [ref.bls_keygen(tag + bytes([j])) for j in range(3)]
+    sigs = [ref.bls_sign(tag, sk) for sk, _ in keys]
+    bad = [sigs[0], sigs[1], ref.g1_add(sigs[2], ref.G1_GEN)]
+    pks = [pk for _, pk in keys]
+    hx, hy, _ = k.g1_to_limbs([ref.hash_to_g1(tag)] * 2)
+    sx, sy, sm = k.g1_committee_to_limbs([sigs, bad], 3)
+    gx, gy, gm = k.g2_committee_to_limbs([pks, pks], 3)
+    sig = k.aggregate_g1_proj(jnp.asarray(sx), jnp.asarray(sy),
+                              jnp.asarray(sm))
+    pk = k.aggregate_g2_proj(jnp.asarray(gx), jnp.asarray(gy),
+                             jnp.asarray(gm))
+    return sig, (jnp.asarray(hx), jnp.asarray(hy)), pk
+
+
+def _f_vals(arr):
+    out = np.zeros(arr.shape[:-1], dtype=object)
+    for i in range(arr.shape[-1]):
+        out = out + (arr[..., i].astype(object) << (12 * i))
+    return out % m.P
+
+
+@slow
+def test_miller_oracle_matches_xla_path():
+    sig, (hx, hy), pk = _committee_workload()
+
+    def widen(v):
+        v = np.asarray(v)
+        if v.shape[-1] < m.KNL:
+            v = np.concatenate(
+                [v, np.zeros(v.shape[:-1] + (m.KNL - v.shape[-1],),
+                             np.int32)], axis=-1)
+        return v
+
+    want = np.asarray(k._bls_miller_opt(sig, hx, hy, pk))
+    got = np.asarray(m.run_miller_xla(
+        tuple(widen(v) for v in sig), (widen(hx), widen(hy)),
+        tuple(widen(v) for v in pk)))
+    assert (_f_vals(want) == _f_vals(got)).all()
+
+
+@slow
+def test_miller_mega_kernel_interpret_matches_xla():
+    sig, (hx, hy), pk = _committee_workload()
+    want = np.asarray(k._bls_miller_opt(sig, hx, hy, pk))
+    got = np.asarray(m.miller_f(sig, hx, hy, pk, interpret=True))
+    assert (_f_vals(want) == _f_vals(got)).all()
+    # end-to-end boolean parity through the final exponentiation
+    assert list(np.asarray(k.pairing_is_one(jnp.asarray(got)))) == \
+        [True, False]
