@@ -62,6 +62,10 @@ CONFIGS = [
     # the two-launch pairing check: Miller AND final exp each one kernel
     {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "scan",
      "GETHSHARDING_TPU_FINALEXP": "mega", "GETHSHARDING_TPU_MILLER": "mega"},
+    # the four-launch audit dispatch: aggregation kernels too
+    {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "scan",
+     "GETHSHARDING_TPU_FINALEXP": "mega", "GETHSHARDING_TPU_MILLER": "mega",
+     "GETHSHARDING_TPU_AGG": "mega"},
     {"GETHSHARDING_TPU_LIMB_FORM": "wide", "GETHSHARDING_TPU_NORM": "relaxed",
      "GETHSHARDING_TPU_FINALEXP": "mega"},
     # r3 additions, probed right after the champion: the statically
@@ -739,7 +743,9 @@ def main() -> None:
         + (["finalexp-mega"]
            if best_cfg.get("GETHSHARDING_TPU_FINALEXP") == "mega" else [])
         + (["miller-mega"]
-           if best_cfg.get("GETHSHARDING_TPU_MILLER") == "mega" else []))
+           if best_cfg.get("GETHSHARDING_TPU_MILLER") == "mega" else [])
+        + (["agg-mega"]
+           if best_cfg.get("GETHSHARDING_TPU_AGG") == "mega" else []))
     _print_metric(best["sig_rate"], best, f"{knobs}, {best['platform']}")
 
 

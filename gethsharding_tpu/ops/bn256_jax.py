@@ -128,6 +128,15 @@ if MILLER == "mega" and PAIR_UNROLL:
                      "GETHSHARDING_TPU_PAIR_UNROLL=1 both rewrite the "
                      "Miller loop; set one")
 
+# GETHSHARDING_TPU_AGG=mega routes the masked committee tree reductions
+# through the single-launch aggregation kernels (ops/pallas_finalexp.
+# aggregate_proj) — with all three mega knobs the audit dispatch is 4
+# kernel launches total (G1 agg, G2 agg, Miller, final exp).
+AGG = os.environ.get("GETHSHARDING_TPU_AGG", "xla")
+if AGG not in ("xla", "mega"):
+    raise ValueError(f"GETHSHARDING_TPU_AGG must be 'xla' or 'mega', "
+                     f"got {AGG!r}")
+
 
 def _use_pallas_conv() -> bool:
     return PAIRCONV == "pallas" and _limb._pallas_wanted()
@@ -1195,6 +1204,10 @@ def aggregate_g1_proj(xs, ys, mask):
     xs/ys: (..., C, 22) affine limbs; mask: (..., C) bool (False slots
     contribute the identity); any C >= 1. Returns the projective
     (X, Y, Z) sum, each (..., 22)."""
+    if AGG == "mega" and _limb._pallas_wanted():
+        from gethsharding_tpu.ops.pallas_finalexp import aggregate_proj
+
+        return aggregate_proj(xs, ys, mask, fp2=False)
     m = mask[..., None]
     one = jnp.broadcast_to(jnp.asarray(FP.one), xs.shape)
     px = jnp.where(m, xs, 0)
@@ -1205,6 +1218,10 @@ def aggregate_g1_proj(xs, ys, mask):
 
 def aggregate_g2_proj(xs, ys, mask):
     """Masked committee sum of G2 points: xs/ys (..., C, 2, 22)."""
+    if AGG == "mega" and _limb._pallas_wanted():
+        from gethsharding_tpu.ops.pallas_finalexp import aggregate_proj
+
+        return aggregate_proj(xs, ys, mask, fp2=True)
     m = mask[..., None, None]
     one = jnp.broadcast_to(jnp.asarray(FP2_ONE), xs.shape)
     px = jnp.where(m, xs, 0)

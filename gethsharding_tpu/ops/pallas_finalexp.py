@@ -875,3 +875,177 @@ def miller_f(sig, hx, hy, pk, *, interpret: bool = False):
     f = f.reshape(lead + (6, 2, KNL))
     # back to the ambient lazy form (exact-width callers fold 25 -> 22)
     return k.FP.normalize(f)
+
+
+# == the aggregation mega-kernels ==========================================
+# The remaining 10% of the dispatch: the masked projective tree sums of
+# committee signatures (G1) and voter pubkeys (G2). Same complete RCB16
+# addition formulas as bn256_jax._proj_add_impl, with the committee tree
+# as a STATIC 8-level loop inside one kernel — each level's adds process
+# every surviving pair in full-tile ops, so the whole 135-slot committee
+# reduction is ONE launch per group instead of ~25 XLA dispatch levels.
+# With FINALEXP/MILLER/AGG all mega, the audit dispatch is 4 launches.
+
+AGG_LANES = 64  # smaller lane block: level-0 conv temporaries dominate VMEM
+
+
+def _fp_mul_rows(x, y, C: Consts):
+    """Fp product on (..., 25, B) rows: 1-plane conv + normalize."""
+    return _normalize(_conv(x, y), C)
+
+
+def _fp_sub_rows(x, y, C: Consts):
+    return _normalize(x - y + C.negpad, C)
+
+
+def _agg_tree(px, py, pz, C: Consts, *, fp2: bool, b3):
+    """(2^k, ...) point stacks -> the projective sum, RCB16 complete
+    adds (a=0), halving per level. b3: int 9 for G1, Fp2 rows for G2."""
+    if fp2:
+        mul = lambda a, b: _fp2_mul(a, b, C)
+        add = lambda a, b: _fp2_add(a, b, C)
+        sub = lambda a, b: _fp2_sub(a, b, C)
+        mul_b3 = lambda v: _fp2_mul(v, b3, C)
+    else:
+        mul = lambda a, b: _fp_mul_rows(a, b, C)
+        add = lambda a, b: _normalize(a + b, C)
+        sub = lambda a, b: _fp_sub_rows(a, b, C)
+        mul_b3 = lambda v: _normalize(v * jnp.int32(b3), C)
+
+    def proj_add(p1, p2):
+        x1, y1, z1 = p1
+        x2, y2, z2 = p2
+        t0 = mul(x1, x2)
+        t1 = mul(y1, y2)
+        t2 = mul(z1, z2)
+        t3 = sub(mul(add(x1, y1), add(x2, y2)), add(t0, t1))
+        t4 = sub(mul(add(y1, z1), add(y2, z2)), add(t1, t2))
+        t5 = sub(mul(add(x1, z1), add(x2, z2)), add(t0, t2))
+        t0 = add(add(t0, t0), t0)
+        t2 = mul_b3(t2)
+        zs = add(t1, t2)
+        t1 = sub(t1, t2)
+        yb = mul_b3(t5)
+        return (sub(mul(t3, t1), mul(t4, yb)),
+                add(mul(t1, zs), mul(t0, yb)),
+                add(mul(zs, t4), mul(t0, t3)))
+
+    while px.shape[0] > 1:
+        half = px.shape[0] // 2
+        px, py, pz = proj_add(
+            (px[:half], py[:half], pz[:half]),
+            (px[half:], py[half:], pz[half:]))
+    return px[0], py[0], pz[0]
+
+
+def _agg_kernel(xs_ref, ys_ref, mask_ref, b3_ref,
+                c_fold, c_lift, c_mulpad, c_fp2pad, c_negpad, c_gamma,
+                c_linepad, c_one12, ox_ref, oy_ref, oz_ref,
+                *, fp2: bool, g1_b3: int):
+    C = Consts(fold_t=c_fold[:], lift=c_lift[:], mulpad=c_mulpad[:],
+               fp2pad=c_fp2pad[:], negpad=c_negpad[:], gamma=c_gamma[:],
+               linepad=c_linepad[:], one12=c_one12[:])
+    xs = xs_ref[:]                     # (Cp, [2,] 25, B)
+    ys = ys_ref[:]
+    m = mask_ref[:]                    # (Cp, 1, B) | (Cp, 1, 1, B)
+    one_limb = (C.one12[0] if fp2 else C.one12[0, 0])  # (2,25,1)|(25,1)
+    one = jnp.broadcast_to(one_limb, xs.shape[1:]).astype(jnp.int32)
+    px = jnp.where(m != 0, xs, 0)
+    py = jnp.where(m != 0, ys, one)
+    pz = jnp.where(m != 0, one, jnp.zeros_like(one))
+    b3 = b3_ref[:] if fp2 else g1_b3
+    X, Y, Z = _agg_tree(px, py, pz, C, fp2=fp2, b3=b3)
+    ox_ref[:] = X
+    oy_ref[:] = Y
+    oz_ref[:] = Z
+
+
+@functools.lru_cache(maxsize=16)
+def _agg_compiled(cp: int, fp2: bool, interpret: bool):
+    from gethsharding_tpu.ops import bn256_jax as k
+
+    g1_b3 = 9  # 3*b on y^2 = x^3 + 3
+    b3g2 = np.zeros((2, KNL, 1), np.int32)
+    src = np.asarray(k._B3_G2_LIMBS, np.int32)
+    b3g2[:, : src.shape[-1], 0] = src
+    kernel = functools.partial(_agg_kernel, fp2=fp2, g1_b3=g1_b3)
+    point_shape = (cp, 2, KNL) if fp2 else (cp, KNL)
+    mask_shape = (cp, 1, 1) if fp2 else (cp, 1)
+    out_shape = (2, KNL) if fp2 else (KNL,)
+
+    @jax.jit
+    def run(xs, ys, mask):
+        n = xs.shape[-1]
+        grid = (n // AGG_LANES,)
+        from jax.experimental.pallas import tpu as pltpu
+
+        def whole(shape):
+            rank = len(shape)
+            return pl.BlockSpec(shape, lambda i, _r=rank: (0,) * _r)
+
+        def data(shape):
+            rank = len(shape) + 1
+            return pl.BlockSpec(shape + (AGG_LANES,),
+                                lambda i, _r=rank: (0,) * (_r - 1) + (i,))
+
+        out_specs = [data(out_shape)] * 3
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[data(point_shape), data(point_shape),
+                      data(mask_shape), whole(b3g2.shape)]
+            + [whole(np.asarray(c).shape) for c in _NP_CONSTS],
+            out_specs=out_specs,
+            out_shape=[jax.ShapeDtypeStruct(out_shape + (n,), jnp.int32)
+                       ] * 3,
+            interpret=interpret,
+        )(xs, ys, mask, jnp.asarray(b3g2),
+          *(jnp.asarray(c) for c in _NP_CONSTS))
+
+    return run
+
+
+def aggregate_proj(xs, ys, mask, *, fp2: bool, interpret: bool = False):
+    """Masked committee sum via the tree mega-kernel (ambient in/out).
+
+    xs/ys: (..., C, NL) G1 or (..., C, 2, NL) G2 affine limbs;
+    mask (..., C) bool. Returns projective (X, Y, Z)."""
+    from gethsharding_tpu.ops import bn256_jax as k
+
+    point_rank = 3 if fp2 else 2
+    lead = xs.shape[:-point_rank]
+    cdim = xs.shape[len(lead)]
+    cp = 1 << max(1, (cdim - 1).bit_length())   # pad committee to pow2
+    n = 1
+    for dim in lead:
+        n *= dim
+
+    def prep(v, extra_dims):
+        v = v.reshape((n,) + v.shape[len(lead):])
+        if v.shape[-1] < KNL and extra_dims >= 0:
+            v = jnp.concatenate(
+                [v, jnp.zeros(v.shape[:-1] + (KNL - v.shape[-1],),
+                              v.dtype)], axis=-1)
+        pad_c = cp - cdim
+        if pad_c:
+            v = jnp.concatenate(
+                [v, jnp.zeros((n, pad_c) + v.shape[2:], v.dtype)], axis=1)
+        v = jnp.moveaxis(v, 0, -1)              # (Cp, ..., n)
+        pad = (-n) % AGG_LANES
+        if pad:
+            v = jnp.concatenate(
+                [v, jnp.zeros(v.shape[:-1] + (pad,), v.dtype)], axis=-1)
+        return v
+
+    xs_t = prep(jnp.asarray(xs), 0)
+    ys_t = prep(jnp.asarray(ys), 0)
+    m = mask[..., None, None] if fp2 else mask[..., None]
+    m_t = prep(jnp.asarray(m, jnp.int32), -1)
+    out = _agg_compiled(cp, fp2, interpret)(xs_t, ys_t, m_t)
+    res = []
+    for v in out:
+        if (-n) % AGG_LANES:
+            v = v[..., :n]
+        v = jnp.moveaxis(v, -1, 0).reshape(lead + v.shape[:-1])
+        res.append(k.FP.normalize(v))
+    return tuple(res)

@@ -254,3 +254,35 @@ def test_miller_mega_kernel_interpret_matches_xla():
     # end-to-end boolean parity through the final exponentiation
     assert list(np.asarray(k.pairing_is_one(jnp.asarray(got)))) == \
         [True, False]
+
+
+@slow
+def test_aggregation_mega_kernel_interpret_matches_xla():
+    """The tree-reduction kernels reproduce the XLA masked projective
+    sums (same rational point: affine cross-multiplication equality),
+    and the aggregates verify end-to-end."""
+    tag = b"agg-mega"
+    keys = [ref.bls_keygen(tag + bytes([j])) for j in range(5)]
+    sigs = [ref.bls_sign(tag, sk) for sk, _ in keys]
+    pks = [pk for _, pk in keys]
+    sx, sy, sm = k.g1_committee_to_limbs([sigs, sigs[:3]], 5)
+    gx, gy, gm = k.g2_committee_to_limbs([pks, pks[:3]], 5)
+    want_g1 = k.aggregate_g1_proj(jnp.asarray(sx), jnp.asarray(sy),
+                                  jnp.asarray(sm))
+    got_g1 = m.aggregate_proj(jnp.asarray(sx), jnp.asarray(sy),
+                              jnp.asarray(sm), fp2=False, interpret=True)
+    want_g2 = k.aggregate_g2_proj(jnp.asarray(gx), jnp.asarray(gy),
+                                  jnp.asarray(gm))
+    got_g2 = m.aggregate_proj(jnp.asarray(gx), jnp.asarray(gy),
+                              jnp.asarray(gm), fp2=True, interpret=True)
+    assert np.asarray(k.FP.eq(k.FP.mul(want_g1[0], got_g1[2]),
+                              k.FP.mul(got_g1[0], want_g1[2]))).all()
+    assert np.asarray(k.FP.eq(k.FP.mul(want_g1[1], got_g1[2]),
+                              k.FP.mul(got_g1[1], want_g1[2]))).all()
+    assert np.asarray(k.fp2_eq(k.fp2_mul(want_g2[0], got_g2[2]),
+                               k.fp2_mul(got_g2[0], want_g2[2]))).all()
+    assert np.asarray(k.fp2_eq(k.fp2_mul(want_g2[1], got_g2[2]),
+                               k.fp2_mul(got_g2[1], want_g2[2]))).all()
+    hx, hy, _ = k.g1_to_limbs([ref.hash_to_g1(tag)] * 2)
+    f = k._bls_miller_opt(got_g1, jnp.asarray(hx), jnp.asarray(hy), got_g2)
+    assert list(np.asarray(k.pairing_is_one(f))) == [True, True]
