@@ -25,12 +25,19 @@ def _pair(config=None):
     return leader, server, follower_chain, follower
 
 
-def _wait_sync(leader, follower_chain, timeout=10.0):
+def _wait_sync(leader, follower_chain, timeout=10.0, follower=None):
+    """Heads equal AND (when the service is given) the leader's state
+    checkpoint installed — header import and state install are two
+    steps of one sync round, and a bare head match can be observed
+    between them."""
     deadline = time.time() + timeout
     while time.time() < deadline:
-        if (follower_chain.block_number == leader.block_number
-                and bytes(follower_chain.blocks[-1].hash)
-                == bytes(leader.blocks[-1].hash)):
+        heads_match = (follower_chain.block_number == leader.block_number
+                       and bytes(follower_chain.blocks[-1].hash)
+                       == bytes(leader.blocks[-1].hash))
+        state_match = (follower is None
+                       or follower._installed_seq == leader.state_seq())
+        if heads_match and state_match:
             return True
         time.sleep(0.02)
     return False
@@ -59,7 +66,7 @@ def test_follower_replicates_chain_and_smc_state():
                                      bytes(vote_digest(2, period, root))))
         leader.commit()
 
-        assert _wait_sync(leader, follower_chain)
+        assert _wait_sync(leader, follower_chain, follower=follower)
         # block-level identity
         assert [bytes(b.hash) for b in follower_chain.blocks] == \
             [bytes(b.hash) for b in leader.blocks]
@@ -86,7 +93,7 @@ def test_follower_tracks_leader_reorg():
         follower.start()
         for _ in range(6):
             leader.commit()
-        assert _wait_sync(leader, follower_chain)
+        assert _wait_sync(leader, follower_chain, follower=follower)
 
         # the leader rolls back and grows a DIFFERENT branch: dev blocks
         # hash only on (number, parent) so we must change the branch
@@ -96,7 +103,7 @@ def test_follower_tracks_leader_reorg():
         leader.fund(acct.address, 1 * ETHER)  # state divergence marker
         for _ in range(5):
             leader.commit()
-        assert _wait_sync(leader, follower_chain)
+        assert _wait_sync(leader, follower_chain, follower=follower)
         assert follower.reorgs_followed >= 0  # reorg may resolve as
         # a pure extension if the follower saw set_head before regrow
         assert follower_chain.balance_of(acct.address) == 1 * ETHER
