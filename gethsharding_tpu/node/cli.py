@@ -188,6 +188,26 @@ def build_parser() -> argparse.ArgumentParser:
                         help="supervision/status cadence")
     devnet.add_argument("--verbosity", default="warning",
                         choices=("debug", "info", "warning", "error"))
+
+    swarm = sub.add_parser(
+        "swarm", help="content-addressed storage: up/get/serve over the "
+                      "chunk tree + shardp2p netstore (cmd/swarm role)")
+    swarm.add_argument("action", choices=("up", "get", "serve"))
+    swarm.add_argument("target", nargs="?", default="",
+                       help="up: file path; get: hex root key")
+    swarm.add_argument("--datadir", required=True,
+                       help="chunk DB directory (swarmchunks sqlite)")
+    swarm.add_argument("--endpoint", default="",
+                       help="relay HOST:PORT — serve chunks to / fetch "
+                            "missing chunks from peers over shardp2p")
+    swarm.add_argument("-o", "--output", default="-",
+                       help="get: output file (- = stdout)")
+    swarm.add_argument("--timeout", type=float, default=5.0,
+                       help="per-chunk network fetch timeout")
+    swarm.add_argument("--runtime", type=float, default=0.0,
+                       help="serve: seconds before exit (0 = forever)")
+    swarm.add_argument("--verbosity", default="warning",
+                       choices=("debug", "info", "warning", "error"))
     return parser
 
 
@@ -228,6 +248,10 @@ def run_cli(argv: Optional[List[str]] = None) -> int:
         from gethsharding_tpu.devnet import run_devnet
 
         return run_devnet(args)
+    if args.command == "swarm":
+        from gethsharding_tpu.tools import run_swarm
+
+        return run_swarm(args)
     if args.command == "signer":
         from gethsharding_tpu.signer import run_signer
 

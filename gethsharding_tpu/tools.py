@@ -29,6 +29,8 @@ server surface it was generated from.
 from __future__ import annotations
 
 import getpass
+import json
+import os
 import sys
 
 
@@ -348,3 +350,89 @@ def run_faucet(args) -> int:
         chain.close()
     print(f"funded {args.address}: balance {balance / ETHER:g} ETH")
     return 0
+
+
+def run_swarm(args) -> int:
+    """`swarm`: content-addressed storage CLI (the cmd/swarm up/get
+    role over storage/ — local chunk DB, or the shardp2p netstore tier
+    when an --endpoint is given).
+
+    up FILE    chunk + store content, print the 32-byte root key
+    get ROOT   reassemble + verify content under a root key
+    serve      keep a netstore attached, serving chunks to peers
+    """
+    import time as _time
+
+    from gethsharding_tpu.db.kv import SqliteKV
+    from gethsharding_tpu.storage.chunker import (ChunkStore,
+                                                  ChunkStoreError, KEY_SIZE)
+    from gethsharding_tpu.storage.netstore import NetStore
+
+    endpoint = None
+    if args.endpoint:
+        host, _, port_str = args.endpoint.rpartition(":")
+        if not host or not port_str.isdigit():
+            print(f"invalid --endpoint {args.endpoint!r} (HOST:PORT)",
+                  file=sys.stderr)
+            return 1
+        endpoint = (host, int(port_str))
+    os.makedirs(args.datadir, exist_ok=True)  # geth initializes datadirs
+    store = ChunkStore(kv=SqliteKV(os.path.join(args.datadir,
+                                                "swarmchunks")))
+    try:
+        if args.action == "up":
+            with open(args.target, "rb") as fh:
+                data = fh.read()
+            root = store.store(data)
+            print(root.hex())
+            return 0
+
+        hub = None
+        netstore = NetStore(store=store)
+        if endpoint is not None:
+            from gethsharding_tpu.mainchain.accounts import AccountManager
+            from gethsharding_tpu.p2p.remote import RemoteHub
+            from gethsharding_tpu.p2p.service import P2PServer
+
+            manager = AccountManager()
+            acct = manager.new_account()
+            hub = RemoteHub.dial(*endpoint, accounts=manager,
+                                 account=acct.address)
+            netstore = NetStore(store=store, p2p=P2PServer(hub=hub),
+                                fetch_timeout=args.timeout)
+        netstore.start()
+        try:
+            if args.action == "serve":
+                print(json.dumps({"serving": True,
+                                  "datadir": args.datadir}), flush=True)
+                deadline = (_time.monotonic() + args.runtime
+                            if args.runtime else None)
+                while deadline is None or _time.monotonic() < deadline:
+                    _time.sleep(0.2)
+                return 0
+            try:
+                root = bytes.fromhex(args.target.removeprefix("0x"))
+            except ValueError:
+                root = b""
+            if len(root) != KEY_SIZE:
+                print(f"invalid root {args.target!r} (need "
+                      f"{KEY_SIZE}-byte hex)", file=sys.stderr)
+                return 1
+            try:
+                data = netstore.retrieve(root)
+            except ChunkStoreError as exc:
+                print(str(exc), file=sys.stderr)
+                return 1
+            if args.output == "-":
+                sys.stdout.buffer.write(data)
+            else:
+                with open(args.output, "wb") as fh:
+                    fh.write(data)
+                print(f"{len(data)} bytes -> {args.output}")
+            return 0
+        finally:
+            netstore.stop()
+            if hub is not None:
+                hub.close()
+    finally:
+        store.kv.close()
