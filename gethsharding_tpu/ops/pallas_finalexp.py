@@ -185,6 +185,11 @@ def _normalize(z, C: Consts):
     w = z.shape[-2]
     if w > KFOLD_BASE + KFOLD_ROWS - 2:
         raise ValueError(f"accumulator too wide: {w}")
+    lead = z.shape[:-2]
+    n = 1
+    for d in lead:
+        n *= d
+    z = z.reshape((n,) + z.shape[-2:])  # rank-3: Mosaic-safe (see _conv)
     z = jnp.concatenate([z, _zeros_like_rows(z, 2)], axis=-2)
     z = _round(_round(z))
     # fold rows >= KFOLD_BASE through the fold matrix (broadcast MACs)
@@ -196,16 +201,28 @@ def _normalize(z, C: Consts):
     acc = jnp.concatenate(
         [acc, _zeros_like_rows(acc, KNL - KFOLD_BASE)], axis=-2)
     acc = acc + C.lift
-    return _round(_round(_round(acc)))
+    return _round(_round(_round(acc))).reshape(lead + (KNL, z.shape[-1]))
 
 
 def _conv(u, v):
     """Schoolbook columns: (..., 25, B) x (..., 25, B) -> (..., 49, B),
     leading dims broadcast — the stacked-plane form of pallas_conv's
-    shift-MAC loop (25 full-tile MACs for ALL planes at once)."""
+    shift-MAC loop (25 full-tile MACs for ALL planes at once).
+
+    Leading dims are FLATTENED around the loop (free reshapes — minor
+    dims untouched): the fp12 paths otherwise build rank-7 arrays,
+    which interpret mode accepts but real Mosaic may not."""
+    lead = jnp.broadcast_shapes(u.shape[:-2], v.shape[:-2])
+    n = 1
+    for d in lead:
+        n *= d
+    uf = jnp.broadcast_to(u, lead + u.shape[-2:]).reshape(
+        (n,) + u.shape[-2:])
+    vf = jnp.broadcast_to(v, lead + v.shape[-2:]).reshape(
+        (n,) + v.shape[-2:])
     acc = None
     for l in range(KNL):
-        term = u[..., l:l + 1, :] * v
+        term = uf[:, l:l + 1, :] * vf
         parts = []
         if l:
             parts.append(_zeros_like_rows(term, l))
@@ -216,7 +233,7 @@ def _conv(u, v):
         shifted = parts[0] if len(parts) == 1 else jnp.concatenate(
             parts, axis=-2)
         acc = shifted if acc is None else acc + shifted
-    return acc
+    return acc.reshape(lead + (KNCOLS, acc.shape[-1]))
 
 
 def _mul_xi(y, C: Consts):
