@@ -400,29 +400,35 @@ class Notary(Service):
         # (shard_auditData) instead of O(shards) record reads + O(votes)
         # registry lookups.
         data = self.client.audit_data(period)
+        raw = bool(data.get("raw"))  # in-process pull: no hex wire codec
         shards, msgs, sig_rows, pk_rows, pk_keys = [], [], [], [], []
         signed_counts, total_counts, expected = [], [], []
         for shard_id in sorted(data["shards"]):
             rec = data["shards"][shard_id]
             member_pks, sigs, key_parts = [], [], []
             for vote in rec["votes"]:
-                pk = codec.dec_g2(vote["pubkey"])
+                pk = (vote["pubkey"] if raw
+                      else codec.dec_g2(vote["pubkey"]))
                 if pk is None:
                     member_pks = None  # released voter: not resolvable
                     break
                 member_pks.append(pk)
-                sigs.append(codec.dec_g1(vote["sig"]))
-                (xa, xb), (ya, yb) = vote["pubkey"]
-                key_parts.extend((xa, xb, ya, yb))
+                sigs.append(vote["sig"] if raw
+                            else codec.dec_g1(vote["sig"]))
+                # transport-independent cache key: the decoded point's
+                # int limbs identify the row's pubkeys either way
+                x, y = pk
+                key_parts.extend((x.a, x.b, y.a, y.b))
             if member_pks is None:
                 continue
             shards.append(shard_id)
-            msgs.append(vote_digest(
-                shard_id, period, Hash32(bytes.fromhex(rec["chunk_root"]))))
+            root = (Hash32(rec["chunk_root"]) if raw
+                    else Hash32(bytes.fromhex(rec["chunk_root"])))
+            msgs.append(vote_digest(shard_id, period, root))
             sig_rows.append(sigs)
             pk_rows.append(member_pks)
-            # the wire hex strings uniquely determine the row's pubkeys:
-            # the backend caches the marshalled row under this key, so a
+            # the decoded pubkey limbs uniquely determine the row: the
+            # backend caches the marshalled row under this key, so a
             # repeat committee (the steady state) skips the G2 limb
             # conversion entirely
             pk_keys.append(tuple(key_parts))

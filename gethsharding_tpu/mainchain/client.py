@@ -173,13 +173,14 @@ class SMCClient:
 
     def audit_data(self, period: int) -> dict:
         """Bulk period-audit data (records + vote sigs + voter pubkeys) —
-        one round trip against backends that serve it in bulk."""
+        one round trip against backends that serve it in bulk; the
+        in-process walk skips the hex wire codec (raw point tuples)."""
         fn = getattr(self.backend, "audit_data", None)
         if fn is not None:
             return fn(period)
         from gethsharding_tpu.mainchain.mirror import assemble_audit_data
 
-        return assemble_audit_data(self, period)
+        return assemble_audit_data(self, period, jsonable=False)
 
     # -- tx resilience (WaitForTransaction parity) ------------------------
 
