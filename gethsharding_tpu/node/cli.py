@@ -130,9 +130,18 @@ def build_parser() -> argparse.ArgumentParser:
 
     evm = sub.add_parser(
         "evm", help="run a JSON op scenario through the standalone SMC "
-                    "engine (the cmd/evm analog)")
+                    "engine, or raw bytecode through the general EVM "
+                    "interpreter (the cmd/evm analog)")
     evm.add_argument("scenario", help="scenario JSON (tests/testdata/"
-                                      "smc.json format)")
+                                      "smc.json format), or hex bytecode "
+                                      "with --code")
+    evm.add_argument("--code", action="store_true",
+                     help="SCENARIO is hex EVM bytecode: execute it with "
+                          "the byzantium interpreter (core/vm.py)")
+    evm.add_argument("--input", default="",
+                     help="--code: hex calldata")
+    evm.add_argument("--gas", type=int, default=10_000_000,
+                     help="--code: gas budget")
     evm.add_argument("--trace", action="store_true",
                      help="print each op's outcome as it executes")
     evm.add_argument("--verbosity", default="warning",

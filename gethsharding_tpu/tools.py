@@ -132,13 +132,39 @@ def _print_item(item, depth: int) -> None:
 def run_evm(args) -> int:
     """`evm`: execute a JSON op scenario against a fresh SMC chain and
     print the outcome (the cmd/evm standalone-runner role; the fixture
-    format is the one tests/testdata/smc.json freezes).
+    format is the one tests/testdata/smc.json freezes) — or, with
+    --code, run raw hex BYTECODE through the general byzantium
+    interpreter (core/vm.py), `cmd/evm run` style.
 
     Script ops: register / deregister / release / fund / fast_forward /
     commit / add_header / submit_vote / vote_eligible. Accounts are
     derived from `account_seeds`; submit_vote and vote_eligible BLS-sign
     with the voter's derived vote key automatically."""
     import json
+
+    if getattr(args, "code", False):
+        from gethsharding_tpu.core.vm import execute
+
+        try:
+            code = bytes.fromhex(args.scenario.removeprefix("0x"))
+            calldata = bytes.fromhex(args.input.removeprefix("0x"))
+        except ValueError:
+            print("not hex input", file=sys.stderr)
+            return 1
+        res, vm = execute(code, data=calldata, gas=args.gas,
+                          trace=args.trace)
+        if args.trace:
+            for step in vm.trace:
+                print(f"pc={step['pc']:5d} op=0x{step['op']:02x} "
+                      f"gas={step['gas']} stack={step['stack']}")
+        print(json.dumps({
+            "success": res.success,
+            "output": res.output.hex(),
+            "gas_used": args.gas - res.gas_left,
+            "logs": [{"address": a.hex(), "topics": [hex(t) for t in ts],
+                      "data": d.hex()} for a, ts, d in res.logs],
+        }, indent=1))
+        return 0 if res.success else 1
 
     from gethsharding_tpu.mainchain.accounts import AccountManager
     from gethsharding_tpu.params import Config, ETHER

@@ -1,0 +1,385 @@
+"""General EVM interpreter (core/vm.py, byzantium rules): opcode
+semantics, gas accounting, call-context rules, precompiles — the
+tooling-tier executor behind `evm` (phase-1 consensus stays on the
+native SMC kernels)."""
+
+import pytest
+
+from gethsharding_tpu.core.vm import (
+    Account, EVM, Env, StateDB, UINT_MAX, execute)
+from gethsharding_tpu.crypto import bn256, secp256k1
+from gethsharding_tpu.crypto.keccak import keccak256
+from gethsharding_tpu.utils.rlp import rlp_encode
+
+
+def _asm(*parts) -> bytes:
+    """Tiny assembler: ints are opcodes, bytes are literal, ('push', v)
+    emits the smallest PUSHn."""
+    out = bytearray()
+    for part in parts:
+        if isinstance(part, tuple):
+            _, v = part
+            blob = v.to_bytes(max(1, (v.bit_length() + 7) // 8), "big")
+            out.append(0x60 + len(blob) - 1)
+            out.extend(blob)
+        elif isinstance(part, bytes):
+            out.extend(part)
+        else:
+            out.append(part)
+    return bytes(out)
+
+
+def _run(code, **kw):
+    res, vm = execute(code, **kw)
+    return res, vm
+
+
+def test_arithmetic_and_stack_semantics():
+    # (7 + 5) * 3 - 1 = 35, returned as a 32-byte word
+    code = _asm(("push", 5), ("push", 7), 0x01,   # ADD -> 12
+                ("push", 3), 0x02,                # MUL -> 36
+                ("push", 1), 0x90, 0x03,          # SWAP1; SUB -> 35
+                ("push", 0), 0x52,                # MSTORE @0
+                ("push", 32), ("push", 0), 0xF3)  # RETURN
+    res, _ = _run(code)
+    assert res.success
+    assert int.from_bytes(res.output, "big") == 35
+
+
+@pytest.mark.parametrize("code,want", [
+    # SDIV: -8 / 3 == -2 (truncated toward zero)
+    (_asm(("push", 3), ("push", UINT_MAX - 7), 0x05), UINT_MAX - 1),
+    # SMOD: -8 % 3 == -2
+    (_asm(("push", 3), ("push", UINT_MAX - 7), 0x07), UINT_MAX - 1),
+    # DIV by zero = 0
+    (_asm(("push", 0), ("push", 12), 0x04), 0),
+    # SIGNEXTEND byte 0 of 0xFF -> -1
+    (_asm(("push", 0xFF), ("push", 0), 0x0B), UINT_MAX),
+    # BYTE 31 of 0x..01 -> 1
+    (_asm(("push", 1), ("push", 31), 0x1A), 1),
+    # SLT: -1 < 1
+    (_asm(("push", 1), ("push", UINT_MAX), 0x12), 1),
+    # EXP 2^10
+    (_asm(("push", 10), ("push", 2), 0x0A), 1024),
+])
+def test_opcode_values(code, want):
+    full = code + _asm(("push", 0), 0x52, ("push", 32), ("push", 0), 0xF3)
+    res, _ = _run(full)
+    assert res.success
+    assert int.from_bytes(res.output, "big") == want
+
+
+def test_keccak_and_calldata():
+    # return keccak256(calldata[0:4])
+    code = _asm(("push", 4), ("push", 0), ("push", 0), 0x37,  # CALLDATACOPY
+                ("push", 4), ("push", 0), 0x20,               # KECCAK256
+                ("push", 0), 0x52, ("push", 32), ("push", 0), 0xF3)
+    res, _ = _run(code, data=b"abcd")
+    assert res.output == keccak256(b"abcd")
+
+
+def test_loop_sums_to_100_and_gas_is_exact_for_straightline():
+    # straight-line gas check: PUSH1 PUSH1 ADD STOP = 3+3+3+0
+    res, _ = _run(_asm(("push", 1), ("push", 2), 0x01, 0x00), gas=100)
+    assert res.success and res.gas_left == 100 - 9
+    # a JUMPI loop: sum 1..10 in storage slot 0 via memory counter
+    code = _asm(
+        ("push", 0), ("push", 0), 0x52,            # mem[0] = 0 (i)
+        ("push", 0), ("push", 32), 0x52,           # mem[32] = 0 (acc)
+        0x5B,                                      # loop: JUMPDEST @10
+        ("push", 0), 0x51, ("push", 1), 0x01,      # i+1
+        0x80, ("push", 0), 0x52,                   # mem[0] = i+1 (dup)
+        ("push", 32), 0x51, 0x01,                  # acc += i+1
+        ("push", 32), 0x52,
+        ("push", 10), ("push", 0), 0x51, 0x10,     # i < 10 ?
+        ("push", 10), 0x57,                        # JUMPI loop
+        ("push", 32), 0x51, ("push", 0), 0x55,     # SSTORE 0, acc
+        0x00)
+    res, vm = _run(code, gas=200_000)
+    assert res.success
+    assert vm.state.get(b"\xc0" * 20).storage[0] == 55
+
+
+def test_sstore_gas_and_refund_rules():
+    addr = b"\xc0" * 20
+    # zero -> nonzero: 20000; nonzero -> nonzero: 5000;
+    # nonzero -> zero: 5000 + 15000 refund
+    code = _asm(("push", 1), ("push", 0), 0x55, 0x00)
+    res, vm = _run(code, gas=30_000)
+    assert res.success and res.gas_left == 30_000 - 3 - 3 - 20000
+    state = vm.state
+    code2 = _asm(("push", 2), ("push", 0), 0x55, 0x00)
+    res2, vm2 = _run(code2, state=state, gas=30_000)
+    assert res2.gas_left == 30_000 - 3 - 3 - 5000
+    # clearing refunds 15000, CAPPED at gas_used // 2 (= 2503 here)
+    code3 = _asm(("push", 0), ("push", 0), 0x55, 0x00)
+    res3, vm3 = _run(code3, state=state, gas=30_000)
+    used = 3 + 3 + 5000
+    assert res3.gas_left == 30_000 - used + used // 2
+    assert 0 not in state.get(addr).storage
+
+
+def test_out_of_gas_consumes_frame_and_reverts_state():
+    code = _asm(("push", 1), ("push", 0), 0x55, 0x00)  # SSTORE needs 20006
+    res, vm = _run(code, gas=10_000)
+    assert not res.success and res.gas_left == 0
+    assert vm.state.get(b"\xc0" * 20).storage == {}
+
+
+def test_invalid_jump_and_stack_underflow_fail_loudly():
+    res, _ = _run(_asm(("push", 3), 0x56, 0x00))  # JUMP to non-JUMPDEST
+    assert not res.success and res.gas_left == 0
+    res, _ = _run(bytes([0x01]))                  # ADD on empty stack
+    assert not res.success
+    # jump INTO push data must be rejected
+    res, _ = _run(_asm(("push", 1), 0x56))        # dest 1 = inside PUSH
+    assert not res.success
+
+
+def test_revert_returns_data_and_restores_state():
+    # SSTORE then REVERT("xy")
+    code = _asm(("push", 9), ("push", 5), 0x55,
+                ("push", 0x7879), ("push", 0), 0x52,
+                ("push", 2), ("push", 30), 0xFD)
+    res, vm = _run(code, gas=50_000)
+    assert not res.success
+    assert res.output == b"xy"
+    assert res.gas_left > 0  # REVERT refunds remaining gas
+    assert vm.state.get(b"\xc0" * 20).storage == {}
+
+
+def _install(vm_state, addr, code, balance=0):
+    acct = vm_state.get(addr)
+    acct.code = code
+    acct.balance = balance
+
+
+def test_call_value_transfer_and_returndata():
+    state = StateDB()
+    callee = b"\x11" * 20
+    # callee: return CALLVALUE
+    _install(state, callee, _asm(0x34, ("push", 0), 0x52,
+                                 ("push", 32), ("push", 0), 0xF3))
+    # caller: CALL(gas, callee, value=7, in 0/0, out 0/32); return mem[0]
+    code = _asm(("push", 32), ("push", 0), ("push", 0), ("push", 0),
+                ("push", 7), ("push", int.from_bytes(callee, "big")),
+                ("push", 100_000), 0xF1,
+                ("push", 0), 0x52,  # store success flag
+                ("push", 32), ("push", 0), 0xF3)
+    state.get(b"\xc0" * 20).balance = 100
+    res, vm = _run(code, state=state, gas=500_000)
+    assert res.success
+    # the call returned CALLVALUE=7 into mem[0]; then we overwrote with
+    # the success flag (1)
+    assert int.from_bytes(res.output, "big") == 1
+    assert vm.state.get(callee).balance == 7
+    assert vm.state.get(b"\xc0" * 20).balance == 93
+
+
+def test_delegatecall_keeps_context_and_moves_no_balance():
+    state = StateDB()
+    lib = b"\x22" * 20
+    # library code: SSTORE(0, CALLER); SSTORE(1, CALLVALUE)
+    _install(state, lib, _asm(0x33, ("push", 0), 0x55,
+                              0x34, ("push", 1), 0x55, 0x00))
+    caller_addr = b"\xc0" * 20
+    code = _asm(("push", 0), ("push", 0), ("push", 0), ("push", 0),
+                ("push", int.from_bytes(lib, "big")),
+                ("push", 200_000), 0xF4,
+                ("push", 0), 0x52, ("push", 32), ("push", 0), 0xF3)
+    state.get(caller_addr).balance = 50
+    state.get(b"\xca" * 20).balance = 13  # top-level call transfers it
+    res, vm = _run(code, state=state, gas=500_000, value=13,
+                   caller=b"\xca" * 20)
+    assert res.success and int.from_bytes(res.output, "big") == 1
+    stored = vm.state.get(caller_addr).storage
+    # storage written in the CALLER's account, caller/value inherited
+    assert stored[0] == int.from_bytes(b"\xca" * 20, "big")
+    assert stored[1] == 13
+    assert vm.state.get(lib).storage == {}
+    assert vm.state.get(lib).balance == 0
+
+
+def test_staticcall_blocks_writes():
+    state = StateDB()
+    writer = b"\x33" * 20
+    _install(state, writer, _asm(("push", 1), ("push", 0), 0x55, 0x00))
+    code = _asm(("push", 0), ("push", 0), ("push", 0), ("push", 0),
+                ("push", int.from_bytes(writer, "big")),
+                ("push", 100_000), 0xFA,
+                ("push", 0), 0x52, ("push", 32), ("push", 0), 0xF3)
+    res, vm = _run(code, state=state, gas=500_000)
+    assert res.success
+    assert int.from_bytes(res.output, "big") == 0  # inner call failed
+    assert vm.state.get(writer).storage == {}
+
+
+def test_create_address_and_code_deposit():
+    # initcode: returns 2 bytes of runtime code (0x00 0x00)
+    initcode = _asm(("push", 2), ("push", 0), 0xF3)
+    code = _asm(("push", len(initcode)),
+                ("push", 32 - len(initcode)),  # offset of code in mem word
+                ("push", 0), 0xF0,
+                ("push", 0), 0x52, ("push", 32), ("push", 0), 0xF3)
+    # place initcode into memory first: MSTORE a word whose tail is it
+    word = int.from_bytes(initcode.rjust(32, b"\x00"), "big")
+    full = _asm(("push", word), ("push", 0), 0x52) + code
+    res, vm = _run(full, gas=500_000)
+    assert res.success
+    created = int.from_bytes(res.output, "big")
+    want = keccak256(rlp_encode([b"\xc0" * 20, 0]))[12:]
+    assert created == int.from_bytes(want, "big")
+    assert vm.state.get(want).code == b"\x00\x00"
+    assert vm.state.get(b"\xc0" * 20).nonce == 1
+
+
+def test_selfdestruct_moves_balance():
+    state = StateDB()
+    victim = b"\x44" * 20
+    heir = b"\x55" * 20
+    _install(state, victim,
+             _asm(("push", int.from_bytes(heir, "big")), 0xFF), balance=77)
+    code = _asm(("push", 0), ("push", 0), ("push", 0), ("push", 0),
+                ("push", 0), ("push", int.from_bytes(victim, "big")),
+                ("push", 100_000), 0xF1, 0x00)
+    res, vm = _run(code, state=state, gas=500_000)
+    assert res.success
+    assert vm.state.get(heir).balance == 77
+    assert vm.state.get(victim).balance == 0
+    assert vm.state.get(victim).code == b""
+
+
+def test_logs_are_emitted_and_reverted_with_the_frame():
+    code = _asm(("push", 0xAB), ("push", 0), 0x52,
+                ("push", 0xBEEF),                  # topic
+                ("push", 32), ("push", 0), 0xA1,   # LOG1(mem[0:32])
+                0x00)
+    res, vm = _run(code, gas=100_000)
+    assert res.success and len(res.logs) == 1
+    addr, topics, data = res.logs[0]
+    assert topics == [0xBEEF] and data[-1] == 0xAB
+    # a reverting frame keeps no logs
+    code_rev = _asm(("push", 0), ("push", 0), 0xA0, ("push", 0),
+                    ("push", 0), 0xFD)
+    res2, vm2 = _run(code_rev, gas=100_000)
+    assert not res2.success and vm2.logs == []
+
+
+# -- precompiles ------------------------------------------------------------
+
+
+def _call_precompile(pid, data, gas=10_000_000):
+    vm = EVM()
+    return vm.call(b"\xca" * 20, pid.to_bytes(20, "big"), 0, data, gas)
+
+
+def test_precompile_ecrecover_matches_our_secp256k1():
+    priv = 0xB0B
+    digest = keccak256(b"vm-ecrecover")
+    sig = secp256k1.sign(digest, priv)
+    data = (digest + (27 + sig.v).to_bytes(32, "big")
+            + sig.r.to_bytes(32, "big") + sig.s.to_bytes(32, "big"))
+    res = _call_precompile(1, data)
+    assert res.success
+    assert res.output[12:] == bytes(secp256k1.priv_to_address(priv))
+    # corrupted digest recovers a DIFFERENT address (or nothing)
+    res_bad = _call_precompile(1, b"\x01" * 32 + data[32:])
+    assert res_bad.output != res.output
+
+
+def test_precompile_sha256_identity_modexp():
+    res = _call_precompile(2, b"abc")
+    import hashlib
+
+    assert res.output == hashlib.sha256(b"abc").digest()
+    res = _call_precompile(4, b"zzz")
+    assert res.output == b"zzz"
+    # modexp: 3^5 mod 7 = 5
+    data = ((1).to_bytes(32, "big") + (1).to_bytes(32, "big")
+            + (1).to_bytes(32, "big") + b"\x03" + b"\x05" + b"\x07")
+    res = _call_precompile(5, data)
+    assert res.output == b"\x05"
+
+
+def test_precompile_bn256_trio_matches_our_curve_stack():
+    g = bn256.G1_GEN
+    g2 = bn256.g1_mul(2, g)
+    data = (g[0].to_bytes(32, "big") + g[1].to_bytes(32, "big")
+            + g[0].to_bytes(32, "big") + g[1].to_bytes(32, "big"))
+    res = _call_precompile(6, data)          # G + G
+    assert res.success
+    assert res.output == (g2[0].to_bytes(32, "big")
+                          + g2[1].to_bytes(32, "big"))
+    res = _call_precompile(7, data[:64] + (3).to_bytes(32, "big"))  # 3·G
+    g3 = bn256.g1_mul(3, g)
+    assert res.output == (g3[0].to_bytes(32, "big")
+                          + g3[1].to_bytes(32, "big"))
+    # pairing: e(aP, Q)·e(-P, aQ) == 1
+    a = 777
+    p1 = bn256.g1_mul(a, g)
+    q1 = bn256.G2_GEN
+    p2 = bn256.g1_neg(g)
+    q2 = bn256.g2_mul(a, q1)
+
+    def enc_pair(p, q):
+        (qx, qy) = q
+        return (p[0].to_bytes(32, "big") + p[1].to_bytes(32, "big")
+                + qx.b.to_bytes(32, "big") + qx.a.to_bytes(32, "big")
+                + qy.b.to_bytes(32, "big") + qy.a.to_bytes(32, "big"))
+
+    res = _call_precompile(8, enc_pair(p1, q1) + enc_pair(p2, q2))
+    assert res.success
+    assert int.from_bytes(res.output, "big") == 1
+    # tampered pairing fails the check (returns 0, still succeeds)
+    res_bad = _call_precompile(8, enc_pair(p1, q1) + enc_pair(g, q2))
+    assert res_bad.success
+    assert int.from_bytes(res_bad.output, "big") == 0
+    # a not-on-curve point is a precompile FAILURE, not a false result
+    bad = b"\x01" * 64 + enc_pair(p1, q1)[64:]
+    res_err = _call_precompile(8, bad + enc_pair(p2, q2))
+    assert not res_err.success
+
+
+def test_call_gas_uses_63_64_rule():
+    state = StateDB()
+    spender = b"\x66" * 20
+    # callee burns all its gas in an infinite loop
+    _install(state, spender, _asm(0x5B, ("push", 0), 0x56))
+    code = _asm(("push", 0), ("push", 0), ("push", 0), ("push", 0),
+                ("push", 0), ("push", int.from_bytes(spender, "big")),
+                ("push", UINT_MAX), 0xF1,   # request ALL gas
+                ("push", 0), 0x52, ("push", 32), ("push", 0), 0xF3)
+    res, _ = _run(code, state=state, gas=300_000)
+    # the callee fails (out of gas) but the caller retains its 1/64
+    assert res.success
+    assert int.from_bytes(res.output, "big") == 0
+
+
+def test_delegatecall_to_precompile_runs_the_precompile():
+    """geth checks the precompile set before any code lookup — the
+    identity precompile must answer DELEGATECALL/CALLCODE too."""
+    code = _asm(("push", 0x61626364), ("push", 0), 0x52,   # mem = ..abcd
+                ("push", 0), ("push", 0),                  # out 0/0
+                ("push", 4), ("push", 28),                 # in 28/4
+                ("push", 4),                               # address 0x04
+                ("push", 100_000), 0xF4,                   # DELEGATECALL
+                0x50,                                      # POP success
+                0x3D, ("push", 0), 0x52,                   # RETURNDATASIZE
+                ("push", 32), ("push", 0), 0xF3)
+    res, _ = _run(code, gas=500_000)
+    assert res.success
+    assert int.from_bytes(res.output, "big") == 4
+
+
+def test_selfdestruct_to_fresh_heir_charges_newaccount():
+    state = StateDB()
+    victim = b"\x44" * 20
+    heir = b"\x77" * 20  # does not exist
+    _install(state, victim,
+             _asm(("push", int.from_bytes(heir, "big")), 0xFF), balance=5)
+    vm = EVM(state=state)
+    res = vm.call(b"\xca" * 20, victim, 0, b"", 100_000)
+    assert res.success
+    # PUSH20 (3) + SELFDESTRUCT 5000 + 25000 new-account surcharge
+    assert 100_000 - res.gas_left == 3 + 5000 + 25000
+    assert state.get(heir).balance == 5
