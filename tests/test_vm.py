@@ -383,3 +383,90 @@ def test_selfdestruct_to_fresh_heir_charges_newaccount():
     # PUSH20 (3) + SELFDESTRUCT 5000 + 25000 new-account surcharge
     assert 100_000 - res.gas_left == 3 + 5000 + 25000
     assert state.get(heir).balance == 5
+
+
+def test_memory_expansion_gas_is_quadratic_exact():
+    # MSTORE at offset 0: 1 word -> 3; at 31*32: 32 words ->
+    # 3*32 + 32*32//512 = 98; charged incrementally
+    code = _asm(("push", 1), ("push", 992), 0x52, 0x00)
+    res, _ = _run(code, gas=10_000)
+    want = 3 + 3 + 3 + (3 * 32 + 32 * 32 // 512)  # pushes + MSTORE + mem
+    assert res.success and 10_000 - res.gas_left == want
+
+
+def test_callcode_uses_callers_storage():
+    state = StateDB()
+    lib = b"\x88" * 20
+    _install(state, lib, _asm(("push", 42), ("push", 0), 0x55, 0x00))
+    me = b"\xc0" * 20
+    code = _asm(("push", 0), ("push", 0), ("push", 0), ("push", 0),
+                ("push", 0), ("push", int.from_bytes(lib, "big")),
+                ("push", 100_000), 0xF2, 0x00)
+    res, vm = _run(code, state=state, gas=500_000)
+    assert res.success
+    assert vm.state.get(me).storage.get(0) == 42    # OUR storage
+    assert vm.state.get(lib).storage == {}
+
+
+def test_blockhash_window_and_env():
+    env = Env(number=300, timestamp=777)
+    # NUMBER, TIMESTAMP, BLOCKHASH(number-1), BLOCKHASH(number-257)=0
+    code = _asm(("push", 299), 0x40, ("push", 0), 0x52,
+                ("push", 43), 0x40, ("push", 32), 0x52,
+                ("push", 64), ("push", 0), 0xF3)
+    res, _ = _run(code, env=env, gas=100_000)
+    assert res.success
+    assert res.output[:32] == env.blockhash(299)
+    assert res.output[32:] == b"\x00" * 32   # outside the 256 window
+
+
+def test_returndatacopy_out_of_bounds_is_exceptional():
+    # no prior call: returndata is empty; copying 1 byte must abort
+    code = _asm(("push", 1), ("push", 0), ("push", 0), 0x3E, 0x00)
+    res, _ = _run(code, gas=100_000)
+    assert not res.success and res.gas_left == 0
+
+
+def test_extcodecopy_and_extcodesize():
+    state = StateDB()
+    other = b"\x99" * 20
+    _install(state, other, b"\xde\xad\xbe\xef")
+    code = _asm(("push", int.from_bytes(other, "big")), 0x3B,  # EXTCODESIZE
+                ("push", 0), 0x52,
+                ("push", 4), ("push", 0), ("push", 60),
+                ("push", int.from_bytes(other, "big")), 0x3C,  # EXTCODECOPY
+                ("push", 64), ("push", 0), 0xF3)
+    res, _ = _run(code, state=state, gas=100_000)
+    assert res.success
+    assert int.from_bytes(res.output[:32], "big") == 4
+    assert res.output[32 + 28:32 + 32] == b"\xde\xad\xbe\xef"
+
+
+def test_modexp_zero_modulus_and_empty_output():
+    # modulus 0 -> zero-filled output of m_len
+    data = ((1).to_bytes(32, "big") + (1).to_bytes(32, "big")
+            + (4).to_bytes(32, "big") + b"\x03" + b"\x05"
+            + b"\x00\x00\x00\x00")
+    res = _call_precompile(5, data)
+    assert res.success and res.output == b"\x00" * 4
+
+
+def test_stack_limit_enforced():
+    code = _asm(*[("push", 1)] * 1025)
+    res, _ = _run(code, gas=10_000)
+    assert not res.success
+
+
+def test_create_inside_staticcall_is_blocked():
+    state = StateDB()
+    creator = b"\xaa" * 20
+    _install(state, creator,
+             _asm(("push", 0), ("push", 0), ("push", 0), 0xF0, 0x00))
+    code = _asm(("push", 0), ("push", 0), ("push", 0), ("push", 0),
+                ("push", int.from_bytes(creator, "big")),
+                ("push", 200_000), 0xFA,
+                ("push", 0), 0x52, ("push", 32), ("push", 0), 0xF3)
+    res, vm = _run(code, state=state, gas=500_000)
+    assert res.success
+    assert int.from_bytes(res.output, "big") == 0  # inner frame aborted
+    assert vm.state.get(creator).nonce == 0        # no CREATE happened
