@@ -66,6 +66,16 @@ CONFIGS = [
     {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "scan",
      "GETHSHARDING_TPU_FINALEXP": "mega", "GETHSHARDING_TPU_MILLER": "mega",
      "GETHSHARDING_TPU_AGG": "mega"},
+    # mega kernels composed over the slices conv ambient (the r4 TPU
+    # sweep's non-mega champion) — the non-pairing remainder of the
+    # dispatch also runs its fastest measured form
+    {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "scan",
+     "GETHSHARDING_TPU_CONV": "slices",
+     "GETHSHARDING_TPU_FINALEXP": "mega", "GETHSHARDING_TPU_MILLER": "mega",
+     "GETHSHARDING_TPU_AGG": "mega"},
+    {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "scan",
+     "GETHSHARDING_TPU_CONV": "slices",
+     "GETHSHARDING_TPU_FINALEXP": "mega", "GETHSHARDING_TPU_MILLER": "mega"},
     {"GETHSHARDING_TPU_LIMB_FORM": "wide", "GETHSHARDING_TPU_NORM": "relaxed",
      "GETHSHARDING_TPU_FINALEXP": "mega"},
     # r3 additions, probed right after the champion: the statically

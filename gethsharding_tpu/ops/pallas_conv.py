@@ -142,7 +142,12 @@ def pair_conv_combine(x: jnp.ndarray, y: jnp.ndarray, comb: np.ndarray,
     B = y.shape[-2]
     _, _, _, C, Gr = comb.shape
     ncols = 2 * NL - 1
-    lead = x.shape[:-3]
+    # the XLA fallback broadcast-multiplies, so callers may pass one
+    # operand with fewer leading dims (e.g. a constant against a batch);
+    # broadcast both to the common lead before flattening
+    lead = jnp.broadcast_shapes(x.shape[:-3], y.shape[:-3])
+    x = jnp.broadcast_to(x, lead + x.shape[-3:])
+    y = jnp.broadcast_to(y, lead + y.shape[-3:])
     n = 1
     for d in lead:
         n *= d

@@ -268,9 +268,14 @@ def _fp12_mul(x, y, C: Consts):
     cols = _conv(xe, ve)                       # (..., 6, 6, 2, 2, 49, B)
     re = cols[..., 0, 0, :, :] - cols[..., 1, 1, :, :]   # (..., 6, 6, 49, B)
     im = cols[..., 0, 1, :, :] + cols[..., 1, 0, :, :]
-    # group pairs of i: g = i // 2  -> (..., 6, 3, 49, B)
-    re_g = re[..., 0::2, :, :] + re[..., 1::2, :, :]
-    im_g = im[..., 0::2, :, :] + im[..., 1::2, :, :]
+    # group pairs of i: g = i // 2  -> (..., 6, 3, 49, B). Strided
+    # middle-axis slices (re[..., 0::2, :, :]) lower to lax.gather,
+    # which Mosaic rejects (>2D); a leading-dim reshape + static index
+    # is the supported spelling of the same pairing.
+    re_p = re.reshape(re.shape[:-3] + (3, 2) + re.shape[-2:])
+    im_p = im.reshape(im.shape[:-3] + (3, 2) + im.shape[-2:])
+    re_g = re_p[..., 0, :, :] + re_p[..., 1, :, :]
+    im_g = im_p[..., 0, :, :] + im_p[..., 1, :, :]
     acc = jnp.stack([re_g, im_g], axis=-4)     # (..., 6, 2c, 3g, 49, B)
     acc = acc + C.mulpad
     parts = _normalize(acc, C)                 # (..., 6, 2, 3, 25, B)

@@ -1,0 +1,11 @@
+#!/bin/bash
+# The four-launch audit dispatch (all mega-kernels) over the slices
+# conv ambient — today's sweep champion — so the non-pairing remainder
+# of the dispatch also runs its fastest measured form.
+cd /root/repo || exit 1
+env GETHSHARDING_TPU_LIMB_FORM=exact GETHSHARDING_TPU_CARRY=scan \
+    GETHSHARDING_TPU_CONV=slices \
+    GETHSHARDING_TPU_FINALEXP=mega GETHSHARDING_TPU_MILLER=mega \
+    GETHSHARDING_TPU_AGG=mega \
+  timeout 4800 python bench.py --single >"$1.out" 2>"$1.err"
+grep -q sig_rate "$1.out" && grep -q '"platform": "tpu' "$1.out"

@@ -95,6 +95,26 @@ def test_pair_conv_combine_partial_block_and_leading_dims():
     assert (want == got).all()
 
 
+def test_pair_conv_combine_broadcast_operand():
+    """One operand with FEWER leading dims (a constant against a batch)
+    broadcasts exactly like the XLA fallback — the r4 TPU probe failure
+    shape: a batched x against an unbatched Frobenius/line constant y."""
+    from gethsharding_tpu.ops import bn256_jax as k
+    from gethsharding_tpu.ops.pallas_conv import pair_conv_combine
+
+    rng = np.random.default_rng(23)
+    G, A, B, _, _ = k._COMB_FP2.shape
+    xb = rng.integers(0, 1 << 12, (5, G, A, limb.NLIMBS)).astype(np.int32)
+    yc = rng.integers(0, 1 << 12, (G, B, limb.NLIMBS)).astype(np.int32)
+    for x, y in ((xb, yc), (yc, xb)):
+        want = np.asarray(_xla_pair_conv(
+            jnp.asarray(x), jnp.asarray(y), k._COMB_FP2))
+        got = np.asarray(pair_conv_combine(
+            jnp.asarray(x), jnp.asarray(y), k._COMB_FP2, interpret=True))
+        assert want.shape == got.shape
+        assert (want == got).all()
+
+
 def test_pair_conv_combine_identity_comb_mul_many():
     """The identity combine (n independent products in one kernel call)
     matches n separate schoolbook products bit-for-bit — the G1

@@ -386,11 +386,15 @@ def test_selfdestruct_to_fresh_heir_charges_newaccount():
 
 
 def test_memory_expansion_gas_is_quadratic_exact():
-    # MSTORE at offset 0: 1 word -> 3; at 31*32: 32 words ->
-    # 3*32 + 32*32//512 = 98; charged incrementally
-    code = _asm(("push", 1), ("push", 992), 0x52, 0x00)
+    # MSTORE at 992 expands to 32 words: mem cost 3*32 + 32*32//512 = 98.
+    # The second MSTORE at offset 0 fits inside the already-paid region,
+    # so it must charge ZERO memory gas — expansion is charged on the
+    # delta, not re-charged per touch.
+    code = _asm(("push", 1), ("push", 992), 0x52,
+                ("push", 1), ("push", 0), 0x52, 0x00)
     res, _ = _run(code, gas=10_000)
-    want = 3 + 3 + 3 + (3 * 32 + 32 * 32 // 512)  # pushes + MSTORE + mem
+    mem = 3 * 32 + 32 * 32 // 512
+    want = (3 + 3 + 3 + mem) + (3 + 3 + 3)  # second MSTORE: no mem gas
     assert res.success and 10_000 - res.gas_left == want
 
 
@@ -410,14 +414,19 @@ def test_callcode_uses_callers_storage():
 
 def test_blockhash_window_and_env():
     env = Env(number=300, timestamp=777)
-    # NUMBER, TIMESTAMP, BLOCKHASH(number-1), BLOCKHASH(number-257)=0
+    # BLOCKHASH(number-1), BLOCKHASH far outside the 256 window -> 0,
+    # NUMBER and TIMESTAMP straight from the env
     code = _asm(("push", 299), 0x40, ("push", 0), 0x52,
                 ("push", 43), 0x40, ("push", 32), 0x52,
-                ("push", 64), ("push", 0), 0xF3)
+                0x43, ("push", 64), 0x52,        # NUMBER
+                0x42, ("push", 96), 0x52,        # TIMESTAMP
+                ("push", 128), ("push", 0), 0xF3)
     res, _ = _run(code, env=env, gas=100_000)
     assert res.success
     assert res.output[:32] == env.blockhash(299)
-    assert res.output[32:] == b"\x00" * 32   # outside the 256 window
+    assert res.output[32:64] == b"\x00" * 32   # outside the 256 window
+    assert int.from_bytes(res.output[64:96], "big") == 300
+    assert int.from_bytes(res.output[96:128], "big") == 777
 
 
 def test_returndatacopy_out_of_bounds_is_exceptional():
@@ -449,6 +458,11 @@ def test_modexp_zero_modulus_and_empty_output():
             + b"\x00\x00\x00\x00")
     res = _call_precompile(5, data)
     assert res.success and res.output == b"\x00" * 4
+    # m_len 0 -> EMPTY output (not a zero word)
+    data = ((1).to_bytes(32, "big") + (1).to_bytes(32, "big")
+            + (0).to_bytes(32, "big") + b"\x03" + b"\x05")
+    res = _call_precompile(5, data)
+    assert res.success and res.output == b""
 
 
 def test_stack_limit_enforced():
