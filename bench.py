@@ -285,6 +285,10 @@ def measure_single() -> dict:
         "sig_rate": round(sig_rate, 1),
         "dispatch_s": round(dispatch, 4),
         "audit_wall_s": round(wall, 4),
+        # GETHSHARDING_SIG_TIMING=1: host-marshal / transfer / device
+        # split of the last dispatch (see sigbackend.last_timing)
+        **({"sig_timing": notary.sig_backend.last_timing}
+           if os.environ.get("GETHSHARDING_SIG_TIMING") == "1" else {}),
         # the active kernel knobs, so probe outputs are self-describing
         # (scripts/tpu_pick_winner.py rebuilds the autotune cache from
         # the best probe)
@@ -322,7 +326,7 @@ def _measure_extras(dispatch_s: float) -> dict:
     t0 = time.perf_counter()
     for _ in range(3):
         r = fn(*args)
-    r.block_until_ready()
+    np.asarray(r)  # device->host pull: block_until_ready can no-op
     out["config1_pairing_check_s"] = round((time.perf_counter() - t0) / 3, 4)
 
     # config 2: ONE 135-vote aggregate (batch 1 of the BLS kernel)
@@ -341,7 +345,7 @@ def _measure_extras(dispatch_s: float) -> dict:
     t0 = time.perf_counter()
     for _ in range(3):
         r = fn2(*args2)
-    r.block_until_ready()
+    np.asarray(r)  # device->host pull: block_until_ready can no-op
     out["config2_aggregate_verify_s"] = round((time.perf_counter() - t0) / 3,
                                               4)
 
@@ -365,7 +369,7 @@ def _measure_extras(dispatch_s: float) -> dict:
     t0 = time.perf_counter()
     for _ in range(3):
         out4 = replay_jax.replay_batch(inp)
-    jax.block_until_ready(out4)
+    jax.device_get(out4)  # real pull: block_until_ready can no-op
     dt = (time.perf_counter() - t0) / 3
     out["config4_replay_txs_per_s"] = round(n_txs / dt, 1)
 
@@ -383,10 +387,10 @@ def _measure_extras(dispatch_s: float) -> dict:
             committee_size=COMMITTEE)
         pipe = StressPipeline(config=Config(), mesh=None)
         res = pipe.run(inputs, pool, bh, 1, sample_size)
-        jax.block_until_ready(res.roots)
+        jax.device_get(res.roots)
         t0 = time.perf_counter()
         res = pipe.run(inputs, pool, bh, 1, sample_size)
-        jax.block_until_ready(res.roots)
+        jax.device_get(res.roots)  # real pull: block_until_ready can no-op
         dt = time.perf_counter() - t0
         out["config5_stress_shards_per_s"] = round(n_shards / dt, 1)
     return out

@@ -967,9 +967,14 @@ def _agg_kernel(xs_ref, ys_ref, mask_ref, b3_ref,
     C = Consts(fold_t=c_fold[:], lift=c_lift[:], mulpad=c_mulpad[:],
                fp2pad=c_fp2pad[:], negpad=c_negpad[:], gamma=c_gamma[:],
                linepad=c_linepad[:], one12=c_one12[:])
-    xs = xs_ref[:]                     # (Cp, [2,] 25, B)
-    ys = ys_ref[:]
-    m = mask_ref[:]                    # (Cp, 1, B) | (Cp, 1, 1, B)
+    # data refs carry a leading size-1 lane-group axis (the grid axis):
+    # Mosaic requires a block's LANE dim to be 128-divisible or equal
+    # the array's, so lanes are pre-split host-side into (groups, 64)
+    # and the grid walks groups (r4 TPU probe: block 64 over a 128-lane
+    # array is rejected)
+    xs = xs_ref[0]                     # (Cp, [2,] 25, B)
+    ys = ys_ref[0]
+    m = mask_ref[0]                    # (Cp, 1, B) | (Cp, 1, 1, B)
     one_limb = (C.one12[0] if fp2 else C.one12[0, 0])  # (2,25,1)|(25,1)
     one = jnp.broadcast_to(one_limb, xs.shape[1:]).astype(jnp.int32)
     px = jnp.where(m != 0, xs, 0)
@@ -977,9 +982,9 @@ def _agg_kernel(xs_ref, ys_ref, mask_ref, b3_ref,
     pz = jnp.where(m != 0, one, jnp.zeros_like(one))
     b3 = b3_ref[:] if fp2 else g1_b3
     X, Y, Z = _agg_tree(px, py, pz, C, fp2=fp2, b3=b3)
-    ox_ref[:] = X
-    oy_ref[:] = Y
-    oz_ref[:] = Z
+    ox_ref[0] = X
+    oy_ref[0] = Y
+    oz_ref[0] = Z
 
 
 @functools.lru_cache(maxsize=16)
@@ -997,8 +1002,11 @@ def _agg_compiled(cp: int, fp2: bool, interpret: bool):
 
     @jax.jit
     def run(xs, ys, mask):
-        n = xs.shape[-1]
-        grid = (n // AGG_LANES,)
+        # data arrays arrive as (groups, ..., AGG_LANES): the lane axis
+        # is pre-split so each block's lane dim EQUALS the array's (the
+        # Mosaic block-shape rule), and the grid walks the group axis
+        g = xs.shape[0]
+        grid = (g,)
         from jax.experimental.pallas import tpu as pltpu
 
         def whole(shape):
@@ -1006,9 +1014,9 @@ def _agg_compiled(cp: int, fp2: bool, interpret: bool):
             return pl.BlockSpec(shape, lambda i, _r=rank: (0,) * _r)
 
         def data(shape):
-            rank = len(shape) + 1
-            return pl.BlockSpec(shape + (AGG_LANES,),
-                                lambda i, _r=rank: (0,) * (_r - 1) + (i,))
+            rank = len(shape) + 2
+            return pl.BlockSpec((1,) + shape + (AGG_LANES,),
+                                lambda i, _r=rank: (i,) + (0,) * (_r - 1))
 
         out_specs = [data(out_shape)] * 3
         return pl.pallas_call(
@@ -1018,8 +1026,8 @@ def _agg_compiled(cp: int, fp2: bool, interpret: bool):
                       data(mask_shape), whole(b3g2.shape)]
             + [whole(np.asarray(c).shape) for c in _NP_CONSTS],
             out_specs=out_specs,
-            out_shape=[jax.ShapeDtypeStruct(out_shape + (n,), jnp.int32)
-                       ] * 3,
+            out_shape=[jax.ShapeDtypeStruct((g,) + out_shape + (AGG_LANES,),
+                                            jnp.int32)] * 3,
             interpret=interpret,
         )(xs, ys, mask, jnp.asarray(b3g2),
           *(jnp.asarray(c) for c in _NP_CONSTS))
@@ -1057,7 +1065,12 @@ def aggregate_proj(xs, ys, mask, *, fp2: bool, interpret: bool = False):
         if pad:
             v = jnp.concatenate(
                 [v, jnp.zeros(v.shape[:-1] + (pad,), v.dtype)], axis=-1)
-        return v
+        # split lanes into (groups, AGG_LANES) and lead with the group
+        # axis: each pallas block's lane dim then EQUALS its array's
+        # lane dim (Mosaic's block-shape rule; see _agg_compiled)
+        groups = v.shape[-1] // AGG_LANES
+        v = v.reshape(v.shape[:-1] + (groups, AGG_LANES))
+        return jnp.moveaxis(v, -2, 0)           # (g, Cp, ..., 64)
 
     xs_t = prep(jnp.asarray(xs), 0)
     ys_t = prep(jnp.asarray(ys), 0)
@@ -1066,6 +1079,8 @@ def aggregate_proj(xs, ys, mask, *, fp2: bool, interpret: bool = False):
     out = _agg_compiled(cp, fp2, interpret)(xs_t, ys_t, m_t)
     res = []
     for v in out:
+        v = jnp.moveaxis(v, 0, -2)              # (out..., g, 64)
+        v = v.reshape(v.shape[:-2] + (v.shape[-2] * AGG_LANES,))
         if (-n) % AGG_LANES:
             v = v[..., :n]
         v = jnp.moveaxis(v, -1, 0).reshape(lead + v.shape[:-1])

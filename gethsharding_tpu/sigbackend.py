@@ -19,6 +19,7 @@ the CLI exposes ``--sigbackend``.
 
 from __future__ import annotations
 
+import os
 from typing import List, Optional, Sequence, Tuple
 
 from gethsharding_tpu.crypto import bn256 as bls
@@ -201,8 +202,12 @@ class JaxSigBackend(SigBackend):
 
     def bls_verify_committees(self, messages, sig_rows, pk_rows,
                               pk_row_keys=None):
+        import time
+
         import numpy as np
 
+        timing = os.environ.get("GETHSHARDING_SIG_TIMING") == "1"
+        t0 = time.perf_counter()
         jnp = self._jnp
         n = len(messages)
         if n == 0:
@@ -224,11 +229,37 @@ class JaxSigBackend(SigBackend):
             list(pk_rows) + [[]] * pad, width,
             row_keys=(None if pk_row_keys is None
                       else list(pk_row_keys) + [None] * pad))
-        out = self._bls_committee(
-            jnp.asarray(hx), jnp.asarray(hy), jnp.asarray(sx),
-            jnp.asarray(sy), jnp.asarray(sm), jnp.asarray(px),
-            jnp.asarray(py), jnp.asarray(pm), jnp.asarray(hok))
-        return [bool(b) for b in np.asarray(out)[:n]]
+        t1 = time.perf_counter()
+        args = (jnp.asarray(hx), jnp.asarray(hy), jnp.asarray(sx),
+                jnp.asarray(sy), jnp.asarray(sm), jnp.asarray(px),
+                jnp.asarray(py), jnp.asarray(pm), jnp.asarray(hok))
+        if timing:
+            # force EVERY host->device transfer to completion (one tiny
+            # element pull per buffer waits on that buffer; plain
+            # block_until_ready can no-op under the tunnel plugin) so
+            # the dispatch phase times only the kernel + result pull
+            for a in args:
+                np.asarray(a.ravel()[0])
+            t2 = time.perf_counter()
+        out = self._bls_committee(*args)
+        res = [bool(b) for b in np.asarray(out)[:n]]
+        if timing:
+            t3 = time.perf_counter()
+            # per-instance: two backends in one process must not clobber
+            # each other's split
+            self.last_timing = {
+                "prep_s": round(t1 - t0, 4),
+                "transfer_s": round(t2 - t1, 4),
+                "dispatch_s": round(t3 - t2, 4),
+                "rows": n, "width": width,
+            }
+        return res
+
+    # populated by bls_verify_committees under GETHSHARDING_SIG_TIMING=1:
+    # host marshalling vs tunnel transfer vs device dispatch of the LAST
+    # audit call — the split that decides which side of the dispatch
+    # boundary the next optimization belongs to
+    last_timing: dict | None = None
 
     # -- pubkey-row limb cache ---------------------------------------------
     # Committee PUBKEYS recur period after period (registered keys are

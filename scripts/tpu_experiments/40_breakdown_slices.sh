@@ -1,0 +1,8 @@
+#!/bin/bash
+# Stage breakdown under the r4 sweep champion (slices conv), with the
+# fixed pull-forced timing ($1 = out prefix).
+cd /root/repo || exit 1
+env GETHSHARDING_TPU_LIMB_FORM=exact GETHSHARDING_TPU_CARRY=scan \
+    GETHSHARDING_TPU_CONV=slices \
+  timeout 2400 python scripts/tpu_breakdown.py >"$1.json" 2>"$1.err"
+grep -q stage_seconds "$1.json" && grep -q '"platform": "tpu' "$1.json"

@@ -1,0 +1,11 @@
+#!/bin/bash
+# Stage breakdown with all three mega-kernels active over the slices
+# ambient: attributes whatever remains of the dispatch after the
+# aggregation/Miller/final-exp stages each collapse to one launch.
+cd /root/repo || exit 1
+env GETHSHARDING_TPU_LIMB_FORM=exact GETHSHARDING_TPU_CARRY=scan \
+    GETHSHARDING_TPU_CONV=slices \
+    GETHSHARDING_TPU_FINALEXP=mega GETHSHARDING_TPU_MILLER=mega \
+    GETHSHARDING_TPU_AGG=mega \
+  timeout 3600 python scripts/tpu_breakdown.py >"$1.json" 2>"$1.err"
+grep -q stage_seconds "$1.json" && grep -q '"platform": "tpu' "$1.json"

@@ -1,0 +1,10 @@
+#!/bin/bash
+# Host-marshal / tunnel-transfer / device-dispatch split of the audit
+# call under the champion knobs: decides whether the next lever belongs
+# on the device side (kernels) or the host side (marshalling, transfer
+# width, device-resident rows).
+cd /root/repo || exit 1
+env GETHSHARDING_TPU_LIMB_FORM=exact GETHSHARDING_TPU_CARRY=scan \
+    GETHSHARDING_TPU_CONV=slices GETHSHARDING_SIG_TIMING=1 \
+  timeout 4800 python bench.py --single >"$1.out" 2>"$1.err"
+grep -q sig_timing "$1.out" && grep -q '"platform": "tpu' "$1.out"
