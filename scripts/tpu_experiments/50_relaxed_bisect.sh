@@ -1,0 +1,9 @@
+#!/bin/bash
+# Find the first field op where wide/relaxed diverges from the host
+# goldens on this backend (passes on CPU, fails the audit gate on TPU —
+# r4). $1 = out prefix.
+cd /root/repo || exit 1
+env GETHSHARDING_TPU_LIMB_FORM=wide GETHSHARDING_TPU_NORM=relaxed \
+  timeout 3600 python scripts/tpu_relaxed_bisect.py >"$1.json" 2>"$1.err"
+rc=$?
+[ $rc -eq 0 ] && grep -Eq '"platform": "(tpu|axon)' "$1.json"

@@ -1,0 +1,135 @@
+"""Bisect the relaxed-normalize wrong-result on the live backend.
+
+r4 finding: `GETHSHARDING_TPU_LIMB_FORM=wide GETHSHARDING_TPU_NORM=
+relaxed` fails the audit correctness gate on TPU (every shard's
+aggregate rejected) while the IDENTICAL knobs pass on CPU at the same
+shape — a backend-specific numeric divergence, not a bound violation.
+This probe runs the field stack bottom-up under the ambient knobs and
+compares every stage against host scalar bigint goldens, printing the
+FIRST diverging stage: the r5 fix (or the formal parking justification)
+starts from that op instead of the whole dispatch.
+
+Run under the relaxed env:
+  GETHSHARDING_TPU_LIMB_FORM=wide GETHSHARDING_TPU_NORM=relaxed \
+    python scripts/tpu_relaxed_bisect.py
+Prints ONE JSON line {platform, stages: {name: ok}, first_bad}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    from gethsharding_tpu.parallel.virtual import configure_compile_cache
+
+    configure_compile_cache()
+
+    import jax
+    import jax.numpy as jnp
+
+    from gethsharding_tpu.crypto import bn256 as ref
+    from gethsharding_tpu.ops import bn256_jax as k
+    from gethsharding_tpu.ops.limb import NLIMBS, ints_to_limbs, limbs_to_int
+
+    P = ref.P
+    rng = np.random.default_rng(1234)
+    out = {"platform": jax.devices()[0].platform,
+           "knobs": {key: val for key, val in os.environ.items()
+                     if key.startswith("GETHSHARDING_TPU_")}}
+
+    def rand_fp(n):
+        # full 32-byte range mod P: the top-limb carry paths are the most
+        # likely home of a relaxed-normalization bound bug
+        return [int.from_bytes(rng.bytes(32), "big") % P for _ in range(n)]
+
+    def to_limbs(vals):
+        return jnp.asarray(ints_to_limbs(vals, NLIMBS))
+
+    def ints_of(arr):
+        arr = np.asarray(k.FP.canon(jnp.asarray(arr)))
+        flat = arr.reshape(-1, arr.shape[-1])
+        return [limbs_to_int(row) % P for row in flat]
+
+    stages = {}
+    first_bad = None
+    B = 16
+
+    def check(name, got_limbs, want_ints):
+        nonlocal first_bad
+        ok = ints_of(got_limbs) == [w % P for w in want_ints]
+        stages[name] = bool(ok)
+        if not ok and first_bad is None:
+            first_bad = name
+        return ok
+
+    xs, ys = rand_fp(B), rand_fp(B)
+    xa, ya = to_limbs(xs), to_limbs(ys)
+
+    # 1: one normalize of a plain canonical value (identity)
+    check("normalize_identity", jax.jit(k.FP.normalize)(xa), xs)
+    # 2: add -> normalize
+    check("add", jax.jit(lambda a, b: k.FP.normalize(a + b))(xa, ya),
+          [a + b for a, b in zip(xs, ys)])
+    # 3: sub (negative intermediates + pad lift)
+    check("sub", jax.jit(k.FP.sub)(xa, ya),
+          [a - b for a, b in zip(xs, ys)])
+    # 4: single product (fold matrix + relaxed rounds)
+    check("mul", jax.jit(k.FP.mul)(xa, ya),
+          [a * b for a, b in zip(xs, ys)])
+    # 5: product CHAIN (quasi-canonical inputs feeding the next mul —
+    # the case the one-shot tests miss)
+    def chain(a, b):
+        c = k.FP.mul(a, b)
+        d = k.FP.mul(c, a)
+        return k.FP.mul(d, c)
+    check("mul_chain", jax.jit(chain)(xa, ya),
+          [((a * b % P) * a % P) * (a * b % P) for a, b in zip(xs, ys)])
+    # 6: fp2 mul with four INDEPENDENT components (a symmetric operand
+    # pair makes the real part identically zero and hides cancellation
+    # bugs in the subtracting path)
+    cs, ds = rand_fp(B), rand_fp(B)
+    ca, da = to_limbs(cs), to_limbs(ds)
+    f2a = jnp.stack([xa, ya], axis=-2)
+    f2b = jnp.stack([ca, da], axis=-2)
+    got = jax.jit(k.fp2_mul)(f2a, f2b)
+    want = []
+    for a, b, c, d in zip(xs, ys, cs, ds):
+        want.extend([(a * c - b * d) % P, (a * d + b * c) % P])
+    check("fp2_mul", got, want)
+    # 7: fp2 square
+    got = jax.jit(k.fp2_sqr)(f2a)
+    want = []
+    for a, b in zip(xs, ys):
+        want.extend([(a * a - b * b) % P, (2 * a * b) % P])
+    check("fp2_sqr", got, want)
+    # 8: full pairing check on a protocol-valid product (the gate that
+    # fails in the audit)
+    sk = 987654321
+    p1 = ref.g1_mul(sk, ref.G1_GEN)
+    q2 = ref.g2_mul(sk, ref.G2_GEN)
+    px, py, _ = k.g1_to_limbs([p1, ref.g1_neg(ref.G1_GEN)])
+    qx, qy, _ = k.g2_to_limbs([ref.G2_GEN, q2])
+    got = jax.jit(k.pairing_check)(
+        jnp.asarray(px)[None], jnp.asarray(py)[None],
+        jnp.asarray(qx)[None], jnp.asarray(qy)[None],
+        jnp.ones((1, 2), bool))
+    ok = bool(np.asarray(got)[0])
+    stages["pairing_check_valid"] = ok
+    if not ok and first_bad is None:
+        first_bad = "pairing_check_valid"
+
+    out["stages"] = stages
+    out["first_bad"] = first_bad
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
