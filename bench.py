@@ -76,6 +76,11 @@ CONFIGS = [
     {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "scan",
      "GETHSHARDING_TPU_CONV": "slices",
      "GETHSHARDING_TPU_FINALEXP": "mega", "GETHSHARDING_TPU_MILLER": "mega"},
+    # the uint16 wire format: halves host->device transfer bytes (12-bit
+    # limbs in int32 waste 20 bits); widened on device, value-identical
+    {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "scan",
+     "GETHSHARDING_TPU_FINALEXP": "mega", "GETHSHARDING_TPU_MILLER": "mega",
+     "GETHSHARDING_TPU_WIRE": "u16"},
     {"GETHSHARDING_TPU_LIMB_FORM": "wide", "GETHSHARDING_TPU_NORM": "relaxed",
      "GETHSHARDING_TPU_FINALEXP": "mega"},
     # r3 additions, probed right after the champion: the statically

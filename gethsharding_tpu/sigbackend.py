@@ -112,6 +112,21 @@ class JaxSigBackend(SigBackend):
         self._bls = jax.jit(bn256_jax.bls_verify_aggregate_batch)
         self._bls_committee = jax.jit(
             bn256_jax.bls_aggregate_verify_committee_batch)
+        # GETHSHARDING_TPU_WIRE=u16: ship limb planes over the
+        # host->device link as uint16 (12-bit limbs waste 20 of 32 bits;
+        # halves the audit's transfer bytes over the tunnel) and widen
+        # to int32 ON DEVICE before the kernel — value-identical, the
+        # wire format never reaches the arithmetic
+        self._wire_u16 = os.environ.get("GETHSHARDING_TPU_WIRE") == "u16"
+
+        def _committee_u16(hx, hy, sx, sy, sm, px, py, pm, hok):
+            i32 = jnp.int32
+            return bn256_jax.bls_aggregate_verify_committee_batch(
+                hx.astype(i32), hy.astype(i32), sx.astype(i32),
+                sy.astype(i32), sm, px.astype(i32), py.astype(i32),
+                pm, hok)
+
+        self._bls_committee_u16 = jax.jit(_committee_u16)
         # the backend is a process-wide singleton shared by every actor
         # thread (get_backend caches instances): the row cache needs a
         # lock or concurrent audits race the eviction loop
@@ -230,9 +245,19 @@ class JaxSigBackend(SigBackend):
             row_keys=(None if pk_row_keys is None
                       else list(pk_row_keys) + [None] * pad))
         t1 = time.perf_counter()
-        args = (jnp.asarray(hx), jnp.asarray(hy), jnp.asarray(sx),
-                jnp.asarray(sy), jnp.asarray(sm), jnp.asarray(px),
-                jnp.asarray(py), jnp.asarray(pm), jnp.asarray(hok))
+        if self._wire_u16:
+            # px/py already arrive uint16 from the cache-aware pk path;
+            # the remaining casts are the fresh-per-period buffers
+            def narrow(a):
+                return jnp.asarray(np.asarray(a, np.uint16))
+
+            args = (narrow(hx), narrow(hy), narrow(sx), narrow(sy),
+                    jnp.asarray(sm), narrow(px), narrow(py),
+                    jnp.asarray(pm), jnp.asarray(hok))
+        else:
+            args = (jnp.asarray(hx), jnp.asarray(hy), jnp.asarray(sx),
+                    jnp.asarray(sy), jnp.asarray(sm), jnp.asarray(px),
+                    jnp.asarray(py), jnp.asarray(pm), jnp.asarray(hok))
         if timing:
             # force EVERY host->device transfer to completion (one tiny
             # element pull per buffer waits on that buffer; plain
@@ -241,7 +266,9 @@ class JaxSigBackend(SigBackend):
             for a in args:
                 np.asarray(a.ravel()[0])
             t2 = time.perf_counter()
-        out = self._bls_committee(*args)
+        fn = (self._bls_committee_u16 if self._wire_u16
+              else self._bls_committee)
+        out = fn(*args)
         res = [bool(b) for b in np.asarray(out)[:n]]
         if timing:
             t3 = time.perf_counter()
@@ -283,8 +310,12 @@ class JaxSigBackend(SigBackend):
         cache = self._pk_row_cache
         nl = int(np.asarray(self._bn.FP.one).shape[-1])
         B = len(rows)
-        xs = np.zeros((B, width, 2, nl), np.int32)
-        ys = np.zeros((B, width, 2, nl), np.int32)
+        # under the u16 wire the pk planes — the audit's largest buffers
+        # — are assembled (and cached) as uint16 at MISS time, so cache
+        # hits skip the narrowing copy entirely (limbs are 12-bit)
+        dtype = np.uint16 if self._wire_u16 else np.int32
+        xs = np.zeros((B, width, 2, nl), dtype)
+        ys = np.zeros((B, width, 2, nl), dtype)
         mask = np.zeros((B, width), bool)
         misses = []  # (b, key, row) — bulk-converted in ONE pass below
         for b, row in enumerate(rows):
@@ -321,8 +352,10 @@ class JaxSigBackend(SigBackend):
                             # FIFO: evict one stale row, not all of them
                             cache.pop(next(iter(cache)))
                         # copies, not views: a view would pin the whole
-                        # bulk conversion array per cached row
-                        cache[key] = (mx[i, :k].copy(), my[i, :k].copy(),
+                        # bulk conversion array per cached row (astype
+                        # copies; it also narrows under the u16 wire)
+                        cache[key] = (mx[i, :k].astype(dtype),
+                                      my[i, :k].astype(dtype),
                                       mm[i, :k].copy())
         return xs, ys, mask
 

@@ -1,0 +1,11 @@
+#!/bin/bash
+# The two-launch mega pairing with the uint16 wire format (halves the
+# audit's host->device bytes) + the marshal/transfer/dispatch split in
+# one probe: measures the rate AND attributes where the win (if any)
+# lands.
+cd /root/repo || exit 1
+env GETHSHARDING_TPU_LIMB_FORM=exact GETHSHARDING_TPU_CARRY=scan \
+    GETHSHARDING_TPU_FINALEXP=mega GETHSHARDING_TPU_MILLER=mega \
+    GETHSHARDING_TPU_WIRE=u16 GETHSHARDING_SIG_TIMING=1 \
+  timeout 4800 python bench.py --single >"$1.out" 2>"$1.err"
+grep -q sig_rate "$1.out" && grep -q '"platform": "tpu' "$1.out"

@@ -146,6 +146,43 @@ def test_bls_committee_backends_agree():
     assert py == jx == [True, True, True]
 
 
+def test_bls_committee_u16_wire_verdict_identical(monkeypatch):
+    """GETHSHARDING_TPU_WIRE=u16 ships limb planes as uint16 and widens
+    on device — verdicts must be identical to the int32 wire, including
+    the tampered-row reject."""
+    from gethsharding_tpu.sigbackend import JaxSigBackend
+
+    monkeypatch.setenv("GETHSHARDING_TPU_WIRE", "u16")
+    backend = JaxSigBackend()
+    assert backend._wire_u16
+    msgs, sig_rows, pk_rows = [], [], []
+    for i in range(3):
+        tag = b"wire-%d" % i
+        keys = [bls.bls_keygen(tag + bytes([j])) for j in range(4)]
+        sigs = [bls.bls_sign(tag, sk) for sk, _ in keys]
+        if i == 1:
+            sigs[2] = bls.bls_sign(b"tampered", keys[2][0])
+        sig_rows.append(sigs)
+        pk_rows.append([pk for _, pk in keys])
+        msgs.append(tag)
+    got = backend.bls_verify_committees(msgs, sig_rows, pk_rows)
+    # oracle: the scalar python backend — get_backend("jax") here would
+    # construct (and cache process-wide) a u16-wired singleton while the
+    # env var is active, comparing u16 against itself
+    want = get_backend("python").bls_verify_committees(
+        msgs, sig_rows, pk_rows)
+    assert got == want == [True, False, True]
+    # pk-row cache under the u16 wire: entries are stored uint16 at miss
+    # time; the hit path must return identical verdicts
+    keys = [f"wire-row-{i}" for i in range(3)]
+    miss = backend.bls_verify_committees(msgs, sig_rows, pk_rows,
+                                         pk_row_keys=keys)
+    hit = backend.bls_verify_committees(msgs, sig_rows, pk_rows,
+                                        pk_row_keys=keys)
+    assert miss == hit == want
+    assert backend._pk_row_cache[keys[0]][0].dtype.name == "uint16"
+
+
 def test_bls_committee_pk_row_cache_consistency():
     """The pubkey-row limb cache (jax backend): warm calls with row keys
     return byte-identical verdicts to the keyless path, a changed row
