@@ -167,55 +167,86 @@ def _point_from_bytes(b) -> tuple:
     return (int.from_bytes(raw[:32], "big"), int.from_bytes(raw[32:], "big"))
 
 
-def _bench_identities():
-    """The deterministic identities + per-shard vote digests shared by the
-    cache builder and the chain builder (single source of truth: a drift
-    would silently invalidate the signature cache)."""
+def _bench_root(s: int, p: int):
+    """The deterministic per-(shard, period) collation root — ONE formula
+    shared by the identity builder and the cache-readiness gate (period 1
+    keeps the original single-period formula so old caches stay valid)."""
     from gethsharding_tpu.crypto.keccak import keccak256
-    from gethsharding_tpu.mainchain.accounts import AccountManager
-    from gethsharding_tpu.smc.state_machine import vote_digest
     from gethsharding_tpu.utils.hexbytes import Hash32
 
-    period = 1  # build_audit_workload asserts the chain lands here
+    return Hash32(keccak256(b"bench-root-%d" % s if p == 1
+                            else b"bench-root-%d-p%d" % (s, p)))
+
+
+def _bench_identities(k_periods: int = 1):
+    """The deterministic identities + per-shard vote digests shared by the
+    cache builder and the chain builder (single source of truth: a drift
+    would silently invalidate the signature cache). With k_periods > 1
+    the workload spans periods 1..K (the `audit_periods` catch-up form:
+    BASELINE's protocol-level batching lever); period 1 keeps its
+    original root formula so existing signature caches stay valid."""
+    from gethsharding_tpu.mainchain.accounts import AccountManager
+    from gethsharding_tpu.smc.state_machine import vote_digest
+
     manager = AccountManager()
     accounts = [manager.new_account(seed=b"bench-notary-%d" % i)
                 for i in range(COMMITTEE)]
-    roots = [Hash32(keccak256(b"bench-root-%d" % s)) for s in range(SHARDS)]
-    digests = [bytes(vote_digest(s, period, roots[s])) for s in range(SHARDS)]
-    return manager, accounts, roots, digests, period
+    periods = list(range(1, k_periods + 1))
+    roots, digests = {}, {}
+    for p in periods:
+        roots[p] = [_bench_root(s, p) for s in range(SHARDS)]
+        digests[p] = [bytes(vote_digest(s, p, roots[p][s]))
+                      for s in range(SHARDS)]
+    return manager, accounts, roots, digests, periods
 
 
-def _load_or_build_vote_sigs(accounts, manager, digests) -> np.ndarray:
-    """(SHARDS, COMMITTEE, 64) uint8 — every committee slot's signature
-    per shard digest, signed with the notary's real derived vote key."""
+def _load_or_build_vote_sigs(accounts, manager, digests) -> dict:
+    """{period: (SHARDS, COMMITTEE, 64) uint8} — every committee slot's
+    signature per shard digest, signed with the notary's real derived
+    vote key. Cached per period (period 1 under the original npz keys, so
+    pre-existing single-period caches are reused verbatim; building K=8
+    extends a K=4 cache instead of restarting it)."""
     path = _workload_path()
+    data: dict = {}
     try:
-        cached = np.load(path)
-        sigs = cached["vote_sigs"]
-        if (sigs.shape == (SHARDS, COMMITTEE, 64)
-                and bytes(cached["digest0"]) == digests[0]):
-            return sigs
-    except (OSError, KeyError, ValueError):
-        pass
-    print("# building vote-signature workload "
-          f"({SHARDS}x{COMMITTEE} BLS signs, ~3 min once)...", file=sys.stderr)
-    sigs = np.zeros((SHARDS, COMMITTEE, 64), np.uint8)
-    for s in range(SHARDS):
-        for i, acct in enumerate(accounts):
-            sig = manager.bls_sign(acct.address, digests[s])
-            sigs[s, i] = _point_to_bytes(sig)
-    try:
-        np.savez_compressed(path, vote_sigs=sigs,
-                            digest0=np.frombuffer(digests[0], np.uint8))
-    except OSError:
-        pass
-    return sigs
+        with np.load(path) as cached:
+            data = {key: cached[key] for key in cached.files}
+    except (OSError, ValueError):
+        data = {}
+    out, dirty = {}, False
+    for p in sorted(digests):
+        dg = digests[p]
+        skey, dkey = (("vote_sigs", "digest0") if p == 1
+                      else (f"vote_sigs_p{p}", f"digest0_p{p}"))
+        sigs = data.get(skey)
+        if (sigs is not None and sigs.shape == (SHARDS, COMMITTEE, 64)
+                and dkey in data and bytes(data[dkey]) == dg[0]):
+            out[p] = sigs
+            continue
+        print(f"# building vote-signature workload for period {p} "
+              f"({SHARDS}x{COMMITTEE} BLS signs, ~3 min once)...",
+              file=sys.stderr)
+        sigs = np.zeros((SHARDS, COMMITTEE, 64), np.uint8)
+        for s in range(SHARDS):
+            for i, acct in enumerate(accounts):
+                sig = manager.bls_sign(acct.address, dg[s])
+                sigs[s, i] = _point_to_bytes(sig)
+        data[skey] = sigs
+        data[dkey] = np.frombuffer(dg[0], np.uint8)
+        out[p] = sigs
+        dirty = True
+    if dirty:
+        try:
+            np.savez_compressed(path, **data)
+        except OSError:
+            pass
+    return out
 
 
-def build_audit_workload():
-    """A real chain at the end of a full 100-shard period: registry,
+def build_audit_workload(k_periods: int = 1):
+    """A real chain at the end of K full 100-shard periods: registry,
     records, and signed votes all built through protocol objects. Returns
-    (notary, period) ready for repeated audit_period calls."""
+    (notary, periods) ready for repeated audit_period(s) calls."""
     from gethsharding_tpu.actors.notary import Notary
     from gethsharding_tpu.core.shard import Shard
     from gethsharding_tpu.db.kv import MemoryKV
@@ -227,33 +258,35 @@ def build_audit_workload():
 
     config = Config()  # protocol-scale: 100 shards, committee 135
     chain = SimulatedMainchain(config=config)
-    manager, accounts, roots, digests, period = _bench_identities()
+    manager, accounts, roots, digests, periods = _bench_identities(k_periods)
     for acct in accounts:
         chain.fund(acct.address, 2000 * ETHER)
         chain.register_notary(
             acct.address, bls_pubkey=acct.bls_pubkey,
             bls_pop=manager.bls_proof_of_possession(acct.address))
-    chain.fast_forward(1)
-    assert chain.current_period() == period, "identity/digest drift"
-    proposer = manager.new_account(seed=b"bench-proposer")
-    for s in range(SHARDS):
-        chain.add_header(proposer.address, s, period, roots[s])
     sig_bytes = _load_or_build_vote_sigs(accounts, manager, digests)
-    for s in range(SHARDS):
-        record = chain.smc.collation_records[(s, period)]
-        for i, acct in enumerate(accounts):
-            record.vote_sigs[i] = VoteSig(
-                sig=_point_from_bytes(sig_bytes[s, i]), signer=acct.address)
-        record.vote_count = COMMITTEE
-        record.is_elected = True
-        chain.smc.last_approved_collation[s] = period
-    chain.fast_forward(1)  # close the period
+    proposer = manager.new_account(seed=b"bench-proposer")
+    for period in periods:
+        chain.fast_forward(1)
+        assert chain.current_period() == period, "identity/digest drift"
+        for s in range(SHARDS):
+            chain.add_header(proposer.address, s, period, roots[period][s])
+        for s in range(SHARDS):
+            record = chain.smc.collation_records[(s, period)]
+            for i, acct in enumerate(accounts):
+                record.vote_sigs[i] = VoteSig(
+                    sig=_point_from_bytes(sig_bytes[period][s, i]),
+                    signer=acct.address)
+            record.vote_count = COMMITTEE
+            record.is_elected = True
+            chain.smc.last_approved_collation[s] = period
+    chain.fast_forward(1)  # close the last period
 
     client = SMCClient(backend=chain, accounts=manager, account=accounts[0],
                        config=config)
     notary = Notary(client=client, shard=Shard(shard_id=0, shard_db=MemoryKV()),
                     config=config, sig_backend=get_backend("jax"))
-    return notary, period
+    return notary, periods
 
 
 # == measurements ==========================================================
@@ -261,17 +294,12 @@ def build_audit_workload():
 
 def measure_single() -> dict:
     """Measure under the CURRENT env config; prints one stats JSON line."""
-    if os.environ.get("GETHSHARDING_BENCH_CPU") == "1":
-        # hermetic/offline runs: force the CPU backend before any init
-        from gethsharding_tpu.parallel.virtual import force_virtual_cpu_devices
-
-        force_virtual_cpu_devices(1)
+    _setup_bench_env()
 
     import jax
 
-    _enable_compile_cache()
-
-    notary, period = build_audit_workload()
+    notary, periods = build_audit_workload()
+    period = periods[-1]
 
     # warm-up (compiles the bucketed batch shape) + correctness gate
     assert notary.audit_period(period) is True, "audit must be consistent"
@@ -294,17 +322,136 @@ def measure_single() -> dict:
         # split of the last dispatch (see sigbackend.last_timing)
         **({"sig_timing": notary.sig_backend.last_timing}
            if os.environ.get("GETHSHARDING_SIG_TIMING") == "1" else {}),
-        # the active kernel knobs, so probe outputs are self-describing
-        # (scripts/tpu_pick_winner.py rebuilds the autotune cache from
-        # the best probe)
-        "knobs": {key: val for key, val in os.environ.items()
-                  if key.startswith("GETHSHARDING_TPU_")},
+        "knobs": _knob_snapshot(),
     }
     if os.environ.get("GETHSHARDING_BENCH_EXTRAS") == "1":
         # configs 1/2/4/5 run only for the sweep winner (main() re-invokes
         # with this flag) — not in every autotune subprocess
         stats.update(_measure_extras(dispatch))
     return stats
+
+
+def _kperiod_cache_ready(max_k: int = 8) -> bool:
+    """True only when every period's cached signature block EXISTS, has
+    the current (SHARDS, COMMITTEE, 64) shape, and its pinned digest
+    matches the current identity formula — a stale cache (drifted seed /
+    digest scheme / protocol shape) must read as not-ready, or the extras
+    pass would start the ~20-min rebuild inside a tunnel window (the same
+    checks _load_or_build_vote_sigs uses to decide a rebuild)."""
+    from gethsharding_tpu.smc.state_machine import vote_digest
+
+    try:
+        with np.load(_workload_path()) as cached:
+            for p in range(1, max_k + 1):
+                skey, dkey = (("vote_sigs", "digest0") if p == 1
+                              else (f"vote_sigs_p{p}", f"digest0_p{p}"))
+                if skey not in cached.files or dkey not in cached.files:
+                    return False
+                if cached[skey].shape != (SHARDS, COMMITTEE, 64):
+                    return False
+                if bytes(cached[dkey]) != bytes(
+                        vote_digest(0, p, _bench_root(0, p))):
+                    return False
+    except (OSError, ValueError):
+        return False
+    return True
+
+
+def _kperiod_headroom(min_s: float) -> bool:
+    """Enough wall-clock left before BOTH deadlines (the finalize
+    window's GETHSHARDING_BENCH_DEADLINE_TS and the extras subprocess's
+    advertised kill timer) for more K-period work? Standalone --kperiod
+    probes set neither and always proceed (their own timeout governs)."""
+    rem = _remaining()
+    if rem is not None and rem < min_s:
+        return False
+    child = float(
+        os.environ.get("GETHSHARDING_BENCH_CHILD_DEADLINE_TS", "0"))
+    if child and child - time.time() < min_s:
+        return False
+    return True
+
+
+def _setup_bench_env() -> None:
+    """The shared measurement preamble (CPU forcing + compile cache) —
+    one definition so --single and --kperiod captures stay comparable."""
+    if os.environ.get("GETHSHARDING_BENCH_CPU") == "1":
+        # hermetic/offline runs: force the CPU backend before any init
+        from gethsharding_tpu.parallel.virtual import force_virtual_cpu_devices
+
+        force_virtual_cpu_devices(1)
+    _enable_compile_cache()
+
+
+def _knob_snapshot() -> dict:
+    """The active kernel knobs, so probe outputs are self-describing
+    (scripts/tpu_pick_winner.py rebuilds the autotune cache from the
+    best probe)."""
+    return {key: val for key, val in os.environ.items()
+            if key.startswith("GETHSHARDING_TPU_")}
+
+
+def measure_kperiod(ks=None) -> dict:
+    """sigs/sec vs K for the `audit_periods` K-period catch-up batch —
+    the protocol-level lever (PERF.md): K periods' rows share ONE
+    signature dispatch, so on a latency-bound kernel K periods cost
+    nearly one. Reports the honest aggregate rate AND the per-dispatch /
+    per-period latency for every K so the batching's latency cost is
+    never hidden behind the throughput number."""
+    _setup_bench_env()
+
+    import jax
+
+    if ks is None:
+        ks = [int(x) for x in os.environ.get(
+            "GETHSHARDING_BENCH_KLIST", "1,4,8").split(",")]
+    ks = sorted(set(ks))
+    notary, periods = build_audit_workload(max(ks))
+    timer = notary.m_audit_latency
+    sweep = []
+    for k in ks:
+        if sweep and not _kperiod_headroom(1800):
+            # a truncated sweep (first K measured) beats a SIGKILLed
+            # child that loses every extra already measured
+            print(f"# kperiod sweep truncated before K={k}: deadline "
+                  f"near", file=sys.stderr)
+            break
+        ps = periods[:k]
+        res = notary.audit_periods(ps)  # warm-up compile + correctness gate
+        assert all(res[p] is True for p in ps), "audit must be consistent"
+        # isolate THIS K's dispatch samples: the registry timer is shared
+        # across the whole sweep (reservoir 1024 >> samples taken here,
+        # so the ring never wraps and the slice below is exact)
+        base = len(timer._samples)
+        iters = 3
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            res = notary.audit_periods(ps)
+            assert all(res[p] is True for p in ps)
+        wall = (time.perf_counter() - t0) / iters
+        new = sorted(timer._samples[base:])
+        dispatch = new[len(new) // 2]
+        sweep.append({
+            "k": k,
+            "dispatch_s": round(dispatch, 4),
+            "per_period_s": round(dispatch / k, 4),
+            "audit_wall_s": round(wall, 4),
+            "sig_rate": round(k * SHARDS * COMMITTEE / dispatch, 1),
+        })
+        print(f"# K={k}: {sweep[-1]['sig_rate']:.1f} sigs/sec aggregate, "
+              f"dispatch {dispatch:.4f} s ({sweep[-1]['per_period_s']:.4f} "
+              f"s/period)", file=sys.stderr)
+    best = max(sweep, key=lambda r: r["sig_rate"])
+    return {
+        "platform": jax.devices()[0].platform,
+        "sig_rate": best["sig_rate"],
+        "dispatch_s": best["dispatch_s"],
+        "audit_wall_s": best["audit_wall_s"],
+        "k_periods": best["k"],
+        "per_period_dispatch_s": best["per_period_s"],
+        "kperiod_sweep": sweep,
+        "knobs": _knob_snapshot(),
+    }
 
 
 def _measure_extras(dispatch_s: float) -> dict:
@@ -398,6 +545,26 @@ def _measure_extras(dispatch_s: float) -> dict:
         jax.device_get(res.roots)  # real pull: block_until_ready can no-op
         dt = time.perf_counter() - t0
         out["config5_stress_shards_per_s"] = round(n_shards / dt, 1)
+
+    # the protocol-level lever (audit_periods K-period catch-up batching):
+    # measured only when the K-period signature workload is ALREADY on
+    # disk — the build is ~20 min of host scalar crypto, too much to
+    # spend inside a tunnel window (scripts/tpu_experiments/03e and the
+    # cache pre-builder create it) — and when enough window remains
+    # the K sweep needs a fresh 8-period chain + up to two cold heavy
+    # compiles (one cold heavy compile alone budgets 1800 s elsewhere):
+    # enter only with headroom for at least the first K before BOTH
+    # deadlines (finalize window + this subprocess's advertised kill
+    # timer), and measure_kperiod rechecks between Ks — so a slow sweep
+    # truncates instead of SIGKILLing away the extras already measured
+    if dispatch_s < 2.0 and _kperiod_headroom(2400):
+        if _kperiod_cache_ready(8):
+            try:
+                kstats = measure_kperiod(ks=[4, 8])
+                out["kperiod_sweep"] = kstats["kperiod_sweep"]
+                out["kperiod_best_sig_rate"] = kstats["sig_rate"]
+            except Exception as exc:  # extras must never sink the winner
+                print(f"# kperiod extra failed: {exc!r}", file=sys.stderr)
     return out
 
 
@@ -429,8 +596,15 @@ def _run_config(cfg: dict, extras: bool = False) -> dict | None:
     # it gets a budget of its own, scaled with the run's overall budget
     # knob so a capped hermetic run stays capped; heavy configs get a
     # longer window for their first Mosaic compile
-    timeout = min(4200, max(560, 1.25 * SWEEP_BUDGET_S)) if extras else min(
-        1800 if _heavy_config(cfg) else 560, SWEEP_BUDGET_S)
+    if extras:
+        timeout = min(4200, max(560, 1.25 * SWEEP_BUDGET_S))
+        if _kperiod_cache_ready(8):
+            # the extras pass will also attempt the K-period sweep (a
+            # fresh 8-period chain + two cold batch shapes) — the
+            # standalone 03e probe budgets 6900 s for the same work
+            timeout = max(timeout, min(6000, 4 * SWEEP_BUDGET_S))
+    else:
+        timeout = min(1800 if _heavy_config(cfg) else 560, SWEEP_BUDGET_S)
     rem = _remaining()
     if rem is not None:
         if rem < 120:
@@ -438,6 +612,10 @@ def _run_config(cfg: dict, extras: bool = False) -> dict | None:
         timeout = min(timeout, max(90, rem - 45))
     if extras:
         env["GETHSHARDING_BENCH_EXTRAS"] = "1"
+        # let the child skip the K-period sweep when too little of THIS
+        # timeout remains for it (finished extras must survive)
+        env["GETHSHARDING_BENCH_CHILD_DEADLINE_TS"] = str(
+            time.time() + timeout - 120)
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--single"],
@@ -472,7 +650,8 @@ def ensure_workload_cache() -> None:
     """Build the signing workload ONCE in the orchestrating process (host
     scalar crypto only, no accelerator) so each sweep subprocess loads it
     from disk instead of paying ~3 minutes."""
-    manager, accounts, _roots, digests, _period = _bench_identities()
+    k = int(os.environ.get("GETHSHARDING_BENCH_KPERIOD_MAX", "1"))
+    manager, accounts, _roots, digests, _periods = _bench_identities(k)
     _load_or_build_vote_sigs(accounts, manager, digests)
 
 
@@ -604,6 +783,22 @@ def _probe_backend(timeout: float = 120.0):
 def main() -> None:
     if "--single" in sys.argv:
         print(json.dumps(measure_single()))
+        return
+
+    if "--kperiod" in sys.argv:
+        # the K-period catch-up sweep under the CURRENT env knobs; emits
+        # the full metric line itself so a watcher probe's output is a
+        # replayable capture (the aggregate metric is honest only next to
+        # its per-period latency, which rides in extra.kperiod_sweep)
+        stats = measure_kperiod()
+        label = "/".join(
+            f"{key.replace('GETHSHARDING_TPU_', '').lower()}={val}"
+            for key, val in sorted(stats["knobs"].items())) or "defaults"
+        _print_metric(
+            stats["sig_rate"],
+            {key: val for key, val in stats.items() if key != "sig_rate"},
+            f"audit_periods K={stats['k_periods']} catch-up batch, "
+            f"{label}, {stats['platform']}")
         return
 
     ensure_workload_cache()

@@ -144,7 +144,12 @@ def pair_conv_combine(x: jnp.ndarray, y: jnp.ndarray, comb: np.ndarray,
     ncols = 2 * NL - 1
     # the XLA fallback broadcast-multiplies, so callers may pass one
     # operand with fewer leading dims (e.g. a constant against a batch);
-    # broadcast both to the common lead before flattening
+    # broadcast both to the common lead before flattening. NOTE: the
+    # reshape of a broadcast view below forces a copy, so a constant
+    # operand's data is materialized n times and shipped per batch
+    # element — correct (parity with the XLA fallback) but if the
+    # constant-vs-batch case ever becomes hot, tile the constant inside
+    # the kernel or pre-transpose the unbroadcast operand once instead
     lead = jnp.broadcast_shapes(x.shape[:-3], y.shape[:-3])
     x = jnp.broadcast_to(x, lead + x.shape[-3:])
     y = jnp.broadcast_to(y, lead + y.shape[-3:])
