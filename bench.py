@@ -200,6 +200,26 @@ def _bench_identities(k_periods: int = 1):
     return manager, accounts, roots, digests, periods
 
 
+def _sig_cache_keys(p: int) -> tuple:
+    """npz keys for period p's signature block (period 1 keeps the
+    original single-period keys so pre-existing caches stay valid)."""
+    return (("vote_sigs", "digest0") if p == 1
+            else (f"vote_sigs_p{p}", f"digest0_p{p}"))
+
+
+def _sig_cache_entry_ok(cache, p: int, digest0: bytes) -> bool:
+    """ONE validity rule for a cached period (key presence + protocol
+    shape + pinned digest), shared by the loader and the readiness gate —
+    a drift between the two would either silently skip K-period coverage
+    or start the ~20-min rebuild inside a tunnel window. `cache` is any
+    mapping of npz keys to arrays (dict or an open NpzFile)."""
+    skey, dkey = _sig_cache_keys(p)
+    if skey not in cache or dkey not in cache:
+        return False
+    return (cache[skey].shape == (SHARDS, COMMITTEE, 64)
+            and bytes(cache[dkey]) == digest0)
+
+
 def _load_or_build_vote_sigs(accounts, manager, digests) -> dict:
     """{period: (SHARDS, COMMITTEE, 64) uint8} — every committee slot's
     signature per shard digest, signed with the notary's real derived
@@ -216,12 +236,9 @@ def _load_or_build_vote_sigs(accounts, manager, digests) -> dict:
     out, dirty = {}, False
     for p in sorted(digests):
         dg = digests[p]
-        skey, dkey = (("vote_sigs", "digest0") if p == 1
-                      else (f"vote_sigs_p{p}", f"digest0_p{p}"))
-        sigs = data.get(skey)
-        if (sigs is not None and sigs.shape == (SHARDS, COMMITTEE, 64)
-                and dkey in data and bytes(data[dkey]) == dg[0]):
-            out[p] = sigs
+        skey, dkey = _sig_cache_keys(p)
+        if _sig_cache_entry_ok(data, p, dg[0]):
+            out[p] = data[skey]
             continue
         print(f"# building vote-signature workload for period {p} "
               f"({SHARDS}x{COMMITTEE} BLS signs, ~3 min once)...",
@@ -343,14 +360,9 @@ def _kperiod_cache_ready(max_k: int = 8) -> bool:
     try:
         with np.load(_workload_path()) as cached:
             for p in range(1, max_k + 1):
-                skey, dkey = (("vote_sigs", "digest0") if p == 1
-                              else (f"vote_sigs_p{p}", f"digest0_p{p}"))
-                if skey not in cached.files or dkey not in cached.files:
-                    return False
-                if cached[skey].shape != (SHARDS, COMMITTEE, 64):
-                    return False
-                if bytes(cached[dkey]) != bytes(
-                        vote_digest(0, p, _bench_root(0, p))):
+                if not _sig_cache_entry_ok(
+                        cached, p, bytes(vote_digest(0, p,
+                                                     _bench_root(0, p)))):
                     return False
     except (OSError, ValueError):
         return False

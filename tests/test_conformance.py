@@ -424,23 +424,240 @@ def test_external_trie_vectors_any_insertion_order():
                 case["name"], order)
 
 
-def test_go_sharding_vectors():
-    """Sharding-domain golden vectors regenerated from the reference Go
-    code (scripts/go_vector_gen) — skipped until someone runs the
-    generator on a Go-equipped host (none exists here; see the
-    generator's README for the environment-blocked record)."""
+# == reference-authored sharding-domain vectors ============================
+# tests/testdata/go_sharding_vectors.json holds expected values transcribed
+# VERBATIM from the reference's own Go test assertions (every vector cites
+# its /root/reference file:line) — the "byte-identical vs the pure-Go path"
+# claim witnessed by reference-produced ground truth without needing a Go
+# toolchain. scripts/go_vector_gen can extend the file with generated
+# byte-exact header/POC sections on a Go-equipped host.
+
+def _go_vectors() -> dict:
+    """The transcribed vector file, or {} when absent (a partial
+    checkout must skip these tests, not fail the whole module's
+    collection)."""
     path = os.path.join(os.path.dirname(__file__), "testdata",
                         "go_sharding_vectors.json")
-    if not os.path.exists(path):
-        pytest.skip("go_sharding_vectors.json not generated "
-                    "(needs a Go toolchain; scripts/go_vector_gen)")
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except OSError:
+        return {}
+
+
+def _go_vec_accounts(n: int):
+    """Deterministic stand-ins for the reference helper's random keys
+    (sharding_manager_test.go:46-48): the SMC never checks signatures at
+    registration (registration is scalar-crypto-free by design), so any
+    distinct Address20s reproduce the pinned outcomes."""
+    from gethsharding_tpu.crypto.keccak import keccak256
+    from gethsharding_tpu.utils.hexbytes import Address20
+
+    return [Address20(keccak256(b"go-vector-account-%d" % i)[:20])
+            for i in range(n)]
+
+
+def _run_smc_scenario(scenario: dict) -> None:
+    """Interpret one transcribed SMC scenario against this repo's chain.
+
+    Mirrors the Go test helpers: one backend.Commit() after every
+    mutating call (sharding_manager_test.go:84,121,156,192), accounts
+    funded 2000 ETH like the genesis alloc (:32,51), chunk roots are
+    [32]byte{b} (:151)."""
+    from gethsharding_tpu.params import Config, ETHER
+    from gethsharding_tpu.smc.chain import SimulatedMainchain
+    from gethsharding_tpu.smc.state_machine import SMCRevert
+    from gethsharding_tpu.utils.hexbytes import Hash32
+
+    overrides = scenario.get("config", {})
+    config = Config(**overrides) if overrides else Config()
+    chain = SimulatedMainchain(config=config)
+    accounts = _go_vec_accounts(1001)
+    funded = set()
+
+    def fund(i):
+        if i not in funded:
+            chain.fund(accounts[i], 2000 * ETHER)
+            funded.add(i)
+
+    def root32(b):
+        return Hash32(bytes([b]) + b"\x00" * 31)
+
+    def attempt(fn):  # tx + Commit, reverts reported not raised
+        try:
+            fn()
+            outcome = "ok"
+        except SMCRevert:
+            outcome = "revert"
+        chain.commit()
+        return outcome
+
+    def sample(shard):
+        return chain.get_notary_in_committee(accounts[0], shard)
+
+    for step in scenario["steps"]:
+        op = step["op"]
+        ctx = (scenario["name"], step)
+        if op == "register":
+            i = step["account"]
+            fund(i)
+            got = attempt(lambda: chain.register_notary(
+                accounts[i], value=step["deposit_eth"] * ETHER))
+            assert got == step["expect"], ctx
+            if got == "ok":
+                entry = chain.notary_registry(accounts[i])
+                assert entry is not None and entry.deposited, ctx
+                if "check_pool_index" in step:
+                    assert entry.pool_index == step["check_pool_index"], ctx
+                if "check_deregistered_period" in step:
+                    assert (entry.deregistered_period
+                            == step["check_deregistered_period"]), ctx
+        elif op == "register_many":
+            # registerNotaries helper (:77-113): incremental pool indices,
+            # zero deregistered period, one commit per registration
+            for i in range(step["count"]):
+                fund(i)
+                chain.register_notary(
+                    accounts[i], value=step["deposit_eth"] * ETHER)
+                chain.commit()
+                entry = chain.notary_registry(accounts[i])
+                assert (entry.pool_index == i
+                        and entry.deregistered_period == 0), (ctx, i)
+        elif op == "deregister":
+            i = step["account"]
+            got = attempt(lambda: chain.deregister_notary(accounts[i]))
+            assert got == step.get("expect", "ok"), ctx
+            if step.get("check_deregistered_period_nonzero"):
+                # deregisterNotaries helper pin (:122-126)
+                entry = chain.notary_registry(accounts[i])
+                assert entry.deregistered_period != 0, ctx
+        elif op == "release":
+            got = attempt(
+                lambda: chain.release_notary(accounts[step["account"]]))
+            assert got == step["expect"], ctx
+        elif op == "fast_forward":
+            chain.fast_forward(step["periods"])
+        elif op == "pool_length":
+            # checkNotaryPoolLength (:219-230)
+            assert chain.smc.notary_pool_length == step["expect"], ctx
+        elif op == "registry_check":
+            entry = chain.notary_registry(accounts[step["account"]])
+            assert bool(entry and entry.deposited) == step["deposited"], ctx
+        elif op == "balance_vs_deposit":
+            # balance.Cmp(notaryDeposit) pins (:389-398 released >= deposit,
+            # :434-437 unreleased <= deposit)
+            bal = chain.balance_of(accounts[step["account"]])
+            if step["cmp"] == "at_least":
+                assert bal >= config.notary_deposit, ctx
+            else:
+                assert bal <= config.notary_deposit, ctx
+        elif op == "add_header":
+            shard, period = step["shard"], step["period"]
+            root = root32(step["root_byte"])
+            got = attempt(lambda: chain.add_header(
+                accounts[step["account"]], shard, period, root,
+                b"SIGNATURE"))
+            assert got == step["expect"], ctx
+            if got == "ok":
+                # the addHeader helper's own pins (:156-170)
+                assert chain.last_submitted_collation(shard) == period, ctx
+                record = chain.collation_record(shard, period)
+                assert (record is not None
+                        and bytes(record.chunk_root) == bytes(root)), ctx
+        elif op == "submit_vote":
+            shard, index = step["shard"], step["index"]
+            got = attempt(lambda: chain.submit_vote(
+                accounts[step["account"]], shard, step["period"], index,
+                root32(step["root_byte"])))
+            assert got == step["expect"], ctx
+            if got == "ok":
+                # submitVote helper pin (:196-201)
+                assert chain.has_voted(shard, index), ctx
+        elif op == "vote_count":
+            assert chain.get_vote_count(step["shard"]) == step["expect"], ctx
+        elif op == "last_approved":
+            assert (chain.last_approved_collation(step["shard"])
+                    == step["expect"]), ctx
+        elif op == "sample_equals":
+            assert sample(step["shard"]) == accounts[step["account"]], ctx
+        elif op == "sample_not":
+            # the Go originals loop the SAME deterministic view call
+            # (e.g. :481-486) — the repeat is transcription fidelity,
+            # not extra coverage
+            for _ in range(step["times"]):
+                assert sample(step["shard"]) != accounts[step["account"]], ctx
+        elif op == "samples_differ":
+            for _ in range(step["times"]):
+                assert sample(step["shard_a"]) != sample(step["shard_b"]), ctx
+        else:
+            raise AssertionError(f"unknown op {op!r}")
+
+
+def _smc_scenario_params():
+    scenarios = _go_vectors().get("smc_scenarios")
+    if not scenarios:
+        return [pytest.param({}, id="vectors-missing",
+                             marks=pytest.mark.skip(
+                                 reason="go_sharding_vectors.json absent"))]
+    out = []
+    for scenario in scenarios:
+        marks = [pytest.mark.slow] if scenario.get("slow") else []
+        out.append(pytest.param(scenario, id=scenario["name"], marks=marks))
+    return out
+
+
+@pytest.mark.parametrize("scenario", _smc_scenario_params())
+def test_go_sharding_vectors_smc(scenario):
+    _run_smc_scenario(scenario)
+
+
+def test_go_sharding_vectors_blob_codec():
+    """The marshal_test.go byte pins: indicator bytes, terminal lengths,
+    skip-EVM flags, and data placement of the reference's own serialize/
+    deserialize assertions."""
+    from gethsharding_tpu.utils.blob import (RawBlob, deserialize_blobs,
+                                             serialize_blobs)
+
+    cases = _go_vectors().get("blob_vectors")
+    if not cases:
+        pytest.skip("go_sharding_vectors.json absent")
+    for case in cases:
+        expect = case["expect"]
+        if case["op"] == "deserialize":
+            blobs = deserialize_blobs(bytes.fromhex(case["input_hex"]))
+            assert len(blobs) == expect["num_blobs"], case["name"]
+            for blob, want in zip(blobs, expect["blobs"]):
+                assert blob.skip_evm == want["skip_evm"], case["name"]
+                assert len(blob.data) == want["data_len"], case["name"]
+        else:
+            blobs = [RawBlob(data=bytes.fromhex(b["data_hex"]),
+                             skip_evm=b["skip_evm"])
+                     for b in case["blobs"]]
+            out = serialize_blobs(blobs)
+            assert len(out) == expect["total_len"], case["name"]
+            for pos, want in expect.get("byte_checks", {}).items():
+                assert out[int(pos)] == int(want, 16), (case["name"], pos)
+            for start, end, value_start in expect.get("ranges", []):
+                for i in range(start, end):
+                    assert out[i] == (value_start + i - start) & 0xFF, (
+                        case["name"], i)
+
+
+def test_go_sharding_vectors_generated_sections():
+    """Byte-exact header/POC vectors from scripts/go_vector_gen — only
+    present once someone runs the generator on a Go-equipped host; the
+    transcribed sections above carry the reference-authored coverage
+    either way."""
+    vectors = _go_vectors()
+    if not vectors or "collation_headers" not in vectors:
+        pytest.skip("generated sections absent (scripts/go_vector_gen "
+                    "needs a Go toolchain; transcribed sections cover "
+                    "the reference-authored pins)")
     from gethsharding_tpu.core.types import Collation, CollationHeader
     from gethsharding_tpu.utils.blob import RawBlob, serialize_blobs
     from gethsharding_tpu.utils.hexbytes import Address20, Hash32
     from gethsharding_tpu.utils.rlp import rlp_encode
 
-    with open(path) as fh:
-        vectors = json.load(fh)
     for case in vectors["collation_headers"]:
         header = CollationHeader(
             shard_id=int(case["shardID"]),
@@ -450,12 +667,12 @@ def test_go_sharding_vectors():
             proposer_signature=bytes.fromhex(case["sig"]),
         )
         assert bytes(header.hash()).hex() == case["hash"], case
-    for case in vectors["blob_codec"]:
+    for case in vectors.get("blob_codec", []):
         blobs = [RawBlob(data=rlp_encode(bytes.fromhex(b["payload"])),
                          skip_evm=bool(b["skip_evm"]))
                  for b in case["blobs"]]
         assert serialize_blobs(blobs).hex() == case["serialized"]
-    for case in vectors["poc"]:
+    for case in vectors.get("poc", []):
         coll = Collation(header=CollationHeader(shard_id=0, period=1),
                          body=bytes.fromhex(case["body"]))
         poc = coll.calculate_poc(bytes.fromhex(case["salt"]))
