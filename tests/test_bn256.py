@@ -184,6 +184,7 @@ def test_bls_proof_of_possession():
     assert not bls_verify_possession(rogue, bls_prove_possession(sk2, rogue))
 
 
+@pytest.mark.slow  # ~4 s host scalar pairing; the BLS verify tests exercise the same path fast
 def test_optimal_ate_check_parity():
     """pairing_check_optimal (6u+2 loop + frobenius lines, the batched
     kernel's scalar twin) agrees with the plain-ate pairing_check."""

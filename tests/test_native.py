@@ -87,6 +87,7 @@ def test_derive_sha_native_matches_python_across_sizes():
             list(zip(keys, items))), n
 
 
+@pytest.mark.slow  # ~5 s capacity case; derive_sha parity across sizes stays fast
 def test_chunk_root_one_mebibyte_body():
     """The protocol's collation size cap (collation.go:45) is now
     computable in seconds instead of minutes."""

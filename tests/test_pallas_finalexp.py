@@ -122,6 +122,7 @@ def _fp12_to_vals(f):
     return out
 
 
+@slow  # ~5 s of eager host fp12 parity; conv/normalize/mul_xi stay as the fast guards
 def test_fp12_mul_value_parity():
     rng = np.random.default_rng(54)
     x = _rand_quasi(rng, (3, 6, 2))
@@ -138,6 +139,7 @@ def test_fp12_mul_value_parity():
         assert (got[i] == wv).all()
 
 
+@slow  # ~10 s (three frobenius powers through the XLA oracle)
 def test_frobenius_value_parity():
     """Oracle: bn256_jax.fp12_frobenius (itself pinned to the scalar
     reference in test_bn256_jax) on the same values in ambient limbs."""

@@ -10,6 +10,7 @@ resolution of in-batch (shard, index) collisions.
 """
 
 import numpy as np
+import pytest
 import jax
 import jax.numpy as jnp
 
@@ -167,6 +168,7 @@ def test_vote_batch_matches_scalar_sequential():
             smc.last_submitted_collation.get(s, 0), f"shard {s}"
 
 
+@pytest.mark.slow  # ~9 s vmap compile; the scalar-parity pair above guards the kernel fast
 def test_vmap_over_period_batches():
     """The kernel vmaps: independent periods in parallel give the same
     result as one-at-a-time application (shard axis stays inside)."""
@@ -208,6 +210,7 @@ def test_vmap_over_period_batches():
             np.asarray(vs.vote_count)[bi], np.asarray(s1.vote_count))
 
 
+@pytest.mark.slow  # ~6 s multi-period batch compile
 def test_no_quorum_carryover_across_periods():
     """A shard that reached quorum in period 1 and has NO header in period 2
     must keep last_approved = 1 when a period-2 batch (for other shards)

@@ -6,6 +6,8 @@ import json
 import os
 import time
 
+import pytest
+
 from gethsharding_tpu.node.cli import build_parser, run_cli
 from gethsharding_tpu.tools import generate_bindings
 
@@ -204,6 +206,7 @@ def test_swarm_up_get_local_roundtrip(tmp_path, capsys):
                     "-o", str(tmp_path / "nope")]) == 1
 
 
+@pytest.mark.slow  # ~9 s three-node socket e2e; the local up/get roundtrip stays fast
 def test_swarm_networked_get_via_relay(tmp_path, capsys):
     """Content uploaded on node A retrieves on node B over the shardp2p
     netstore tier (chunks ride the direct plane; the relay introduces)."""
