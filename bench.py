@@ -81,6 +81,12 @@ CONFIGS = [
     {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "scan",
      "GETHSHARDING_TPU_FINALEXP": "mega", "GETHSHARDING_TPU_MILLER": "mega",
      "GETHSHARDING_TPU_WIRE": "u16"},
+    # r5: in-kernel slice-accumulate conv (no shifted-concat copies per
+    # schoolbook MAC) — the in-kernel analog of the XLA-land slices
+    # winner, composed under the two-launch champion
+    {"GETHSHARDING_TPU_LIMB_FORM": "exact", "GETHSHARDING_TPU_CARRY": "scan",
+     "GETHSHARDING_TPU_FINALEXP": "mega", "GETHSHARDING_TPU_MILLER": "mega",
+     "GETHSHARDING_TPU_MEGA_CONV": "slices"},
     {"GETHSHARDING_TPU_LIMB_FORM": "wide", "GETHSHARDING_TPU_NORM": "relaxed",
      "GETHSHARDING_TPU_FINALEXP": "mega"},
     # r3 additions, probed right after the champion: the statically
@@ -982,7 +988,9 @@ def main() -> None:
         + (["miller-mega"]
            if best_cfg.get("GETHSHARDING_TPU_MILLER") == "mega" else [])
         + (["agg-mega"]
-           if best_cfg.get("GETHSHARDING_TPU_AGG") == "mega" else []))
+           if best_cfg.get("GETHSHARDING_TPU_AGG") == "mega" else [])
+        + ([f"mega-conv-{best_cfg['GETHSHARDING_TPU_MEGA_CONV']}"]
+           if best_cfg.get("GETHSHARDING_TPU_MEGA_CONV") else []))
     _print_metric(best["sig_rate"], best, f"{knobs}, {best['platform']}")
 
 

@@ -44,6 +44,7 @@ program-logic bugs and Pallas-mechanics bugs isolate cleanly.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any, NamedTuple
 
 import numpy as np
@@ -57,6 +58,21 @@ from gethsharding_tpu.crypto import bn256 as ref
 from gethsharding_tpu.ops.limb import LIMB_BITS, LIMB_MASK, int_to_limbs
 
 BLOCK_LANES = 128
+
+# In-kernel schoolbook-column implementation (GETHSHARDING_TPU_MEGA_CONV):
+# - "shift" (default): 25 shifted-concatenate MACs per conv — each step
+#   materializes a zero-padded copy of the full column block (the
+#   original form, measured at 45.5k sigs/sec composed into the r4
+#   champion).
+# - "slices": accumulate step l into columns [l, l+25) of a persistent
+#   accumulator via static-offset dynamic_update_slice — the in-kernel
+#   analog of ops/limb.py CONV=slices (the XLA-land sweep winner at
+#   31.2k): minimal working set, no concat copies. Value-identical;
+#   differential tests cover both (tests/test_pallas_finalexp.py).
+MEGA_CONV = os.environ.get("GETHSHARDING_TPU_MEGA_CONV", "shift")
+if MEGA_CONV not in ("shift", "slices"):
+    raise ValueError(f"GETHSHARDING_TPU_MEGA_CONV must be 'shift' or "
+                     f"'slices', got {MEGA_CONV!r}")
 
 # == self-contained wide-relaxed limb constants ============================
 # The kernel always computes in the 25-limb wide form with relaxed
@@ -204,14 +220,17 @@ def _normalize(z, C: Consts):
     return _round(_round(_round(acc))).reshape(lead + (KNL, z.shape[-1]))
 
 
-def _conv(u, v):
+def _conv(u, v, impl: "str | None" = None):
     """Schoolbook columns: (..., 25, B) x (..., 25, B) -> (..., 49, B),
     leading dims broadcast — the stacked-plane form of pallas_conv's
     shift-MAC loop (25 full-tile MACs for ALL planes at once).
 
     Leading dims are FLATTENED around the loop (free reshapes — minor
     dims untouched): the fp12 paths otherwise build rank-7 arrays,
-    which interpret mode accepts but real Mosaic may not."""
+    which interpret mode accepts but real Mosaic may not.
+
+    `impl` overrides GETHSHARDING_TPU_MEGA_CONV per call (tests)."""
+    impl = impl or MEGA_CONV
     lead = jnp.broadcast_shapes(u.shape[:-2], v.shape[:-2])
     n = 1
     for d in lead:
@@ -220,6 +239,18 @@ def _conv(u, v):
         (n,) + u.shape[-2:])
     vf = jnp.broadcast_to(v, lead + v.shape[-2:]).reshape(
         (n,) + v.shape[-2:])
+    # the LANE dim broadcasts too (e.g. a B=1 constant against a batch)
+    (b,) = jnp.broadcast_shapes(u.shape[-1:], v.shape[-1:])
+    if impl == "slices":
+        # step l lands in columns [l, l+25): read-modify-write that
+        # window with STATIC offsets (lowers to vector moves, no
+        # zero-padded concat copy per step)
+        acc = jnp.zeros((n, KNCOLS, b), jnp.int32)
+        for l in range(KNL):
+            term = uf[:, l:l + 1, :] * vf              # (n, 25, B)
+            window = lax.dynamic_slice(acc, (0, l, 0), (n, KNL, b))
+            acc = lax.dynamic_update_slice(acc, window + term, (0, l, 0))
+        return acc.reshape(lead + (KNCOLS, b))
     acc = None
     for l in range(KNL):
         term = uf[:, l:l + 1, :] * vf

@@ -1,0 +1,14 @@
+#!/bin/bash
+# The two-launch mega pairing with the in-kernel slice-accumulate conv
+# (GETHSHARDING_TPU_MEGA_CONV=slices): each schoolbook MAC lands in its
+# column window via static-offset dynamic_update_slice instead of a
+# zero-padded concatenate copy — the in-kernel analog of the XLA-land
+# CONV=slices sweep winner. First Mosaic compile of the re-traced
+# kernels can be slow; value-parity is pinned by
+# tests/test_pallas_finalexp.py (bit-identical columns + interpret e2e).
+cd /root/repo || exit 1
+env GETHSHARDING_TPU_LIMB_FORM=exact GETHSHARDING_TPU_CARRY=scan \
+    GETHSHARDING_TPU_FINALEXP=mega GETHSHARDING_TPU_MILLER=mega \
+    GETHSHARDING_TPU_MEGA_CONV=slices \
+  timeout 4800 python bench.py --single >"$1.out" 2>"$1.err"
+grep -q sig_rate "$1.out" && grep -q '"platform": "tpu' "$1.out"

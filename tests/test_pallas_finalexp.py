@@ -79,11 +79,28 @@ def test_conv_matches_schoolbook():
     rng = np.random.default_rng(52)
     a = rng.integers(0, 1 << 12, (3, m.KNL)).astype(np.int32)
     b = rng.integers(0, 1 << 12, (3, m.KNL)).astype(np.int32)
-    got = np.asarray(m._conv(_to_rows(a), _to_rows(b)))[..., 0]
-    for i in range(3):
-        va = limbs_to_int(a[i])
-        vb = limbs_to_int(b[i])
-        assert limbs_to_int(got[i].astype(object)) == va * vb
+    for impl in ("shift", "slices"):
+        got = np.asarray(m._conv(_to_rows(a), _to_rows(b),
+                                 impl=impl))[..., 0]
+        for i in range(3):
+            va = limbs_to_int(a[i])
+            vb = limbs_to_int(b[i])
+            assert limbs_to_int(got[i].astype(object)) == va * vb, impl
+
+
+def test_conv_impls_bit_identical():
+    """Every MEGA_CONV implementation produces the SAME columns on
+    quasi-canonical inputs (incl. the -1 limbs relaxed normalize can
+    leave) and with broadcast leading dims — the shapes the fp12 paths
+    actually use."""
+    rng = np.random.default_rng(57)
+    u = rng.integers(-1, (1 << 12) + 65, (2, 3, m.KNL, 4)).astype(np.int32)
+    v = rng.integers(-1, (1 << 12) + 65, (3, m.KNL, 4)).astype(np.int32)
+    ref_cols = np.asarray(m._conv(jnp.asarray(u), jnp.asarray(v),
+                                  impl="shift"))
+    got = np.asarray(m._conv(jnp.asarray(u), jnp.asarray(v), impl="slices"))
+    assert (got == ref_cols).all()
+    assert got.shape == (2, 3, m.KNCOLS, 4)
 
 
 def test_mul_xi_value_parity():
@@ -204,6 +221,39 @@ def test_mega_kernel_interpret_matches_pairing_is_one():
     assert (got == wants).all()
 
 
+class _mega_conv:
+    """Flip the trace-time MEGA_CONV knob and drop every compiled-kernel
+    cache (finalexp, miller, agg) so the next call re-traces under it."""
+
+    def __init__(self, impl):
+        self.impl = impl
+
+    @staticmethod
+    def _clear():
+        m._compiled.cache_clear()
+        m._miller_compiled.cache_clear()
+        m._agg_compiled.cache_clear()
+
+    def __enter__(self):
+        self.old = m.MEGA_CONV
+        m.MEGA_CONV = self.impl
+        self._clear()
+
+    def __exit__(self, *exc):
+        m.MEGA_CONV = self.old
+        self._clear()
+
+
+@slow
+def test_mega_kernel_interpret_slices_conv():
+    """The whole final-exp kernel under MEGA_CONV=slices agrees with the
+    pairing oracle."""
+    fs, wants = _miller_products(1, 1)
+    with _mega_conv("slices"):
+        got = np.asarray(m.finalexp_is_one(jnp.asarray(fs), interpret=True))
+    assert (got == wants).all()
+
+
 # == the Miller mega-kernel (same module) ==================================
 
 
@@ -260,6 +310,35 @@ def test_miller_mega_kernel_interpret_matches_xla():
     # end-to-end boolean parity through the final exponentiation
     assert list(np.asarray(k.pairing_is_one(jnp.asarray(got)))) == \
         [True, False]
+
+
+@slow
+def test_miller_and_agg_kernels_interpret_slices_conv():
+    """MEGA_CONV=slices switches _conv inside the Miller AND aggregation
+    kernels too (the line-eval and tree-reduction shapes the unit
+    bit-identity test can't reach) — both must stay value-identical to
+    the XLA path under the knob, and the whole two-kernel pairing must
+    still separate valid from tampered."""
+    sig, (hx, hy), pk = _committee_workload()
+    want = np.asarray(k._bls_miller_opt(sig, hx, hy, pk))
+    tag = b"agg-mega-slices"
+    keys = [ref.bls_keygen(tag + bytes([j])) for j in range(4)]
+    sigs = [ref.bls_sign(tag, sk) for sk, _ in keys]
+    sx, sy, sm = k.g1_committee_to_limbs([sigs, sigs[:2]], 4)
+    want_g1 = k.aggregate_g1_proj(jnp.asarray(sx), jnp.asarray(sy),
+                                  jnp.asarray(sm))
+    with _mega_conv("slices"):
+        got = np.asarray(m.miller_f(sig, hx, hy, pk, interpret=True))
+        got_g1 = m.aggregate_proj(jnp.asarray(sx), jnp.asarray(sy),
+                                  jnp.asarray(sm), fp2=False,
+                                  interpret=True)
+    assert (_f_vals(want) == _f_vals(got)).all()
+    assert list(np.asarray(k.pairing_is_one(jnp.asarray(got)))) == \
+        [True, False]
+    assert np.asarray(k.FP.eq(k.FP.mul(want_g1[0], got_g1[2]),
+                              k.FP.mul(got_g1[0], want_g1[2]))).all()
+    assert np.asarray(k.FP.eq(k.FP.mul(want_g1[1], got_g1[2]),
+                              k.FP.mul(got_g1[1], want_g1[2]))).all()
 
 
 @slow
