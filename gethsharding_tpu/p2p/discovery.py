@@ -23,6 +23,21 @@ With that, introduction survives the relay: once two nodes have
 exchanged announces, directed sends, broadcasts and body exchange all
 run peer-to-peer with the relay process gone (the r3 SPOF, VERDICT
 Missing #1).
+
+Scale bound (documented, by design): the directory is FLAT — verified
+announces gossip to every peer and the table caps at MAX_VERIFIED
+entries with liveness aging, so lookups are O(1) and table state/churn
+traffic are O(n) per node. That is the right trade at this framework's
+deployment scale (a devnet or a pod-local fleet of dozens of actor
+processes: the reference's own devnet topology), where the XOR-bucket
+Kademlia structure (`p2p/discover/table.go:68`) would add lookup
+round-trips without shrinking any real table. What changes at
+thousand-node WAN scale: the flat table stops fitting (MAX_VERIFIED
+evicts live peers) and O(n) gossip dominates — the upgrade path is
+XOR-distance buckets over the EXISTING verified announces (they already
+carry the node identity the distance metric needs) with the same
+authenticated frames serving FINDNODE-style bucket queries; nothing in
+the data plane or the announce format would change.
 """
 
 from __future__ import annotations
