@@ -27,6 +27,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main() -> int:
+    if os.environ.get("GETHSHARDING_BENCH_CPU") == "1":
+        # hermetic validation runs: JAX_PLATFORMS=cpu alone is NOT
+        # enough — the tunnel PJRT plugin can hang at registration when
+        # the tunnel is half-open; this drops the plugin factories
+        from gethsharding_tpu.parallel.virtual import (
+            force_virtual_cpu_devices)
+
+        force_virtual_cpu_devices(1)
     from gethsharding_tpu.parallel.virtual import configure_compile_cache
 
     configure_compile_cache()
@@ -63,10 +71,38 @@ def main() -> int:
 
     def check(name, got_limbs, want_ints):
         nonlocal first_bad
-        ok = ints_of(got_limbs) == [w % P for w in want_ints]
+        # breadcrumb BEFORE the device pull: a timed-out probe's .err
+        # then shows the stage it died in
+        print(f"# stage {name}...", file=sys.stderr, flush=True)
+        got_ints = ints_of(got_limbs)
+        want_mod = [w % P for w in want_ints]
+        ok = got_ints == want_mod
         stages[name] = bool(ok)
         if not ok and first_bad is None:
             first_bad = name
+            # evidence for the mechanism, not just the location: the
+            # first mismatching element's value pair + its raw limbs
+            # (pre-canon) — a bound violation shows as an out-of-range
+            # limb, a backend arithmetic quirk as a wrong in-range one
+            idx = next((i for i, (g, w)
+                        in enumerate(zip(got_ints, want_mod)) if g != w),
+                       None)
+            raw = np.asarray(got_limbs).reshape(-1,
+                                                np.asarray(got_limbs).shape[-1])
+            out["first_bad_evidence"] = {
+                "raw_limb_min": int(raw.min()),
+                "raw_limb_max": int(raw.max()),
+            }
+            if idx is None:  # lengths differ with an equal prefix
+                out["first_bad_evidence"]["length_mismatch"] = [
+                    len(got_ints), len(want_mod)]
+            else:
+                out["first_bad_evidence"].update({
+                    "element": idx,
+                    "got": hex(got_ints[idx]),
+                    "want": hex(want_mod[idx]),
+                    "raw_limbs": raw[idx].tolist(),
+                })
         return ok
 
     xs, ys = rand_fp(B), rand_fp(B)
@@ -109,8 +145,86 @@ def main() -> int:
     for a, b in zip(xs, ys):
         want.extend([(a * a - b * b) % P, (2 * a * b) % P])
     check("fp2_sqr", got, want)
+
+    # 7b: DEPTH sweep — a divergence that accumulates (quasi-canonical
+    # growth feeding the next op past a bound) shows at some chain depth
+    # between the 3-deep unit chain and the ~600-op pairing; the first
+    # failing depth IS the bisect. Each step multiplies by a fresh
+    # random operand so cancellation can't mask drift.
+    ops = [rand_fp(B) for _ in range(128)]
+    ops_l = [to_limbs(o) for o in ops]
+
+    def chain_n(n):
+        # lax.scan, not an unrolled loop: ONE compiled body per depth
+        # (an unrolled depth-128 jit costs many minutes of compile — too
+        # slow for a tunnel window) and the same sequential structure the
+        # production Miller/final-exp drivers use
+        from jax import lax
+
+        ops_arr = jnp.stack(ops_l[:n])          # (n, B, NL)
+
+        def step(acc, o):
+            return k.FP.mul(acc, o), None
+
+        def f(a, os):
+            out, _ = lax.scan(step, a, os)
+            return out
+
+        return jax.jit(f)(xa, ops_arr)
+
+    for depth in (8, 32, 128):
+        want = []
+        for i, a in enumerate(xs):
+            acc = a
+            for o in ops[:depth]:
+                acc = acc * o[i] % P
+            want.append(acc)
+        check(f"mul_chain_depth_{depth}", chain_n(depth), want)
+
+    # 7c: fp12 product (the cyclic-convolution + xi-wrap layer the fp2
+    # stages never reach)
+    f12a = jnp.stack([jnp.stack([to_limbs(rand_fp(B)) for _ in range(2)],
+                                axis=-2) for _ in range(6)], axis=-3)
+    f12b = jnp.stack([jnp.stack([to_limbs(rand_fp(B)) for _ in range(2)],
+                                axis=-2) for _ in range(6)], axis=-3)
+    got12 = jax.jit(k.fp12_mul)(f12a, f12b)
+
+    # host goldens via the scalar tower classes; the SHARED w-basis<->
+    # tower mapping (`fp12_to_int_coeffs` / `_WSLOT`, ops/bn256_jax) does
+    # the basis work — ONE whole-array canon per operand, no per-lane
+    # device round-trips, no third copy of the slot convention
+    ca_all = k.fp12_to_int_coeffs(f12a)     # (B, 2, 3, 2) object ints
+    cb_all = k.fp12_to_int_coeffs(f12b)
+
+    def tower_fp12(c):
+        halves = [ref.Fp6(ref.Fp2(int(c[h, 0, 0]), int(c[h, 0, 1])),
+                          ref.Fp2(int(c[h, 1, 0]), int(c[h, 1, 1])),
+                          ref.Fp2(int(c[h, 2, 0]), int(c[h, 2, 1])))
+                  for h in range(2)]
+        return ref.Fp12(halves[0], halves[1])
+
+    # every lane, flat in ints_of's (b, w-slot, component) row order,
+    # through check() so a divergence HERE also carries the evidence
+    want12 = []
+    for b in range(B):
+        prod = tower_fp12(ca_all[b]) * tower_fp12(cb_all[b])
+        for (h, l) in k._WSLOT:
+            fp2c = ((prod.c0 if h == 0 else prod.c1).c0,
+                    (prod.c0 if h == 0 else prod.c1).c1,
+                    (prod.c0 if h == 0 else prod.c1).c2)[l]
+            want12.extend([fp2c.a % P, fp2c.b % P])
+    check("fp12_mul", got12, want12)
+
     # 8: full pairing check on a protocol-valid product (the gate that
-    # fails in the audit)
+    # fails in the audit). The heaviest compile in the script — LAST on
+    # purpose, and skippable for quick smoke validation of the cheaper
+    # stages (GETHSHARDING_BISECT_QUICK=1).
+    if os.environ.get("GETHSHARDING_BISECT_QUICK") == "1":
+        out["stages"] = stages
+        out["first_bad"] = first_bad
+        print(json.dumps(out))
+        return 0
+    print("# stage pairing_check_valid...", file=sys.stderr, flush=True)
     sk = 987654321
     p1 = ref.g1_mul(sk, ref.G1_GEN)
     q2 = ref.g2_mul(sk, ref.G2_GEN)

@@ -611,6 +611,29 @@ def test_go_sharding_vectors_smc(scenario):
     _run_smc_scenario(scenario)
 
 
+def test_go_sharding_vectors_params():
+    """The reference's own config_test.go constant pins, applied to this
+    framework's Config (the constants ARE the consensus)."""
+    from gethsharding_tpu.params import Config
+
+    cases = _go_vectors().get("params")
+    if not cases:
+        pytest.skip("go_sharding_vectors.json absent")
+    config = Config()
+    field_of = {
+        "notary_deposit_wei": "notary_deposit",
+        "period_length": "period_length",
+        "notary_lockup_length": "notary_lockup_length",
+        "proposer_lockup_length": "proposer_lockup_length",
+        "committee_size": "committee_size",
+        "quorum_size": "quorum_size",
+        "challenge_period": "challenge_period",
+    }
+    for case in cases:
+        got = getattr(config, field_of[case["name"]])
+        assert got == int(case["value"]), (case["name"], got)
+
+
 def test_go_sharding_vectors_blob_codec():
     """The marshal_test.go byte pins: indicator bytes, terminal lengths,
     skip-EVM flags, and data placement of the reference's own serialize/
