@@ -1,10 +1,13 @@
 #!/bin/bash
 # Host-marshal / tunnel-transfer / device-dispatch split of the audit
-# call under the champion knobs: decides whether the next lever belongs
-# on the device side (kernels) or the host side (marshalling, transfer
-# width, device-resident rows).
+# call under the CHAMPION knobs (exact/scan + two-launch mega pairing,
+# the 45.5k r4 config): decides whether the next lever belongs on the
+# device side (kernels) or the host side (marshalling, transfer width,
+# device-resident rows). The timing path syncs transfers with ONE fused
+# pull (r5), so transfer_s reflects bandwidth, not per-buffer RTTs.
 cd /root/repo || exit 1
 env GETHSHARDING_TPU_LIMB_FORM=exact GETHSHARDING_TPU_CARRY=scan \
-    GETHSHARDING_TPU_CONV=slices GETHSHARDING_SIG_TIMING=1 \
+    GETHSHARDING_TPU_FINALEXP=mega GETHSHARDING_TPU_MILLER=mega \
+    GETHSHARDING_SIG_TIMING=1 \
   timeout 4800 python bench.py --single >"$1.out" 2>"$1.err"
 grep -q sig_timing "$1.out" && grep -q '"platform": "tpu' "$1.out"
