@@ -27,6 +27,11 @@ all read at import): the bench AUTOTUNES by re-executing itself
 per configuration in a subprocess and reports the fastest, caching the
 winner per backend in .bench_autotune.json. Signing workloads are cached
 in .bench_workload.npz (first build ~3 min of host-side scalar crypto).
+
+`bench.py --serving` measures the verification SERVING tier instead: M
+concurrent clients x single-item requests coalesced into shared
+dispatches vs the same clients driving the backend directly
+(scripts/serving_stress.py is the open-ended soak form).
 """
 
 from __future__ import annotations
@@ -586,6 +591,82 @@ def _measure_extras(dispatch_s: float) -> dict:
     return out
 
 
+# == serving-tier amortization (bench.py --serving) ========================
+
+
+def measure_serving() -> dict:
+    """M concurrent clients x small requests through the serving tier vs
+    the same clients driving the backend directly — the dispatch-
+    amortization claim measured, not asserted. Hermetic by default
+    (python inner backend: the coalescing win is dispatch-count
+    amortization, visible on any backend; set
+    GETHSHARDING_BENCH_SERVING_BACKEND=jax on a live accelerator)."""
+    import threading
+
+    from gethsharding_tpu.crypto import secp256k1 as ecdsa
+    from gethsharding_tpu.crypto.keccak import keccak256
+    from gethsharding_tpu.serving import ServingConfig, ServingSigBackend
+    from gethsharding_tpu.sigbackend import get_backend
+
+    clients = int(os.environ.get("GETHSHARDING_BENCH_SERVING_CLIENTS", "32"))
+    per_client = int(os.environ.get("GETHSHARDING_BENCH_SERVING_REQS", "16"))
+    inner = get_backend(
+        os.environ.get("GETHSHARDING_BENCH_SERVING_BACKEND", "python"))
+
+    cases = []
+    for i in range(clients * per_client):
+        priv = int.from_bytes(keccak256(b"serve-%d" % i), "big") % ecdsa.N
+        digest = keccak256(b"serve-msg-%d" % i)
+        cases.append((digest, ecdsa.sign(digest, priv).to_bytes65(),
+                      ecdsa.priv_to_address(priv)))
+
+    def drive(recover) -> float:
+        """Each client thread issues `per_client` single-item requests;
+        returns wall seconds. Divergence is a hard failure."""
+        errors: list = []
+
+        def client(c: int) -> None:
+            for r in range(per_client):
+                digest, sig, want = cases[c * per_client + r]
+                if recover([digest], [sig]) != [want]:
+                    errors.append((c, r))
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, f"result divergence at {errors[:4]}"
+        return time.perf_counter() - t0
+
+    total = clients * per_client
+    direct_s = drive(inner.ecrecover_addresses)
+
+    serving = ServingSigBackend(inner, ServingConfig(
+        max_batch=int(os.environ.get("GETHSHARDING_SERVING_MAX_BATCH",
+                                     "128")),
+        flush_us=float(os.environ.get("GETHSHARDING_SERVING_FLUSH_US",
+                                      "2000"))))
+    try:
+        serving_s = drive(serving.ecrecover_addresses)
+        dispatches = serving.dispatch_count
+    finally:
+        serving.close()
+
+    return {
+        "backend": inner.name,
+        "clients": clients,
+        "requests": total,
+        "serving_rate": round(total / serving_s, 1),
+        "direct_rate": round(total / direct_s, 1),
+        "speedup": round(direct_s / serving_s, 3),
+        "dispatches": dispatches,
+        "coalesce_ratio": round(total / max(1, dispatches), 1),
+    }
+
+
 # == autotune orchestration ================================================
 
 
@@ -801,6 +882,24 @@ def _probe_backend(timeout: float = 120.0):
 def main() -> None:
     if "--single" in sys.argv:
         print(json.dumps(measure_single()))
+        return
+
+    if "--serving" in sys.argv:
+        # the serving-tier extra: coalesced verifications/sec for M
+        # concurrent small-request clients, with the direct-backend
+        # baseline riding in the same JSON line
+        stats = measure_serving()
+        print(json.dumps({
+            "metric": "serving_coalesced_verifications_per_sec",
+            "value": stats["serving_rate"],
+            "unit": (f"verifs/sec ({stats['clients']} concurrent clients x "
+                     f"single-item ecrecover through the serving tier, "
+                     f"{stats['backend']} backend)"),
+            "vs_baseline": round(
+                stats["serving_rate"] / max(stats["direct_rate"], 1e-9), 4),
+            "extra": {k: v for k, v in stats.items()
+                      if k != "serving_rate"},
+        }))
         return
 
     if "--kperiod" in sys.argv:

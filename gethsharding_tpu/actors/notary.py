@@ -203,8 +203,26 @@ class Notary(Service):
         signed = [c for c in candidates if c[2].signature]
         sig_ok = {}
         if signed:
-            for (shard_id, _, _), good in zip(
-                    signed, self.verify_proposer_signatures(signed)):
+            submit = getattr(self.sig_backend, "submit", None)
+            if submit is not None:
+                # serving backend (--serving): the recovery batch runs on
+                # the serving tier's dispatch thread while THIS thread
+                # fires body-request broadcasts for not-yet-local
+                # collations — the syncer round trips overlap the device
+                # dispatch instead of queueing behind it. Fire-and-forget
+                # only: the authoritative (polling) availability check
+                # stays in submit_vote, so this adds zero stalls.
+                # (Requests for rows that then fail the signature gate
+                # are speculative but harmless: body fetches carry no
+                # vote authority.)
+                digests, sigs = self._proposer_sig_inputs(signed)
+                future = submit("ecrecover_addresses", digests, sigs)
+                for shard_id, p, record in candidates:
+                    self._prefetch_availability(shard_id, p, record)
+                results = self._match_proposers(future.result(), signed)
+            else:
+                results = self.verify_proposer_signatures(signed)
+            for (shard_id, _, _), good in zip(signed, results):
                 sig_ok[shard_id] = good
 
         # phase 3: availability checks + signed vote submission per shard
@@ -479,9 +497,11 @@ class Notary(Service):
                     f"{mismatch}")
 
         # the replay check runs the jax batch kernel; skip it for pure-host
-        # control planes (sigbackend 'python') to keep them accelerator-free
+        # control planes (sigbackend 'python') to keep them accelerator-free.
+        # A serving wrapper keeps the wrapped backend's nature: unwrap it.
+        base = getattr(self.sig_backend, "inner", self.sig_backend)
         replay = (self.client.verify_period_batch(period)
-                  if self.sig_backend.name == "jax" else None)
+                  if base.name == "jax" else None)
         if replay is False:
             consistent = False
             self.audit_mismatches += 1
@@ -499,6 +519,13 @@ class Notary(Service):
         dispatch covers the whole batch: with sigbackend 'jax' this is the
         vmapped recovery ladder over every shard's record at once.
         """
+        digests, sigs = self._proposer_sig_inputs(records)
+        recovered = self.sig_backend.ecrecover_addresses(digests, sigs)
+        return self._match_proposers(recovered, records)
+
+    @staticmethod
+    def _proposer_sig_inputs(records) -> Tuple[list, list]:
+        """(digests, sigs65) for a [(shard_id, period, record)] batch."""
         digests, sigs = [], []
         for shard_id, period, record in records:
             unsigned = CollationHeader(
@@ -509,7 +536,10 @@ class Notary(Service):
             )
             digests.append(bytes(unsigned.hash()))
             sigs.append(record.signature)
-        recovered = self.sig_backend.ecrecover_addresses(digests, sigs)
+        return digests, sigs
+
+    @staticmethod
+    def _match_proposers(recovered, records) -> list:
         return [
             got is not None and got == rec[2].proposer
             for got, rec in zip(recovered, records)
@@ -551,14 +581,16 @@ class Notary(Service):
                 return False
         return True
 
-    def _check_availability(self, shard_id: int, period: int, record) -> bool:
+    def _availability_probe(self, shard_id: int, period: int, record):
+        """(header, verdict): the shardDB's LOCAL answer. True/False is
+        authoritative; None means the body is not local (ShardError), in
+        which case the body request has been broadcast over shardp2p —
+        fire-and-forget, never blocks."""
         header = self._reconstruct_header(shard_id, period, record)
         try:
-            return self.shard.check_availability(header)
+            return header, self.shard.check_availability(header)
         except ShardError:
             pass
-        # body not local: request over shardp2p, then poll briefly — the
-        # responding syncer stores the body asynchronously
         if self.p2p is not None:
             self.p2p.broadcast(
                 CollationBodyRequest(
@@ -568,6 +600,23 @@ class Notary(Service):
                     proposer=record.proposer,
                 )
             )
+        return header, None
+
+    def _prefetch_availability(self, shard_id: int, period: int,
+                               record) -> None:
+        """Fire the body request for a not-yet-local collation NOW so
+        the responding syncer's round trip runs concurrently with
+        whatever this thread overlaps it with; `_check_availability`
+        remains the authoritative (polling) gate."""
+        self._availability_probe(shard_id, period, record)
+
+    def _check_availability(self, shard_id: int, period: int, record) -> bool:
+        header, verdict = self._availability_probe(shard_id, period, record)
+        if verdict is not None:
+            return verdict
+        # body not local: the probe broadcast the request; poll briefly —
+        # the responding syncer stores the body asynchronously
+        if self.p2p is not None:
             for _ in range(20):
                 if self.wait(0.05):
                     return False

@@ -40,7 +40,8 @@ class TXPool(Service):
     def __init__(self, simulate_interval: Optional[float] = 5.0,
                  payload_size: int = 1024, capacity: int = 4096,
                  max_payload: int = 1 << 20,
-                 journal_path: Optional[str] = None):
+                 journal_path: Optional[str] = None,
+                 sig_backend=None):
         super().__init__()
         self.transactions_feed = Feed()
         self.simulate_interval = simulate_interval
@@ -48,11 +49,21 @@ class TXPool(Service):
         self.capacity = capacity
         self.max_payload = max_payload
         self.journal_path = journal_path
+        # opt-in serving-tier wiring (--serving): sender recovery goes
+        # through the coalescing SigBackend, so many submitter threads'
+        # single-tx recoveries share device dispatches instead of each
+        # paying the scalar host path (core/tx_pool.go keeps a sender
+        # cache for the same hot spot)
+        self.sig_backend = sig_backend
         self._nonce = 0
         # sender -> {nonce: tx}; contiguous-from-lowest prefix is pending,
         # the gapped remainder queued (tx_pool.go pending/queue split)
         self._by_sender: Dict[Address20, Dict[int, Transaction]] = {}
         self._hashes: set = set()
+        # tx hash -> sender recovered at admission (core/tx_pool.go's
+        # sender cache): removal paths — take_pending() for every
+        # collation — must never pay recovery again
+        self._senders: Dict[bytes, Address20] = {}
         self.m_known = metrics.gauge("txpool/known")
         self.m_dropped = metrics.counter("txpool/evicted")
 
@@ -89,19 +100,49 @@ class TXPool(Service):
             # price-bump rule, simplified to >)
             if tx.gas_price <= existing.gas_price:
                 raise TxPoolError("replacement transaction underpriced")
-            self._hashes.discard(bytes(existing.hash()))
+            old_hash = bytes(existing.hash())
+            self._hashes.discard(old_hash)
+            self._senders.pop(old_hash, None)
         slot[tx.nonce] = tx
         self._hashes.add(tx_hash)
+        self._senders[tx_hash] = sender
         self._enforce_capacity()
         self.m_known.set(len(self._hashes))
 
     def _sender_of(self, tx: Transaction) -> Address20:
         if tx.v or tx.r or tx.s:
-            sender = recover_sender(tx)
+            if self.sig_backend is not None:
+                try:
+                    sender = self._recover_via_backend(tx)
+                except Exception as exc:  # noqa: BLE001 - the pool's
+                    # contract is TxPoolError only: a serving tier
+                    # shedding under overload (or shutting down) must
+                    # read as a pool rejection the caller can retry,
+                    # not crash the submitter/proposer loop
+                    raise TxPoolError(
+                        f"signature verification unavailable: {exc}"
+                    ) from exc
+            else:
+                sender = recover_sender(tx)
             if sender is None:
                 raise TxPoolError("invalid signature")
             return sender
         return Address20()  # phase-1 opaque txs pool under the zero sender
+
+    def _recover_via_backend(self, tx: Transaction) -> Optional[Address20]:
+        """`recover_sender` through the SigBackend seam: same homestead
+        rule (v = 27 + parity over sig_hash), but the recovery itself is
+        a backend batch row — behind a serving backend, concurrent
+        submitters coalesce into one device dispatch."""
+        if tx.v not in (27, 28):
+            return None
+        try:
+            sig65 = (tx.r.to_bytes(32, "big") + tx.s.to_bytes(32, "big")
+                     + bytes([tx.v - 27]))
+        except (OverflowError, ValueError):
+            return None  # out-of-range r/s: invalid, like the scalar path
+        return self.sig_backend.ecrecover_addresses(
+            [bytes(tx.sig_hash())], [sig65])[0]
 
     def _enforce_capacity(self) -> None:
         """Evict the globally cheapest transactions over capacity
@@ -118,7 +159,9 @@ class TXPool(Service):
             victim = self._by_sender[sender].pop(nonce)
             if not self._by_sender[sender]:
                 del self._by_sender[sender]
-            self._hashes.discard(bytes(victim.hash()))
+            victim_hash = bytes(victim.hash())
+            self._hashes.discard(victim_hash)
+            self._senders.pop(victim_hash, None)
             self.m_dropped.inc()
 
     # -- views (tx_pool.go Pending) ----------------------------------------
@@ -179,7 +222,12 @@ class TXPool(Service):
             if tx_hash not in self._hashes:
                 continue
             self._hashes.discard(tx_hash)
-            sender = self._sender_of(tx)
+            # admission-time sender cache: the removal hot path
+            # (take_pending per collation) must not re-run recovery —
+            # per tx that would be a fresh backend dispatch each
+            sender = self._senders.pop(tx_hash, None)
+            if sender is None:
+                sender = self._sender_of(tx)
             slot = self._by_sender.get(sender)
             if slot is not None:
                 slot.pop(tx.nonce, None)

@@ -63,6 +63,65 @@ class Gauge:
         return {"type": "gauge", "value": self._value}
 
 
+class Histogram:
+    """Fixed-bucket distribution of discrete observations.
+
+    The right shape for batch sizes (the serving layer's coalescing
+    evidence): `Timer`'s reservoir percentiles interpolate between
+    sample values, which is meaningless for discrete quantities that
+    only ever take bucket-shaped values — a histogram reports how many
+    observations fell at-or-below each bound, exactly.
+
+    Snapshot fields are FLAT (``le_<bound>`` / ``le_inf`` counts next
+    to ``count``/``mean``) so the influx exporter and the dashboard
+    render them without nested-dict special cases.
+    """
+
+    DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+
+    def __init__(self, buckets=DEFAULT_BUCKETS) -> None:
+        if not buckets:
+            raise ValueError("histogram needs at least one bucket bound")
+        self._bounds = tuple(sorted(buckets))
+        # one slot per bound + the overflow (> last bound) slot
+        self._counts = [0] * (len(self._bounds) + 1)
+        self._count = 0
+        self._total = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        slot = len(self._bounds)
+        for i, bound in enumerate(self._bounds):
+            if value <= bound:
+                slot = i
+                break
+        with self._lock:
+            self._counts[slot] += 1
+            self._count += 1
+            self._total += value
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def mean(self) -> float:
+        return self._total / self._count if self._count else 0.0
+
+    def bucket_counts(self) -> Dict[str, int]:
+        """Per-bucket counts, NON-cumulative (each observation lands in
+        exactly one slot)."""
+        with self._lock:
+            counts = list(self._counts)
+        out = {f"le_{bound:g}": counts[i]
+               for i, bound in enumerate(self._bounds)}
+        out["le_inf"] = counts[-1]
+        return out
+
+    def snapshot(self) -> dict:
+        return {"type": "histogram", "count": self._count,
+                "mean": round(self.mean(), 3), **self.bucket_counts()}
+
+
 class Timer:
     """Duration observations with percentile snapshots over a recent
     window (ring buffer of the last `reservoir` observations)."""
@@ -149,6 +208,13 @@ class Registry:
     def timer(self, name: str) -> Timer:
         return self._get_or_register(name, Timer)
 
+    def histogram(self, name: str, buckets=None) -> Histogram:
+        """`buckets` applies only on first registration (like every
+        metric here, the first caller defines the instrument)."""
+        factory = (Histogram if buckets is None
+                   else (lambda: Histogram(buckets)))
+        return self._get_or_register(name, factory)
+
     def get(self, name: str) -> Optional[object]:
         return self._metrics.get(name)
 
@@ -172,6 +238,10 @@ def gauge(name: str) -> Gauge:
 
 def timer(name: str) -> Timer:
     return DEFAULT_REGISTRY.timer(name)
+
+
+def histogram(name: str, buckets=None) -> Histogram:
+    return DEFAULT_REGISTRY.histogram(name, buckets=buckets)
 
 
 class PeriodicReporter:

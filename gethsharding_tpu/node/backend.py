@@ -48,12 +48,30 @@ class ShardNode:
                  password: Optional[str] = None,
                  supervise: bool = False,
                  supervise_interval: float = 1.0,
-                 http_port: Optional[int] = None):
+                 http_port: Optional[int] = None,
+                 serving: bool = False,
+                 serving_config=None):
         if actor not in self.ACTORS:
             raise ValueError(f"unknown actor {actor!r}; pick from {self.ACTORS}")
         self.actor = actor
         self.shard_id = shard_id
         self.config = config
+        # --serving: one coalescing tier in front of the chosen backend,
+        # shared by every consumer on this node (notary audits, txpool
+        # sender recovery) — the whole point is one admission queue per
+        # device, so it is built ONCE here, not per service factory
+        self._serving_backend = None
+        if serving:
+            from gethsharding_tpu.serving import (ServingConfig,
+                                                  ServingSigBackend)
+
+            self._serving_backend = ServingSigBackend(
+                get_backend(sig_backend),
+                config=serving_config or ServingConfig())
+
+        def node_sig_backend():
+            return (self._serving_backend if self._serving_backend
+                    is not None else get_backend(sig_backend))
         self._services: Dict[Type, object] = {}
         self._order: List[object] = []
         self._factories: Dict[Type, object] = {}
@@ -106,7 +124,8 @@ class ShardNode:
             lambda: StateMirror(client=client, shard_db=shard_db.db))
 
         if actor == "proposer":
-            txpool = TXPool(simulate_interval=txpool_interval)
+            txpool = TXPool(simulate_interval=txpool_interval,
+                            sig_backend=self._serving_backend)
             self._register(txpool)
             self._register_factory(
                 lambda: Proposer(client=client, txpool=txpool,
@@ -115,7 +134,7 @@ class ShardNode:
             self._register_factory(
                 lambda: Notary(client=client, shard=shard, p2p=p2p,
                                config=config, deposit_flag=deposit,
-                               sig_backend=get_backend(sig_backend),
+                               sig_backend=node_sig_backend(),
                                mirror=self.service(StateMirror)))
         elif actor == "light":
             # the les/light role: no shard data, SMC-anchored proof-
@@ -190,6 +209,9 @@ class ShardNode:
                 service.stop()
             except Exception:
                 pass
+        if self._serving_backend is not None:
+            # after the consumers: a draining actor must still resolve
+            self._serving_backend.close()
 
     # -- supervision (failure detection / elastic recovery) ----------------
 

@@ -61,6 +61,25 @@ def build_parser() -> argparse.ArgumentParser:
                           help="signature verification backend: scalar host "
                                "crypto or batched TPU kernels (the "
                                "reference's native-crypto build seam)")
+    sharding.add_argument("--serving", action="store_true",
+                          help="run signature verification through the "
+                               "micro-batching serving tier: concurrent "
+                               "callers' requests coalesce into shared "
+                               "device dispatches (gethsharding_tpu/"
+                               "serving/)")
+    sharding.add_argument("--serving-max-batch", type=int, default=128,
+                          help="flush a coalesced batch at this many rows "
+                               "(rounded to a sigbackend bucket shape)")
+    sharding.add_argument("--serving-flush-us", type=float, default=500.0,
+                          help="deadline flush: a queued request waits at "
+                               "most this many microseconds for company")
+    sharding.add_argument("--serving-queue-cap", type=int, default=4096,
+                          help="admission cap in rows; beyond it the "
+                               "backpressure policy applies")
+    sharding.add_argument("--serving-policy", default="block",
+                          choices=("block", "shed"),
+                          help="backpressure at the queue cap: block the "
+                               "caller or shed with a fast error")
     sharding.add_argument("--verbosity", default="info",
                           choices=("debug", "info", "warning", "error"))
     sharding.add_argument("--metrics", action="store_true",
@@ -299,6 +318,16 @@ def run_sharding_node(args) -> int:
                 password = fh.read().strip()
         except OSError:
             pass  # treat as a literal password
+    serving_config = None
+    if args.serving:
+        from gethsharding_tpu.serving import ServingConfig
+
+        serving_config = ServingConfig(
+            max_batch=args.serving_max_batch,
+            flush_us=args.serving_flush_us,
+            queue_cap=args.serving_queue_cap,
+            policy=args.serving_policy,
+        )
     node = ShardNode(
         actor=args.actor,
         shard_id=args.shardid,
@@ -313,6 +342,8 @@ def run_sharding_node(args) -> int:
         supervise=args.supervise,
         http_port=args.http,
         hub=hub,
+        serving=args.serving,
+        serving_config=serving_config,
     )
     if hub is not None:
         # the node's public identity in the relay's peer table

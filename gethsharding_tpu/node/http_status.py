@@ -63,7 +63,7 @@ class StatusServer(Service):
             block = node.client.block_number
         except Exception:
             period, block = None, None
-        return {
+        payload = {
             "actor": node.actor,
             "shard_id": node.shard_id,
             "account": node.client.account().hex_str,
@@ -71,6 +71,16 @@ class StatusServer(Service):
             "period": period,
             "restarts": dict(node.restarts),
         }
+        # the serving tier's health at a glance (--serving): queue
+        # depths, coalesced batch sizes, shed counts — the /metrics
+        # snapshot filtered to the serving/ namespace so an operator
+        # reads backpressure state off /status without grepping
+        serving = {name: snap
+                   for name, snap in DEFAULT_REGISTRY.snapshot().items()
+                   if name.startswith("serving/")}
+        if serving:
+            payload["serving"] = serving
+        return payload
 
     def metrics_payload(self) -> dict:
         return DEFAULT_REGISTRY.snapshot()

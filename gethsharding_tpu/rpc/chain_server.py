@@ -42,6 +42,12 @@ def main(argv=None) -> int:
                              "chain process at HOST:PORT (headers "
                              "engine-verified, state via checkpoint "
                              "pull — smc/sync.py)")
+    parser.add_argument("--sigbackend", default="python",
+                        choices=("python", "jax"),
+                        help="backend behind the shard_ecrecover / "
+                             "shard_verifyAggregates serving tier: handler "
+                             "threads coalesce concurrent requests into "
+                             "shared dispatches (jax = batched TPU kernels)")
     parser.add_argument("--verbosity", default="warning")
     args = parser.parse_args(argv)
 
@@ -55,7 +61,13 @@ def main(argv=None) -> int:
         overrides["network_id"] = args.networkid
     config = Config(**overrides)
     backend = SimulatedMainchain(config=config)
-    server = RPCServer(backend, host=args.host, port=args.port)
+    # the serving seam: verification RPCs coalesce across handler
+    # threads onto the chosen backend (built lazily by RPCServer when a
+    # plain SigBackend is handed in)
+    from gethsharding_tpu.sigbackend import get_backend
+
+    server = RPCServer(backend, host=args.host, port=args.port,
+                       sig_backend=get_backend(args.sigbackend))
     server.start()
     follower = None
     if args.follow:

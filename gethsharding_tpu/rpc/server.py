@@ -42,10 +42,19 @@ class RPCServer:
     free one (`server.address` reports the bound endpoint)."""
 
     def __init__(self, backend: SimulatedMainchain,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 sig_backend=None):
         self.backend = backend
-        self._subscribers: dict = {}  # wfile -> lock
+        self._subscribers: dict = {}  # wfile -> (lock, peer id)
         self._sub_lock = threading.Lock()
+        # verification serving seam: handler threads SUBMIT signature
+        # work to the coalescing tier instead of driving a backend
+        # inline, so concurrent RPC clients share device dispatches
+        # (gethsharding_tpu/serving/). Built lazily on first use when
+        # not injected — chain processes that never verify pay nothing.
+        self._sig_backend = sig_backend
+        self._sig_serving = None
+        self._sig_serving_owned = False
         server = self
 
         class Handler(socketserver.StreamRequestHandler):
@@ -86,6 +95,9 @@ class RPCServer:
         self._tcp.server_close()
         if self._thread is not None:
             self._thread.join(timeout=2.0)
+        if self._sig_serving is not None and self._sig_serving_owned:
+            self._sig_serving.close()
+            self._sig_serving = None
 
     # -- head push (eth_subscribe newHeads parity) -------------------------
 
@@ -98,14 +110,20 @@ class RPCServer:
         }) + "\n").encode()
         with self._sub_lock:
             targets = list(self._subscribers.items())
-        for wfile, lock in targets:
+        for wfile, (lock, peer) in targets:
             try:
                 with lock:
                     wfile.write(note)
                     wfile.flush()
-            except OSError:
+            except (OSError, ValueError) as exc:
+                # connection-level failures only: the peer reset/broke
+                # the pipe (OSError) or the handler already closed its
+                # wfile (ValueError). Anything else is a server bug and
+                # must surface to the head-feed caller, not silently
+                # unsubscribe a healthy peer.
                 with self._sub_lock:
                     self._subscribers.pop(wfile, None)
+                log.warning("dropping head subscriber %s: %s", peer, exc)
 
     # -- connection loop ---------------------------------------------------
 
@@ -147,8 +165,12 @@ class RPCServer:
             self.method_calls[method] = self.method_calls.get(method, 0) + 1
         try:
             if method == "shard_subscribe":
+                try:
+                    peer = "%s:%d" % handler.client_address[:2]
+                except (TypeError, IndexError):
+                    peer = repr(handler.client_address)
                 with self._sub_lock:
-                    self._subscribers[handler.wfile] = write_lock
+                    self._subscribers[handler.wfile] = (write_lock, peer)
                 result = "newHeads"
             elif method == "shard_p2pChallenge":
                 import secrets
@@ -280,6 +302,60 @@ class RPCServer:
 
     def rpc_verifyPeriodBatch(self, period):
         return self.backend.verify_period_batch(period)
+
+    # -- verification serving (the coalescing tier) ------------------------
+
+    def _serving(self):
+        """The shared serving backend, built on first use. Injected
+        backends that already expose `submit` are used as-is (and not
+        closed by us); a plain `SigBackend` gets wrapped."""
+        with self._sub_lock:
+            if self._sig_serving is None:
+                inner = self._sig_backend
+                if inner is not None and hasattr(inner, "submit"):
+                    self._sig_serving = inner
+                else:
+                    from gethsharding_tpu.serving import ServingSigBackend
+                    from gethsharding_tpu.sigbackend import get_backend
+
+                    self._sig_serving = ServingSigBackend(
+                        inner or get_backend("python"))
+                    self._sig_serving_owned = True
+            return self._sig_serving
+
+    def rpc_ecrecover(self, digests, sigs):
+        """Batch address recovery for external clients (txpool feeders,
+        light verifiers). The handler thread SUBMITS to the serving
+        tier and parks on the request's future — while this batch waits
+        out its flush window, other connection threads enqueue into the
+        SAME dispatch, so N concurrent small requests cost one device
+        batch instead of N."""
+        future = self._serving().submit(
+            "ecrecover_addresses",
+            [codec.dec_bytes(d) for d in digests],
+            [codec.dec_bytes(s) for s in sigs])
+        return [None if addr is None else codec.enc_bytes(bytes(addr))
+                for addr in future.result()]
+
+    def rpc_verifyAggregates(self, messages, agg_sigs, agg_pks):
+        """Batch aggregate-vote verification over the serving tier (the
+        coalescing analog of the notary's bls_verify_aggregates)."""
+        future = self._serving().submit(
+            "bls_verify_aggregates",
+            [codec.dec_bytes(m) for m in messages],
+            [codec.dec_g1(s) for s in agg_sigs],
+            [codec.dec_g2(p) for p in agg_pks])
+        return [bool(b) for b in future.result()]
+
+    def rpc_servingStats(self):
+        """Dispatch/coalescing counters of the serving tier (None until
+        the first submit builds it)."""
+        with self._sub_lock:
+            serving = self._sig_serving
+        if serving is None or not hasattr(serving, "batcher"):
+            return None
+        return {"dispatches": dict(serving.batcher.dispatch_counts),
+                "shed": serving.batcher.shed_counts()}
 
     # transactions
 

@@ -1,0 +1,41 @@
+"""Verification serving layer: dynamic micro-batching for the hot path.
+
+An inference-server-shaped request-coalescing tier between the actors /
+RPC layer and the batched signature kernels. Every caller of a
+`SigBackend` today drives the device synchronously — one private batch
+per call — so concurrent traffic serializes and small requests pay full
+dispatch latency. This package turns per-caller batches into AGGREGATE
+device batches (the zkSpeed / MSM-outsourcing scheduler shape):
+
+- ``queue.py``    — bounded admission queue: per-request futures,
+  deadline-based flush, explicit backpressure (block / shed).
+- ``batcher.py``  — the dynamic micro-batcher: coalesces concurrent
+  requests per operation into single device dispatches, capped at the
+  sigbackend's quarter-pow2 bucket shapes so coalesced traffic never
+  widens the compile cache.
+- ``pipeline.py`` — double-buffered dispatch: host-side aggregation of
+  batch N+1 overlaps device execution of batch N.
+- ``backend.py``  — ``ServingSigBackend``: the drop-in `SigBackend`
+  wrapper (differential-tested byte-identical against what it wraps)
+  plus the async ``submit()`` future API for RPC handler threads.
+"""
+
+from gethsharding_tpu.serving.backend import ServingConfig, ServingSigBackend
+from gethsharding_tpu.serving.batcher import MicroBatcher, SERVING_OPS
+from gethsharding_tpu.serving.pipeline import PipelinedDispatcher
+from gethsharding_tpu.serving.queue import (
+    AdmissionQueue,
+    Request,
+    ServingOverloadError,
+)
+
+__all__ = [
+    "AdmissionQueue",
+    "MicroBatcher",
+    "PipelinedDispatcher",
+    "Request",
+    "SERVING_OPS",
+    "ServingConfig",
+    "ServingOverloadError",
+    "ServingSigBackend",
+]

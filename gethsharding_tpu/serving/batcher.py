@@ -1,0 +1,250 @@
+"""Dynamic micro-batcher: many callers' rows, one device dispatch.
+
+One `AdmissionQueue` + flusher thread per signature operation (the
+three ops have incompatible batch layouts and separate compiled
+kernels, so they coalesce separately), all feeding ONE shared
+`PipelinedDispatcher`. A flusher drains whatever concurrent callers
+queued, concatenates their rows into single batch columns (host-side
+aggregation — stage 1 of the double buffer), and hands the assembled
+batch to the dispatch thread, then immediately loops back to drain the
+next window while the device executes.
+
+Batch sizing reuses the sigbackend's quarter-power-of-two bucket
+policy (`sigbackend.bucket_size`): `max_batch` is rounded to a bucket
+at construction and partial (deadline) flushes are padded BY THE
+WRAPPED BACKEND to the same buckets it compiles for direct callers —
+coalesced traffic therefore never widens the device compile cache, it
+only fills existing shapes better.
+
+Per-op observability (the registry names the status page groups under
+``serving/``):
+
+- ``serving/<op>/requests``, ``/dispatches``, ``/shed`` counters —
+  the coalescing ratio and the backpressure drop rate;
+- ``serving/<op>/flush_full`` / ``/flush_deadline`` counters — whether
+  traffic is dense enough to fill buckets or the deadline is doing the
+  flushing;
+- ``serving/<op>/batch_rows`` fixed-bucket histogram — the batch-size
+  distribution (discrete sizes: a reservoir-percentile timer would
+  interpolate between bucket shapes that never occur);
+- ``serving/<op>/queue_depth`` gauge, ``/wait_time`` and
+  ``/dispatch_latency`` timers.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Sequence
+
+from gethsharding_tpu import metrics
+from gethsharding_tpu.serving.pipeline import PipelinedDispatcher
+from gethsharding_tpu.serving.queue import (
+    AdmissionQueue,
+    Request,
+    ServingOverloadError,
+)
+
+# the SigBackend batch API surface the serving tier coalesces
+SERVING_OPS = ("ecrecover_addresses", "bls_verify_aggregates",
+               "bls_verify_committees")
+
+# registry-friendly short labels
+_OP_LABELS = {
+    "ecrecover_addresses": "ecrecover",
+    "bls_verify_aggregates": "bls_aggregate",
+    "bls_verify_committees": "bls_committee",
+}
+
+# batch-row histogram buckets: the quarter-pow2 ladder the backend pads
+# to, so each histogram bucket is (roughly) one compiled shape
+_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 48, 64, 96, 128, 192, 256, 384,
+                  512, 768, 1024)
+
+
+class _OpMetrics:
+    """The per-operation metric handles, resolved once."""
+
+    def __init__(self, registry: metrics.Registry, label: str):
+        base = f"serving/{label}"
+        self.requests = registry.counter(f"{base}/requests")
+        self.request_rows = registry.counter(f"{base}/request_rows")
+        self.dispatches = registry.counter(f"{base}/dispatches")
+        self.shed = registry.counter(f"{base}/shed")
+        self.flush_full = registry.counter(f"{base}/flush_full")
+        self.flush_deadline = registry.counter(f"{base}/flush_deadline")
+        self.batch_rows = registry.histogram(f"{base}/batch_rows",
+                                             buckets=_BATCH_BUCKETS)
+        self.queue_depth = registry.gauge(f"{base}/queue_depth")
+        self.wait_time = registry.timer(f"{base}/wait_time")
+        self.dispatch_latency = registry.timer(f"{base}/dispatch_latency")
+
+
+class MicroBatcher:
+    """Coalesce concurrent per-op requests into single inner-backend calls.
+
+    `submit()` is the only producer entry: it validates shape, enqueues
+    a `Request`, and returns its future. Results come back per-request
+    in the caller's own row order — coalescing is invisible except in
+    the dispatch counters.
+    """
+
+    def __init__(self, inner, max_batch: int = 128,
+                 flush_us: float = 500.0, queue_cap: int = 4096,
+                 policy: str = "block",
+                 registry: metrics.Registry = metrics.DEFAULT_REGISTRY):
+        from gethsharding_tpu.sigbackend import bucket_size
+
+        self.inner = inner
+        # full-flush quantum = a compiled bucket shape, never between two
+        self.max_batch = bucket_size(max(1, max_batch))
+        self.flush_us = flush_us
+        self.queue_cap = queue_cap
+        self.policy = policy
+        # per-op dispatch counts mutated only on the dispatch thread;
+        # tests read them after joining traffic
+        self.dispatch_counts: Dict[str, int] = {op: 0 for op in SERVING_OPS}
+        self._metrics = {op: _OpMetrics(registry, _OP_LABELS[op])
+                         for op in SERVING_OPS}
+        self._queues = {
+            op: AdmissionQueue(cap_rows=queue_cap, policy=policy,
+                               max_batch=self.max_batch, flush_us=flush_us)
+            for op in SERVING_OPS
+        }
+        self._dispatcher = PipelinedDispatcher()
+        self._flushers: List[threading.Thread] = []
+        self._closed = False
+        for op in SERVING_OPS:
+            thread = threading.Thread(
+                target=self._flush_loop, args=(op,),
+                name=f"serving-flush-{_OP_LABELS[op]}", daemon=True)
+            self._flushers.append(thread)
+            thread.start()
+
+    # -- producer ----------------------------------------------------------
+
+    def submit(self, op: str, args: Sequence[Sequence], rows: int) -> Future:
+        """Enqueue one request; returns the future of its per-row results."""
+        if op not in SERVING_OPS:
+            raise ValueError(f"unknown serving op {op!r}; "
+                             f"choose from {SERVING_OPS}")
+        if self._closed:
+            raise RuntimeError("serving batcher is closed")
+        for column in args:
+            if len(column) != rows:
+                # reject HERE: a short column concatenated into a
+                # coalesced batch would misalign every batch-mate's rows
+                raise ValueError(
+                    f"{op}: column of {len(column)} rows in a "
+                    f"{rows}-row request")
+        met = self._metrics[op]
+        met.requests.inc()
+        met.request_rows.inc(rows)
+        if rows == 0:
+            # nothing to coalesce; resolve without touching the queue so
+            # empty probes can't occupy flush windows
+            future: Future = Future()
+            future.set_result([])
+            return future
+        request = Request(op, tuple(args), rows)
+        queue = self._queues[op]
+        try:
+            queue.put(request)
+        except ServingOverloadError:
+            met.shed.inc()
+            raise
+        met.queue_depth.set(queue.depth_rows)
+        return request.future
+
+    # -- consumer ----------------------------------------------------------
+
+    def _flush_loop(self, op: str) -> None:
+        queue = self._queues[op]
+        met = self._metrics[op]
+        while True:
+            batch, reason = queue.take_batch()
+            if batch is None:
+                return
+            met.queue_depth.set(queue.depth_rows)
+            if reason == AdmissionQueue.FLUSH_FULL:
+                met.flush_full.inc()
+            elif reason == AdmissionQueue.FLUSH_DEADLINE:
+                met.flush_deadline.inc()
+            try:
+                now = time.monotonic()
+                rows = 0
+                for request in batch:
+                    met.wait_time.observe(request.wait_s(now))
+                    rows += request.rows
+                met.batch_rows.observe(rows)
+                # host-side aggregation HERE, on the flusher thread: the
+                # dispatch thread may still be executing the previous
+                # batch (the double-buffer overlap pipeline.py documents)
+                n_args = len(batch[0].args)
+                cols = tuple(
+                    [row for request in batch for row in request.args[i]]
+                    for i in range(n_args))
+                self._dispatcher.submit(
+                    lambda batch=batch, cols=cols, rows=rows:
+                    self._run_batch(op, batch, cols, rows))
+            except Exception as exc:  # noqa: BLE001 - a malformed batch
+                # must fail ITS futures, not kill the op's only consumer
+                # (a dead flusher would hang every later caller forever)
+                for request in batch:
+                    if not request.future.done():
+                        request.future.set_exception(exc)
+
+    def _run_batch(self, op: str, batch: List[Request], cols: tuple,
+                   rows: int) -> None:
+        """Stage 2 (dispatch thread): one inner-backend call, results
+        sliced back out per request."""
+        met = self._metrics[op]
+        try:
+            with met.dispatch_latency.time():
+                out = list(self._dispatch(op, cols))
+            if len(out) != rows:
+                raise RuntimeError(
+                    f"{op} returned {len(out)} results for {rows} rows")
+        except Exception as exc:  # noqa: BLE001 - fail the batch, keep serving
+            for request in batch:
+                request.future.set_exception(exc)
+            return
+        self.dispatch_counts[op] += 1
+        met.dispatches.inc()
+        offset = 0
+        for request in batch:
+            request.future.set_result(out[offset:offset + request.rows])
+            offset += request.rows
+
+    def _dispatch(self, op: str, cols: tuple):
+        if op == "bls_verify_committees":
+            messages, sig_rows, pk_rows, keys = cols
+            if any(key is not None for key in keys):
+                return self.inner.bls_verify_committees(
+                    messages, sig_rows, pk_rows, pk_row_keys=keys)
+            return self.inner.bls_verify_committees(
+                messages, sig_rows, pk_rows)
+        return getattr(self.inner, op)(*cols)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self) -> None:
+        """Drain queued requests, stop the flushers and the dispatcher."""
+        if self._closed:
+            return
+        self._closed = True
+        for queue in self._queues.values():
+            queue.close()
+        for thread in self._flushers:
+            thread.join(timeout=10.0)
+        self._dispatcher.close(wait=True)
+
+    # -- observability -----------------------------------------------------
+
+    def queue_depth_rows(self, op: str) -> int:
+        return self._queues[op].depth_rows
+
+    def shed_counts(self) -> Dict[str, int]:
+        return {op: queue.shed_requests
+                for op, queue in self._queues.items()}

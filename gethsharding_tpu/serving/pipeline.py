@@ -1,0 +1,83 @@
+"""Double-buffered dispatch: overlap host work for batch N+1 with N.
+
+The serving tier splits each flush into two stages on two threads:
+
+- the per-op FLUSHER thread drains the admission queue and does the
+  host-side aggregation — concatenating the coalesced requests' rows
+  into one set of batch columns (and, inside the wrapped backend, the
+  limb marshalling + bucket padding);
+- ONE shared DISPATCH thread drives the device.
+
+`PipelinedDispatcher` is the handoff between them: a depth-1 queue of
+ready batches. While the dispatch thread executes batch N, the flusher
+drains and assembles batch N+1 and parks it in the slot — the double
+buffer. A third batch blocks the flusher, which in turn lets the
+admission queue fill, which is exactly the backpressure chain we want:
+the device's pace propagates to callers instead of batches piling up
+in unbounded memory.
+
+One dispatcher is shared by ALL operation flushers on purpose — there
+is one device, and serializing dispatches through a single thread keeps
+the compiled-executable working set warm and the dispatch timeline
+observable (a per-op thread pool would just move the serialization to
+the device lock with worse fairness).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Callable, Optional
+
+log = logging.getLogger("serving.pipeline")
+
+
+class PipelinedDispatcher:
+    """A single dispatch thread behind a bounded ready-batch slot.
+
+    `submit(fn)` parks a zero-arg callable (a fully assembled batch
+    bound to its requests' futures) and returns as soon as the slot has
+    room; the dispatch thread runs callables in submission order. The
+    callable owns its own error handling (it must route failures to its
+    batch's futures) — a raise here would mean requests hang, so the
+    run loop also backstops unexpected escapes.
+    """
+
+    _SENTINEL = None
+
+    def __init__(self, name: str = "serving-dispatch", depth: int = 1):
+        # depth 1 = classic double buffering: one batch executing, one
+        # assembled and waiting
+        self._ready: "queue.Queue[Optional[Callable[[], None]]]" = (
+            queue.Queue(maxsize=max(1, depth)))
+        self._thread = threading.Thread(
+            target=self._run, name=name, daemon=True)
+        self._thread.start()
+        self._closed = False
+
+    def submit(self, fn: Callable[[], None]) -> None:
+        """Hand one assembled batch to the dispatch thread (blocks while
+        both buffers are busy — the backpressure edge)."""
+        if self._closed:
+            raise RuntimeError("dispatcher is closed")
+        self._ready.put(fn)
+
+    def close(self, wait: bool = True) -> None:
+        """Stop after draining already-submitted batches."""
+        if self._closed:
+            return
+        self._closed = True
+        self._ready.put(self._SENTINEL)
+        if wait:
+            self._thread.join(timeout=10.0)
+
+    def _run(self) -> None:
+        while True:
+            fn = self._ready.get()
+            if fn is self._SENTINEL:
+                return
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 - futures already failed; keep serving
+                log.exception("dispatch batch escaped its error handler")

@@ -1,0 +1,171 @@
+"""Bounded admission queue: futures, deadline flush, backpressure.
+
+The front door of the serving tier. Producers (actor threads, RPC
+handler threads) `put()` requests; ONE consumer per operation drains
+with `take_batch()`, which blocks until a flush condition holds:
+
+- **full**: at least `max_batch` rows are queued — a full device bucket
+  is ready, dispatch now;
+- **deadline**: `flush_us` microseconds elapsed since the OLDEST queued
+  request — a lone small request never waits longer than the latency
+  budget for company that isn't coming;
+- **close**: shutdown drains whatever is left.
+
+Backpressure is explicit, not accidental: when queued rows reach
+`cap_rows`, `put()` either blocks until the drain frees space
+(policy ``block`` — callers absorb the device's pace) or raises
+`ServingOverloadError` immediately (policy ``shed`` — callers get a
+fast failure they can retry/queue upstream, and the shed is counted).
+The reference behavior this replaces — every caller dispatching
+privately — has neither: overload just piles threads onto the device
+lock. Capacity is accounted in ROWS (verification items), not request
+objects, since rows are what size the device batch.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import List, Optional, Tuple
+
+
+class ServingOverloadError(RuntimeError):
+    """The admission queue is at capacity and the policy is ``shed``."""
+
+
+class Request:
+    """One caller's batch of verification rows plus its completion future.
+
+    `args` holds the operation's per-row parallel sequences (e.g.
+    ``(digests, sigs65)``); `rows` is their common length. The future
+    resolves to the per-row results in the caller's own order.
+    """
+
+    __slots__ = ("op", "args", "rows", "future", "enqueued_at")
+
+    def __init__(self, op: str, args: tuple, rows: int):
+        self.op = op
+        self.args = args
+        self.rows = rows
+        self.future: Future = Future()
+        self.enqueued_at = time.monotonic()
+
+    def wait_s(self, now: Optional[float] = None) -> float:
+        """Seconds this request has been queued."""
+        return (time.monotonic() if now is None else now) - self.enqueued_at
+
+
+class AdmissionQueue:
+    """Bounded FIFO of `Request`s with deadline-based flush.
+
+    One queue per operation; `take_batch()` drains WHOLE requests (a
+    request's rows are never split across dispatches) up to `max_batch`
+    rows, always taking at least one request so an oversized caller
+    batch still flows through as its own dispatch.
+    """
+
+    FLUSH_FULL = "full"
+    FLUSH_DEADLINE = "deadline"
+    FLUSH_CLOSE = "close"
+
+    def __init__(self, cap_rows: int = 4096, policy: str = "block",
+                 max_batch: int = 128, flush_us: float = 500.0):
+        if policy not in ("block", "shed"):
+            raise ValueError(f"unknown backpressure policy {policy!r}; "
+                             f"choose 'block' or 'shed'")
+        if cap_rows < max_batch:
+            # a cap below one flush quantum would let the queue starve the
+            # batcher of ever reaching a full bucket
+            cap_rows = max_batch
+        self.cap_rows = cap_rows
+        self.policy = policy
+        self.max_batch = max_batch
+        self.flush_s = flush_us / 1e6
+        self.shed_requests = 0
+        self.shed_rows = 0
+        self._items: List[Request] = []
+        self._rows = 0
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
+        self._closed = False
+
+    # -- producer side -----------------------------------------------------
+
+    def put(self, request: Request) -> None:
+        """Admit `request`, applying the backpressure policy at the cap.
+
+        A request is admitted whenever current depth is below the cap
+        (even if its own rows push past it) — an always-oversized request
+        must not deadlock against a cap it can never fit under.
+        """
+        with self._lock:
+            while self._rows >= self.cap_rows and not self._closed:
+                if self.policy == "shed":
+                    self.shed_requests += 1
+                    self.shed_rows += request.rows
+                    raise ServingOverloadError(
+                        f"serving queue for {request.op} at capacity "
+                        f"({self._rows}/{self.cap_rows} rows); request shed")
+                self._not_full.wait()
+            if self._closed:
+                raise RuntimeError("serving queue is closed")
+            self._items.append(request)
+            self._rows += request.rows
+            self._not_empty.notify()
+
+    # -- consumer side -----------------------------------------------------
+
+    def take_batch(self) -> Tuple[Optional[List[Request]], str]:
+        """Block until a flush condition holds; drain one batch.
+
+        Returns ``(requests, reason)`` with reason in {'full',
+        'deadline', 'close'}; ``(None, 'close')`` once closed AND empty.
+        """
+        with self._lock:
+            while True:
+                if self._items:
+                    if self._rows >= self.max_batch:
+                        reason = self.FLUSH_FULL
+                        break
+                    if self._closed:
+                        reason = self.FLUSH_CLOSE
+                        break
+                    deadline = self._items[0].enqueued_at + self.flush_s
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        reason = self.FLUSH_DEADLINE
+                        break
+                    self._not_empty.wait(timeout=remaining)
+                else:
+                    if self._closed:
+                        return None, self.FLUSH_CLOSE
+                    self._not_empty.wait()
+            batch: List[Request] = []
+            rows = 0
+            while self._items and (
+                    not batch or rows + self._items[0].rows <= self.max_batch):
+                request = self._items.pop(0)
+                batch.append(request)
+                rows += request.rows
+            self._rows -= rows
+            self._not_full.notify_all()
+            return batch, reason
+
+    def close(self) -> None:
+        """Stop admitting; wake the consumer to drain the remainder."""
+        with self._lock:
+            self._closed = True
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
+
+    # -- observability -----------------------------------------------------
+
+    @property
+    def depth_rows(self) -> int:
+        return self._rows
+
+    @property
+    def depth_requests(self) -> int:
+        return len(self._items)

@@ -15,6 +15,11 @@ same seam is a runtime-selected backend object:
 Both backends implement the same API and are differential-tested against
 each other (tests/test_sigbackend.py). Actors take a backend instance;
 the CLI exposes ``--sigbackend``.
+
+- ``serving-python`` / ``serving-jax``: either backend behind the
+  request-coalescing serving tier (``gethsharding_tpu/serving/``) —
+  concurrent small calls from many threads share device dispatches;
+  the CLI's ``--serving`` flag wires the same wrapper.
 """
 
 from __future__ import annotations
@@ -25,6 +30,30 @@ from typing import List, Optional, Sequence, Tuple
 from gethsharding_tpu.crypto import bn256 as bls
 from gethsharding_tpu.crypto import secp256k1 as ecdsa
 from gethsharding_tpu.utils.hexbytes import Address20
+
+
+def bucket_size(n: int) -> int:
+    """THE batch padding policy: quarter-power-of-two buckets (…, 64,
+    80, 96, 112, 128, …) — a handful of compiled shapes per octave
+    instead of one per distinct batch size, with <19% padded rows above
+    8 (worst case 65 -> 80); the plain pow2 rule wasted 28% of every
+    kernel launch at the production 100-shard audit (100 -> 128).
+
+    Public and single-sourced on purpose: the serving layer sizes its
+    coalesced flush quanta with the SAME function the jax backend pads
+    with, so coalesced traffic lands on shapes the device has already
+    compiled instead of widening the compile cache."""
+    if n <= 8:  # pow2 below 8: tiny pads, few compiled shapes
+        size = 1
+        while size < n:
+            size *= 2
+        return size
+    size = 8
+    while size * 2 < n:
+        size *= 2
+    # quarter steps inside the octave (size, 2*size]
+    quarter = size // 4
+    return -(-n // quarter) * quarter
 
 
 class SigBackend:
@@ -135,24 +164,9 @@ class JaxSigBackend(SigBackend):
         self._pk_row_cache: dict = {}
         self._pk_row_lock = threading.Lock()
 
-    @staticmethod
-    def _bucket(n: int) -> int:
-        """Pad batches to quarter-power-of-two buckets (…, 64, 80, 96,
-        112, 128, …): a handful of compiled shapes per octave instead of
-        one per distinct batch size, with <19% padded rows above 8
-        (worst case 65 -> 80) — the plain pow2 rule wasted 28% of every
-        kernel launch at the production 100-shard audit (100 -> 128)."""
-        if n <= 8:  # pow2 below 8: tiny pads, few compiled shapes
-            size = 1
-            while size < n:
-                size *= 2
-            return size
-        size = 8
-        while size * 2 < n:
-            size *= 2
-        # quarter steps inside the octave (size, 2*size]
-        quarter = size // 4
-        return -(-n // quarter) * quarter
+    # the module-level bucket_size, kept as a staticmethod so kernel
+    # call sites read as "this backend's padding policy"
+    _bucket = staticmethod(bucket_size)
 
     def ecrecover_addresses(self, digests, sigs65):
         import numpy as np
@@ -388,12 +402,32 @@ class JaxSigBackend(SigBackend):
         return xs, ys, mask
 
 
-_BACKENDS = {"python": PythonSigBackend, "jax": JaxSigBackend}
+def _serving_factory(inner_name: str):
+    """Factory for the serving-tier wrappers ('serving-python' /
+    'serving-jax'): the wrapped backend stays the process singleton, the
+    wrapper adds the micro-batching admission tier in front of it. Lazy
+    import: control planes that never serve must not pay for the
+    serving threads module."""
+    def build() -> SigBackend:
+        from gethsharding_tpu.serving.backend import ServingSigBackend
+
+        return ServingSigBackend(get_backend(inner_name))
+
+    return build
+
+
+_BACKENDS = {
+    "python": PythonSigBackend,
+    "jax": JaxSigBackend,
+    "serving-python": _serving_factory("python"),
+    "serving-jax": _serving_factory("jax"),
+}
 _cache: dict = {}
 
 
 def get_backend(name: str = "python") -> SigBackend:
-    """Backend registry: 'python' (scalar host) or 'jax' (batched TPU)."""
+    """Backend registry: 'python' (scalar host), 'jax' (batched TPU), or
+    the 'serving-*' coalescing wrappers over either."""
     if name not in _BACKENDS:
         raise ValueError(
             f"unknown sigbackend {name!r}; choose from {sorted(_BACKENDS)}")

@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""Soak driver for the verification serving tier.
+
+M client threads hammer the serving backend with small ecrecover
+requests for a fixed duration, verifying EVERY result against the
+known signer (zero-divergence soak, not just throughput), while a
+reporter prints one JSON stats line per interval:
+
+    python scripts/serving_stress.py --clients 32 --duration 30 \
+        --policy shed --queue-cap 256 --flush-us 500
+
+What to look for:
+- `rate`: served verifications/sec (coalesced) — should sit well above
+  the direct-backend rate for the same client count (bench.py --serving
+  reports that baseline next to it);
+- `coalesce_ratio`: requests per device dispatch — the amortization;
+- `shed`: with --policy shed, how much traffic the admission cap
+  refused (should be zero until the offered load exceeds the device);
+- `queue_depth` / `wait_p50_ms`: the backpressure state.
+
+Exit code 1 on any result divergence or hung client.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gethsharding_tpu import metrics
+from gethsharding_tpu.crypto import secp256k1 as ecdsa
+from gethsharding_tpu.crypto.keccak import keccak256
+from gethsharding_tpu.serving import (ServingConfig, ServingOverloadError,
+                                      ServingSigBackend)
+from gethsharding_tpu.sigbackend import get_backend
+
+
+def build_cases(n: int):
+    """n distinct (digest, sig65, expected address) rows."""
+    cases = []
+    for i in range(n):
+        priv = int.from_bytes(keccak256(b"soak-%d" % i), "big") % ecdsa.N
+        digest = keccak256(b"soak-msg-%d" % i)
+        cases.append((digest, ecdsa.sign(digest, priv).to_bytes65(),
+                      ecdsa.priv_to_address(priv)))
+    return cases
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        description="soak the verification serving tier")
+    parser.add_argument("--clients", type=int, default=16)
+    parser.add_argument("--duration", type=float, default=10.0,
+                        help="seconds of offered load")
+    parser.add_argument("--backend", default="python",
+                        choices=("python", "jax"),
+                        help="wrapped backend (jax needs an accelerator)")
+    parser.add_argument("--max-batch", type=int, default=128)
+    parser.add_argument("--flush-us", type=float, default=500.0)
+    parser.add_argument("--queue-cap", type=int, default=4096)
+    parser.add_argument("--policy", default="block",
+                        choices=("block", "shed"))
+    parser.add_argument("--report-interval", type=float, default=2.0)
+    parser.add_argument("--cases", type=int, default=256,
+                        help="distinct signed rows cycled by the clients")
+    args = parser.parse_args()
+
+    cases = build_cases(args.cases)
+    serving = ServingSigBackend(
+        get_backend(args.backend),
+        ServingConfig(max_batch=args.max_batch, flush_us=args.flush_us,
+                      queue_cap=args.queue_cap, policy=args.policy))
+
+    done = [0] * args.clients
+    shed = [0] * args.clients
+    divergences: list = []
+    deadline = time.monotonic() + args.duration
+    stop = threading.Event()
+
+    def client(c: int) -> None:
+        i = c  # stagger the case cycle per client
+        while time.monotonic() < deadline and not stop.is_set():
+            digest, sig, want = cases[i % len(cases)]
+            i += args.clients
+            try:
+                got = serving.ecrecover_addresses([digest], [sig])
+            except ServingOverloadError:
+                shed[c] += 1
+                continue
+            if got != [want]:
+                divergences.append((c, i))
+                stop.set()
+                return
+            done[c] += 1
+
+    threads = [threading.Thread(target=client, args=(c,), daemon=True)
+               for c in range(args.clients)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+
+    wait_timer = metrics.DEFAULT_REGISTRY.timer("serving/ecrecover/wait_time")
+    last_done = 0
+    while time.monotonic() < deadline and not stop.is_set():
+        time.sleep(min(args.report_interval, deadline - time.monotonic())
+                   if deadline > time.monotonic() else 0)
+        total = sum(done)
+        print(json.dumps({
+            "t_s": round(time.monotonic() - t0, 1),
+            "done": total,
+            "rate": round((total - last_done) / args.report_interval, 1),
+            "shed": sum(shed),
+            "dispatches": serving.dispatch_count,
+            "coalesce_ratio": round(total / max(1, serving.dispatch_count),
+                                    1),
+            "queue_depth": serving.batcher.queue_depth_rows(
+                "ecrecover_addresses"),
+            "wait_p50_ms": round(wait_timer.percentile(0.5) * 1e3, 2),
+        }), flush=True)
+        last_done = total
+
+    for t in threads:
+        t.join(timeout=30)
+    hung = [t for t in threads if t.is_alive()]
+    wall = time.monotonic() - t0
+    serving.close()
+
+    total = sum(done)
+    print(json.dumps({
+        "summary": True,
+        "clients": args.clients,
+        "policy": args.policy,
+        "wall_s": round(wall, 2),
+        "done": total,
+        "rate": round(total / wall, 1) if wall else 0.0,
+        "shed": sum(shed),
+        "dispatches": serving.dispatch_count,
+        "coalesce_ratio": round(total / max(1, serving.dispatch_count), 1),
+        "divergences": len(divergences),
+        "hung_clients": len(hung),
+    }), flush=True)
+    return 1 if divergences or hung else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

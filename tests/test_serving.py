@@ -1,0 +1,589 @@
+"""The verification serving layer: dynamic micro-batching, backpressure,
+pipelined dispatch (gethsharding_tpu/serving/).
+
+Four contracts:
+- COALESCING: N concurrent single-item callers share device dispatches
+  (the acceptance bar: >= 4x fewer dispatches than requests at 64
+  callers, zero result divergence).
+- BACKPRESSURE: at the queue cap, policy 'shed' fails fast with counted
+  ServingOverloadError while already-admitted requests still complete.
+- LATENCY: a lone request flushes at the deadline, never waits for a
+  full bucket.
+- PARITY: `ServingSigBackend` is a drop-in `SigBackend` — byte-identical
+  results to the wrapped python backend on every operation, including
+  the invalid/tampered rows of the sigbackend differential contract.
+"""
+
+import threading
+import time
+
+import pytest
+
+from gethsharding_tpu import metrics
+from gethsharding_tpu.crypto import bn256 as bls
+from gethsharding_tpu.crypto import secp256k1 as ecdsa
+from gethsharding_tpu.crypto.keccak import keccak256
+from gethsharding_tpu.serving import (
+    AdmissionQueue,
+    ServingConfig,
+    ServingOverloadError,
+    ServingSigBackend,
+)
+from gethsharding_tpu.sigbackend import SigBackend, bucket_size, get_backend
+
+
+class CountingSigBackend(SigBackend):
+    """Deterministic fake: records every dispatch's batch size; results
+    are a pure function of the row so divergence is detectable."""
+
+    name = "counting"
+
+    def __init__(self, delay_s: float = 0.0):
+        self.calls = []
+        self.delay_s = delay_s
+        self._lock = threading.Lock()
+
+    def _record(self, n: int) -> None:
+        with self._lock:
+            self.calls.append(n)
+        if self.delay_s:
+            time.sleep(self.delay_s)
+
+    def ecrecover_addresses(self, digests, sigs65):
+        self._record(len(digests))
+        return [bytes(d)[:20] for d in digests]
+
+    def bls_verify_aggregates(self, messages, agg_sigs, agg_pks):
+        self._record(len(messages))
+        return [len(bytes(m)) % 2 == 0 for m in messages]
+
+    def bls_verify_committees(self, messages, sig_rows, pk_rows,
+                              pk_row_keys=None):
+        self._record(len(messages))
+        return [len(r) > 0 for r in sig_rows]
+
+    @property
+    def dispatches(self) -> int:
+        return len(self.calls)
+
+
+def _registry() -> metrics.Registry:
+    """A private registry per test: assertions must not see other tests'
+    serving traffic through the process-default registry."""
+    return metrics.Registry()
+
+
+# -- the padding-policy export ---------------------------------------------
+
+
+def test_bucket_size_public_helper():
+    """bucket_size is the single padding policy, exported: quarter-pow2
+    above 8, pow2 below, and the jax backend's staticmethod IS it."""
+    from gethsharding_tpu.sigbackend import JaxSigBackend
+
+    assert [bucket_size(n) for n in (1, 2, 3, 5, 8)] == [1, 2, 4, 8, 8]
+    assert bucket_size(65) == 80
+    assert bucket_size(100) == 112
+    assert bucket_size(128) == 128
+    assert bucket_size(129) == 160
+    assert JaxSigBackend._bucket is bucket_size
+    # monotone and never shrinking: a coalesced batch can only land on
+    # the same-or-larger compiled shape as its pieces
+    sizes = [bucket_size(n) for n in range(1, 300)]
+    assert all(s >= n for n, s in enumerate(sizes, start=1))
+    assert sizes == sorted(sizes)
+
+
+# -- coalescing (the acceptance criterion) ---------------------------------
+
+
+def test_concurrent_callers_coalesce():
+    """64 concurrent single-item callers -> >= 4x fewer dispatches than
+    requests, zero result divergence."""
+    fake = CountingSigBackend(delay_s=0.005)
+    serving = ServingSigBackend(
+        fake, ServingConfig(max_batch=64, flush_us=50_000),
+        registry=_registry())
+    n = 64
+    digests = [keccak256(b"co-%d" % i) for i in range(n)]
+    sigs = [bytes([i]) * 65 for i in range(n)]
+    barrier = threading.Barrier(n)
+    results: dict = {}
+
+    def caller(i: int) -> None:
+        barrier.wait()
+        results[i] = serving.ecrecover_addresses([digests[i]], [sigs[i]])
+
+    threads = [threading.Thread(target=caller, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    try:
+        assert len(results) == n
+        for i in range(n):  # zero divergence vs the fake's pure function
+            assert results[i] == [digests[i][:20]]
+        assert serving.dispatch_count == fake.dispatches
+        assert serving.dispatch_count * 4 <= n, (
+            f"{serving.dispatch_count} dispatches for {n} requests")
+        assert sum(fake.calls) == n  # every row dispatched exactly once
+    finally:
+        serving.close()
+
+
+def test_mixed_size_requests_preserve_row_order():
+    """Coalescing concatenates many callers' rows; each future must get
+    back exactly its own slice, in its own order."""
+    fake = CountingSigBackend()
+    serving = ServingSigBackend(
+        fake, ServingConfig(max_batch=128, flush_us=20_000),
+        registry=_registry())
+    futures = []
+    expected = []
+    for size in (3, 1, 5, 2, 8):
+        digests = [keccak256(b"mix-%d-%d" % (size, j)) for j in range(size)]
+        sigs = [b"\x00" * 65] * size
+        futures.append(serving.submit("ecrecover_addresses", digests, sigs))
+        expected.append([d[:20] for d in digests])
+    try:
+        for future, want in zip(futures, expected):
+            assert future.result(timeout=10) == want
+    finally:
+        serving.close()
+
+
+def test_empty_request_resolves_immediately():
+    fake = CountingSigBackend()
+    serving = ServingSigBackend(fake, registry=_registry())
+    try:
+        assert serving.ecrecover_addresses([], []) == []
+        assert fake.dispatches == 0
+    finally:
+        serving.close()
+
+
+# -- backpressure ----------------------------------------------------------
+
+
+def test_shed_policy_at_queue_cap():
+    """With policy 'shed', overload fails fast (counted), and every
+    ADMITTED request still completes correctly."""
+    registry = _registry()
+    fake = CountingSigBackend(delay_s=0.15)  # slow device: queue backs up
+    serving = ServingSigBackend(
+        fake, ServingConfig(max_batch=4, flush_us=0, queue_cap=4,
+                            policy="shed"),
+        registry=registry)
+    futures, shed = [], 0
+    digest = keccak256(b"shed")
+    try:
+        for _ in range(64):
+            try:
+                futures.append(serving.submit(
+                    "ecrecover_addresses", [digest], [b"\x00" * 65]))
+            except ServingOverloadError:
+                shed += 1
+        assert shed > 0
+        assert futures  # the in-flight window was admitted
+        for future in futures:
+            assert future.result(timeout=30) == [digest[:20]]
+        assert serving.batcher.shed_counts()["ecrecover_addresses"] == shed
+        assert registry.counter("serving/ecrecover/shed").value == shed
+    finally:
+        serving.close()
+
+
+def test_block_policy_absorbs_overload():
+    """Policy 'block' never sheds: all requests complete once the device
+    drains the backlog."""
+    fake = CountingSigBackend(delay_s=0.01)
+    serving = ServingSigBackend(
+        fake, ServingConfig(max_batch=8, flush_us=0, queue_cap=8,
+                            policy="block"),
+        registry=_registry())
+    digest = keccak256(b"block")
+    try:
+        futures = [serving.submit("ecrecover_addresses", [digest],
+                                  [b"\x00" * 65]) for _ in range(64)]
+        for future in futures:
+            assert future.result(timeout=30) == [digest[:20]]
+        assert sum(fake.calls) == 64
+    finally:
+        serving.close()
+
+
+def test_admission_queue_oversized_request_never_deadlocks():
+    """A request larger than the cap is admitted when the queue is below
+    the cap (the cap is a high-water mark, not a hard ceiling) and is
+    dispatched alone."""
+    queue = AdmissionQueue(cap_rows=4, policy="block", max_batch=4,
+                           flush_us=0)
+    from gethsharding_tpu.serving.queue import Request
+
+    big = Request("ecrecover_addresses", ((), ()), rows=16)
+    queue.put(big)
+    batch, reason = queue.take_batch()
+    assert batch == [big] and reason == "full"  # >= max_batch rows queued
+    assert queue.depth_rows == 0
+
+
+# -- deadline flush --------------------------------------------------------
+
+
+def test_deadline_flush_latency():
+    """A lone request must flush at the deadline, not wait for a full
+    bucket; the flush-reason counter attributes it."""
+    registry = _registry()
+    fake = CountingSigBackend()
+    serving = ServingSigBackend(
+        fake, ServingConfig(max_batch=1024, flush_us=5_000),
+        registry=registry)
+    digest = keccak256(b"deadline")
+    try:
+        t0 = time.monotonic()
+        out = serving.ecrecover_addresses([digest], [b"\x00" * 65])
+        elapsed = time.monotonic() - t0
+        assert out == [digest[:20]]
+        assert elapsed < 2.0  # 5 ms deadline + scheduling slack, not "never"
+        assert registry.counter("serving/ecrecover/flush_deadline").value >= 1
+        assert registry.counter("serving/ecrecover/flush_full").value == 0
+        hist = registry.histogram("serving/ecrecover/batch_rows")
+        assert hist.count == 1 and hist.bucket_counts()["le_1"] == 1
+    finally:
+        serving.close()
+
+
+# -- drop-in parity with the wrapped backend -------------------------------
+
+
+def _ecdsa_cases():
+    """Valid + invalid recovery rows (the test_sigbackend contract)."""
+    digests, sigs = [], []
+    for i in range(4):
+        priv = int.from_bytes(keccak256(b"sv" + bytes([i])), "big") % ecdsa.N
+        msg = keccak256(b"m" + bytes([i]))
+        digests.append(msg)
+        sigs.append(ecdsa.sign(msg, priv).to_bytes65())
+    digests.append(keccak256(b"x"))
+    sigs.append(b"\x00" * 10)  # truncated
+    digests.append(keccak256(b"y"))
+    sigs.append(b"\x00" * 64 + b"\x00")  # zeroed r
+    return digests, sigs
+
+
+def test_serving_matches_python_backend_ecrecover():
+    python = get_backend("python")
+    serving = ServingSigBackend(python, registry=_registry())
+    digests, sigs = _ecdsa_cases()
+    try:
+        assert (serving.ecrecover_addresses(digests, sigs)
+                == python.ecrecover_addresses(digests, sigs))
+    finally:
+        serving.close()
+
+
+def test_serving_matches_python_backend_bls():
+    """Aggregate + committee ops byte-identical through the serving
+    tier, including reject rows (tampered sig, empty committee)."""
+    python = get_backend("python")
+    serving = ServingSigBackend(python, registry=_registry())
+    header = b"serve-agg"
+    keys = [bls.bls_keygen(bytes([i])) for i in range(2)]
+    agg_sig = bls.bls_aggregate_sigs([bls.bls_sign(header, sk)
+                                      for sk, _ in keys])
+    agg_pk = bls.bls_aggregate_pks([pk for _, pk in keys])
+    tampered = bls.g1_add(agg_sig, bls.G1_GEN)
+    agg_args = ([header, header, header], [agg_sig, tampered, None],
+                [agg_pk, agg_pk, agg_pk])
+
+    msgs, sig_rows, pk_rows = [], [], []
+    for i, n in enumerate((2, 1)):
+        tag = b"serve-row%d" % i
+        committee = [bls.bls_keygen(tag + bytes([j])) for j in range(n)]
+        msgs.append(tag)
+        sig_rows.append([bls.bls_sign(tag, sk) for sk, _ in committee])
+        pk_rows.append([pk for _, pk in committee])
+    msgs.append(b"serve-empty")
+    sig_rows.append([])
+    pk_rows.append([])  # empty committee proves nothing: reject
+
+    try:
+        assert (serving.bls_verify_aggregates(*agg_args)
+                == python.bls_verify_aggregates(*agg_args)
+                == [True, False, False])
+        assert (serving.bls_verify_committees(msgs, sig_rows, pk_rows)
+                == python.bls_verify_committees(msgs, sig_rows, pk_rows)
+                == [True, True, False])
+        # pk_row_keys pass through the coalescer per row (python backend
+        # ignores them; the call shape is the jax cache contract)
+        assert serving.bls_verify_committees(
+            msgs, sig_rows, pk_rows,
+            pk_row_keys=["k0", "k1", None]) == [True, True, False]
+    finally:
+        serving.close()
+
+
+def test_registry_exposes_serving_wrappers():
+    """get_backend('serving-python') is the drop-in registered form."""
+    serving = get_backend("serving-python")
+    assert isinstance(serving, ServingSigBackend)
+    assert isinstance(serving, SigBackend)
+    assert serving.inner is get_backend("python")
+    assert serving.name == "serving+python"
+    assert get_backend("serving-python") is serving  # cached singleton
+    with pytest.raises(ValueError):
+        ServingSigBackend(serving)  # no nested admission tiers
+
+
+def test_surplus_pk_row_keys_do_not_shift_batch_mates():
+    """A caller passing MORE keys than rows must not misalign the keys
+    of other requests coalesced into the same dispatch (the jax pk-row
+    cache resolves rows BY key: a shift would verify against the wrong
+    cached committee)."""
+
+    class KeyRecorder(SigBackend):
+        name = "keyrec"
+
+        def __init__(self):
+            self.seen_keys = None
+
+        def bls_verify_committees(self, messages, sig_rows, pk_rows,
+                                  pk_row_keys=None):
+            self.seen_keys = list(pk_row_keys)
+            return [True] * len(messages)
+
+    fake = KeyRecorder()
+    serving = ServingSigBackend(
+        fake, ServingConfig(max_batch=64, flush_us=50_000),
+        registry=_registry())
+    try:
+        future_a = serving.submit(
+            "bls_verify_committees", [b"a0", b"a1"], [[], []], [[], []],
+            pk_row_keys=["a0", "a1", "surplus"])  # one key too many
+        future_b = serving.submit(
+            "bls_verify_committees", [b"b0", b"b1"], [[], []], [[], []],
+            pk_row_keys=["b0", "b1"])
+        assert future_a.result(timeout=10) == [True, True]
+        assert future_b.result(timeout=10) == [True, True]
+        assert fake.seen_keys == ["a0", "a1", "b0", "b1"]
+    finally:
+        serving.close()
+
+
+def test_ragged_request_rejected_and_flusher_survives_poison():
+    """Misaligned columns are rejected at submit; a poison request that
+    reaches the queue anyway fails ITS OWN future, and the flusher keeps
+    serving later requests."""
+    from gethsharding_tpu.serving.queue import Request
+
+    fake = CountingSigBackend()
+    serving = ServingSigBackend(fake, ServingConfig(flush_us=1_000),
+                                registry=_registry())
+    try:
+        with pytest.raises(ValueError, match="ragged"):
+            serving.submit("ecrecover_addresses",
+                           [keccak256(b"r")], [b"\x00" * 65] * 2)
+        with pytest.raises(ValueError, match="rows"):
+            serving.batcher.submit(
+                "ecrecover_addresses",
+                (([keccak256(b"r")]), [b"\x00" * 65] * 2), 2)
+        # poison past the validation (white box): rows claims 2, columns
+        # hold 1 — the flusher must fail this future and stay alive
+        poison = Request("ecrecover_addresses",
+                         ([keccak256(b"p")], [b"\x00" * 65]), rows=2)
+        serving.batcher._queues["ecrecover_addresses"].put(poison)
+        with pytest.raises(RuntimeError, match="results for"):
+            poison.future.result(timeout=10)
+        digest = keccak256(b"after-poison")
+        assert serving.ecrecover_addresses(
+            [digest], [b"\x00" * 65]) == [digest[:20]]
+    finally:
+        serving.close()
+
+
+def test_serving_error_propagates_to_all_requests():
+    class Broken(SigBackend):
+        name = "broken"
+
+        def ecrecover_addresses(self, digests, sigs65):
+            raise RuntimeError("device on fire")
+
+    serving = ServingSigBackend(Broken(), ServingConfig(flush_us=1_000),
+                                registry=_registry())
+    try:
+        futures = [serving.submit("ecrecover_addresses",
+                                  [keccak256(b"err")], [b"\x00" * 65])
+                   for _ in range(3)]
+        for future in futures:
+            with pytest.raises(RuntimeError, match="device on fire"):
+                future.result(timeout=10)
+    finally:
+        serving.close()
+
+
+# -- the notary through the serving tier -----------------------------------
+
+
+def test_notary_proposer_gate_through_serving():
+    """The notary's proposer-signature gate is byte-identical through a
+    serving backend (the async-submit overlap path resolves to the same
+    verdicts as the inline path)."""
+    from gethsharding_tpu.actors.notary import Notary
+    from gethsharding_tpu.core.shard import Shard
+    from gethsharding_tpu.core.types import CollationHeader
+    from gethsharding_tpu.db.kv import MemoryKV
+    from gethsharding_tpu.mainchain.client import SMCClient
+    from gethsharding_tpu.params import ETHER
+    from gethsharding_tpu.smc.chain import SimulatedMainchain
+    from gethsharding_tpu.smc.state_machine import CollationRecord
+    from gethsharding_tpu.utils.hexbytes import Hash32
+
+    serving = ServingSigBackend(get_backend("python"), registry=_registry())
+    chain = SimulatedMainchain()
+    client = SMCClient(backend=chain)
+    chain.fund(client.account(), 2000 * ETHER)
+    notary = Notary(client=client, shard=Shard(0, MemoryKV()),
+                    sig_backend=serving)
+    try:
+        priv = 0xBEEF
+        proposer = ecdsa.priv_to_address(priv)
+        root = Hash32(keccak256(b"root"))
+        unsigned = CollationHeader(shard_id=0, chunk_root=root, period=1,
+                                   proposer_address=proposer)
+        good = CollationRecord(
+            chunk_root=root, proposer=proposer,
+            signature=ecdsa.sign(bytes(unsigned.hash()), priv).to_bytes65())
+        bad = CollationRecord(
+            chunk_root=root, proposer=proposer,
+            signature=ecdsa.sign(bytes(unsigned.hash()),
+                                 priv + 1).to_bytes65())
+        assert notary.verify_proposer_signatures(
+            [(0, 1, good), (0, 1, bad)]) == [True, False]
+    finally:
+        serving.close()
+
+
+# -- the txpool through the serving tier -----------------------------------
+
+
+def test_txpool_serving_cache_and_error_contract():
+    """Sender recovery dispatches once at admission (removal uses the
+    admission-time cache), and serving failures surface as TxPoolError —
+    the pool's only documented exception."""
+    from gethsharding_tpu.actors.txpool import TXPool, TxPoolError
+    from gethsharding_tpu.core.state_processor import sign_transaction
+    from gethsharding_tpu.core.types import Transaction
+
+    fake = CountingSigBackend()
+    serving = ServingSigBackend(fake, ServingConfig(flush_us=1_000),
+                                registry=_registry())
+    pool = TXPool(simulate_interval=None, sig_backend=serving)
+    tx = sign_transaction(
+        Transaction(nonce=0, gas_price=1, gas_limit=30000, payload=b"t"),
+        0xAB)
+    pool.submit(tx)
+    assert pool.known_count() == 1
+    admit_dispatches = fake.dispatches
+    pool.remove([tx])  # the take_pending() hot path
+    assert pool.known_count() == 0
+    assert fake.dispatches == admit_dispatches, (
+        "remove() must use the admission-time sender cache, not re-recover")
+    serving.close()
+    tx2 = sign_transaction(
+        Transaction(nonce=1, gas_price=1, gas_limit=30000, payload=b"t"),
+        0xAB)
+    with pytest.raises(TxPoolError, match="unavailable"):
+        pool.submit(tx2)  # closed/overloaded tier = pool rejection
+
+
+# -- the RPC handler-thread path -------------------------------------------
+
+
+def test_rpc_handlers_submit_through_serving():
+    """Concurrent shard_ecrecover calls from separate connections share
+    serving dispatches (handler threads submit, not call inline), and
+    results match the python backend."""
+    from gethsharding_tpu.rpc import codec
+    from gethsharding_tpu.rpc.client import RPCClient
+    from gethsharding_tpu.rpc.server import RPCServer
+    from gethsharding_tpu.smc.chain import SimulatedMainchain
+
+    fake = CountingSigBackend(delay_s=0.005)
+    serving = ServingSigBackend(
+        fake, ServingConfig(max_batch=64, flush_us=50_000),
+        registry=_registry())
+    server = RPCServer(SimulatedMainchain(), sig_backend=serving)
+    server.start()
+    n = 8
+    digests = [keccak256(b"rpc-%d" % i) for i in range(n)]
+    results: dict = {}
+    barrier = threading.Barrier(n)
+
+    def call(i: int) -> None:
+        client = RPCClient(*server.address)
+        try:
+            barrier.wait()
+            results[i] = server_call = client.call(
+                "shard_ecrecover",
+                [codec.enc_bytes(digests[i])],
+                [codec.enc_bytes(b"\x00" * 65)])
+            assert server_call is not None
+        finally:
+            client.close()
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(n)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert len(results) == n
+        for i in range(n):
+            assert results[i] == [codec.enc_bytes(digests[i][:20])]
+        stats = RPCClient(*server.address)
+        try:
+            served = stats.call("shard_servingStats")
+        finally:
+            stats.close()
+        dispatches = sum(served["dispatches"].values())
+        assert 0 < dispatches < n  # coalesced across handler threads
+    finally:
+        server.stop()
+
+
+# -- metrics + status surfaces ---------------------------------------------
+
+
+def test_histogram_metric():
+    hist = metrics.Histogram(buckets=(1, 4, 16))
+    for value in (1, 1, 3, 9, 100):
+        hist.observe(value)
+    assert hist.count == 5
+    assert hist.bucket_counts() == {"le_1": 2, "le_4": 1, "le_16": 1,
+                                    "le_inf": 1}
+    snap = hist.snapshot()
+    assert snap["type"] == "histogram" and snap["count"] == 5
+    assert snap["le_inf"] == 1  # flat fields: exporter/dashboard ready
+    registry = metrics.Registry()
+    assert (registry.histogram("h", buckets=(1, 2))
+            is registry.histogram("h"))
+    assert "h" in registry.snapshot()
+
+
+def test_status_page_surfaces_serving_metrics():
+    """/status carries the serving/ namespace once serving traffic
+    exists (default-registry metrics, as a node runs them)."""
+    from gethsharding_tpu.node.http_status import StatusServer
+    from gethsharding_tpu.node.backend import ShardNode
+    from gethsharding_tpu.smc.chain import SimulatedMainchain
+
+    serving = ServingSigBackend(CountingSigBackend())  # DEFAULT_REGISTRY
+    try:
+        serving.ecrecover_addresses([keccak256(b"status")], [b"\x00" * 65])
+    finally:
+        serving.close()
+    node = ShardNode(actor="observer", backend=SimulatedMainchain())
+    status = StatusServer(node)
+    payload = status.status_payload()
+    assert any(name.startswith("serving/ecrecover/")
+               for name in payload.get("serving", {}))
