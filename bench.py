@@ -32,6 +32,10 @@ in .bench_workload.npz (first build ~3 min of host-side scalar crypto).
 concurrent clients x single-item requests coalesced into shared
 dispatches vs the same clients driving the backend directly
 (scripts/serving_stress.py is the open-ended soak form).
+
+`bench.py --trace [--trace-out PATH]` runs the serving benchmark with
+the span tracer on and writes a Chrome trace-event JSON (Perfetto):
+per-request queue_wait / batch_assembly / device_dispatch attribution.
 """
 
 from __future__ import annotations
@@ -882,6 +886,42 @@ def _probe_backend(timeout: float = 120.0):
 def main() -> None:
     if "--single" in sys.argv:
         print(json.dumps(measure_single()))
+        return
+
+    if "--trace" in sys.argv:
+        # profile ONE serving benchmark run with the span tracer on:
+        # every coalesced request's queue_wait / batch_assembly /
+        # device_dispatch attribution lands in a Chrome trace-event JSON
+        # (open in Perfetto) — the artifact that says WHERE a slow
+        # request spent its time, which the aggregate timers cannot
+        from gethsharding_tpu import tracing
+
+        out_path = os.environ.get(
+            "GETHSHARDING_TRACE_OUT", os.path.join(REPO, "bench_trace.json"))
+        if "--trace-out" in sys.argv:
+            idx = sys.argv.index("--trace-out")
+            if idx + 1 < len(sys.argv):
+                out_path = sys.argv[idx + 1]
+        tracing.enable(ring_spans=65536)
+        stats = measure_serving()
+        events = tracing.write_chrome_trace(out_path)
+        requests = sum(
+            1 for rec in tracing.TRACER.recent_spans()
+            if rec["name"].endswith("/request"))
+        print(json.dumps({
+            "metric": "serving_trace_profile",
+            "value": stats["serving_rate"],
+            "unit": (f"verifs/sec ({stats['clients']} concurrent clients, "
+                     f"span-traced serving run, {stats['backend']} "
+                     f"backend)"),
+            "vs_baseline": round(
+                stats["serving_rate"] / max(stats["direct_rate"], 1e-9), 4),
+            "extra": {**{k: v for k, v in stats.items()
+                         if k != "serving_rate"},
+                      "trace_out": out_path,
+                      "trace_events": events,
+                      "traced_requests": requests},
+        }))
         return
 
     if "--serving" in sys.argv:

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 from typing import List, Optional, Tuple
 
-from gethsharding_tpu import metrics
+from gethsharding_tpu import metrics, tracing
 from gethsharding_tpu.actors.base import Service
 from gethsharding_tpu.core.shard import Shard, ShardError
 from gethsharding_tpu.core.types import CollationHeader
@@ -150,6 +150,13 @@ class Notary(Service):
         return snap
 
     def notarize_collations(self, head: Optional[int] = None) -> None:
+        # the per-head trace root: fetch -> recover -> vote phases below
+        # parent under it, and (with --serving) the recovery dispatch's
+        # serving/... request spans stitch to the recover phase
+        with tracing.span("notary/notarize"):
+            self._notarize_collations(head)
+
+    def _notarize_collations(self, head: Optional[int]) -> None:
         if not self.is_account_in_notary_pool():
             return
         snap = self._head_snapshot(head)
@@ -178,22 +185,24 @@ class Notary(Service):
         # phase 1: collect every eligible (shard, record) pair this period
         # — from the snapshot (zero extra round trips) when mirrored
         candidates: List[Tuple[int, int, object]] = []
-        for shard_id in self._eligible_shards(shard_ids, snap):
-            if snap is not None:
-                from gethsharding_tpu.mainchain.mirror import decode_record
+        with tracing.span("notary/fetch"):
+            for shard_id in self._eligible_shards(shard_ids, snap):
+                if snap is not None:
+                    from gethsharding_tpu.mainchain.mirror import (
+                        decode_record)
 
-                if snap["last_submitted"].get(shard_id) != period:
+                    if snap["last_submitted"].get(shard_id) != period:
+                        continue
+                    rec = snap["records"].get(shard_id)
+                    record = None if rec is None else decode_record(rec)
+                else:
+                    record = self.client.collation_record(shard_id, period)
+                    if (record is not None and self.client
+                            .last_submitted_collation(shard_id) != period):
+                        record = None
+                if record is None:
                     continue
-                rec = snap["records"].get(shard_id)
-                record = None if rec is None else decode_record(rec)
-            else:
-                record = self.client.collation_record(shard_id, period)
-                if (record is not None and self.client
-                        .last_submitted_collation(shard_id) != period):
-                    record = None
-            if record is None:
-                continue
-            candidates.append((shard_id, period, record))
+                candidates.append((shard_id, period, record))
         if not candidates:
             return
 
@@ -203,39 +212,46 @@ class Notary(Service):
         signed = [c for c in candidates if c[2].signature]
         sig_ok = {}
         if signed:
-            submit = getattr(self.sig_backend, "submit", None)
-            if submit is not None:
-                # serving backend (--serving): the recovery batch runs on
-                # the serving tier's dispatch thread while THIS thread
-                # fires body-request broadcasts for not-yet-local
-                # collations — the syncer round trips overlap the device
-                # dispatch instead of queueing behind it. Fire-and-forget
-                # only: the authoritative (polling) availability check
-                # stays in submit_vote, so this adds zero stalls.
-                # (Requests for rows that then fail the signature gate
-                # are speculative but harmless: body fetches carry no
-                # vote authority.)
-                digests, sigs = self._proposer_sig_inputs(signed)
-                future = submit("ecrecover_addresses", digests, sigs)
-                for shard_id, p, record in candidates:
-                    self._prefetch_availability(shard_id, p, record)
-                results = self._match_proposers(future.result(), signed)
-            else:
-                results = self.verify_proposer_signatures(signed)
-            for (shard_id, _, _), good in zip(signed, results):
-                sig_ok[shard_id] = good
+            with tracing.span("notary/recover", rows=len(signed)):
+                submit = getattr(self.sig_backend, "submit", None)
+                if submit is not None:
+                    # serving backend (--serving): the recovery batch runs
+                    # on the serving tier's dispatch thread while THIS
+                    # thread fires body-request broadcasts for
+                    # not-yet-local collations — the syncer round trips
+                    # overlap the device dispatch instead of queueing
+                    # behind it. Fire-and-forget only: the authoritative
+                    # (polling) availability check stays in submit_vote,
+                    # so this adds zero stalls. (Requests for rows that
+                    # then fail the signature gate are speculative but
+                    # harmless: body fetches carry no vote authority.)
+                    from gethsharding_tpu.serving.batcher import (
+                        observe_future_wake)
+
+                    digests, sigs = self._proposer_sig_inputs(signed)
+                    future = submit("ecrecover_addresses", digests, sigs)
+                    for shard_id, p, record in candidates:
+                        self._prefetch_availability(shard_id, p, record)
+                    recovered = future.result()
+                    observe_future_wake(future)
+                    results = self._match_proposers(recovered, signed)
+                else:
+                    results = self.verify_proposer_signatures(signed)
+                for (shard_id, _, _), good in zip(signed, results):
+                    sig_ok[shard_id] = good
 
         # phase 3: availability checks + signed vote submission per shard
-        for shard_id, p, record in candidates:
-            if record.signature and not sig_ok.get(shard_id, False):
-                self.signatures_rejected += 1
-                self.record_error(
-                    f"proposer signature invalid: shard {shard_id} "
-                    f"period {p}")
-                continue
-            with self.m_validate_latency.time():
-                self.submit_vote(shard_id, p, record,
-                                 proposer_sig_checked=True)
+        with tracing.span("notary/vote", candidates=len(candidates)):
+            for shard_id, p, record in candidates:
+                if record.signature and not sig_ok.get(shard_id, False):
+                    self.signatures_rejected += 1
+                    self.record_error(
+                        f"proposer signature invalid: shard {shard_id} "
+                        f"period {p}")
+                    continue
+                with self.m_validate_latency.time():
+                    self.submit_vote(shard_id, p, record,
+                                     proposer_sig_checked=True)
 
     def _eligible_shards(self, shard_ids, snap=None) -> List[int]:
         """Committee eligibility for ALL shards from one sampling-context
@@ -310,18 +326,19 @@ class Notary(Service):
 
         # data-availability check against the local shardDB; fetch the body
         # over shardp2p when missing (the reference's syncer round-trip)
-        if not self._check_availability(shard_id, period, record):
-            self.record_error(
-                f"collation body unavailable for shard {shard_id} "
-                f"period {period}"
-            )
-            return False
+        with tracing.span("notary/verify", shard=shard_id):
+            if not self._check_availability(shard_id, period, record):
+                self.record_error(
+                    f"collation body unavailable for shard {shard_id} "
+                    f"period {period}"
+                )
+                return False
 
-        # enforced windback (sharding/README.md): the previous W periods'
-        # collations on this shard chain must also be available before we
-        # extend it with a vote
-        if not self._check_windback(shard_id, period):
-            return False
+            # enforced windback (sharding/README.md): the previous W
+            # periods' collations on this shard chain must also be
+            # available before we extend it with a vote
+            if not self._check_windback(shard_id, period):
+                return False
 
         # the vote carries our aggregatable BLS signature over
         # (shard, period, chunkRoot) — the artifact the period audit
@@ -397,9 +414,11 @@ class Notary(Service):
         # aggregation + verification are ONE backend call: with sigbackend
         # 'jax' the per-shard point sums AND the batched pairing happen in
         # a single device dispatch (no host point arithmetic per vote)
-        with self.m_audit_latency.time():
-            ok = self.sig_backend.bls_verify_committees(
-                msgs, sig_rows, pk_rows, pk_row_keys=pk_keys)
+        with tracing.span("notary/audit", periods=len(spans),
+                          rows=len(msgs)):
+            with self.m_audit_latency.time():
+                ok = self.sig_backend.bls_verify_committees(
+                    msgs, sig_rows, pk_rows, pk_row_keys=pk_keys)
         self.audits_run += len(spans)
         for period, (start, end) in spans.items():
             results[period] = self._judge_period(

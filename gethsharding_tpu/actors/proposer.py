@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from typing import List, Optional
 
+from gethsharding_tpu import tracing
 from gethsharding_tpu.actors.base import Service
 from gethsharding_tpu.core.shard import Shard
 from gethsharding_tpu.core.types import (
@@ -95,29 +96,37 @@ class Proposer(Service):
                 self.record_error(f"create collation failed: {exc}")
 
     def create_and_submit(self, txs: List[Transaction]) -> Optional[Collation]:
-        # the addHeader tx executes in the pending block; derive the period
-        # from it so headers never straddle a period boundary
-        period = (self.client.block_number + 1) // self.config.period_length
-        collation = create_collation(self.client, self.shard.shard_id,
-                                     period, txs)
-        # persist locally regardless; only one header per (shard, period)
-        # can go on-chain (service.go:93 createCollation)
-        self.shard.save_collation(collation)
-        self.collations_proposed += 1
-        self.log.info(
-            "Saved collation with header hash %s",
-            collation.header.hash().hex_str,
-        )
-        if check_header_added(self.client, self.shard.shard_id, period):
-            self.add_header(collation)
-        return collation
+        # the collation lifecycle trace root: create (serialize ->
+        # chunk root -> sign -> persist) then addHeader on-chain
+        with tracing.span("proposer/propose", txs=len(txs)):
+            # the addHeader tx executes in the pending block; derive the
+            # period from it so headers never straddle a period boundary
+            period = ((self.client.block_number + 1)
+                      // self.config.period_length)
+            with tracing.span("proposer/create"):
+                collation = create_collation(self.client,
+                                             self.shard.shard_id,
+                                             period, txs)
+                # persist locally regardless; only one header per
+                # (shard, period) can go on-chain (service.go:93)
+                self.shard.save_collation(collation)
+            self.collations_proposed += 1
+            self.log.info(
+                "Saved collation with header hash %s",
+                collation.header.hash().hex_str,
+            )
+            if check_header_added(self.client, self.shard.shard_id, period):
+                self.add_header(collation)
+            return collation
 
     def add_header(self, collation: Collation) -> None:
         """Submit the header to the SMC (proposer.go:20 AddHeader)."""
         header = collation.header
-        self.client.add_header(
-            header.shard_id, header.period, header.chunk_root,
-            header.proposer_signature,
-        )
+        with tracing.span("proposer/add_header", shard=header.shard_id,
+                          period=header.period):
+            self.client.add_header(
+                header.shard_id, header.period, header.chunk_root,
+                header.proposer_signature,
+            )
         self.log.info("Added header to SMC: shard %s period %s",
                       header.shard_id, header.period)

@@ -22,7 +22,7 @@ from __future__ import annotations
 import os
 from typing import Dict, List, Optional, Tuple
 
-from gethsharding_tpu import metrics
+from gethsharding_tpu import metrics, tracing
 from gethsharding_tpu.actors.base import Service
 from gethsharding_tpu.core.state_processor import recover_sender
 from gethsharding_tpu.core.types import Transaction
@@ -111,19 +111,23 @@ class TXPool(Service):
 
     def _sender_of(self, tx: Transaction) -> Address20:
         if tx.v or tx.r or tx.s:
-            if self.sig_backend is not None:
-                try:
-                    sender = self._recover_via_backend(tx)
-                except Exception as exc:  # noqa: BLE001 - the pool's
-                    # contract is TxPoolError only: a serving tier
-                    # shedding under overload (or shutting down) must
-                    # read as a pool rejection the caller can retry,
-                    # not crash the submitter/proposer loop
-                    raise TxPoolError(
-                        f"signature verification unavailable: {exc}"
-                    ) from exc
-            else:
-                sender = recover_sender(tx)
+            # the admission hot spot: behind --serving this span parents
+            # the coalesced serving/ecrecover request spans, attributing
+            # recovery latency per submitted transaction
+            with tracing.span("txpool/recover_sender"):
+                if self.sig_backend is not None:
+                    try:
+                        sender = self._recover_via_backend(tx)
+                    except Exception as exc:  # noqa: BLE001 - the pool's
+                        # contract is TxPoolError only: a serving tier
+                        # shedding under overload (or shutting down) must
+                        # read as a pool rejection the caller can retry,
+                        # not crash the submitter/proposer loop
+                        raise TxPoolError(
+                            f"signature verification unavailable: {exc}"
+                        ) from exc
+                else:
+                    sender = recover_sender(tx)
             if sender is None:
                 raise TxPoolError("invalid signature")
             return sender

@@ -15,22 +15,39 @@ percentiles, which is what a validate-latency dashboard wants).
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 from typing import Dict, List, Optional
 
 
 class Counter:
-    """Monotonic event count with a creation-time rate."""
+    """Monotonic event count with a creation-time rate AND a windowed
+    one (the go-metrics `Meter` EWMA analog).
+
+    `rate()` (events/sec since creation) goes stale on a long-running
+    node — an hour of silence barely moves it. `rate_1m()` is the
+    1-minute exponentially-weighted moving average over 5-second ticks
+    (go-metrics `meter.go` constants), advanced lazily on read so idle
+    counters cost nothing between snapshots."""
+
+    _TICK_S = 5.0
+    _ALPHA_1M = 1.0 - math.exp(-_TICK_S / 60.0)
 
     def __init__(self) -> None:
         self._value = 0
         self._t0 = time.monotonic()
         self._lock = threading.Lock()
+        # EWMA state: events since the last tick, the tick clock, and
+        # the smoothed per-second rate (unset until the first tick)
+        self._uncounted = 0
+        self._last_tick = self._t0
+        self._ewma: Optional[float] = None
 
     def inc(self, n: int = 1) -> None:
         with self._lock:
             self._value += n
+            self._uncounted += n
 
     @property
     def value(self) -> int:
@@ -41,9 +58,33 @@ class Counter:
         elapsed = time.monotonic() - self._t0
         return self._value / elapsed if elapsed > 0 else 0.0
 
+    def rate_1m(self, now: Optional[float] = None) -> float:
+        """Events/sec, 1-minute EWMA (0.0 until the first 5 s tick)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            ticks = int((now - self._last_tick) / self._TICK_S)
+            if ticks > 0:
+                # lazy ticking must agree with a real periodic ticker:
+                # spread the accumulated events evenly over the elapsed
+                # ticks (crediting them all to one tick and then pure-
+                # decaying would under-report steady rates on infrequent
+                # reads). Constant per-tick rate makes the K-tick EWMA
+                # update exact in closed form.
+                instant = self._uncounted / (ticks * self._TICK_S)
+                remaining = ticks
+                if self._ewma is None:
+                    self._ewma = instant  # go-metrics: first tick seeds
+                    remaining -= 1
+                self._ewma = instant + (self._ewma - instant) * (
+                    (1.0 - self._ALPHA_1M) ** remaining)
+                self._uncounted = 0
+                self._last_tick += ticks * self._TICK_S
+            return self._ewma or 0.0
+
     def snapshot(self) -> dict:
         return {"type": "counter", "count": self._value,
-                "rate_per_s": round(self.rate(), 3)}
+                "rate_per_s": round(self.rate(), 3),
+                "rate_1m": round(self.rate_1m(), 3)}
 
 
 class Gauge:
@@ -75,6 +116,11 @@ class Histogram:
     Snapshot fields are FLAT (``le_<bound>`` / ``le_inf`` counts next
     to ``count``/``mean``) so the influx exporter and the dashboard
     render them without nested-dict special cases.
+
+    Bucket semantics are Prometheus's: ``le_*`` counts are CUMULATIVE
+    (observations at-or-below the bound; ``le_inf`` == ``count``).
+    The exact per-slot counts remain available under ``bucket_*`` keys
+    (`slot_counts()`) — each observation lands in exactly one slot.
     """
 
     DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
@@ -107,19 +153,52 @@ class Histogram:
     def mean(self) -> float:
         return self._total / self._count if self._count else 0.0
 
-    def bucket_counts(self) -> Dict[str, int]:
-        """Per-bucket counts, NON-cumulative (each observation lands in
-        exactly one slot)."""
+    @property
+    def bounds(self) -> tuple:
+        return self._bounds
+
+    @property
+    def total(self) -> float:
+        return self._total
+
+    def read(self) -> tuple:
+        """ONE consistent locked read: (per-slot counts, count, total).
+        Every derived view builds from this so a scrape racing
+        observe() can never emit ``le_inf != count`` (the Prometheus
+        histogram invariant)."""
         with self._lock:
-            counts = list(self._counts)
-        out = {f"le_{bound:g}": counts[i]
-               for i, bound in enumerate(self._bounds)}
-        out["le_inf"] = counts[-1]
+            return list(self._counts), self._count, self._total
+
+    def _cumulative(self, counts) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        running = 0
+        for i, bound in enumerate(self._bounds):
+            running += counts[i]
+            out[f"le_{bound:g}"] = running
+        out["le_inf"] = running + counts[-1]
         return out
 
+    def _per_slot(self, counts) -> Dict[str, int]:
+        out = {f"bucket_{bound:g}": counts[i]
+               for i, bound in enumerate(self._bounds)}
+        out["bucket_inf"] = counts[-1]
+        return out
+
+    def bucket_counts(self) -> Dict[str, int]:
+        """CUMULATIVE at-or-below counts under Prometheus ``le_*`` keys
+        (what the name has always implied; ``le_inf`` == ``count``)."""
+        return self._cumulative(self.read()[0])
+
+    def slot_counts(self) -> Dict[str, int]:
+        """EXACT per-slot counts under ``bucket_*`` keys (each
+        observation in exactly one slot; ``bucket_inf`` is overflow)."""
+        return self._per_slot(self.read()[0])
+
     def snapshot(self) -> dict:
-        return {"type": "histogram", "count": self._count,
-                "mean": round(self.mean(), 3), **self.bucket_counts()}
+        counts, count, total = self.read()
+        return {"type": "histogram", "count": count,
+                "mean": round(total / count if count else 0.0, 3),
+                **self._cumulative(counts), **self._per_slot(counts)}
 
 
 class Timer:
@@ -282,7 +361,9 @@ class InfluxLineExporter:
 
     One line per metric: ``<namespace>.<name> f1=v1,f2=v2 <ns-epoch>``
     with metric path separators normalized and every field emitted as a
-    float (a stable schema: influx rejects type flips per field)."""
+    float (a stable schema: influx rejects type flips per field).
+    Histogram lines carry BOTH the cumulative ``le_*`` fields and the
+    exact per-slot ``bucket_*`` fields of the snapshot."""
 
     def __init__(self, registry: Registry = DEFAULT_REGISTRY,
                  interval: float = 10.0, path: Optional[str] = None,
@@ -356,3 +437,62 @@ class InfluxLineExporter:
                 self.push()
             except OSError:
                 pass  # sink unavailable: keep collecting, retry next tick
+
+
+# -- Prometheus text exposition (scrape without Telegraf) -------------------
+
+
+def _prom_name(name: str, namespace: str) -> str:
+    """Metric path -> a legal Prometheus metric name."""
+    import re
+
+    flat = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    return f"{namespace}_{flat}" if namespace else flat
+
+
+def prometheus_text(registry: Registry = DEFAULT_REGISTRY,
+                    namespace: str = "gethsharding") -> str:
+    """The registry as Prometheus text exposition format (0.0.4) — the
+    ``GET /metrics?format=prom`` payload, so a node is scrapeable with
+    no Telegraf/Influx hop:
+
+    - Counter   -> ``<name>_total`` counter (+ ``<name>_rate_1m`` gauge)
+    - Gauge     -> gauge
+    - Timer     -> summary (quantiles 0.5/0.95/0.99, ``_count``/``_sum``)
+    - Histogram -> histogram (cumulative ``_bucket{le=...}``,
+      ``le="+Inf"`` == ``_count``, plus ``_sum``)
+    """
+    with registry._lock:
+        items = sorted(registry._metrics.items())
+    lines: List[str] = []
+    for name, metric in items:
+        prom = _prom_name(name, namespace)
+        if isinstance(metric, Counter):
+            lines += [f"# TYPE {prom}_total counter",
+                      f"{prom}_total {metric.value}",
+                      f"# TYPE {prom}_rate_1m gauge",
+                      f"{prom}_rate_1m {metric.rate_1m():g}"]
+        elif isinstance(metric, Gauge):
+            lines += [f"# TYPE {prom} gauge", f"{prom} {metric.value:g}"]
+        elif isinstance(metric, Timer):
+            lines.append(f"# TYPE {prom} summary")
+            for q in (0.5, 0.95, 0.99):
+                lines.append(
+                    f'{prom}{{quantile="{q:g}"}} {metric.percentile(q):g}')
+            lines += [f"{prom}_count {metric.count}",
+                      f"{prom}_sum {metric.mean() * metric.count:g}"]
+        elif isinstance(metric, Histogram):
+            lines.append(f"# TYPE {prom} histogram")
+            # ONE locked read: +Inf bucket, _count and _sum must agree
+            # even when a scrape races observe()
+            counts, count, total = metric.read()
+            cumulative = metric._cumulative(counts)
+            for bound in metric.bounds:
+                lines.append(f'{prom}_bucket{{le="{bound:g}"}} '
+                             f'{cumulative[f"le_{bound:g}"]}')
+            lines += [f'{prom}_bucket{{le="+Inf"}} {cumulative["le_inf"]}',
+                      f"{prom}_count {count}",
+                      f"{prom}_sum {total:g}"]
+    # never empty: a scraper (or the observability smoke step) reading
+    # zero bytes cannot tell "no metrics yet" from a broken endpoint
+    return "\n".join(lines) + "\n" if lines else "# empty registry\n"

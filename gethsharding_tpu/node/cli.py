@@ -107,6 +107,19 @@ def build_parser() -> argparse.ArgumentParser:
                           help="write a JAX profiler trace to this directory "
                                "while running (the --pprof/--trace analog, "
                                "internal/debug/flags.go:40-90)")
+    sharding.add_argument("--trace", action="store_true",
+                          help="collect pipeline spans (notary/proposer/"
+                               "txpool phases, serving queue_wait/"
+                               "batch_assembly/device_dispatch attribution) "
+                               "in the in-memory tracer; served at /trace "
+                               "on the --http status server")
+    sharding.add_argument("--trace-out", default="",
+                          help="write the collected spans as Chrome "
+                               "trace_event JSON at exit (open in Perfetto "
+                               "or chrome://tracing); implies --trace")
+    sharding.add_argument("--trace-ring", type=int, default=4096,
+                          help="finished-span ring capacity (bounded "
+                               "memory: oldest spans fall off)")
     attach = sub.add_parser(
         "attach", help="interactive console on a running chain process "
                        "(the geth attach / console analog)")
@@ -382,6 +395,12 @@ def run_sharding_node(args) -> int:
             profiling = True
         except Exception as exc:
             log.warning("JAX profiler unavailable: %s", exc)
+    tracing_on = args.trace or args.trace_out
+    if tracing_on:
+        from gethsharding_tpu import tracing
+
+        tracing.enable(ring_spans=args.trace_ring)
+        log.info("span tracing enabled (ring %d)", args.trace_ring)
 
     node.start()
 
@@ -403,6 +422,15 @@ def run_sharding_node(args) -> int:
             import jax
 
             jax.profiler.stop_trace()
+        if tracing_on and args.trace_out:
+            from gethsharding_tpu import tracing
+
+            try:
+                events = tracing.write_chrome_trace(args.trace_out)
+                log.info("wrote %d trace events to %s (open in Perfetto)",
+                         events, args.trace_out)
+            except OSError as exc:
+                log.warning("trace export failed: %s", exc)
         if reporter is not None:
             reporter.stop()
         if influx is not None:

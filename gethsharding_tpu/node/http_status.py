@@ -6,9 +6,13 @@ the ethstats push reporter (`ethstats/ethstats.go:86`), and the expvar
 metrics exporter (`metrics/exp`). One small stdlib HTTP server exposes:
 
   GET /healthz  -> {"status": "ok"|"degraded", "services": {...}}
-  GET /metrics  -> the metrics registry snapshot (counters/gauges/timers)
+  GET /metrics  -> the metrics registry snapshot (counters/gauges/timers);
+                   ?format=prom serves Prometheus text exposition so the
+                   node is scrapeable without Telegraf
   GET /status   -> node identity + chain view (actor, shard, account,
                    period, restart counts)
+  GET /trace    -> recent finished traces from the span tracer
+                   (gethsharding_tpu/tracing; enable with --trace)
   GET /         -> a single-file live dashboard (no build step, no
                    bundle: inline JS polling the three JSON endpoints)
 
@@ -24,9 +28,10 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs, urlparse
 
 from gethsharding_tpu.actors.base import Service
-from gethsharding_tpu.metrics import DEFAULT_REGISTRY
+from gethsharding_tpu.metrics import DEFAULT_REGISTRY, prometheus_text
 
 
 class StatusServer(Service):
@@ -85,6 +90,16 @@ class StatusServer(Service):
     def metrics_payload(self) -> dict:
         return DEFAULT_REGISTRY.snapshot()
 
+    def trace_payload(self) -> dict:
+        """Recent finished traces (root + child spans grouped by trace
+        id). `enabled` false means the tracer is collecting nothing —
+        start the node with --trace (or call tracing.enable())."""
+        from gethsharding_tpu import tracing
+
+        return {"enabled": tracing.TRACER.enabled,
+                "spans_recorded": tracing.TRACER.spans_recorded,
+                "traces": tracing.TRACER.recent_traces(limit=100)}
+
     # -- lifecycle ---------------------------------------------------------
 
     def on_start(self) -> None:
@@ -94,21 +109,39 @@ class StatusServer(Service):
             def log_message(self, fmt, *args):  # route through our logger
                 status.log.debug("http %s", fmt % args)
 
+            def _send(self, code, content_type, body):
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
-                path = self.path.split("?")[0]
+                parsed = urlparse(self.path)
+                path = parsed.path
                 if path == "/":
-                    body = _DASHBOARD_HTML.encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type",
-                                     "text/html; charset=utf-8")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    self._send(200, "text/html; charset=utf-8",
+                               _DASHBOARD_HTML.encode())
+                    return
+                if path == "/metrics" and "prom" in parse_qs(
+                        parsed.query).get("format", []):
+                    # Prometheus text exposition: scrape directly. Same
+                    # degraded-node-still-answers contract as the JSON
+                    # routes: a failing render is a 500 body, not a
+                    # dropped connection.
+                    try:
+                        body, code = prometheus_text().encode(), 200
+                    except Exception as exc:  # noqa: BLE001
+                        body, code = f"# error: {exc!r}\n".encode(), 500
+                    self._send(code,
+                               "text/plain; version=0.0.4; charset=utf-8",
+                               body)
                     return
                 routes = {
                     "/healthz": status.health_payload,
                     "/metrics": status.metrics_payload,
                     "/status": status.status_payload,
+                    "/trace": status.trace_payload,
                 }
                 fn = routes.get(path)
                 if fn is None:
@@ -121,11 +154,7 @@ class StatusServer(Service):
                 except Exception as exc:  # degraded node must still answer
                     body = json.dumps({"error": repr(exc)}).encode()
                     code = 500
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                self._send(code, "application/json", body)
 
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]  # resolved for port=0

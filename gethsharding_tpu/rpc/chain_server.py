@@ -48,10 +48,23 @@ def main(argv=None) -> int:
                              "shard_verifyAggregates serving tier: handler "
                              "threads coalesce concurrent requests into "
                              "shared dispatches (jax = batched TPU kernels)")
+    parser.add_argument("--trace", action="store_true",
+                        help="collect RPC-handler + serving-tier spans "
+                             "(per-request queue/assembly/dispatch "
+                             "attribution) in the in-memory tracer")
+    parser.add_argument("--trace-out", default="",
+                        help="write collected spans as Chrome trace_event "
+                             "JSON at exit (Perfetto); implies --trace")
+    parser.add_argument("--trace-ring", type=int, default=4096,
+                        help="finished-span ring capacity")
     parser.add_argument("--verbosity", default="warning")
     args = parser.parse_args(argv)
 
     logging.basicConfig(level=getattr(logging, args.verbosity.upper()))
+    if args.trace or args.trace_out:
+        from gethsharding_tpu import tracing
+
+        tracing.enable(ring_spans=args.trace_ring)
     overrides = {"period_length": args.periodlength}
     if args.quorum is not None:
         overrides["quorum_size"] = args.quorum
@@ -93,6 +106,14 @@ def main(argv=None) -> int:
         if follower is not None:
             follower.stop()
         server.stop()
+        if args.trace_out:
+            from gethsharding_tpu import tracing
+
+            try:
+                tracing.write_chrome_trace(args.trace_out)
+            except OSError:
+                logging.getLogger("chain-server").warning(
+                    "trace export to %s failed", args.trace_out)
     return 0
 
 

@@ -20,6 +20,7 @@ import socketserver
 import threading
 from typing import Optional
 
+from gethsharding_tpu import tracing
 from gethsharding_tpu.rpc import codec
 from gethsharding_tpu.p2p.service import (
     PROTOCOL_NAME as P2P_PROTOCOL_NAME,
@@ -161,6 +162,7 @@ class RPCServer:
         rid = req.get("id")
         method = req.get("method", "")
         params = req.get("params", [])
+        trace_id = None
         with self._sub_lock:
             self.method_calls[method] = self.method_calls.get(method, 0) + 1
         try:
@@ -203,7 +205,14 @@ class RPCServer:
                     return {"jsonrpc": "2.0", "id": rid,
                             "error": {"code": METHOD_NOT_FOUND,
                                       "message": f"unknown method {method}"}}
-                result = fn(*params)
+                # per-request handler span: parents any serving-tier
+                # request spans the handler submits (the cross-process
+                # attribution seam), and its trace id rides back to the
+                # client on the response envelope. Extra envelope keys
+                # are legal JSON-RPC: clients read `result`/`error` only.
+                with tracing.span(f"rpc/{method}") as handler_span:
+                    result = fn(*params)
+                trace_id = handler_span.trace_id
         except SMCRevert as exc:
             return {"jsonrpc": "2.0", "id": rid,
                     "error": {"code": REVERT_CODE, "message": str(exc),
@@ -214,7 +223,10 @@ class RPCServer:
                     "error": {"code": INTERNAL_ERROR, "message": str(exc)}}
         if rid is None:
             return None  # notification
-        return {"jsonrpc": "2.0", "id": rid, "result": result}
+        response = {"jsonrpc": "2.0", "id": rid, "result": result}
+        if trace_id is not None:
+            response["trace"] = trace_id
+        return response
 
     # -- method surface (shard_* namespace) --------------------------------
     # views
@@ -307,8 +319,9 @@ class RPCServer:
 
     def _serving(self):
         """The shared serving backend, built on first use. Injected
-        backends that already expose `submit` are used as-is (and not
-        closed by us); a plain `SigBackend` gets wrapped."""
+        backends that already expose `submit` (a `ServingSigBackend`)
+        are used as-is (and not closed by us); a plain `SigBackend`
+        gets wrapped."""
         with self._sub_lock:
             if self._sig_serving is None:
                 inner = self._sig_backend
@@ -325,27 +338,27 @@ class RPCServer:
 
     def rpc_ecrecover(self, digests, sigs):
         """Batch address recovery for external clients (txpool feeders,
-        light verifiers). The handler thread SUBMITS to the serving
-        tier and parks on the request's future — while this batch waits
-        out its flush window, other connection threads enqueue into the
-        SAME dispatch, so N concurrent small requests cost one device
-        batch instead of N."""
-        future = self._serving().submit(
-            "ecrecover_addresses",
+        light verifiers). The serving backend's sync face enqueues and
+        parks the handler thread on the request's future — while this
+        batch waits out its flush window, other connection threads
+        enqueue into the SAME dispatch, so N concurrent small requests
+        cost one device batch instead of N. (The sync face also records
+        the future_wake trace phase — one await-then-wake sequence for
+        every entry point, serving/backend.py.)"""
+        out = self._serving().ecrecover_addresses(
             [codec.dec_bytes(d) for d in digests],
             [codec.dec_bytes(s) for s in sigs])
         return [None if addr is None else codec.enc_bytes(bytes(addr))
-                for addr in future.result()]
+                for addr in out]
 
     def rpc_verifyAggregates(self, messages, agg_sigs, agg_pks):
         """Batch aggregate-vote verification over the serving tier (the
         coalescing analog of the notary's bls_verify_aggregates)."""
-        future = self._serving().submit(
-            "bls_verify_aggregates",
+        out = self._serving().bls_verify_aggregates(
             [codec.dec_bytes(m) for m in messages],
             [codec.dec_g1(s) for s in agg_sigs],
             [codec.dec_g2(p) for p in agg_pks])
-        return [bool(b) for b in future.result()]
+        return [bool(b) for b in out]
 
     def rpc_servingStats(self):
         """Dispatch/coalescing counters of the serving tier (None until

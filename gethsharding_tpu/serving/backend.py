@@ -25,7 +25,11 @@ from dataclasses import dataclass
 from typing import List, Optional, Sequence
 
 from gethsharding_tpu import metrics
-from gethsharding_tpu.serving.batcher import SERVING_OPS, MicroBatcher
+from gethsharding_tpu.serving.batcher import (
+    SERVING_OPS,
+    MicroBatcher,
+    observe_future_wake,
+)
 from gethsharding_tpu.sigbackend import SigBackend
 
 
@@ -110,17 +114,25 @@ class ServingSigBackend(SigBackend):
 
     # -- the synchronous SigBackend contract -------------------------------
 
+    def _await(self, future):
+        """Park on the future; attribute the wake when tracing is on."""
+        out = future.result()
+        observe_future_wake(future)
+        return out
+
     def ecrecover_addresses(self, digests, sigs65):
-        return self.submit("ecrecover_addresses", digests, sigs65).result()
+        return self._await(self.submit("ecrecover_addresses", digests,
+                                       sigs65))
 
     def bls_verify_aggregates(self, messages, agg_sigs, agg_pks):
-        return self.submit("bls_verify_aggregates", messages, agg_sigs,
-                           agg_pks).result()
+        return self._await(self.submit("bls_verify_aggregates", messages,
+                                       agg_sigs, agg_pks))
 
     def bls_verify_committees(self, messages, sig_rows, pk_rows,
                               pk_row_keys=None):
-        return self.submit("bls_verify_committees", messages, sig_rows,
-                           pk_rows, pk_row_keys=pk_row_keys).result()
+        return self._await(self.submit("bls_verify_committees", messages,
+                                       sig_rows, pk_rows,
+                                       pk_row_keys=pk_row_keys))
 
     # -- lifecycle / observability -----------------------------------------
 

@@ -29,6 +29,13 @@ Per-op observability (the registry names the status page groups under
   interpolate between bucket shapes that never occur);
 - ``serving/<op>/queue_depth`` gauge, ``/wait_time`` and
   ``/dispatch_latency`` timers.
+
+With tracing enabled (``gethsharding_tpu.tracing``), every request also
+emits a span tree: ``serving/<op>/request`` decomposing into contiguous
+``queue_wait`` / ``batch_assembly`` / ``device_dispatch`` children (the
+per-request latency attribution the aggregate timers cannot give), plus
+a ``future_wake`` phase recorded by the caller on resume. When tracing
+is off the hot path pays one attribute read per request.
 """
 
 from __future__ import annotations
@@ -38,7 +45,7 @@ import time
 from concurrent.futures import Future
 from typing import Dict, List, Sequence
 
-from gethsharding_tpu import metrics
+from gethsharding_tpu import metrics, tracing
 from gethsharding_tpu.serving.pipeline import PipelinedDispatcher
 from gethsharding_tpu.serving.queue import (
     AdmissionQueue,
@@ -148,6 +155,14 @@ class MicroBatcher:
             future.set_result([])
             return future
         request = Request(op, tuple(args), rows)
+        # trace stitching: the caller's active span (an RPC handler, a
+        # notary phase) becomes the parent of this request's lifecycle
+        # spans, recorded later from the flusher/dispatch threads. ONE
+        # attribute read when tracing is off (the <2% overhead budget).
+        request.trace_ctx = tracing.request_context()
+        if tracing.TRACER.enabled:
+            # let the caller-side wake observer find the request again
+            request.future._serving_request = request
         queue = self._queues[op]
         try:
             queue.put(request)
@@ -174,9 +189,12 @@ class MicroBatcher:
             try:
                 now = time.monotonic()
                 rows = 0
+                traced = tracing.TRACER.enabled
                 for request in batch:
                     met.wait_time.observe(request.wait_s(now))
                     rows += request.rows
+                    if traced:
+                        request.t_taken = now  # queue_wait ends here
                 met.batch_rows.observe(rows)
                 # host-side aggregation HERE, on the flusher thread: the
                 # dispatch thread may still be executing the previous
@@ -185,9 +203,18 @@ class MicroBatcher:
                 cols = tuple(
                     [row for request in batch for row in request.args[i]]
                     for i in range(n_args))
+                if traced:
+                    # batch_assembly ends HERE, before the (possibly
+                    # blocking) double-buffer handoff: a stall waiting
+                    # for a free dispatch slot is the device's pace, so
+                    # it belongs to the device_dispatch phase, not to
+                    # host-side assembly
+                    t_assembled = time.monotonic()
+                    for request in batch:
+                        request.t_dispatch = t_assembled
                 self._dispatcher.submit(
-                    lambda batch=batch, cols=cols, rows=rows:
-                    self._run_batch(op, batch, cols, rows))
+                    lambda batch=batch, cols=cols, rows=rows, reason=reason:
+                    self._run_batch(op, batch, cols, rows, reason))
             except Exception as exc:  # noqa: BLE001 - a malformed batch
                 # must fail ITS futures, not kill the op's only consumer
                 # (a dead flusher would hang every later caller forever)
@@ -196,10 +223,11 @@ class MicroBatcher:
                         request.future.set_exception(exc)
 
     def _run_batch(self, op: str, batch: List[Request], cols: tuple,
-                   rows: int) -> None:
+                   rows: int, reason: str = "") -> None:
         """Stage 2 (dispatch thread): one inner-backend call, results
         sliced back out per request."""
         met = self._metrics[op]
+        traced = tracing.TRACER.enabled
         try:
             with met.dispatch_latency.time():
                 out = list(self._dispatch(op, cols))
@@ -207,15 +235,65 @@ class MicroBatcher:
                 raise RuntimeError(
                     f"{op} returned {len(out)} results for {rows} rows")
         except Exception as exc:  # noqa: BLE001 - fail the batch, keep serving
+            if traced:
+                # errored requests are the ones most worth attributing:
+                # emit their spans (error-tagged) before failing them
+                t_done = time.monotonic()
+                for request in batch:
+                    if request.t_taken and request.t_dispatch:
+                        request.t_done = t_done
+                        self._emit_request_trace(op, request, reason, rows,
+                                                 error=repr(exc))
             for request in batch:
                 request.future.set_exception(exc)
             return
         self.dispatch_counts[op] += 1
         met.dispatches.inc()
+        if traced:
+            # emit BEFORE resolving the futures so a waking caller reads
+            # complete trace_ids for its future_wake span
+            t_done = time.monotonic()
+            for request in batch:
+                if request.t_taken and request.t_dispatch:
+                    request.t_done = t_done
+                    self._emit_request_trace(op, request, reason, rows)
         offset = 0
         for request in batch:
             request.future.set_result(out[offset:offset + request.rows])
             offset += request.rows
+
+    def _emit_request_trace(self, op: str, request: Request, reason: str,
+                            batch_rows: int,
+                            error: str = None) -> None:
+        """One request's lifecycle as spans: the parent request span
+        decomposes EXACTLY into contiguous queue_wait / batch_assembly /
+        device_dispatch children (shared boundary timestamps, so the
+        children sum to the parent by construction). device_dispatch
+        runs from the end of host-side assembly, so a flusher stall on
+        the double-buffer slot — the device's pace — is attributed to
+        the device phase, not to assembly. Recorded under the request's
+        own trace id as the display track (tid) so every coalesced
+        request renders as its own Perfetto row; stitched to the
+        submitting caller's span when one was active."""
+        tracer = tracing.TRACER
+        label = _OP_LABELS[op]
+        ctx = request.trace_ctx
+        trace_id = ctx[0] if ctx else tracer.new_trace_id()
+        parent = ctx[1] if ctx else None
+        tags = {"rows": request.rows, "batch_rows": batch_rows,
+                "flush": reason}
+        if error is not None:
+            tags["error"] = error
+        root = tracer.record(
+            f"serving/{label}/request", request.enqueued_at, request.t_done,
+            trace_id=trace_id, parent_id=parent, tags=tags, tid=trace_id)
+        for name, start, end in (
+                ("queue_wait", request.enqueued_at, request.t_taken),
+                ("batch_assembly", request.t_taken, request.t_dispatch),
+                ("device_dispatch", request.t_dispatch, request.t_done)):
+            tracer.record(f"serving/{label}/{name}", start, end,
+                          trace_id=trace_id, parent_id=root, tid=trace_id)
+        request.trace_ids = (trace_id, root, label)
 
     def _dispatch(self, op: str, cols: tuple):
         if op == "bls_verify_committees":
@@ -248,3 +326,21 @@ class MicroBatcher:
     def shed_counts(self) -> Dict[str, int]:
         return {op: queue.shed_requests
                 for op, queue in self._queues.items()}
+
+
+def observe_future_wake(future) -> None:
+    """Record the ``future_wake`` phase for a resolved serving future:
+    result-set on the dispatch thread -> the waiting caller actually
+    resumed. Called by the sync `SigBackend` faces and the RPC handlers
+    right after ``future.result()`` returns; a no-op when tracing is
+    off or the future did not come from a traced request."""
+    tracer = tracing.TRACER
+    if not tracer.enabled:
+        return
+    request = getattr(future, "_serving_request", None)
+    if request is None or request.trace_ids is None:
+        return
+    trace_id, root, label = request.trace_ids
+    tracer.record(f"serving/{label}/future_wake", request.t_done,
+                  time.monotonic(), trace_id=trace_id, parent_id=root,
+                  tid=trace_id)

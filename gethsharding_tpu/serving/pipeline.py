@@ -28,7 +28,10 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
 from typing import Callable, Optional
+
+from gethsharding_tpu import metrics
 
 log = logging.getLogger("serving.pipeline")
 
@@ -46,11 +49,16 @@ class PipelinedDispatcher:
 
     _SENTINEL = None
 
-    def __init__(self, name: str = "serving-dispatch", depth: int = 1):
+    def __init__(self, name: str = "serving-dispatch", depth: int = 1,
+                 registry: metrics.Registry = metrics.DEFAULT_REGISTRY):
         # depth 1 = classic double buffering: one batch executing, one
         # assembled and waiting
         self._ready: "queue.Queue[Optional[Callable[[], None]]]" = (
             queue.Queue(maxsize=max(1, depth)))
+        # how long the FLUSHER stalls waiting for a free buffer slot —
+        # nonzero means the device is the bottleneck (the backpressure
+        # edge is engaged), zero means traffic is arrival-bound
+        self._m_slot_wait = registry.timer("serving/pipeline/slot_wait")
         self._thread = threading.Thread(
             target=self._run, name=name, daemon=True)
         self._thread.start()
@@ -61,7 +69,9 @@ class PipelinedDispatcher:
         both buffers are busy — the backpressure edge)."""
         if self._closed:
             raise RuntimeError("dispatcher is closed")
+        t0 = time.monotonic()
         self._ready.put(fn)
+        self._m_slot_wait.observe(time.monotonic() - t0)
 
     def close(self, wait: bool = True) -> None:
         """Stop after draining already-submitted batches."""

@@ -40,9 +40,19 @@ class Request:
     `args` holds the operation's per-row parallel sequences (e.g.
     ``(digests, sigs65)``); `rows` is their common length. The future
     resolves to the per-row results in the caller's own order.
+
+    Trace fields: `trace_ctx` is the submitting caller's
+    (trace_id, span_id) captured at enqueue (None when tracing is off),
+    and `t_taken`/`t_dispatch`/`t_done` are the phase boundaries the
+    batcher stamps as the request crosses threads — queue wait ends at
+    `t_taken`, batch assembly at `t_dispatch`, device execution at
+    `t_done`. `trace_ids` is set once the request's spans are emitted
+    so the caller-side future wake can attach to the same trace.
     """
 
-    __slots__ = ("op", "args", "rows", "future", "enqueued_at")
+    __slots__ = ("op", "args", "rows", "future", "enqueued_at",
+                 "trace_ctx", "t_taken", "t_dispatch", "t_done",
+                 "trace_ids")
 
     def __init__(self, op: str, args: tuple, rows: int):
         self.op = op
@@ -50,6 +60,11 @@ class Request:
         self.rows = rows
         self.future: Future = Future()
         self.enqueued_at = time.monotonic()
+        self.trace_ctx = None
+        self.t_taken = 0.0
+        self.t_dispatch = 0.0
+        self.t_done = 0.0
+        self.trace_ids = None
 
     def wait_s(self, now: Optional[float] = None) -> float:
         """Seconds this request has been queued."""

@@ -25,8 +25,10 @@ the CLI exposes ``--sigbackend``.
 from __future__ import annotations
 
 import os
+import time
 from typing import List, Optional, Sequence, Tuple
 
+from gethsharding_tpu import metrics, tracing
 from gethsharding_tpu.crypto import bn256 as bls
 from gethsharding_tpu.crypto import secp256k1 as ecdsa
 from gethsharding_tpu.utils.hexbytes import Address20
@@ -163,6 +165,27 @@ class JaxSigBackend(SigBackend):
 
         self._pk_row_cache: dict = {}
         self._pk_row_lock = threading.Lock()
+        # compile-cache visibility: jax.jit compiles once per argument
+        # SHAPE, and every padded bucket this process has not dispatched
+        # before is a fresh XLA compile (seconds to minutes). Tracking
+        # (op, bucket-shape) first-sightings makes recompile storms —
+        # e.g. unbucketed traffic widening the shape set — visible as
+        # counters and span tags instead of mystery latency spikes.
+        self._shape_seen: set = set()
+        self._shape_lock = threading.Lock()
+        self._m_shape_hit = metrics.counter("jax/compile_cache/hits")
+        self._m_shape_miss = metrics.counter("jax/compile_cache/misses")
+
+    def _note_shape(self, op: str, *shape) -> bool:
+        """Count a dispatch against the per-shape compile cache; True
+        when this (op, shape) is NEW to the process (an XLA compile)."""
+        key = (op,) + shape
+        with self._shape_lock:
+            fresh = key not in self._shape_seen
+            if fresh:
+                self._shape_seen.add(key)
+        (self._m_shape_miss if fresh else self._m_shape_hit).inc()
+        return fresh
 
     # the module-level bucket_size, kept as a staticmethod so kernel
     # call sites read as "this backend's padding policy"
@@ -188,16 +211,28 @@ class JaxSigBackend(SigBackend):
                     host_rows.append(i)
                 sigs.append(ecdsa.Signature(r=1, s=1, v=0))  # placeholder
                 valid.append(False)
-        pad = self._bucket(n) - n
+        bucket = self._bucket(n)
+        fresh = self._note_shape("ecrecover", bucket)
+        pad = bucket - n
         sigs.extend([ecdsa.Signature(r=1, s=1, v=0)] * pad)
         valid.extend([False] * pad)
         e = self._sec.hashes_to_limbs(
             [bytes(d) for d in digests] + [b"\x00" * 32] * pad)
         r, s, v = self._sec.sigs_to_limbs(sigs)
+        tracer = tracing.TRACER
+        t0 = time.monotonic() if tracer.enabled else 0.0
         qx, qy, ok = self._recover(
             jnp.asarray(e), jnp.asarray(r), jnp.asarray(s), jnp.asarray(v),
             jnp.asarray(np.asarray(valid)))
+        # limbs_to_pubkeys pulls the device buffers (np.asarray), so the
+        # span closes only after the dispatch has actually executed — on
+        # an async backend recording before materialization would show a
+        # near-zero dispatch span with the device time hidden elsewhere
         pubs = self._sec.limbs_to_pubkeys(qx, qy, ok)[:n]
+        if tracer.enabled:
+            tracer.record("jax/ecrecover_dispatch", t0, time.monotonic(),
+                          tags={"rows": n, "bucket": bucket,
+                                "compile": "miss" if fresh else "hit"})
         out = [ecdsa.pubkey_to_address(p) if p is not None else None
                for p in pubs]
         for i in host_rows:
@@ -216,18 +251,27 @@ class JaxSigBackend(SigBackend):
         n = len(messages)
         if n == 0:
             return []
-        pad = self._bucket(n) - n
+        bucket = self._bucket(n)
+        fresh = self._note_shape("bls_aggregate", bucket)
+        pad = bucket - n
         hashes = [bls.hash_to_g1(bytes(m)) for m in messages] + [None] * pad
         hx, hy, hok = self._bn.g1_to_limbs(hashes)
         sx, sy, sok = self._bn.g1_to_limbs(list(agg_sigs) + [None] * pad)
         pkx, pky, pok = self._bn.g2_to_limbs(list(agg_pks) + [None] * pad)
         # infinity signature/key is an outright rejection (scalar parity)
         valid = hok & sok & pok
+        tracer = tracing.TRACER
+        t0 = time.monotonic() if tracer.enabled else 0.0
         out = self._bls(
             jnp.asarray(hx), jnp.asarray(hy), jnp.asarray(sx),
             jnp.asarray(sy), jnp.asarray(pkx), jnp.asarray(pky),
             jnp.asarray(valid))
-        return [bool(b) for b in np.asarray(out)[:n]]
+        res = [bool(b) for b in np.asarray(out)[:n]]
+        if tracer.enabled:
+            tracer.record("jax/bls_aggregate_dispatch", t0, time.monotonic(),
+                          tags={"rows": n, "bucket": bucket,
+                                "compile": "miss" if fresh else "hit"})
+        return res
 
     def bls_verify_committees(self, messages, sig_rows, pk_rows,
                               pk_row_keys=None):
@@ -246,7 +290,8 @@ class JaxSigBackend(SigBackend):
         n = len(messages)
         if n == 0:
             return []
-        pad = self._bucket(n) - n
+        bucket = self._bucket(n)
+        pad = bucket - n
         # committee axis: the tree reduction takes any width (binary
         # segment decomposition), so bucket only enough to bound the
         # number of compiled shapes — next multiple of 16 (135 -> 144;
@@ -255,6 +300,7 @@ class JaxSigBackend(SigBackend):
         width = max([1] + [len(r) for r in sig_rows]
                     + [len(r) for r in pk_rows])
         width = self._bucket(width) if width <= 32 else -(-width // 16) * 16
+        fresh = self._note_shape("bls_committee", bucket, width)
         hashes = [bls.hash_to_g1(bytes(m)) for m in messages] + [None] * pad
         hx, hy, hok = self._bn.g1_to_limbs(hashes)
         sx, sy, sm = self._bn.g1_committee_to_limbs(
@@ -310,8 +356,14 @@ class JaxSigBackend(SigBackend):
             t2 = time.perf_counter()
         fn = (self._bls_committee_u16 if self._wire_u16
               else self._bls_committee)
+        tracer = tracing.TRACER
+        td = time.monotonic() if tracer.enabled else 0.0
         out = fn(*args)
         res = [bool(b) for b in np.asarray(out)[:n]]
+        if tracer.enabled:
+            tracer.record("jax/bls_committee_dispatch", td, time.monotonic(),
+                          tags={"rows": n, "bucket": bucket, "width": width,
+                                "compile": "miss" if fresh else "hit"})
         if timing:
             t3 = time.perf_counter()
             # per-instance: two backends in one process must not clobber

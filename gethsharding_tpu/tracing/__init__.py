@@ -1,0 +1,46 @@
+"""Span-structured tracing for the period pipeline.
+
+Latency attribution across the asynchronous hot path (the serving
+tier's queue -> batcher -> dispatch lifecycle) and the actor loops
+around it:
+
+- ``tracer.py`` — the span tracer: context-local span stack,
+  monotonic-clock spans with tags, a bounded ring of finished spans,
+  span-duration timers folded into the metrics registry, and an
+  off-means-one-attribute-read enable gate.
+- ``export.py`` — Chrome ``trace_event`` JSON export
+  (Perfetto-loadable; the ``--trace-out`` / ``bench.py --trace``
+  artifact).
+
+Surfaces: ``GET /trace`` on the node StatusServer (recent traces),
+``--trace`` / ``--trace-out`` / ``--trace-ring`` on the sharding CLI,
+and ``trace/<span-name>`` timers on ``/metrics`` + the influx exporter.
+"""
+
+from gethsharding_tpu.tracing.export import (
+    chrome_trace_events,
+    write_chrome_trace,
+)
+from gethsharding_tpu.tracing.tracer import (
+    NOOP_SPAN,
+    Span,
+    TRACER,
+    Tracer,
+    disable,
+    enable,
+    request_context,
+    span,
+)
+
+__all__ = [
+    "NOOP_SPAN",
+    "Span",
+    "TRACER",
+    "Tracer",
+    "chrome_trace_events",
+    "disable",
+    "enable",
+    "request_context",
+    "span",
+    "write_chrome_trace",
+]

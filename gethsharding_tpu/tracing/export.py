@@ -1,0 +1,55 @@
+"""Chrome ``trace_event`` export: open a traced run in Perfetto.
+
+The Chrome trace-event JSON format (the ``{"traceEvents": [...]}``
+object form with complete ``"ph": "X"`` events) is what
+https://ui.perfetto.dev and ``chrome://tracing`` load directly — the
+same artifact a ``jax.profiler`` trace produces for kernels, here for
+the PIPELINE above them: queue wait vs batch assembly vs device
+dispatch per serving request, notary fetch/recover/vote phases,
+proposer create→addHeader, RPC handler spans.
+
+Timestamps are the tracer's raw monotonic clock scaled to microseconds
+(trace viewers only need a consistent origin, not wall time). Each
+cross-thread serving request is recorded under its trace id as the
+``tid`` so every request renders as its own track; context spans keep
+their OS thread id.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+from gethsharding_tpu.tracing.tracer import TRACER, Tracer
+
+
+def chrome_trace_events(spans: List[dict],
+                        pid: Optional[int] = None) -> List[dict]:
+    """Finished span records -> complete ("ph": "X") trace events."""
+    pid = os.getpid() if pid is None else pid
+    events = []
+    for record in spans:
+        events.append({
+            "name": record["name"],
+            "cat": record["name"].split("/", 1)[0],
+            "ph": "X",
+            "ts": round(record["start"] * 1e6, 1),
+            "dur": round((record["end"] - record["start"]) * 1e6, 1),
+            "pid": pid,
+            "tid": record["tid"],
+            "args": {"trace_id": record["trace"],
+                     "span_id": record["span"],
+                     "parent_id": record["parent"],
+                     **record["tags"]},
+        })
+    return events
+
+
+def write_chrome_trace(path: str, tracer: Tracer = TRACER) -> int:
+    """Write the tracer's finished-span ring as Chrome trace JSON.
+    Returns the number of events written."""
+    events = chrome_trace_events(tracer.recent_spans())
+    with open(path, "w") as fh:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, fh)
+    return len(events)

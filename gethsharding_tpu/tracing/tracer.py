@@ -1,0 +1,269 @@
+"""Low-overhead span tracer: latency ATTRIBUTION for the period pipeline.
+
+PR 1's serving tier made the hot path asynchronous (admission queue ->
+micro-batcher -> double-buffered dispatch), so a slow `verifyAggregates`
+can hide in queue wait, batch assembly, or device execution — and a
+`metrics.Timer` snapshot cannot say which. This module is the
+profiling-first answer (the zkSpeed / Versal-MSM methodology: locate the
+bottleneck before optimizing it): spans with monotonic-clock bounds and
+tags, a context-local span stack for parent/child attribution, and a
+bounded in-memory ring of finished spans served by `/trace` and
+exportable as Chrome ``trace_event`` JSON (Perfetto-loadable).
+
+Design constraints, in order:
+
+- **Off means free.** Collection is gated by ONE attribute read
+  (`TRACER.enabled`); every producer entry returns a shared no-op span
+  without allocating when tracing is off. The serving hot path budgets
+  <2% tracer-off overhead (asserted in tests/test_observability.py).
+- **Cross-thread spans are explicit.** The context-local stack follows
+  one thread of control; the serving pipeline's request lifecycle spans
+  THREE threads (caller -> flusher -> dispatch), so those spans are
+  recorded with explicit timestamps via `record()` and stitched to the
+  caller's trace by the context captured at `submit()` time.
+- **Metrics ride along.** Every finished span feeds a
+  ``trace/<name>`` timer in the metrics registry, so the influx
+  exporter and the dashboard get span-duration percentiles for free.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from gethsharding_tpu import metrics
+
+# the active span stack of the current thread of control (contextvars:
+# per-thread for plain threads, per-task under asyncio — either way the
+# parent of a new span is whatever THIS control flow opened last)
+_SPAN_STACK = contextvars.ContextVar("gethsharding_span_stack", default=())
+
+
+class Span:
+    """One named, tagged interval on the context-local stack."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "start",
+                 "end", "tags", "tid", "_tracer", "_token")
+
+    def __init__(self, tracer: "Tracer", name: str, trace_id: int,
+                 span_id: int, parent_id: Optional[int], tags: Optional[dict]):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.tags = dict(tags) if tags else {}
+        self.tid = threading.get_ident()
+        self.start = time.monotonic()
+        self.end: Optional[float] = None
+        self._tracer = tracer
+        self._token = None
+
+    def tag(self, **tags) -> "Span":
+        self.tags.update(tags)
+        return self
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc_type is not None:
+            self.tags.setdefault("error", repr(exc))
+        self._tracer.finish(self)
+        return False
+
+
+class _NoopSpan:
+    """The shared disabled-path span: no allocation, no clock reads."""
+
+    __slots__ = ()
+    trace_id = None
+    span_id = None
+
+    def tag(self, **tags) -> "_NoopSpan":
+        return self
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class Tracer:
+    """Span collector: context stack + bounded finished-span ring.
+
+    The ring holds FINISHED span records (plain dicts, newest-last);
+    `/trace` groups them into traces on read. Bounded by `ring_spans`,
+    so a long-running node holds a recent window, never unbounded
+    memory — the go-metrics "cheap enough to leave on" contract.
+    """
+
+    def __init__(self, ring_spans: int = 4096,
+                 registry: metrics.Registry = metrics.DEFAULT_REGISTRY):
+        self.enabled = False
+        self.registry = registry
+        self._ring: deque = deque(maxlen=ring_spans)
+        self._ids = itertools.count(1)
+        self._lock = threading.Lock()
+        self._timers: Dict[str, metrics.Timer] = {}
+        self.spans_recorded = 0
+
+    # -- configuration ------------------------------------------------------
+
+    def configure(self, ring_spans: Optional[int] = None,
+                  registry: Optional[metrics.Registry] = None) -> None:
+        with self._lock:
+            if ring_spans is not None:
+                self._ring = deque(self._ring, maxlen=ring_spans)
+            if registry is not None:
+                self.registry = registry
+                self._timers = {}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    # -- producer API -------------------------------------------------------
+
+    def new_trace_id(self) -> int:
+        return next(self._ids)
+
+    def start(self, name: str, tags: Optional[dict] = None):
+        """Open a span under the context's current span (a new trace when
+        there is none). Returns NOOP_SPAN when disabled — callers use the
+        result as a context manager either way."""
+        if not self.enabled:
+            return NOOP_SPAN
+        stack = _SPAN_STACK.get()
+        parent = stack[-1] if stack else None
+        span = Span(
+            self, name,
+            trace_id=parent.trace_id if parent else self.new_trace_id(),
+            span_id=self.new_trace_id(),
+            parent_id=parent.span_id if parent else None,
+            tags=tags)
+        span._token = _SPAN_STACK.set(stack + (span,))
+        return span
+
+    def finish(self, span: Span) -> None:
+        if span._token is not None:
+            try:
+                _SPAN_STACK.reset(span._token)
+            except ValueError:
+                pass  # finished from another context: keep the record
+            span._token = None
+        span.end = time.monotonic()
+        self._record(span.name, span.trace_id, span.span_id, span.parent_id,
+                     span.start, span.end, span.tags, span.tid)
+
+    def record(self, name: str, start: float, end: float,
+               trace_id: Optional[int] = None,
+               parent_id: Optional[int] = None,
+               tags: Optional[dict] = None,
+               tid: Optional[int] = None) -> Optional[int]:
+        """Record a completed span from explicit monotonic timestamps —
+        the cross-thread form the serving pipeline uses (a request's
+        lifecycle spans caller, flusher and dispatch threads; no one
+        context owns it). Returns the span id (None when disabled)."""
+        if not self.enabled:
+            return None
+        span_id = self.new_trace_id()
+        self._record(name, trace_id or self.new_trace_id(), span_id,
+                     parent_id, start, end, dict(tags) if tags else {},
+                     threading.get_ident() if tid is None else tid)
+        return span_id
+
+    def current(self) -> Optional[Tuple[int, int]]:
+        """(trace_id, span_id) of the context's active span, or None."""
+        stack = _SPAN_STACK.get()
+        if not stack:
+            return None
+        top = stack[-1]
+        return (top.trace_id, top.span_id)
+
+    # -- sink ---------------------------------------------------------------
+
+    def _record(self, name, trace_id, span_id, parent_id, start, end,
+                tags, tid) -> None:
+        record = {
+            "name": name, "trace": trace_id, "span": span_id,
+            "parent": parent_id, "start": start, "end": end,
+            "dur_us": round((end - start) * 1e6, 1), "tid": tid,
+            "tags": tags,
+        }
+        timer = self._timers.get(name)
+        if timer is None:
+            timer = self.registry.timer(f"trace/{name}")
+            with self._lock:
+                self._timers[name] = timer
+        timer.observe(end - start)
+        # append under the lock: recent_spans() list()s the deque under
+        # it, and an unlocked concurrent append would raise "deque
+        # mutated during iteration" mid-scrape
+        with self._lock:
+            self._ring.append(record)
+            self.spans_recorded += 1
+
+    # -- consumer API -------------------------------------------------------
+
+    def recent_spans(self, limit: Optional[int] = None) -> List[dict]:
+        """Finished span records, oldest first."""
+        with self._lock:
+            spans = list(self._ring)
+        return spans if limit is None else spans[-limit:]
+
+    def recent_traces(self, limit: int = 100) -> List[dict]:
+        """Finished spans grouped into traces, newest trace first."""
+        by_trace: Dict[int, List[dict]] = {}
+        for record in self.recent_spans():
+            by_trace.setdefault(record["trace"], []).append(record)
+        traces = sorted(
+            by_trace.items(),
+            key=lambda item: max(r["end"] for r in item[1]), reverse=True)
+        return [{"trace_id": trace_id,
+                 "duration_us": round(
+                     (max(r["end"] for r in spans)
+                      - min(r["start"] for r in spans)) * 1e6, 1),
+                 "spans": spans}
+                for trace_id, spans in traces[:limit]]
+
+
+# THE process tracer (the metrics.DEFAULT_REGISTRY analog): instrumented
+# code records here; `--trace` / tracing.enable() turn collection on.
+TRACER = Tracer()
+
+
+def enable(ring_spans: int = 4096,
+           registry: Optional[metrics.Registry] = None) -> Tracer:
+    TRACER.configure(ring_spans=ring_spans, registry=registry)
+    TRACER.enabled = True
+    return TRACER
+
+
+def disable() -> None:
+    TRACER.enabled = False
+
+
+def span(name: str, **tags):
+    """Open a context-stacked span on the process tracer (no-op when
+    disabled). Use as ``with tracing.span("notary/fetch"):``."""
+    if not TRACER.enabled:
+        return NOOP_SPAN
+    return TRACER.start(name, tags or None)
+
+
+def request_context() -> Optional[Tuple[int, int]]:
+    """The serving hot path's ONE producer-side guard: the caller's
+    (trace_id, span_id) to stitch a cross-thread request to, or None.
+    Exactly one attribute read when tracing is off — the cost the <2%
+    overhead budget is measured against."""
+    if not TRACER.enabled:
+        return None
+    return TRACER.current()

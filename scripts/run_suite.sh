@@ -10,6 +10,41 @@
 set -u
 cd "$(dirname "$0")/.."
 fail=0
+
+# -- observability smoke: boot an observer node, scrape every surface ------
+# A real `tpu-sharding sharding` process must answer /healthz, Prometheus
+# /metrics?format=prom and /trace with 200 + non-empty payloads — the
+# curl-level contract the dashboards/scrapers depend on, checked against
+# a live process rather than an in-process test double.
+obs_port=$(python -c "import socket; s = socket.socket(); \
+s.bind(('127.0.0.1', 0)); print(s.getsockname()[1]); s.close()")
+echo "== observability smoke (http://127.0.0.1:$obs_port)"
+JAX_PLATFORMS=cpu python -m gethsharding_tpu.node.cli sharding \
+    --actor observer --http "$obs_port" --trace --runtime 60 \
+    --blocktime 0.2 --txinterval 1.0 --verbosity error &
+obs_pid=$!
+up=0
+for _ in $(seq 1 100); do
+    if curl -sf "http://127.0.0.1:$obs_port/healthz" >/dev/null 2>&1; then
+        up=1; break
+    fi
+    sleep 0.2
+done
+if [ "$up" = 1 ]; then
+    for ep in "/healthz" "/metrics?format=prom" "/trace"; do
+        body=$(curl -sf "http://127.0.0.1:$obs_port$ep") || body=""
+        if [ -z "$body" ]; then
+            echo "observability smoke FAILED: $ep returned non-200 or empty"
+            fail=1
+        fi
+    done
+else
+    echo "observability smoke FAILED: node never answered /healthz"
+    fail=1
+fi
+kill "$obs_pid" 2>/dev/null
+wait "$obs_pid" 2>/dev/null
+
 for f in tests/test_*.py; do
     echo "== $f"
     python -m pytest "$f" -q --no-header || fail=1

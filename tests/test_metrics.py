@@ -20,6 +20,28 @@ def test_counter_and_rate():
     assert c.rate() > 0
     snap = c.snapshot()
     assert snap["type"] == "counter" and snap["count"] == 5
+    assert "rate_1m" in snap  # the EWMA meter rides every snapshot
+
+
+def test_counter_rate_1m_ewma():
+    """The go-metrics Meter analog: a 1-minute EWMA over 5 s ticks that
+    tracks recent traffic and decays when it stops — unlike `rate()`,
+    which averages over the counter's whole lifetime."""
+    c = Counter()
+    t0 = c._last_tick
+    assert c.rate_1m(now=t0 + 1.0) == 0.0  # before the first tick
+    c.inc(300)
+    # nudge past the tick boundaries: t0 + exactly N*5.0 can round a
+    # hair below the boundary at large monotonic values (float binade)
+    first = c.rate_1m(now=t0 + 5.1)
+    assert first == 300 / 5.0  # first tick seeds the EWMA
+    # a minute of silence: the rate decays toward zero instead of the
+    # since-creation average's slow drift
+    decayed = c.rate_1m(now=t0 + 65.1)
+    assert 0.0 < decayed < first / 2
+    # fresh traffic pulls it back up
+    c.inc(600)
+    assert c.rate_1m(now=t0 + 70.2) > decayed
 
 
 def test_gauge():
@@ -136,3 +158,104 @@ def test_influx_line_exporter_file_and_udp(tmp_path):
 
     with pytest.raises(ValueError):
         InfluxLineExporter(registry=registry)  # no sink
+
+
+def test_influx_udp_sink_periodic_and_final_flush():
+    """The UDP sink end to end: the background thread pushes on its
+    interval, and stop() sends one FINAL flush so the last interval's
+    activity is never lost (the exporter contract the file sink's tests
+    already pin)."""
+    import socket
+
+    from gethsharding_tpu.metrics import InfluxLineExporter, Registry
+
+    registry = Registry()
+    registry.counter("udp/events").inc(7)
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.bind(("127.0.0.1", 0))
+    sock.settimeout(5.0)
+    exporter = InfluxLineExporter(registry=registry, interval=0.05,
+                                  udp=sock.getsockname())
+    exporter.start()
+    payload = sock.recv(65536).decode()  # a periodic push arrived
+    assert "gethsharding.udp.events" in payload
+    assert "count=7.0" in payload
+    # activity in the final window, then stop: the final flush carries it
+    registry.counter("udp/events").inc(1)
+    pushed_before = exporter.pushes
+    exporter.stop()
+    assert exporter.pushes > pushed_before  # stop() flushed once more
+    final = b""
+    try:
+        while True:
+            final = sock.recv(65536)  # drain to the newest datagram
+            sock.settimeout(0.2)
+    except socket.timeout:
+        pass
+    assert b"count=8.0" in final
+    assert exporter._sock is None  # socket released
+    sock.close()
+
+
+def test_influx_file_sink_final_flush_on_stop(tmp_path):
+    """stop() on a file-sink exporter performs the final flush even when
+    the interval never elapsed."""
+    from gethsharding_tpu.metrics import InfluxLineExporter, Registry
+
+    registry = Registry()
+    registry.counter("f/events").inc(3)
+    path = str(tmp_path / "final.influx")
+    exporter = InfluxLineExporter(registry=registry, interval=600.0,
+                                  path=path)
+    exporter.start()
+    exporter.stop()  # interval (10 min) never fired: only the final flush
+    lines = open(path).read().strip().splitlines()
+    assert len(lines) == 1 and "count=3.0" in lines[0]
+
+
+def test_influx_histogram_fields_are_cumulative_and_exact():
+    """The exporter's histogram lines carry BOTH bucket views: the
+    cumulative Prometheus-style le_* fields and the exact per-slot
+    bucket_* fields."""
+    from gethsharding_tpu.metrics import InfluxLineExporter, Registry
+
+    registry = Registry()
+    hist = registry.histogram("h/rows", buckets=(1, 4))
+    for value in (1, 3, 9):
+        hist.observe(value)
+    payload = InfluxLineExporter(
+        registry=registry, udp=("127.0.0.1", 1)).encode_snapshot(
+        timestamp_ns=1)
+    fields = payload.decode().split(" ")[1].split(",")
+    assert "le_4=2.0" in fields and "le_inf=3.0" in fields  # cumulative
+    assert "bucket_4=1.0" in fields and "bucket_inf=1.0" in fields
+
+
+def test_prometheus_text_exposition():
+    """The /metrics?format=prom payload: every metric kind rendered in
+    text exposition format with legal names, counters as _total,
+    histograms with cumulative le buckets ending at +Inf == count."""
+    from gethsharding_tpu.metrics import Registry, prometheus_text
+
+    registry = Registry()
+    registry.counter("notary/votes submitted").inc(4)
+    registry.gauge("pool/depth").set(2.5)
+    registry.timer("audit/latency").observe(0.25)
+    hist = registry.histogram("serving/rows", buckets=(1, 4))
+    for value in (1, 3, 9):
+        hist.observe(value)
+
+    text = prometheus_text(registry)
+    lines = text.strip().splitlines()
+    assert "gethsharding_notary_votes_submitted_total 4" in lines
+    assert "# TYPE gethsharding_notary_votes_submitted_total counter" in lines
+    assert "gethsharding_pool_depth 2.5" in lines
+    assert 'gethsharding_audit_latency{quantile="0.5"} 0.25' in lines
+    assert "gethsharding_audit_latency_count 1" in lines
+    assert 'gethsharding_serving_rows_bucket{le="1"} 1' in lines
+    assert 'gethsharding_serving_rows_bucket{le="4"} 2' in lines
+    assert 'gethsharding_serving_rows_bucket{le="+Inf"} 3' in lines
+    assert "gethsharding_serving_rows_count 3" in lines
+    assert text.endswith("\n")
+    # an empty registry still yields a non-empty scrape body
+    assert prometheus_text(Registry()).strip()

@@ -1,11 +1,19 @@
-"""HTTP status endpoint + console REPL (dashboard/console analogs)."""
+"""HTTP status endpoint + console REPL (dashboard/console analogs),
+span tracing (gethsharding_tpu/tracing), and the Prometheus exposition
+surface."""
 
 import io
 import json
+import os
 import subprocess
 import sys
+import threading
+import time
 import urllib.request
 
+import pytest
+
+from gethsharding_tpu import tracing
 from gethsharding_tpu.node.backend import ShardNode
 from gethsharding_tpu.smc.chain import SimulatedMainchain
 
@@ -14,6 +22,16 @@ def _get(port, path):
     with urllib.request.urlopen(
             f"http://127.0.0.1:{port}{path}", timeout=5) as resp:
         return resp.status, json.loads(resp.read())
+
+
+@pytest.fixture
+def tracer():
+    """Enabled process tracer, reset afterwards (module-global state)."""
+    tracing.enable(ring_spans=65536)
+    tracing.TRACER.clear()
+    yield tracing.TRACER
+    tracing.disable()
+    tracing.TRACER.clear()
 
 
 def test_status_endpoint_serves_health_metrics_status():
@@ -299,3 +317,285 @@ def test_console_trace_and_python_mode():
     finally:
         chain_proc.terminate()
         chain_proc.wait(timeout=10)
+
+
+# == span tracing (gethsharding_tpu/tracing) ===============================
+
+
+def _garbage_rows(i):
+    """One cheap serving row (invalid sig recovers to None instantly)."""
+    return [bytes([i]) * 32], [bytes([i]) * 65]
+
+
+def _serving_backend(flush_us=2000.0):
+    from gethsharding_tpu.serving import ServingConfig, ServingSigBackend
+    from gethsharding_tpu.sigbackend import get_backend
+
+    return ServingSigBackend(get_backend("python"),
+                             ServingConfig(flush_us=flush_us))
+
+
+def test_serving_request_spans_decompose_to_parent(tracer, tmp_path):
+    """THE attribution contract: every coalesced request's parent span
+    decomposes into queue_wait / batch_assembly / device_dispatch child
+    spans summing (±5%) to the parent — in the tracer AND in the
+    exported Chrome trace-event JSON."""
+    serving = _serving_backend()
+    clients = 4
+    try:
+        def client(c):
+            with tracing.span("client/request", client=c):
+                serving.ecrecover_addresses(*_garbage_rows(c))
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        serving.close()
+
+    spans = tracer.recent_spans()
+    requests = [s for s in spans
+                if s["name"] == "serving/ecrecover/request"]
+    assert len(requests) == clients
+    by_parent = {}
+    for s in spans:
+        by_parent.setdefault(s["parent"], []).append(s)
+    phase_names = {"serving/ecrecover/queue_wait",
+                   "serving/ecrecover/batch_assembly",
+                   "serving/ecrecover/device_dispatch"}
+    for req in requests:
+        kids = [s for s in by_parent.get(req["span"], [])
+                if s["name"] in phase_names]
+        assert {k["name"] for k in kids} == phase_names
+        parent_dur = req["end"] - req["start"]
+        kids_dur = sum(k["end"] - k["start"] for k in kids)
+        assert abs(kids_dur - parent_dur) <= 0.05 * parent_dur
+        # the caller's span parents the request (trace propagation
+        # through submit() across three threads)
+        client_spans = [s for s in spans if s["name"] == "client/request"
+                        and s["trace"] == req["trace"]]
+        assert len(client_spans) == 1
+        assert req["parent"] == client_spans[0]["span"]
+        # the caller-side wake phase rides the same trace
+        wakes = [s for s in by_parent.get(req["span"], [])
+                 if s["name"] == "serving/ecrecover/future_wake"]
+        assert len(wakes) == 1
+
+    # the same contract must hold in the exported Chrome trace
+    path = str(tmp_path / "trace.json")
+    assert tracing.write_chrome_trace(path) == len(spans)
+    events = json.load(open(path))["traceEvents"]
+    assert all(e["ph"] == "X" for e in events)
+    for req in (e for e in events
+                if e["name"] == "serving/ecrecover/request"):
+        kids = [e for e in events
+                if e["args"]["parent_id"] == req["args"]["span_id"]
+                and e["name"] in phase_names]
+        assert len(kids) == 3
+        assert abs(sum(k["dur"] for k in kids) - req["dur"]) \
+            <= 0.05 * req["dur"]
+
+    # span durations fed the metrics registry (timers the influx
+    # exporter and dashboard pick up for free)
+    from gethsharding_tpu.metrics import DEFAULT_REGISTRY
+
+    timer = DEFAULT_REGISTRY.get("trace/serving/ecrecover/request")
+    assert timer is not None and timer.count >= clients
+
+
+def test_failed_dispatch_still_emits_error_tagged_spans(tracer):
+    """Errored requests are the ones most worth attributing: a batch
+    whose device call raises still emits its request span tree, tagged
+    with the error, before the futures fail."""
+    from gethsharding_tpu.serving import ServingConfig, ServingSigBackend
+
+    class BoomBackend:
+        name = "boom"
+
+        def ecrecover_addresses(self, digests, sigs65):
+            raise RuntimeError("device on fire")
+
+    serving = ServingSigBackend(BoomBackend(), ServingConfig(flush_us=500))
+    try:
+        with pytest.raises(RuntimeError, match="device on fire"):
+            serving.ecrecover_addresses(*_garbage_rows(1))
+    finally:
+        serving.close()
+    requests = [s for s in tracer.recent_spans()
+                if s["name"] == "serving/ecrecover/request"]
+    assert len(requests) == 1
+    assert "device on fire" in requests[0]["tags"]["error"]
+
+
+def test_tracer_off_overhead_on_serving_hot_path():
+    """Tracer-off overhead budget: the guards the serving hot path
+    evaluates per request when tracing is disabled must cost <2% of a
+    request's serving latency."""
+    assert not tracing.TRACER.enabled
+    serving = _serving_backend(flush_us=500.0)
+    try:
+        serving.ecrecover_addresses(*_garbage_rows(0))  # warm the threads
+        n = 100
+        t0 = time.perf_counter()
+        for i in range(n):
+            serving.ecrecover_addresses(*_garbage_rows(i % 251))
+        per_request_s = (time.perf_counter() - t0) / n
+    finally:
+        serving.close()
+
+    # the disabled-path work per request: request_context() at submit
+    # plus TRACER.enabled reads on the flusher/dispatch/await sides —
+    # charge 6 guard evaluations per request (3x the real count)
+    m = 100_000
+    t0 = perf = time.perf_counter()
+    for _ in range(m):
+        tracing.request_context()
+    guard_s = (time.perf_counter() - perf) / m
+    overhead = 6 * guard_s
+    assert overhead < 0.02 * per_request_s, (
+        f"tracer-off overhead {overhead * 1e6:.3f}us vs request "
+        f"{per_request_s * 1e6:.1f}us")
+
+
+def test_trace_endpoint_and_prometheus_exposition(tracer):
+    """/trace serves recent traces; /metrics?format=prom serves the
+    Prometheus text exposition; both on the node status server."""
+    serving = _serving_backend()
+    try:
+        serving.ecrecover_addresses(*_garbage_rows(7))
+    finally:
+        serving.close()
+    node = ShardNode(actor="observer", backend=SimulatedMainchain(),
+                     txpool_interval=None, http_port=0)
+    node.start()
+    try:
+        from gethsharding_tpu.node.http_status import StatusServer
+
+        port = node.service(StatusServer).port
+        code, payload = _get(port, "/trace")
+        assert code == 200 and payload["enabled"] is True
+        names = {span["name"] for trace in payload["traces"]
+                 for span in trace["spans"]}
+        assert "serving/ecrecover/request" in names
+        assert "serving/ecrecover/device_dispatch" in names
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics?format=prom",
+                timeout=5) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            text = resp.read().decode()
+        assert "# TYPE" in text
+        assert "gethsharding_serving_ecrecover_requests_total" in text
+        # span-duration timers folded into the registry ride the scrape
+        assert "gethsharding_trace_serving_ecrecover_request" in text
+
+        # plain /metrics stays JSON
+        code, snapshot = _get(port, "/metrics")
+        assert code == 200 and isinstance(snapshot, dict)
+    finally:
+        node.stop()
+
+
+def test_rpc_response_carries_trace_id(tracer):
+    """The RPC server parents serving spans under a handler span and
+    returns the trace id on the response envelope."""
+    import socket
+
+    from gethsharding_tpu.rpc.server import RPCServer
+
+    server = RPCServer(SimulatedMainchain())
+    server.start()
+    try:
+        sock = socket.create_connection(server.address, timeout=5)
+        fh = sock.makefile("rw")
+        digest, sig = _garbage_rows(9)
+        fh.write(json.dumps({
+            "jsonrpc": "2.0", "id": 1, "method": "shard_ecrecover",
+            "params": [["0x" + digest[0].hex()], ["0x" + sig[0].hex()]],
+        }) + "\n")
+        fh.flush()
+        response = json.loads(fh.readline())
+        assert response["result"] == [None]
+        assert isinstance(response["trace"], int)
+        sock.close()
+        # the handler span and the serving request share one trace
+        spans = tracer.recent_spans()
+        rpc_spans = [s for s in spans if s["name"] == "rpc/shard_ecrecover"]
+        assert len(rpc_spans) == 1
+        assert rpc_spans[0]["trace"] == response["trace"]
+        request = [s for s in spans
+                   if s["name"] == "serving/ecrecover/request"][0]
+        assert request["trace"] == response["trace"]
+        wake = [s for s in spans
+                if s["name"] == "serving/ecrecover/future_wake"]
+        assert wake, "RPC handler must record the future_wake phase"
+    finally:
+        server.stop()
+
+
+def test_jax_compile_cache_shape_tracking(tracer):
+    """Per-bucket-shape compile-cache hit/miss counters: the first
+    dispatch of a shape is a miss (an XLA compile), repeats are hits —
+    the recompile-storm signal."""
+    from gethsharding_tpu.metrics import DEFAULT_REGISTRY
+    from gethsharding_tpu.sigbackend import JaxSigBackend
+
+    backend = JaxSigBackend.__new__(JaxSigBackend)  # tracking state only:
+    # full __init__ imports + jits the kernels, which the slow tier owns
+    backend._shape_seen = set()
+    backend._shape_lock = threading.Lock()
+    from gethsharding_tpu import metrics as m
+
+    backend._m_shape_hit = m.counter("jax/compile_cache/hits")
+    backend._m_shape_miss = m.counter("jax/compile_cache/misses")
+    hits0 = backend._m_shape_hit.value
+    misses0 = backend._m_shape_miss.value
+    assert backend._note_shape("ecrecover", 16) is True     # fresh shape
+    assert backend._note_shape("ecrecover", 16) is False    # compiled
+    assert backend._note_shape("ecrecover", 32) is True     # new bucket
+    assert backend._note_shape("bls_committee", 16, 144) is True
+    assert backend._m_shape_miss.value - misses0 == 3
+    assert backend._m_shape_hit.value - hits0 == 1
+    assert DEFAULT_REGISTRY.get("jax/compile_cache/misses") is not None
+
+
+def test_bench_trace_mode_emits_perfetto_profile(tmp_path):
+    """ACCEPTANCE: `bench.py --trace` produces a Chrome trace-event
+    JSON whose serving-request spans decompose into queue_wait /
+    batch_assembly / device_dispatch children summing (±5%) to the
+    parent span."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    trace_path = str(tmp_path / "bench_trace.json")
+    env = {**os.environ,
+           "JAX_PLATFORMS": "cpu",
+           "GETHSHARDING_BENCH_SERVING_CLIENTS": "4",
+           "GETHSHARDING_BENCH_SERVING_REQS": "2"}
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"), "--trace",
+         "--trace-out", trace_path],
+        capture_output=True, text=True, timeout=180, env=env, cwd=repo)
+    assert out.returncode == 0, out.stderr
+    line = json.loads(out.stdout.strip().splitlines()[-1])
+    assert line["metric"] == "serving_trace_profile"
+    assert line["extra"]["trace_out"] == trace_path
+    assert line["extra"]["traced_requests"] == 8
+
+    events = json.load(open(trace_path))["traceEvents"]
+    assert line["extra"]["trace_events"] == len(events)
+    requests = [e for e in events
+                if e["name"] == "serving/ecrecover/request"]
+    assert len(requests) == 8
+    phases = {"serving/ecrecover/queue_wait",
+              "serving/ecrecover/batch_assembly",
+              "serving/ecrecover/device_dispatch"}
+    for req in requests:
+        kids = [e for e in events
+                if e["args"]["parent_id"] == req["args"]["span_id"]
+                and e["name"] in phases]
+        assert {k["name"] for k in kids} == phases
+        assert abs(sum(k["dur"] for k in kids) - req["dur"]) \
+            <= 0.05 * req["dur"]

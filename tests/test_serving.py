@@ -559,11 +559,16 @@ def test_histogram_metric():
     for value in (1, 1, 3, 9, 100):
         hist.observe(value)
     assert hist.count == 5
-    assert hist.bucket_counts() == {"le_1": 2, "le_4": 1, "le_16": 1,
-                                    "le_inf": 1}
+    # le_* counts are CUMULATIVE (Prometheus semantics: at-or-below)
+    assert hist.bucket_counts() == {"le_1": 2, "le_4": 3, "le_16": 4,
+                                    "le_inf": 5}
+    # the exact per-slot counts stay available under bucket_* keys
+    assert hist.slot_counts() == {"bucket_1": 2, "bucket_4": 1,
+                                  "bucket_16": 1, "bucket_inf": 1}
     snap = hist.snapshot()
     assert snap["type"] == "histogram" and snap["count"] == 5
-    assert snap["le_inf"] == 1  # flat fields: exporter/dashboard ready
+    assert snap["le_inf"] == 5  # flat fields: exporter/dashboard ready
+    assert snap["bucket_inf"] == 1
     registry = metrics.Registry()
     assert (registry.histogram("h", buckets=(1, 2))
             is registry.histogram("h"))
