@@ -18,7 +18,9 @@ for all shards in a period are verified as one batch (see
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+import os
+import time
+from typing import Callable, List, Optional, Tuple
 
 from gethsharding_tpu import metrics, tracing
 from gethsharding_tpu.actors.base import Service
@@ -169,10 +171,48 @@ class Notary(Service):
             block_number = self.client.block_number
             shard_count = self.client.shard_count()
         # audit the previous period's aggregate votes once, in one batched
-        # device dispatch (the re-architected hot loop; see audit_period)
+        # device dispatch (the re-architected hot loop; see audit_period).
+        # With overlap on (GETHSHARDING_NOTARY_OVERLAP, default), the
+        # dispatch is FIRED here and the verdict pulled only after the
+        # vote phases: the device verifies period N-1 while this thread
+        # fetches candidates, recovers proposer signatures and votes —
+        # the host pull stays off the critical path until the verdict
+        # is actually needed (the audit counters/mismatch report).
+        finish_audit: Optional[Callable[[], None]] = None
+        prev_audited = self._last_audited_period
         if period > 0 and self._last_audited_period < period:
-            self.audit_period(period - 1)
+            if self._overlap_enabled():
+                finish_audit = self._begin_period_audit(period - 1)
+            else:
+                self.audit_period(period - 1)
             self._last_audited_period = period
+        try:
+            self._vote_phases(snap, period, block_number, shard_count)
+        except Exception:
+            # the vote-phase failure wins; still collect the audit
+            # verdict (its device work is done — dropping the future
+            # would silently skip the mismatch checks for this period)
+            if finish_audit is not None:
+                try:
+                    finish_audit()
+                except Exception as audit_exc:
+                    # transient collect failure: rewind the watermark so
+                    # the NEXT head retries this period's audit (the
+                    # sync path's retry semantics)
+                    self._last_audited_period = prev_audited
+                    self.record_error(
+                        f"period audit failed behind a vote-phase "
+                        f"error: {audit_exc}")
+            raise
+        if finish_audit is not None:
+            try:
+                finish_audit()
+            except Exception:
+                self._last_audited_period = prev_audited  # retry next head
+                raise
+
+    def _vote_phases(self, snap, period: int, block_number: int,
+                     shard_count: int) -> None:
         # a vote submitted now executes in the PENDING block; if that block
         # already belongs to the next period the SMC will revert with
         # "period is not current" — skip and wait for the new period's head
@@ -382,7 +422,13 @@ class Notary(Service):
         """
         return self.audit_periods([period])[period]
 
-    def audit_periods(self, periods) -> dict:
+    def _overlap_enabled(self) -> bool:
+        """GETHSHARDING_NOTARY_OVERLAP (default on): fire the audit
+        dispatch asynchronously and pull the verdict only when it is
+        needed, overlapping device execution with host work."""
+        return os.environ.get("GETHSHARDING_NOTARY_OVERLAP", "1") != "0"
+
+    def audit_periods(self, periods, overlap: bool = False) -> dict:
         """Audit MANY periods in ONE sig-backend dispatch.
 
         The catch-up form of `audit_period` (an observer or light server
@@ -393,9 +439,22 @@ class Notary(Service):
         vote-log replay check remains one `verify_period_batch` call per
         period; its kernel shapes are period-local.) Returns
         {period: True/False/None} with `audit_period` semantics.
+
+        ``overlap=True`` switches to the PIPELINED form: one dispatch
+        per period, fired through the backend's async face, so period
+        N+1's host marshalling/staging (and period N's verdict judging)
+        runs while period N executes on device. Verdicts are identical;
+        pick batched for a latency-bound kernel (fewer dispatches),
+        overlapped when host marshalling is the bottleneck or verdicts
+        should stream per period (``bench.py --overlap`` measures the
+        ratio).
         """
         periods = list(periods)
         collected = {p: self._collect_audit_rows(p) for p in periods}
+        results: dict = {p: None for p in periods}
+        if overlap:
+            return self._audit_periods_overlapped(periods, collected,
+                                                  results)
         msgs, sig_rows, pk_rows, pk_keys = [], [], [], []
         spans = {}
         for period, rows in collected.items():
@@ -408,7 +467,6 @@ class Notary(Service):
             pk_keys.extend(rows["pk_keys"])
             spans[period] = (start, len(msgs))
 
-        results: dict = {p: None for p in periods}
         if not spans:
             return results
         # aggregation + verification are ONE backend call: with sigbackend
@@ -424,6 +482,71 @@ class Notary(Service):
             results[period] = self._judge_period(
                 period, collected[period], ok[start:end])
         return results
+
+    def _audit_periods_overlapped(self, periods, collected,
+                                  results) -> dict:
+        """The marshal/dispatch pipeline: submit every period's dispatch
+        through the async backend face (each submit returns once the
+        device is launched, so period N+1 marshals while N executes),
+        then judge verdicts in order — each `result()` pull overlaps
+        the remaining periods' device work."""
+        pending = []  # (period, rows, verdict future)
+        n_rows = sum(len(r["msgs"]) for r in collected.values()
+                     if r is not None)
+        with tracing.span("notary/audit", periods=len(periods),
+                          rows=n_rows, overlap=True):
+            # the latency timer covers submits + verdict pulls ONLY —
+            # judging (incl. the per-period replay check) stays outside,
+            # like the sync branch, so notary/period_audit_latency is
+            # comparable between the batched and overlapped modes
+            verdicts = []
+            with self.m_audit_latency.time():
+                for period in periods:
+                    rows = collected[period]
+                    if rows is None:
+                        continue
+                    future = self.sig_backend.bls_verify_committees_async(
+                        rows["msgs"], rows["sig_rows"], rows["pk_rows"],
+                        pk_row_keys=rows["pk_keys"])
+                    pending.append((period, rows, future))
+                for period, rows, future in pending:
+                    verdicts.append((period, rows, future.result()))
+            for period, rows, ok in verdicts:
+                results[period] = self._judge_period(period, rows, ok)
+        self.audits_run += len(pending)
+        return results
+
+    def _begin_period_audit(self, period: int) -> Callable[[], None]:
+        """Fire one period's audit dispatch NOW; returns the finalize
+        closure that pulls the verdict and judges it. The head loop
+        calls finalize after the vote phases, so the device verifies
+        the previous period underneath the current period's votes. The
+        audit-latency timer records submit + collect time only — the
+        overlapped middle belongs to the vote phases, not the audit."""
+        with tracing.span("notary/audit_submit", period=period):
+            collected = self._collect_audit_rows(period)
+            if collected is None:
+                return lambda: None
+            # the latency timer mirrors the sync path's scope — the
+            # sig-backend call only: row collection stays before it and
+            # judging (incl. the replay dispatch) after, so the metric
+            # keeps one meaning across GETHSHARDING_NOTARY_OVERLAP
+            t0 = time.monotonic()
+            future = self.sig_backend.bls_verify_committees_async(
+                collected["msgs"], collected["sig_rows"],
+                collected["pk_rows"], pk_row_keys=collected["pk_keys"])
+            submit_s = time.monotonic() - t0
+
+        def finish() -> None:
+            with tracing.span("notary/audit_collect", period=period):
+                t1 = time.monotonic()
+                ok = future.result()
+                self.m_audit_latency.observe(
+                    submit_s + (time.monotonic() - t1))
+                self.audits_run += 1
+                self._judge_period(period, collected, ok)
+
+        return finish
 
     def _collect_audit_rows(self, period: int) -> Optional[dict]:
         """One bulk pull of a period's auditable rows (or None)."""

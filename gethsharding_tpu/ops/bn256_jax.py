@@ -1303,11 +1303,14 @@ def g2_to_limbs(points: Sequence[ref.G2Point]):
     return (np.stack(xs), np.stack(ys), np.asarray(ok))
 
 
-def g1_committee_to_limbs(rows: Sequence[Sequence[ref.G1Point]], width: int):
+def g1_committee_to_limbs(rows: Sequence[Sequence[ref.G1Point]], width: int,
+                          out_dtype=np.int32):
     """B rows of ≤width G1 points (None = empty slot) -> the committee
     kernel inputs (B, width, 22) ×2 + mask (B, width). Vectorized through
     the bulk `ints_to_limbs` bit-plane path — this sits on the audit's
-    host marshalling critical path (B·width points per dispatch)."""
+    host marshalling critical path (B·width points per dispatch).
+    `out_dtype=np.uint16` marshals directly into the u16 wire format
+    (canonical 12-bit limbs) without a second full-plane copy."""
     B = len(rows)
     flat_x, flat_y = [], []
     mask = np.zeros((B, width), bool)
@@ -1323,14 +1326,19 @@ def g1_committee_to_limbs(rows: Sequence[Sequence[ref.G1Point]], width: int):
                 flat_x.append(pt[0] % P)
                 flat_y.append(pt[1] % P)
                 mask[b, c] = True
-    both = ints_to_limbs(flat_x + flat_y)  # one bit-plane pass for x+y
+    # one bit-plane pass for x+y
+    both = ints_to_limbs(flat_x + flat_y, out_dtype=out_dtype)
     xs = both[:B * width].reshape(B, width, NLIMBS)
     ys = both[B * width:].reshape(B, width, NLIMBS)
     return xs, ys, mask
 
 
-def g2_committee_to_limbs(rows: Sequence[Sequence[ref.G2Point]], width: int):
-    """B rows of ≤width G2 points -> (B, width, 2, 22) ×2 + mask."""
+def g2_committee_to_limbs(rows: Sequence[Sequence[ref.G2Point]], width: int,
+                          out_dtype=np.int32):
+    """B rows of ≤width G2 points -> (B, width, 2, 22) ×2 + mask.
+
+    The audit's LARGEST host buffers (the G2 share of every dispatch);
+    `out_dtype` as in `g1_committee_to_limbs`."""
     B = len(rows)
     flat_x, flat_y = [], []
     mask = np.zeros((B, width), bool)
@@ -1347,7 +1355,8 @@ def g2_committee_to_limbs(rows: Sequence[Sequence[ref.G2Point]], width: int):
                 flat_x.extend((x.a % P, x.b % P))
                 flat_y.extend((y.a % P, y.b % P))
                 mask[b, c] = True
-    both = ints_to_limbs(flat_x + flat_y)  # one bit-plane pass for x+y
+    # one bit-plane pass for x+y
+    both = ints_to_limbs(flat_x + flat_y, out_dtype=out_dtype)
     half = B * width * 2
     xs = both[:half].reshape(B, width, 2, NLIMBS)
     ys = both[half:].reshape(B, width, 2, NLIMBS)

@@ -91,15 +91,19 @@ def _limbs_to_int_nd(arr: np.ndarray):
     return out
 
 
-def ints_to_limbs(values: Sequence[int], nlimbs: int = NLIMBS) -> np.ndarray:
-    """Batch conversion: (batch,) python ints -> (batch, nlimbs) int32.
+def ints_to_limbs(values: Sequence[int], nlimbs: int = NLIMBS,
+                  out_dtype=np.int32) -> np.ndarray:
+    """Batch conversion: (batch,) python ints -> (batch, nlimbs) limbs.
 
     Vectorized: one to_bytes per int (C speed), then a numpy bit-plane
     extraction — this sits on the host marshalling critical path
-    (hashes/signatures -> limbs for every batch dispatch)."""
+    (hashes/signatures -> limbs for every batch dispatch). `out_dtype`
+    lets the u16 wire format (12-bit limbs always fit uint16) marshal
+    straight into the wire width instead of paying a second full-plane
+    astype copy of the audit's largest buffers."""
     n = len(values)
     if n == 0:
-        return np.zeros((0, nlimbs), np.int32)
+        return np.zeros((0, nlimbs), out_dtype)
     nbytes = -(-nlimbs * LIMB_BITS // 8)
     try:
         raw = b"".join(v.to_bytes(nbytes, "little") for v in values)
@@ -117,7 +121,7 @@ def ints_to_limbs(values: Sequence[int], nlimbs: int = NLIMBS) -> np.ndarray:
     # high-nibble(b1) | b2<<4. Contiguous reshape + strided writes beat
     # the per-limb gather by ~6x on the audit marshalling path.
     pairs = nlimbs // 2
-    out = np.empty((n, nlimbs), np.int32)
+    out = np.empty((n, nlimbs), out_dtype)
     if pairs:
         main = arr[:, :pairs * 3].reshape(n, pairs, 3).astype(np.uint16)
         out[:, 0:2 * pairs:2] = main[..., 0] | ((main[..., 1] & 0x0F) << 8)

@@ -134,6 +134,16 @@ class ServingSigBackend(SigBackend):
                                        sig_rows, pk_rows,
                                        pk_row_keys=pk_row_keys))
 
+    def bls_verify_committees_async(self, messages, sig_rows, pk_rows,
+                                    pk_row_keys=None):
+        """The overlapped-notary face over the serving tier: the
+        request coalesces with concurrent traffic and the returned
+        `concurrent.futures.Future` is `VerdictFuture`-compatible on
+        `result()`, so `Notary`'s audit pipeline works unchanged under
+        ``--serving``."""
+        return self.submit("bls_verify_committees", messages, sig_rows,
+                           pk_rows, pk_row_keys=pk_row_keys)
+
     # -- lifecycle / observability -----------------------------------------
 
     def close(self) -> None:

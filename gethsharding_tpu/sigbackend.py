@@ -58,6 +58,35 @@ def bucket_size(n: int) -> int:
     return -(-n // quarter) * quarter
 
 
+class VerdictFuture:
+    """Handle on an in-flight committee verification.
+
+    The jax backend's device dispatch is asynchronous: `result()` is
+    where the verdict is pulled to the host (`np.asarray`), so a caller
+    that submits period N+1 (or does any other host work) between
+    submit and `result()` overlaps its host time with N's device
+    execution. `concurrent.futures.Future`-compatible on the one method
+    the notary uses (`result`), so the serving tier's real futures are
+    drop-in."""
+
+    __slots__ = ("_finalize", "_value", "_done")
+
+    def __init__(self, finalize):
+        self._finalize = finalize
+        self._value = None
+        self._done = False
+
+    def result(self, timeout=None):
+        if not self._done:
+            self._value = self._finalize()
+            self._done = True
+            self._finalize = None  # drop the staged buffers
+        return self._value
+
+    def done(self) -> bool:
+        return self._done
+
+
 class SigBackend:
     """Batch signature operations used by the consensus hot loops."""
 
@@ -92,6 +121,24 @@ class SigBackend:
         e.g. the wire encoding) lets a backend cache the marshalled
         pubkey rows — keys MUST uniquely determine the row's points."""
         raise NotImplementedError
+
+    def bls_verify_committees_async(
+            self,
+            messages: Sequence[bytes],
+            sig_rows: Sequence[Sequence[bls.G1Point]],
+            pk_rows: Sequence[Sequence[bls.G2Point]],
+            pk_row_keys: Optional[Sequence] = None) -> VerdictFuture:
+        """`bls_verify_committees` returning a verdict future instead of
+        blocking on the host pull. The jax backend stages and launches
+        the device dispatch before returning, so the caller marshals the
+        NEXT batch while this one executes on device; scalar backends
+        compute eagerly and return a resolved future (same contract, no
+        overlap). Verdicts are bit-identical to the sync form."""
+        out = self.bls_verify_committees(messages, sig_rows, pk_rows,
+                                         pk_row_keys=pk_row_keys)
+        future = VerdictFuture(lambda: out)
+        future.result()  # scalar path: already computed; mark resolved
+        return future
 
 
 class PythonSigBackend(SigBackend):
@@ -149,6 +196,7 @@ class JaxSigBackend(SigBackend):
         # to int32 ON DEVICE before the kernel — value-identical, the
         # wire format never reaches the arithmetic
         self._wire_u16 = os.environ.get("GETHSHARDING_TPU_WIRE") == "u16"
+        self._wire = "u16" if self._wire_u16 else "i32"
 
         def _committee_u16(hx, hy, sx, sy, sm, px, py, pm, hok):
             i32 = jnp.int32
@@ -162,9 +210,37 @@ class JaxSigBackend(SigBackend):
         # thread (get_backend caches instances): the row cache needs a
         # lock or concurrent audits race the eviction loop
         import threading
+        from collections import OrderedDict
 
         self._pk_row_cache: dict = {}
         self._pk_row_lock = threading.Lock()
+        # DEVICE residency (GETHSHARDING_TPU_RESIDENT, default on):
+        # committee pubkey rows are cached as device (`jnp`) buffers
+        # keyed by the caller's pk_row_keys — a steady-state audit then
+        # transfers only the fresh-per-period buffers (hashes, signature
+        # planes, masks); the G2 planes, the largest, stay on device.
+        # Memory-accounted LRU bounded by GETHSHARDING_TPU_RESIDENT_MB.
+        self._resident = os.environ.get(
+            "GETHSHARDING_TPU_RESIDENT", "1") != "0"
+        self._resident_budget = int(float(os.environ.get(
+            "GETHSHARDING_TPU_RESIDENT_MB", "256")) * (1 << 20))
+        self._pk_dev_cache: OrderedDict = OrderedDict()
+        self._pk_dev_bytes = 0
+        self._pk_dev_lock = threading.Lock()
+        # one assembled-batch memo: the steady-state audit repeats the
+        # SAME row-key tuple every period, so the stacked (B, width, …)
+        # device planes are reused whole — zero transfers AND zero
+        # per-dispatch device stacking ops
+        self._pk_batch_memo: "tuple | None" = None  # (key, planes, nbytes)
+        self._pk_zero_rows: dict = {}  # width -> device zero row planes
+        self._m_row_hit = metrics.counter("jax/pk_row_cache/hits")
+        self._m_row_miss = metrics.counter("jax/pk_row_cache/misses")
+        self._m_dev_hit = metrics.counter("jax/pk_device_cache/hits")
+        self._m_dev_miss = metrics.counter("jax/pk_device_cache/misses")
+        self._m_dev_evict = metrics.counter("jax/pk_device_cache/evictions")
+        self._g_dev_bytes = metrics.gauge("jax/pk_device_cache/bytes")
+        self._m_wire_bytes = metrics.counter("jax/wire/bytes")
+        self._m_pk_hit_bytes = metrics.counter("jax/wire/pk_device_hit_bytes")
         # compile-cache visibility: jax.jit compiles once per argument
         # SHAPE, and every padded bucket this process has not dispatched
         # before is a fresh XLA compile (seconds to minutes). Tracking
@@ -275,6 +351,26 @@ class JaxSigBackend(SigBackend):
 
     def bls_verify_committees(self, messages, sig_rows, pk_rows,
                               pk_row_keys=None):
+        return self._committee_submit(messages, sig_rows, pk_rows,
+                                      pk_row_keys).result()
+
+    def bls_verify_committees_async(self, messages, sig_rows, pk_rows,
+                                    pk_row_keys=None):
+        """Stage + launch the dispatch NOW; the device executes while
+        the caller marshals the next period. `result()` is the host
+        pull."""
+        return self._committee_submit(messages, sig_rows, pk_rows,
+                                      pk_row_keys)
+
+    # -- the staged committee path -----------------------------------------
+    # marshal (host limbs + cache resolution) -> transfer (host->device)
+    # -> dispatch (device, async) -> pull (result()). Explicit stages so
+    # the async form overlaps host staging of batch N+1 with batch N's
+    # device execution, and so the SIG_TIMING ledger can attribute every
+    # boundary.
+
+    def _committee_submit(self, messages, sig_rows, pk_rows,
+                          pk_row_keys) -> VerdictFuture:
         import time
 
         import numpy as np
@@ -289,58 +385,14 @@ class JaxSigBackend(SigBackend):
         jnp = self._jnp
         n = len(messages)
         if n == 0:
-            return []
-        bucket = self._bucket(n)
-        pad = bucket - n
-        # committee axis: the tree reduction takes any width (binary
-        # segment decomposition), so bucket only enough to bound the
-        # number of compiled shapes — next multiple of 16 (135 -> 144;
-        # the old mult-32 rule padded 18% of the committee work),
-        # power-of-two-ish below 32
-        width = max([1] + [len(r) for r in sig_rows]
-                    + [len(r) for r in pk_rows])
-        width = self._bucket(width) if width <= 32 else -(-width // 16) * 16
-        fresh = self._note_shape("bls_committee", bucket, width)
-        hashes = [bls.hash_to_g1(bytes(m)) for m in messages] + [None] * pad
-        hx, hy, hok = self._bn.g1_to_limbs(hashes)
-        sx, sy, sm = self._bn.g1_committee_to_limbs(
-            list(sig_rows) + [[]] * pad, width)
-        px, py, pm = self._pk_rows_to_limbs(
-            list(pk_rows) + [[]] * pad, width,
-            row_keys=(None if pk_row_keys is None
-                      else list(pk_row_keys) + [None] * pad))
+            self.last_wire = None
+            future = VerdictFuture(lambda: [])
+            future.result()
+            return future
+        st = self._committee_marshal(messages, sig_rows, pk_rows,
+                                     pk_row_keys)
         t1 = time.perf_counter()
-        if self._wire_u16:
-            # px/py already arrive uint16 from the cache-aware pk path;
-            # the remaining casts are the fresh-per-period buffers
-            # invariant: every wire plane holds CANONICAL 12-bit limbs
-            # (the host marshallers emit [0, 2^12)), so the uint16 cast
-            # is value-preserving. A lazy/wide-form limb (negative or
-            # >=2^16) would wrap silently and corrupt the verdict —
-            # GETHSHARDING_CHECK=1 pins the invariant at the narrowing
-            # site instead of paying the scan on the production path.
-            check = os.environ.get("GETHSHARDING_CHECK") == "1"
-
-            def narrow(a):
-                arr = np.asarray(a)
-                if check and arr.size:
-                    # bound is the CANONICAL limb width (12-bit), not the
-                    # wire width: a wide-form limb in [2^12, 2^16) would
-                    # survive the cast but violate the kernel's headroom
-                    assert arr.min() >= 0 and arr.max() < (1 << 12), (
-                        "u16 wire requires canonical limbs in [0, 2^12)")
-                # copy=False: px/py arrive already-uint16 from the pk-row
-                # cache — the buffers the cache exists to make zero-cost
-                # must not be re-copied per dispatch
-                return jnp.asarray(arr.astype(np.uint16, copy=False))
-
-            args = (narrow(hx), narrow(hy), narrow(sx), narrow(sy),
-                    jnp.asarray(sm), narrow(px), narrow(py),
-                    jnp.asarray(pm), jnp.asarray(hok))
-        else:
-            args = (jnp.asarray(hx), jnp.asarray(hy), jnp.asarray(sx),
-                    jnp.asarray(sy), jnp.asarray(sm), jnp.asarray(px),
-                    jnp.asarray(py), jnp.asarray(pm), jnp.asarray(hok))
+        args, wire = self._committee_transfer(st)
         if timing:
             # force EVERY host->device transfer to completion before
             # timing the dispatch (plain block_until_ready can no-op
@@ -354,33 +406,173 @@ class JaxSigBackend(SigBackend):
                 [a.ravel()[0].astype(jnp.int32) for a in args])
             np.asarray(probe)
             t2 = time.perf_counter()
+        # the per-dispatch wire ledger is always on (pure nbytes
+        # arithmetic, no device sync) — probe-42 transfer attribution
+        # must not require the sync-forcing timing mode
+        self.last_wire = wire
+        self._m_wire_bytes.inc(wire["wire_bytes"])
+        self._m_pk_hit_bytes.inc(wire["pk_hit_bytes"])
+        # stamp the enclosing caller span (the notary's notary/audit);
+        # SUMMED, so a multi-dispatch span reports total bytes
+        tracing.tag_current_add(wire_bytes=wire["wire_bytes"],
+                                pk_hit_bytes=wire["pk_hit_bytes"])
         fn = (self._bls_committee_u16 if self._wire_u16
               else self._bls_committee)
         tracer = tracing.TRACER
         td = time.monotonic() if tracer.enabled else 0.0
-        out = fn(*args)
-        res = [bool(b) for b in np.asarray(out)[:n]]
-        if tracer.enabled:
-            tracer.record("jax/bls_committee_dispatch", td, time.monotonic(),
-                          tags={"rows": n, "bucket": bucket, "width": width,
-                                "compile": "miss" if fresh else "hit"})
-        if timing:
-            t3 = time.perf_counter()
-            # per-instance: two backends in one process must not clobber
-            # each other's split
-            self.last_timing = {
-                "prep_s": round(t1 - t0, 4),
-                "transfer_s": round(t2 - t1, 4),
-                "dispatch_s": round(t3 - t2, 4),
-                "rows": n, "width": width,
-            }
-        return res
+        out = fn(*args)  # async dispatch: returns before execution ends
+        # finalize must close over SCALARS, not the marshal dict: `st`
+        # pins every host limb plane (MBs per dispatch) until result(),
+        # and an overlapped K-period pipeline holds K of them at once
+        bucket, width, fresh = st["bucket"], st["width"], st["fresh"]
+
+        def finalize():
+            res = [bool(b) for b in np.asarray(out)[:n]]
+            if tracer.enabled:
+                # the np.asarray pull above means the span closes only
+                # after the dispatch actually executed; on the async
+                # path it additionally covers the overlapped wait
+                tracer.record(
+                    "jax/bls_committee_dispatch", td, time.monotonic(),
+                    tags={"rows": n, "bucket": bucket,
+                          "width": width, "wire": self._wire,
+                          "compile": "miss" if fresh else "hit",
+                          "wire_bytes": wire["wire_bytes"],
+                          "pk_hit_bytes": wire["pk_hit_bytes"]})
+            if timing:
+                t3 = time.perf_counter()
+                # per-instance: two backends in one process must not
+                # clobber each other's split
+                self.last_timing = {
+                    "prep_s": round(t1 - t0, 4),
+                    "transfer_s": round(t2 - t1, 4),
+                    "dispatch_s": round(t3 - t2, 4),
+                    "rows": n, "width": width,
+                    **wire,
+                }
+            return res
+
+        return VerdictFuture(finalize)
+
+    def _committee_marshal(self, messages, sig_rows, pk_rows,
+                           pk_row_keys) -> dict:
+        """Stage 1, host only: padding policy, limb marshalling of the
+        fresh-per-period buffers (hashes, signatures, masks), pk-row
+        cache resolution (device hits claimed, misses marshalled)."""
+        import numpy as np
+
+        n = len(messages)
+        bucket = self._bucket(n)
+        pad = bucket - n
+        # committee axis: the tree reduction takes any width (binary
+        # segment decomposition), so bucket only enough to bound the
+        # number of compiled shapes — next multiple of 16 (135 -> 144;
+        # the old mult-32 rule padded 18% of the committee work),
+        # power-of-two-ish below 32
+        width = max([1] + [len(r) for r in sig_rows]
+                    + [len(r) for r in pk_rows])
+        width = self._bucket(width) if width <= 32 else -(-width // 16) * 16
+        # the compile-cache key INCLUDES the wire dtype: the u16 wire
+        # compiles a different XLA program for the same (bucket, width),
+        # so counting it against the other wire's entry would book a
+        # real recompile as a hit
+        fresh = self._note_shape("bls_committee", bucket, width, self._wire)
+        # u16 wire invariant: every wire plane holds CANONICAL 12-bit
+        # limbs (the host marshallers emit [0, 2^12)), so narrowing is
+        # value-preserving. A lazy/wide-form limb would wrap silently
+        # and corrupt the verdict — GETHSHARDING_CHECK=1 pins the
+        # invariant at the narrowing site; without it the marshallers
+        # emit the wire width directly (no second full-plane copy)
+        check = os.environ.get("GETHSHARDING_CHECK") == "1"
+        wire_dtype = (np.uint16 if self._wire_u16 and not check
+                      else np.int32)
+        hashes = [bls.hash_to_g1(bytes(m)) for m in messages] + [None] * pad
+        hx, hy, hok = self._bn.g1_to_limbs(hashes)
+        sx, sy, sm = self._bn.g1_committee_to_limbs(
+            list(sig_rows) + [[]] * pad, width, out_dtype=wire_dtype)
+        rows = list(pk_rows) + [[]] * pad
+        if pk_row_keys is None:
+            keys = None
+        else:
+            # normalize to EXACTLY one key per (padded) row: a short
+            # caller list means trailing rows are uncached (None), a
+            # surplus is dropped — the host row cache's contract
+            keys = list(pk_row_keys)[:len(rows)]
+            keys += [None] * (len(rows) - len(keys))
+        st = {"n": n, "bucket": bucket, "pad": pad, "width": width,
+              "fresh": fresh, "check": check,
+              "pk_rows": sum(1 for r in rows if r),
+              "hx": hx, "hy": hy, "hok": hok, "sx": sx, "sy": sy, "sm": sm,
+              "resident": self._resident and keys is not None}
+        if st["resident"]:
+            self._pk_resident_resolve(st, rows, keys)
+        else:
+            px, py, pm = self._pk_rows_to_limbs(rows, width, row_keys=keys)
+            st["px"], st["py"], st["pm"] = px, py, pm
+        return st
+
+    def _committee_transfer(self, st) -> tuple:
+        """Stage 2, host->device: ship the fresh-per-period buffers (+
+        any pk-row misses) and assemble the kernel args. Returns
+        (args, wire_ledger); ledger bytes are LOGICAL wire bytes — what
+        crosses the host->device link for this dispatch. Device-cache
+        hits and on-device stacking contribute zero."""
+        import numpy as np
+
+        jnp = self._jnp
+        check = st["check"]
+
+        def narrow(a):
+            arr = np.asarray(a)
+            if check and arr.size:
+                # bound is the CANONICAL limb width (12-bit), not the
+                # wire width: a wide-form limb in [2^12, 2^16) would
+                # survive the cast but violate the kernel's headroom
+                assert arr.min() >= 0 and arr.max() < (1 << 12), (
+                    "u16 wire requires canonical limbs in [0, 2^12)")
+            # copy=False: planes marshalled straight into uint16 (and
+            # cache-held rows) are not re-copied per dispatch
+            return arr.astype(np.uint16, copy=False)
+
+        conv = narrow if self._wire_u16 else np.asarray
+        hx, hy = conv(st["hx"]), conv(st["hy"])
+        sx, sy = conv(st["sx"]), conv(st["sy"])
+        sm, hok = st["sm"], st["hok"]
+        wire_bytes = (hx.nbytes + hy.nbytes + sx.nbytes + sy.nbytes
+                      + sm.nbytes + hok.nbytes)
+        if st["resident"]:
+            px, py, pm, g2_bytes = self._pk_resident_planes(st)
+            hit_bytes, hit_rows = st["hit_bytes"], st["hit_rows"]
+        else:
+            pxh, pyh, pmh = conv(st["px"]), conv(st["py"]), st["pm"]
+            g2_bytes = pxh.nbytes + pyh.nbytes + pmh.nbytes
+            px, py, pm = (jnp.asarray(pxh), jnp.asarray(pyh),
+                          jnp.asarray(pmh))
+            hit_bytes = hit_rows = 0
+        wire_bytes += g2_bytes
+        args = (jnp.asarray(hx), jnp.asarray(hy), jnp.asarray(sx),
+                jnp.asarray(sy), jnp.asarray(sm), px, py, pm,
+                jnp.asarray(hok))
+        wire = {"wire_bytes": int(wire_bytes),
+                "g2_wire_bytes": int(g2_bytes),
+                "pk_hit_bytes": int(hit_bytes),
+                "pk_rows": int(st["pk_rows"]),
+                "pk_hit_rows": int(hit_rows),
+                "resident": st["resident"], "wire": self._wire}
+        return args, wire
 
     # populated by bls_verify_committees under GETHSHARDING_SIG_TIMING=1:
     # host marshalling vs tunnel transfer vs device dispatch of the LAST
-    # audit call — the split that decides which side of the dispatch
-    # boundary the next optimization belongs to
+    # audit call (+ the wire ledger) — the split that decides which side
+    # of the dispatch boundary the next optimization belongs to
     last_timing: dict | None = None
+
+    # populated by EVERY committee dispatch (no sync, pure nbytes
+    # arithmetic): {wire_bytes, g2_wire_bytes, pk_hit_bytes, pk_rows,
+    # pk_hit_rows, resident, wire} — the transfer-attribution ledger
+    # bench.py records per config and the residency tests assert on
+    # (steady state: g2_wire_bytes == 0)
+    last_wire: dict | None = None
 
     # -- pubkey-row limb cache ---------------------------------------------
     # Committee PUBKEYS recur period after period (registered keys are
@@ -412,6 +604,7 @@ class JaxSigBackend(SigBackend):
         ys = np.zeros((B, width, 2, nl), dtype)
         mask = np.zeros((B, width), bool)
         misses = []  # (b, key, row) — bulk-converted in ONE pass below
+        hits = 0
         for b, row in enumerate(rows):
             if len(row) > width:
                 raise ValueError(
@@ -427,14 +620,19 @@ class JaxSigBackend(SigBackend):
             if entry is None:
                 misses.append((b, key, row))
                 continue
+            hits += 1
             k = entry[0].shape[0]
             xs[b, :k], ys[b, :k], mask[b, :k] = entry
+        self._m_row_hit.inc(hits)
+        self._m_row_miss.inc(sum(1 for _, key, _ in misses
+                                 if key is not None))
         if misses:
             # one bulk bit-plane conversion for every miss row (a cold
-            # audit would otherwise pay the fixed numpy overhead per row)
+            # audit would otherwise pay the fixed numpy overhead per
+            # row), emitted straight into the wire dtype
             miss_w = max(len(row) for _, _, row in misses)
             mx, my, mm = self._bn.g2_committee_to_limbs(
-                [row for _, _, row in misses], miss_w)
+                [row for _, _, row in misses], miss_w, out_dtype=dtype)
             for i, (b, key, row) in enumerate(misses):
                 k = len(row)
                 xs[b, :k] = mx[i, :k]
@@ -447,11 +645,183 @@ class JaxSigBackend(SigBackend):
                             cache.pop(next(iter(cache)))
                         # copies, not views: a view would pin the whole
                         # bulk conversion array per cached row (astype
-                        # copies; it also narrows under the u16 wire)
+                        # copies even at the same dtype)
                         cache[key] = (mx[i, :k].astype(dtype),
                                       my[i, :k].astype(dtype),
                                       mm[i, :k].copy())
         return xs, ys, mask
+
+    # -- device-resident pk planes (GETHSHARDING_TPU_RESIDENT) -------------
+    # The host row cache above removes the limb CONVERSION from a warm
+    # audit; the device cache removes the TRANSFER — the G2 pubkey
+    # planes (~8.4 MB/dispatch as int32 at the bench shape, the largest
+    # buffers) stay resident across periods, the same pattern as
+    # device-resident weights/KV state in a serving stack. Entries are
+    # per-row device buffers keyed by (pk_row_key, width, wire) under a
+    # memory-accounted LRU; a one-entry batch memo short-circuits the
+    # steady state (identical key tuple every period) to ZERO device
+    # ops and ZERO G2 wire bytes.
+
+    def _pk_resident_resolve(self, st: dict, rows, keys) -> None:
+        """Host half of the resident path: claim device-cache hits,
+        bulk-marshal miss rows (through the host row cache). A pointful
+        row without a key is uncacheable — transferred every dispatch;
+        an empty row maps to the shared on-device zero planes."""
+        width, wire = st["width"], self._wire
+        # the batch memo is only sound when every pointful row is keyed
+        # (a keyless row's contents are not determined by the key tuple)
+        if all(k is not None or not row for row, k in zip(rows, keys)):
+            batch_key = (tuple(keys), st["bucket"], width, wire)
+        else:
+            batch_key = None
+        st["batch_key"] = batch_key
+        with self._pk_dev_lock:
+            memo = self._pk_batch_memo
+        if batch_key is not None and memo is not None \
+                and memo[0] == batch_key:
+            st["memo_planes"] = memo[1]
+            st["hit_rows"] = st["pk_rows"]
+            st["hit_bytes"] = memo[2]
+            st["miss_planes"] = None
+            self._m_dev_hit.inc(st["pk_rows"])
+            return
+        st["memo_planes"] = None
+        plan = []  # per row: ("zero",) | ("hit", entry) | ("miss", j)
+        misses = []  # (row, key)
+        hit_rows = hit_bytes = 0
+        with self._pk_dev_lock:
+            cache = self._pk_dev_cache
+            for row, key in zip(rows, keys):
+                if not row:
+                    plan.append(("zero",))
+                    continue
+                entry = None
+                if key is not None:
+                    entry = cache.get((key, width, wire))
+                    if entry is not None:
+                        cache.move_to_end((key, width, wire))
+                if entry is not None:
+                    plan.append(("hit", entry))
+                    hit_rows += 1
+                    hit_bytes += entry[3]
+                else:
+                    plan.append(("miss", len(misses)))
+                    misses.append((row, key))
+        self._m_dev_hit.inc(hit_rows)
+        self._m_dev_miss.inc(len(misses))
+        st["plan"] = plan
+        st["hit_rows"], st["hit_bytes"] = hit_rows, hit_bytes
+        if misses:
+            # bulk conversion at the dispatch width, through the HOST
+            # row cache: a device-evicted row re-transfers but does not
+            # re-pay the bit-plane conversion
+            mx, my, mm = self._pk_rows_to_limbs(
+                [row for row, _ in misses], width,
+                row_keys=[key for _, key in misses])
+            st["miss_planes"] = (mx, my, mm)
+            st["miss_keys"] = [key for _, key in misses]
+        else:
+            st["miss_planes"] = None
+
+    def _pk_resident_planes(self, st: dict):
+        """Device half: ship miss rows, stack hits + misses + zeros into
+        the (B, width, 2, nl) kernel planes. Returns (px, py, pm,
+        transferred_g2_bytes)."""
+        jnp = self._jnp
+        if st["memo_planes"] is not None:
+            px, py, pm = st["memo_planes"]
+            return px, py, pm, 0
+        import numpy as np
+
+        miss_dev = []
+        g2_bytes = 0
+        if st["miss_planes"] is not None:
+            mx, my, mm = st["miss_planes"]
+            if st["check"] and self._wire_u16 and mx.size:
+                # the u16 invariant, pinned once per row AT SHIP TIME
+                # (hit rows were checked when first transferred)
+                assert (int(mx.min()) >= 0 and int(mx.max()) < (1 << 12)
+                        and int(my.min()) >= 0
+                        and int(my.max()) < (1 << 12)), (
+                    "u16 wire requires canonical limbs in [0, 2^12)")
+            # ONE bulk transfer for ALL miss rows (the planes are already
+            # contiguous); the cache entries are per-row device slices —
+            # device-side ops, not M separate host->device round trips
+            dmx, dmy, dmm = (jnp.asarray(mx), jnp.asarray(my),
+                             jnp.asarray(mm))
+            g2_bytes = mx.nbytes + my.nbytes + mm.nbytes
+            for j, key in enumerate(st["miss_keys"]):
+                nbytes = mx[j].nbytes + my[j].nbytes + mm[j].nbytes
+                entry = (dmx[j], dmy[j], dmm[j], nbytes)
+                if key is not None:
+                    self._pk_dev_insert(
+                        (key, st["width"], self._wire), entry)
+                miss_dev.append(entry)
+        zx, zy, zm = self._zero_pk_row(st["width"])
+        xs, ys, ms = [], [], []
+        for step in st["plan"]:
+            if step[0] == "zero":
+                entry = (zx, zy, zm)
+            elif step[0] == "hit":
+                entry = step[1]
+            else:
+                entry = miss_dev[step[1]]
+            xs.append(entry[0])
+            ys.append(entry[1])
+            ms.append(entry[2])
+        # device-side assembly: concatenation of resident buffers, no
+        # host bytes on the link
+        px, py, pm = jnp.stack(xs), jnp.stack(ys), jnp.stack(ms)
+        if st["batch_key"] is not None:
+            # memoize the assembled batch; its hit ledger is what THIS
+            # assembly would have cost over the wire
+            self._set_batch_memo(st["batch_key"], (px, py, pm),
+                                 st["hit_bytes"] + g2_bytes)
+        return px, py, pm, g2_bytes
+
+    def _pk_dev_insert(self, key, entry) -> None:
+        """LRU insert with byte-accounted eviction (gauge + counter)."""
+        with self._pk_dev_lock:
+            cache = self._pk_dev_cache
+            if key in cache:
+                cache.move_to_end(key)
+                return
+            cache[key] = entry
+            self._pk_dev_bytes += entry[3]
+            while self._pk_dev_bytes > self._resident_budget and cache:
+                _, old = cache.popitem(last=False)
+                self._pk_dev_bytes -= old[3]
+                self._m_dev_evict.inc()
+            self._g_dev_bytes.set(
+                self._pk_dev_bytes + self._pk_batch_memo_nbytes)
+
+    _pk_batch_memo_nbytes = 0
+
+    def _set_batch_memo(self, key, planes, hit_bytes) -> None:
+        px, py, pm = planes
+        with self._pk_dev_lock:
+            self._pk_batch_memo = (key, planes, hit_bytes)
+            self._pk_batch_memo_nbytes = px.nbytes + py.nbytes + pm.nbytes
+            self._g_dev_bytes.set(
+                self._pk_dev_bytes + self._pk_batch_memo_nbytes)
+
+    def _zero_pk_row(self, width: int):
+        """Shared on-device zero planes for empty/padded rows (mask all
+        False -> the kernel rejects the row, scalar parity) — created
+        once per (width, wire), never transferred per dispatch."""
+        import numpy as np
+
+        key = (width, self._wire)
+        row = self._pk_zero_rows.get(key)
+        if row is None:
+            jnp = self._jnp
+            nl = int(np.asarray(self._bn.FP.one).shape[-1])
+            dtype = np.uint16 if self._wire_u16 else np.int32
+            row = (jnp.zeros((width, 2, nl), dtype),
+                   jnp.zeros((width, 2, nl), dtype),
+                   jnp.zeros((width,), bool))
+            self._pk_zero_rows[key] = row
+        return row
 
 
 def _serving_factory(inner_name: str):

@@ -30,6 +30,7 @@ from gethsharding_tpu.tracing.tracer import (
     enable,
     request_context,
     span,
+    tag_current_add,
 )
 
 __all__ = [
@@ -42,5 +43,6 @@ __all__ = [
     "enable",
     "request_context",
     "span",
+    "tag_current_add",
     "write_chrome_trace",
 ]

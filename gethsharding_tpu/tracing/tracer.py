@@ -259,6 +259,24 @@ def span(name: str, **tags):
     return TRACER.start(name, tags or None)
 
 
+def tag_current_add(**tags) -> None:
+    """SUM numeric tags into the context's innermost ACTIVE span (no-op
+    when tracing is off or no span is open) — lets a callee annotate
+    its caller's span without threading span objects through the API.
+    The sig backend stamps per-dispatch wire bytes and device-cache hit
+    bytes onto the notary's enclosing ``notary/audit`` span this way;
+    accumulation (not last-writer-wins) makes a span covering several
+    dispatches (a K-period overlapped audit) report TOTALS."""
+    if not TRACER.enabled:
+        return
+    stack = _SPAN_STACK.get()
+    if not stack:
+        return
+    span_tags = stack[-1].tags
+    for key, value in tags.items():
+        span_tags[key] = span_tags.get(key, 0) + value
+
+
 def request_context() -> Optional[Tuple[int, int]]:
     """The serving hot path's ONE producer-side guard: the caller's
     (trace_id, span_id) to stitch a cross-thread request to, or None.

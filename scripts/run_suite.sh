@@ -45,6 +45,36 @@ fi
 kill "$obs_pid" 2>/dev/null
 wait "$obs_pid" 2>/dev/null
 
+# -- resident/overlap parity smoke: the device-resident pk cache and the
+# async committee path, exercised end-to-end on hermetic CPU at a small
+# shape — warm dispatch must ship zero G2 bytes, async == sync == scalar
+echo "== resident/overlap smoke"
+# pin the knob under test: an ambient GETHSHARDING_TPU_RESIDENT=0 A/B
+# setting must not fail the suite's zero-G2 assertion
+JAX_PLATFORMS=cpu GETHSHARDING_TPU_RESIDENT=1 python - <<'PYEOF' || fail=1
+from gethsharding_tpu.crypto import bn256 as bls
+from gethsharding_tpu.sigbackend import get_backend
+
+py, jx = get_backend("python"), get_backend("jax")
+msgs, sig_rows, pk_rows, keys = [], [], [], []
+for i in range(3):
+    tag = b"suite-%d" % i
+    ks = [bls.bls_keygen(tag + bytes([j])) for j in range(2)]
+    sigs = [bls.bls_sign(tag, sk) for sk, _ in ks]
+    if i == 1:
+        sigs[0] = bls.bls_sign(b"tampered", ks[0][0])
+    msgs.append(tag); sig_rows.append(sigs)
+    pk_rows.append([pk for _, pk in ks]); keys.append(("suite", i))
+want = py.bls_verify_committees(msgs, sig_rows, pk_rows)
+assert jx.bls_verify_committees(
+    msgs, sig_rows, pk_rows, pk_row_keys=keys) == want
+fut = jx.bls_verify_committees_async(
+    msgs, sig_rows, pk_rows, pk_row_keys=keys)
+assert fut.result() == want
+assert jx.last_wire["g2_wire_bytes"] == 0, jx.last_wire  # warm = resident
+print("resident/overlap smoke OK:", jx.last_wire)
+PYEOF
+
 for f in tests/test_*.py; do
     echo "== $f"
     python -m pytest "$f" -q --no-header || fail=1

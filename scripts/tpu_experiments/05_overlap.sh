@@ -1,0 +1,14 @@
+#!/bin/bash
+# Marshal/dispatch overlap on the real chip: sequential vs overlapped
+# K=4 period audit pipeline under the champion knobs. The overlapped
+# form marshals+stages period N+1 while N executes on device; the
+# ratio bounds how much host marshal + tunnel RTT the dispatch hides.
+# The 4-period signature workload loads from .bench_workload.npz when
+# the 03e/kperiod pre-builder has run (~12 min host build otherwise —
+# hence the long timeout; repeats are cheap).
+cd /root/repo || exit 1
+env GETHSHARDING_TPU_LIMB_FORM=exact GETHSHARDING_TPU_CARRY=scan \
+    GETHSHARDING_TPU_FINALEXP=mega GETHSHARDING_TPU_MILLER=mega \
+    GETHSHARDING_BENCH_OVERLAP_K=4 \
+  timeout 6900 python bench.py --overlap >"$1.out" 2>"$1.err"
+grep -q overlap_ratio "$1.out" && grep -q '"platform": "tpu' "$1.out"

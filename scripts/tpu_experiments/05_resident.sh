@@ -1,0 +1,14 @@
+#!/bin/bash
+# Device-resident pk planes on the real chip: cold-vs-warm wire ledger
+# of the audit dispatch under the champion knobs. The warm dispatch
+# must ship ZERO G2 pubkey bytes (bench asserts it); the cold/warm
+# wall delta bounds the transfer share of the 0.297 s dispatch — the
+# number that closes probe 42's "transfer dominates" branch. u16 wire
+# stacked on top so the fresh-per-period buffers ship narrow too.
+cd /root/repo || exit 1
+env GETHSHARDING_TPU_LIMB_FORM=exact GETHSHARDING_TPU_CARRY=scan \
+    GETHSHARDING_TPU_FINALEXP=mega GETHSHARDING_TPU_MILLER=mega \
+    GETHSHARDING_TPU_WIRE=u16 GETHSHARDING_TPU_RESIDENT=1 \
+  timeout 4800 python bench.py --resident >"$1.out" 2>"$1.err"
+grep -q '"g2_wire_bytes_warm": 0' "$1.out" \
+  && grep -q '"platform": "tpu' "$1.out"
