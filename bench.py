@@ -791,6 +791,105 @@ def measure_serving() -> dict:
     }
 
 
+def measure_chaos() -> dict:
+    """Failover availability under a seeded chaos schedule: N ecrecover
+    calls through `FailoverSigBackend` while the primary backend is hit
+    by deterministic injected faults. The metric is the fraction of
+    calls answered CORRECTLY (fallback-covered faults included) — the
+    paper's always-vote contract, measured. Also reports the breaker's
+    full cycle (trips, probes, re-close) under the schedule. Hermetic
+    by default (python primary); GETHSHARDING_BENCH_CHAOS_BACKEND=jax
+    runs the real device path on an accelerator (the 06_failover
+    probe)."""
+    from gethsharding_tpu.crypto import secp256k1 as ecdsa
+    from gethsharding_tpu.crypto.keccak import keccak256
+    from gethsharding_tpu.metrics import Registry
+    from gethsharding_tpu.resilience.breaker import (
+        CLOSED, CircuitBreaker, FailoverSigBackend)
+    from gethsharding_tpu.resilience.chaos import (ChaosSchedule,
+                                                   ChaosSigBackend)
+    from gethsharding_tpu.sigbackend import PythonSigBackend, get_backend
+
+    seed = int(os.environ.get("GETHSHARDING_CHAOS_SEED", "42"))
+    rate = float(os.environ.get("GETHSHARDING_CHAOS_RATE", "0.3"))
+    calls = int(os.environ.get("GETHSHARDING_BENCH_CHAOS_CALLS", "60"))
+    rows = int(os.environ.get("GETHSHARDING_BENCH_CHAOS_ROWS", "8"))
+    primary_name = os.environ.get("GETHSHARDING_BENCH_CHAOS_BACKEND",
+                                  "python")
+    import random
+
+    # faults only for the first 2/3 of the run: the tail is the recovery
+    # window where the breaker must probe its way back to closed
+    fault_calls = (calls * 2) // 3
+
+    def fault_rule(idx: int) -> bool:
+        return (idx < fault_calls
+                and random.Random(f"{seed}:bench:{idx}").random() < rate)
+
+    schedule = ChaosSchedule(
+        seed=seed, rules={"backend.ecrecover_addresses": fault_rule})
+    registry = Registry()
+    breaker = CircuitBreaker(name="bench", fault_threshold=2,
+                             reset_s=0.002, registry=registry)
+    backend = FailoverSigBackend(
+        ChaosSigBackend(get_backend(primary_name), schedule),
+        PythonSigBackend(), breaker=breaker, registry=registry)
+
+    batches = []
+    for b in range(calls):
+        digests, sigs, wants = [], [], []
+        for r in range(rows):
+            priv = int.from_bytes(
+                keccak256(b"chaos-%d-%d" % (b, r)), "big") % ecdsa.N
+            digest = keccak256(b"chaos-msg-%d-%d" % (b, r))
+            digests.append(digest)
+            sigs.append(ecdsa.sign(digest, priv).to_bytes65())
+            wants.append(ecdsa.priv_to_address(priv))
+        batches.append((digests, sigs, wants))
+
+    correct = answered = 0
+    t0 = time.perf_counter()
+    for digests, sigs, wants in batches:
+        try:
+            got = backend.ecrecover_addresses(digests, sigs)
+            answered += 1
+            correct += int(got == wants)
+        except Exception:  # noqa: BLE001 - an escape IS the finding
+            pass
+        time.sleep(0.004)  # let open-state cooldowns elapse
+    wall_s = time.perf_counter() - t0
+
+    def count(metric: str) -> int:
+        return registry.counter(f"resilience/breaker/bench/{metric}").value
+
+    return {
+        "primary": primary_name,
+        "seed": seed,
+        "rate": rate,
+        "calls": calls,
+        "rows": rows,
+        "chaos_availability": round(correct / calls, 4),
+        "answered": answered,
+        "injected_faults": schedule.injected.get(
+            "backend.ecrecover_addresses", 0),
+        "breaker_trips": count("trips"),
+        "breaker_probes": count("probes"),
+        "breaker_closes": count("closes"),
+        "fallback_calls": count("fallback_calls"),
+        "breaker_reclosed": breaker.state == CLOSED,
+        "wall_s": round(wall_s, 3),
+        "platform": _chaos_platform(primary_name),
+    }
+
+
+def _chaos_platform(primary_name: str) -> str:
+    if "jax" not in primary_name:
+        return "host"
+    import jax
+
+    return jax.devices()[0].platform
+
+
 # == autotune orchestration ================================================
 
 
@@ -1078,6 +1177,26 @@ def main() -> None:
             "vs_baseline": stats["overlap_ratio"],
             "extra": {k: v for k, v in stats.items()
                       if k != "overlap_ratio"},
+        }))
+        return
+
+    if "--chaos" in sys.argv:
+        # failover availability under a seeded chaos schedule: the
+        # value is the fraction of calls answered correctly while the
+        # primary faults; extras carry the breaker's full open ->
+        # half-open-probe -> closed cycle counters
+        stats = measure_chaos()
+        print(json.dumps({
+            "metric": "chaos_availability",
+            "value": stats["chaos_availability"],
+            "unit": (f"fraction of {stats['calls']} calls answered "
+                     f"correctly under seeded chaos (rate "
+                     f"{stats['rate']}, {stats['injected_faults']} "
+                     f"injected faults, {stats['primary']} primary, "
+                     f"{stats['platform']})"),
+            "vs_baseline": stats["chaos_availability"],
+            "extra": {k: v for k, v in stats.items()
+                      if k != "chaos_availability"},
         }))
         return
 

@@ -30,8 +30,15 @@ from gethsharding_tpu.mainchain.client import SMCClient
 from gethsharding_tpu.p2p.messages import CollationBodyRequest
 from gethsharding_tpu.p2p.service import P2PServer
 from gethsharding_tpu.params import Config, DEFAULT_CONFIG
+from gethsharding_tpu.resilience.errors import FetchAborted, TransientError
+from gethsharding_tpu.resilience.policy import (POLL_MISS, RetryExecutor,
+                                                RetryPolicy, poll_probe)
 from gethsharding_tpu.sigbackend import SigBackend, get_backend
 from gethsharding_tpu.smc.state_machine import SMCRevert, vote_digest
+
+
+class _BodyUnavailable(TransientError):
+    """A collation body did not arrive within one fetch attempt."""
 
 
 class Notary(Service):
@@ -44,11 +51,18 @@ class Notary(Service):
                  deposit_flag: bool = False,
                  all_shards: bool = True,
                  sig_backend: Optional[SigBackend] = None,
-                 mirror=None):
+                 mirror=None,
+                 journal=None):
         super().__init__()
         self.client = client
         self.shard = shard
         self.p2p = p2p
+        # crash-safe vote journal (resilience/journal.VoteJournal): a
+        # restarted notary recovers its submitted (shard, period) votes
+        # and the audit high-water mark on on_start, so it neither
+        # double-votes nor re-audits finished periods. None = process
+        # memory only (the pre-resilience behavior).
+        self.journal = journal
         # eth/downloader analog (mainchain/mirror.StateMirror): when set,
         # the per-head phase-1 scan reads records/watermarks/committee
         # context from ONE bulk snapshot pull instead of O(shards) client
@@ -77,10 +91,49 @@ class Notary(Service):
         self.m_votes = metrics.counter("notary/votes_submitted")
         self.m_audit_mismatch = metrics.counter("notary/audit_mismatches")
         self.m_windback_checks = metrics.counter("notary/windback_checks")
+        # body-fetch retry seam (resilience/policy): each attempt
+        # re-broadcasts the shardp2p request and polls briefly — a lost
+        # request frame costs one backoff, not the whole availability
+        # verdict
+        self._body_retry = RetryExecutor(
+            "collation_body",
+            RetryPolicy(attempts=3, base_s=0.05, cap_s=0.2,
+                        retryable=(_BodyUnavailable,)))
 
     # -- lifecycle ---------------------------------------------------------
 
     def on_start(self) -> None:
+        if self.journal is not None:
+            # a journal AHEAD of the chain belongs to a previous chain
+            # lifetime (wiped devnet, fresh simulated chain under an
+            # old datadir): replaying it would mute the notary until
+            # the new chain catches up to the stale watermark. An
+            # unreachable chain keeps the journal — surviving exactly
+            # that outage is what the journal is for.
+            try:
+                current = self.client.current_period()
+            except Exception:  # noqa: BLE001 - chain down at boot
+                current = None
+            if current is not None \
+                    and self.journal.invalidate_if_reset(current):
+                self.log.warning(
+                    "vote journal was ahead of the chain (period %d): "
+                    "chain reset assumed, journal cleared", current)
+            # recovery replay: a restart must not re-audit periods the
+            # crashed instance already finished (the vote-side replay is
+            # per (shard, period) in submit_vote). The journal records
+            # the audited period itself (None = never audited);
+            # `_last_audited_period = N` means "period N-1 audited",
+            # hence the +1.
+            high_water = self.journal.audit_high_water()
+            if high_water is not None \
+                    and high_water + 1 > self._last_audited_period:
+                self._last_audited_period = high_water + 1
+            recovered = sum(1 for _ in self.journal.votes())
+            if recovered or high_water is not None:
+                self.log.info(
+                    "vote journal recovered: %d submitted votes, audit "
+                    "high-water period %s", recovered, high_water)
         if self.deposit_flag:
             try:
                 self.join_notary_pool()
@@ -345,7 +398,18 @@ class Notary(Service):
                 f"size {self.config.committee_size}"
             )
             return False
+        # the crash-safe journal gate FIRST: it answers "did this
+        # process lineage already submit (shard, period)?" locally, so
+        # a restarted notary cannot double-vote even while its view of
+        # the chain (or the chain connection itself) is catching up
+        if self.journal is not None and self.journal.has_vote(shard_id,
+                                                              period):
+            return False
         if self.client.has_voted(shard_id, registry.pool_index):
+            if self.journal is not None:
+                # the chain knows but the journal missed it (vote landed
+                # in the crash window): sync so the NEXT check is local
+                self.journal.record_vote(shard_id, period)
             return False
 
         # proposer-signature check through the sig backend (the reference's
@@ -391,6 +455,10 @@ class Notary(Service):
         except SMCRevert as exc:
             self.record_error(f"vote reverted: {exc}")
             return False
+        if self.journal is not None:
+            # journal AFTER the chain accepted: the journal answers
+            # "already submitted?", the chain stays authoritative
+            self.journal.record_vote(shard_id, period)
         self.votes_submitted += 1
         self.m_votes.inc()
 
@@ -640,8 +708,11 @@ class Notary(Service):
 
         # the replay check runs the jax batch kernel; skip it for pure-host
         # control planes (sigbackend 'python') to keep them accelerator-free.
-        # A serving wrapper keeps the wrapped backend's nature: unwrap it.
-        base = getattr(self.sig_backend, "inner", self.sig_backend)
+        # Wrappers (serving tier, failover breaker, chaos injection) keep
+        # the wrapped backend's nature: unwrap the whole chain.
+        base = self.sig_backend
+        while hasattr(base, "inner"):
+            base = base.inner
         replay = (self.client.verify_period_batch(period)
                   if base.name == "jax" else None)
         if replay is False:
@@ -650,6 +721,14 @@ class Notary(Service):
             self.record_error(
                 f"period {period} batch-replay mismatch: "
                 f"submit_votes_batch disagrees with the scalar SMC")
+        if self.journal is not None:
+            # this period's audit is DONE (mismatches are reported, not
+            # retried): persist the watermark so a restart skips it —
+            # and prune vote entries for closed periods (a vote can
+            # only target the CURRENT period, so anything older than
+            # the audited one can never be resubmitted)
+            self.journal.set_audit_high_water(period)
+            self.journal.prune_votes(before_period=period)
         return consistent
 
     def verify_proposer_signatures(self, records) -> list:
@@ -756,17 +835,29 @@ class Notary(Service):
         header, verdict = self._availability_probe(shard_id, period, record)
         if verdict is not None:
             return verdict
-        # body not local: the probe broadcast the request; poll briefly —
-        # the responding syncer stores the body asynchronously
-        if self.p2p is not None:
-            for _ in range(20):
-                if self.wait(0.05):
-                    return False
-                try:
-                    return self.shard.check_availability(header)
-                except ShardError:
-                    continue
-        return False
+        if self.p2p is None:
+            return False
+
+        # body not local: poll briefly for the responding syncer's
+        # asynchronous store, under the body-fetch retry policy — every
+        # retry RE-BROADCASTS the request (via the probe), so one lost
+        # frame or one slow peer costs a capped backoff, not the vote
+        def attempt() -> bool:
+            got = poll_probe(
+                lambda: self.shard.check_availability(header), self.wait,
+                interval_s=0.05, polls=7, not_ready=(ShardError,))
+            if got is not POLL_MISS:
+                return got
+            _, late = self._availability_probe(shard_id, period, record)
+            if late is not None:
+                return late
+            raise _BodyUnavailable(
+                f"shard {shard_id} period {period} body not delivered")
+
+        try:
+            return self._body_retry.call(attempt)
+        except (_BodyUnavailable, FetchAborted):
+            return False
 
     def _reconstruct_header(self, shard_id: int, period: int,
                             record) -> CollationHeader:

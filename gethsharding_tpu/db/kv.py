@@ -35,6 +35,15 @@ class KVStore:
     def items(self) -> Iterator[Tuple[bytes, bytes]]:
         raise NotImplementedError
 
+    def keys(self, prefix: bytes = b"") -> Iterator[bytes]:
+        """Keys under `prefix`, WITHOUT materializing values — the
+        cheap scan for small namespaces (e.g. the vote journal) living
+        inside a store whose values can be large (chunk blobs).
+        Engines override with an index-only query where they can."""
+        prefix = bytes(prefix)
+        return iter([key for key, _ in self.items()
+                     if key.startswith(prefix)])
+
 
 class MemoryKV(KVStore):
     """Thread-safe in-memory map (parity: ShardKV, ethdb.MemDatabase)."""
@@ -58,6 +67,12 @@ class MemoryKV(KVStore):
     def items(self):
         with self._lock:
             return iter(list(self._data.items()))
+
+    def keys(self, prefix: bytes = b""):
+        prefix = bytes(prefix)
+        with self._lock:
+            return iter([key for key in self._data
+                         if key.startswith(prefix)])
 
     def __len__(self) -> int:
         with self._lock:
@@ -107,6 +122,33 @@ class SqliteKV(KVStore):
         with self._lock:
             rows = self._conn.execute("SELECT k, v FROM kv ORDER BY k").fetchall()
         return iter([(bytes(k), bytes(v)) for k, v in rows])
+
+    def keys(self, prefix: bytes = b""):
+        # index-only range scan on the primary key: no value pages are
+        # touched, so scanning a small namespace stays cheap even when
+        # the store also holds large blobs
+        prefix = bytes(prefix)
+        with self._lock:
+            if not prefix:
+                rows = self._conn.execute(
+                    "SELECT k FROM kv ORDER BY k").fetchall()
+            else:
+                # upper bound = prefix with its last byte incremented
+                # (carrying over 0xff bytes); a prefix of all 0xff has
+                # no upper bound
+                upper = bytearray(prefix)
+                while upper and upper[-1] == 0xFF:
+                    upper.pop()
+                if upper:
+                    upper[-1] += 1
+                    rows = self._conn.execute(
+                        "SELECT k FROM kv WHERE k >= ? AND k < ? "
+                        "ORDER BY k", (prefix, bytes(upper))).fetchall()
+                else:
+                    rows = self._conn.execute(
+                        "SELECT k FROM kv WHERE k >= ? ORDER BY k",
+                        (prefix,)).fetchall()
+        return iter([bytes(k) for (k,) in rows])
 
     def close(self) -> None:
         with self._lock:

@@ -6,17 +6,49 @@ Sign :245, CreateTXOpts :112, WaitForTransaction :165) and `utils.go`
 in-process SimulatedMainchain (no IPC hop), and transactions apply
 synchronously, so `wait_for_transaction` resolves immediately — the
 polling contract is kept for the RPC backend.
+
+Resilience (gethsharding_tpu/resilience):
+
+- a real Stop: `stop()` marks the client stopped so in-flight
+  `wait_for_transaction` polls exit promptly and every later call
+  raises a clear `ClientStopped` instead of spinning against a dead
+  backend;
+- an optional `retry_policy` routes every idempotent backend READ
+  through a `RetryExecutor` (seam ``mainchain``): transient connection
+  errors against a flaky RPC chain process are absorbed with capped
+  backoff and counted. Writes (votes, headers, registry transactions)
+  are deliberately NOT retried — a connection error mid-write is
+  ambiguous, and replaying it could double-submit. Env default:
+  ``GETHSHARDING_CLIENT_RETRIES`` (attempts, 0 = off) +
+  ``GETHSHARDING_CLIENT_RETRY_BASE_S``.
 """
 
 from __future__ import annotations
 
+import os
+import threading
 import time
 from typing import Optional
 
 from gethsharding_tpu.mainchain.accounts import Account, AccountManager
 from gethsharding_tpu.params import Config, DEFAULT_CONFIG
+from gethsharding_tpu.resilience.policy import RetryExecutor, RetryPolicy
 from gethsharding_tpu.smc.chain import Receipt, SimulatedMainchain
 from gethsharding_tpu.utils.hexbytes import Address20, Hash32
+
+
+class ClientStopped(RuntimeError):
+    """The SMCClient was stopped; this call can never complete."""
+
+
+def _default_retry_policy() -> Optional[RetryPolicy]:
+    attempts = int(os.environ.get("GETHSHARDING_CLIENT_RETRIES", "0"))
+    if attempts <= 0:
+        return None
+    return RetryPolicy(
+        attempts=attempts,
+        base_s=float(os.environ.get(
+            "GETHSHARDING_CLIENT_RETRY_BASE_S", "0.02")))
 
 
 class SMCClient:
@@ -31,7 +63,8 @@ class SMCClient:
                  accounts: Optional[AccountManager] = None,
                  account: Optional[Account] = None,
                  deposit_flag: bool = False,
-                 config: Config = DEFAULT_CONFIG):
+                 config: Config = DEFAULT_CONFIG,
+                 retry_policy: Optional[RetryPolicy] = None):
         self.backend = backend if backend is not None else SimulatedMainchain(config)
         self.accounts = accounts or AccountManager()
         # a FRESH identity per client unless one is supplied (keystore or
@@ -40,15 +73,49 @@ class SMCClient:
         self._account = account or self.accounts.new_account()
         self.deposit_flag = deposit_flag
         self.config = config
+        self._stop = threading.Event()
+        if retry_policy is None:
+            retry_policy = _default_retry_policy()
+        # the stop event doubles as the backoff sleeper: stop() wakes
+        # an in-flight retry ladder mid-backoff, and the abort hook
+        # turns it into ClientStopped instead of one more attempt
+        # against a backend that is going away
+        self._retry = (RetryExecutor("mainchain", retry_policy,
+                                     sleep=self._stop.wait,
+                                     abort=self._retry_abort)
+                       if retry_policy is not None else None)
 
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> None:
         # parity with SMCClient.Start: dial backend, unlock account, bind SMC
+        self._stop.clear()
         self.accounts.unlock(self._account.address)
 
     def stop(self) -> None:
-        pass
+        """Mark the client stopped: in-flight `wait_for_transaction`
+        polls exit promptly and later calls raise `ClientStopped`."""
+        self._stop.set()
+
+    @property
+    def stopped(self) -> bool:
+        return self._stop.is_set()
+
+    def _ensure_running(self) -> None:
+        if self._stop.is_set():
+            raise ClientStopped("SMCClient is stopped")
+
+    def _retry_abort(self) -> Optional[ClientStopped]:
+        if self._stop.is_set():
+            return ClientStopped("SMCClient is stopped")
+        return None
+
+    def _read(self, fn, *args, **kwargs):
+        """One idempotent backend read: stop gate + retry executor."""
+        self._ensure_running()
+        if self._retry is None:
+            return fn(*args, **kwargs)
+        return self._retry.call(fn, *args, **kwargs)
 
     # -- Signer ------------------------------------------------------------
 
@@ -56,67 +123,73 @@ class SMCClient:
         return self._account.address
 
     def sign(self, digest: bytes) -> bytes:
+        self._ensure_running()
         return self.accounts.sign_hash(self._account.address, digest)
 
     def bls_sign(self, message: bytes):
         """Sign a vote message with the account's BLS vote key."""
+        self._ensure_running()
         return self.accounts.bls_sign(self._account.address, message)
 
     # -- ChainReader -------------------------------------------------------
 
     def subscribe_new_head(self, callback):
+        self._ensure_running()
         return self.backend.subscribe_new_head(callback)
 
     def block_by_number(self, number: Optional[int] = None):
-        return self.backend.block_by_number(number)
+        return self._read(self.backend.block_by_number, number)
 
     @property
     def block_number(self) -> int:
-        return self.backend.block_number
+        return self._read(lambda: self.backend.block_number)
 
     def current_period(self) -> int:
-        return self.backend.current_period()
+        return self._read(self.backend.current_period)
 
     # -- ContractCaller ----------------------------------------------------
 
     def get_notary_in_committee(self, shard_id: int,
                                 sender: Optional[Address20] = None) -> Address20:
-        return self.backend.get_notary_in_committee(
-            sender if sender is not None else self._account.address, shard_id
-        )
+        return self._read(
+            self.backend.get_notary_in_committee,
+            sender if sender is not None else self._account.address, shard_id)
 
     def committee_context(self) -> Optional[dict]:
         """One-call sampling context for local all-shard eligibility
         (None when the backend doesn't serve it)."""
         fn = getattr(self.backend, "committee_context", None)
-        return fn() if fn is not None else None
+        return self._read(fn) if fn is not None else None
 
     def notary_registry(self, address: Optional[Address20] = None):
-        return self.backend.notary_registry(
-            address if address is not None else self._account.address
-        )
+        return self._read(
+            self.backend.notary_registry,
+            address if address is not None else self._account.address)
 
     def collation_record(self, shard_id: int, period: int):
-        return self.backend.collation_record(shard_id, period)
+        return self._read(self.backend.collation_record, shard_id, period)
 
     def last_submitted_collation(self, shard_id: int) -> int:
-        return self.backend.last_submitted_collation(shard_id)
+        return self._read(self.backend.last_submitted_collation, shard_id)
 
     def last_approved_collation(self, shard_id: int) -> int:
-        return self.backend.last_approved_collation(shard_id)
+        return self._read(self.backend.last_approved_collation, shard_id)
 
     def has_voted(self, shard_id: int, index: int) -> bool:
-        return self.backend.has_voted(shard_id, index)
+        return self._read(self.backend.has_voted, shard_id, index)
 
     def get_vote_count(self, shard_id: int) -> int:
-        return self.backend.get_vote_count(shard_id)
+        return self._read(self.backend.get_vote_count, shard_id)
 
     def shard_count(self) -> int:
-        return self.backend.shard_count()
+        return self._read(self.backend.shard_count)
 
     # -- ContractTransactor ------------------------------------------------
+    # Writes get the stop gate but NO retry: replaying a write after an
+    # ambiguous connection error could double-submit it.
 
     def register_notary(self) -> Receipt:
+        self._ensure_running()
         # the vote pubkey + proof of possession register with the deposit;
         # validators batch-verify PoPs (rogue-key defense) in the audit
         return self.backend.register_notary(
@@ -127,32 +200,36 @@ class SMCClient:
         )
 
     def deregister_notary(self) -> Receipt:
+        self._ensure_running()
         return self.backend.deregister_notary(self._account.address)
 
     def release_notary(self) -> Receipt:
+        self._ensure_running()
         return self.backend.release_notary(self._account.address)
 
     def add_header(self, shard_id: int, period: int, chunk_root: Hash32,
                    signature: bytes = b"") -> Receipt:
+        self._ensure_running()
         return self.backend.add_header(self._account.address, shard_id,
                                        period, chunk_root, signature)
 
     def submit_vote(self, shard_id: int, period: int, index: int,
                     chunk_root: Hash32, bls_sig=None) -> Receipt:
+        self._ensure_running()
         return self.backend.submit_vote(self._account.address, shard_id,
                                         period, index, chunk_root,
                                         bls_sig=bls_sig)
 
     def notary_by_pool_index(self, index: int) -> Optional[Address20]:
-        return self.backend.notary_by_pool_index(index)
+        return self._read(self.backend.notary_by_pool_index, index)
 
     def notary_registry_of(self, address: Address20):
-        return self.backend.notary_registry(address)
+        return self._read(self.backend.notary_registry, address)
 
     def verify_period_batch(self, period: int) -> Optional[bool]:
         """Chain-side batched vote-replay audit (None if unsupported)."""
         fn = getattr(self.backend, "verify_period_batch", None)
-        return fn(period) if fn is not None else None
+        return self._read(fn, period) if fn is not None else None
 
     def mirror_snapshot(self) -> dict:
         """One consistent snapshot of the hot-loop SMC read surface —
@@ -160,7 +237,7 @@ class SMCClient:
         (the RPC chain process), assembled locally otherwise."""
         fn = getattr(self.backend, "mirror_snapshot", None)
         if fn is not None:
-            return fn()
+            return self._read(fn)
         from gethsharding_tpu.mainchain.mirror import assemble_snapshot
 
         return assemble_snapshot(self)
@@ -177,7 +254,7 @@ class SMCClient:
         in-process walk skips the hex wire codec (raw point tuples)."""
         fn = getattr(self.backend, "audit_data", None)
         if fn is not None:
-            return fn(period)
+            return self._read(fn, period)
         from gethsharding_tpu.mainchain.mirror import assemble_audit_data
 
         return assemble_audit_data(self, period, jsonable=False)
@@ -186,10 +263,17 @@ class SMCClient:
 
     def wait_for_transaction(self, tx_hash: Hash32,
                              timeout_s: float = 10.0) -> Receipt:
+        self._ensure_running()
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
-            receipt = self.backend.transaction_receipt(tx_hash)
+            receipt = self._read(self.backend.transaction_receipt, tx_hash)
             if receipt is not None:
                 return receipt
-            time.sleep(0.01)
+            # the stop event doubles as the poll sleep: a concurrent
+            # stop() wakes the wait immediately instead of letting the
+            # loop spin out its remaining timeout against a dead backend
+            if self._stop.wait(0.01):
+                raise ClientStopped(
+                    f"client stopped while waiting for transaction "
+                    f"{tx_hash.hex_str}")
         raise TimeoutError(f"transaction {tx_hash.hex_str} not mined in time")

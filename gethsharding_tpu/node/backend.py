@@ -11,6 +11,7 @@ reflection copy.
 
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional, Type, TypeVar
 
 from gethsharding_tpu.actors.base import Service
@@ -50,27 +51,54 @@ class ShardNode:
                  supervise_interval: float = 1.0,
                  http_port: Optional[int] = None,
                  serving: bool = False,
-                 serving_config=None):
+                 serving_config=None,
+                 chaos=None):
         if actor not in self.ACTORS:
             raise ValueError(f"unknown actor {actor!r}; pick from {self.ACTORS}")
         self.actor = actor
         self.shard_id = shard_id
         self.config = config
-        # --serving: one coalescing tier in front of the chosen backend,
-        # shared by every consumer on this node (notary audits, txpool
-        # sender recovery) — the whole point is one admission queue per
-        # device, so it is built ONCE here, not per service factory
+        # backend composition, innermost out (each layer optional):
+        #   device backend -> chaos injection -> serving tier -> failover
+        # The chaos wrapper sits where real device faults originate; the
+        # failover breaker sits OUTSIDE the serving tier so watchdog
+        # DeadlineExceeded failures surfacing from serving futures count
+        # as primary faults and trip it. One instance node-wide: one
+        # admission queue per device, one breaker per node.
         self._serving_backend = None
+        self._sig_backend_obj = None
+        failover = sig_backend.startswith("failover-")
+        inner_name = sig_backend[len("failover-"):] if failover \
+            else sig_backend
+        if serving and inner_name.startswith("serving-"):
+            raise ValueError("--serving already wraps the backend; use "
+                             "the bare backend name with --serving")
+        composed = None
+        if chaos is not None:
+            from gethsharding_tpu.resilience.chaos import ChaosSigBackend
+
+            composed = ChaosSigBackend(get_backend(inner_name), chaos)
         if serving:
             from gethsharding_tpu.serving import (ServingConfig,
                                                   ServingSigBackend)
 
-            self._serving_backend = ServingSigBackend(
-                get_backend(sig_backend),
+            composed = ServingSigBackend(
+                composed if composed is not None
+                else get_backend(inner_name),
                 config=serving_config or ServingConfig())
+            self._serving_backend = composed
+        if failover:
+            from gethsharding_tpu.resilience.breaker import (
+                FailoverSigBackend)
+
+            composed = FailoverSigBackend(
+                composed if composed is not None
+                else get_backend(inner_name),
+                get_backend("python"))
+        self._sig_backend_obj = composed
 
         def node_sig_backend():
-            return (self._serving_backend if self._serving_backend
+            return (self._sig_backend_obj if self._sig_backend_obj
                     is not None else get_backend(sig_backend))
         self._services: Dict[Type, object] = {}
         self._order: List[object] = []
@@ -125,17 +153,27 @@ class ShardNode:
 
         if actor == "proposer":
             txpool = TXPool(simulate_interval=txpool_interval,
-                            sig_backend=self._serving_backend)
+                            sig_backend=self._sig_backend_obj)
             self._register(txpool)
             self._register_factory(
                 lambda: Proposer(client=client, txpool=txpool,
                                  shard=shard, config=config))
         elif actor == "notary":
+            # crash-safe vote journal through the node's OWN shard KV
+            # (a --datadir node gets SQLite durability for free); the
+            # env gate exists for A/B and for tests that want the
+            # pre-journal behavior
+            journal = None
+            if os.environ.get("GETHSHARDING_VOTE_JOURNAL", "1") != "0":
+                from gethsharding_tpu.resilience.journal import VoteJournal
+
+                journal = VoteJournal(shard_db.db)
             self._register_factory(
                 lambda: Notary(client=client, shard=shard, p2p=p2p,
                                config=config, deposit_flag=deposit,
                                sig_backend=node_sig_backend(),
-                               mirror=self.service(StateMirror)))
+                               mirror=self.service(StateMirror),
+                               journal=journal))
         elif actor == "light":
             # the les/light role: no shard data, SMC-anchored proof-
             # verified sampling over shardp2p (actors/light.py)
@@ -146,8 +184,11 @@ class ShardNode:
         else:
             self._register_factory(
                 lambda: Observer(client=client, shard=shard,
-                                 replay_engine=("jax" if sig_backend == "jax"
-                                                else "python")))
+                                 # failover-jax / serving-jax keep the
+                                 # wrapped backend's device nature
+                                 replay_engine=(
+                                     "jax" if sig_backend.endswith("jax")
+                                     else "python")))
 
         if actor not in ("notary", "light"):
             # non-notary nodes run the simulator (backend.go:303)

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import sys
 import time
 from typing import List, Optional
@@ -57,10 +58,14 @@ def build_parser() -> argparse.ArgumentParser:
     sharding.add_argument("--txinterval", type=float, default=5.0,
                           help="simulated txpool emission interval")
     sharding.add_argument("--sigbackend", default="python",
-                          choices=("python", "jax"),
+                          choices=("python", "jax", "failover-python",
+                                   "failover-jax"),
                           help="signature verification backend: scalar host "
                                "crypto or batched TPU kernels (the "
-                               "reference's native-crypto build seam)")
+                               "reference's native-crypto build seam); "
+                               "failover-* puts the chosen backend behind "
+                               "a circuit breaker over the scalar fallback "
+                               "(gethsharding_tpu/resilience)")
     sharding.add_argument("--serving", action="store_true",
                           help="run signature verification through the "
                                "micro-batching serving tier: concurrent "
@@ -80,6 +85,21 @@ def build_parser() -> argparse.ArgumentParser:
                           choices=("block", "shed"),
                           help="backpressure at the queue cap: block the "
                                "caller or shed with a fast error")
+    sharding.add_argument("--serving-watchdog-s", type=float, default=0.0,
+                          help="dispatch watchdog deadline in seconds: a "
+                               "device call wedging the serving dispatch "
+                               "thread longer than this fails its batch "
+                               "with DeadlineExceeded and the dispatcher "
+                               "restarts (0 = off)")
+    sharding.add_argument("--chaos", default="",
+                          metavar="SPEC",
+                          help="deterministic chaos schedule, e.g. "
+                               "'seed=7,backend.bls_verify_committees=2,"
+                               "mainchain.collation_record=0.2': seeded "
+                               "failure injection at the sig-backend and "
+                               "mainchain-call seams (resilience/chaos.py; "
+                               "pair with --sigbackend failover-* to watch "
+                               "the breaker ride through it)")
     sharding.add_argument("--verbosity", default="info",
                           choices=("debug", "info", "warning", "error"))
     sharding.add_argument("--metrics", action="store_true",
@@ -218,7 +238,8 @@ def build_parser() -> argparse.ArgumentParser:
     devnet.add_argument("--quorum", type=int, default=None)
     devnet.add_argument("--shardcount", type=int, default=None)
     devnet.add_argument("--sigbackend", default="python",
-                        choices=("python", "jax"))
+                        choices=("python", "jax", "failover-python",
+                                 "failover-jax"))
     devnet.add_argument("--http-base", type=int, default=0,
                         help="first actor status port (0 = no status "
                              "servers); successive actors count up")
@@ -332,6 +353,11 @@ def run_sharding_node(args) -> int:
         except OSError:
             pass  # treat as a literal password
     serving_config = None
+    if args.serving_watchdog_s and not args.serving:
+        logging.getLogger("sharding.node").warning(
+            "--serving-watchdog-s has no effect without --serving (the "
+            "watchdog monitors the serving tier's dispatch thread) — "
+            "hung-dispatch protection is NOT armed")
     if args.serving:
         from gethsharding_tpu.serving import ServingConfig
 
@@ -340,7 +366,36 @@ def run_sharding_node(args) -> int:
             flush_us=args.serving_flush_us,
             queue_cap=args.serving_queue_cap,
             policy=args.serving_policy,
+            watchdog_s=args.serving_watchdog_s,
         )
+    chaos_schedule = None
+    raw_backend = backend
+    if args.chaos:
+        from gethsharding_tpu.resilience import chaos as chaos_mod
+
+        chaos_schedule = chaos_mod.parse_spec(args.chaos)
+        for seam in chaos_mod.unwired_seams(
+                chaos_schedule, ("mainchain", "backend", "dispatch")):
+            logging.getLogger("sharding.node").warning(
+                "chaos rule %r targets a seam this node never wraps "
+                "(wired: mainchain.*, backend.*, dispatch.*) — it will "
+                "inject nothing", seam)
+        if any(seam == "mainchain" or seam.startswith("mainchain.")
+               for seam in chaos_schedule.rules):
+            # mainchain-call seam: the fault proxy fronts the chain
+            # backend UNDER the client's retry executor, so retries are
+            # exercised for real. The dev-mode block-production loop
+            # below keeps driving the RAW chain — chaos targets the
+            # actor's view of the chain, not the chain itself.
+            backend = chaos_mod.wrap(backend, chaos_schedule, "mainchain")
+            if int(os.environ.get("GETHSHARDING_CLIENT_RETRIES",
+                                  "0")) <= 0:
+                logging.getLogger("sharding.node").warning(
+                    "chaos mainchain.* rules are wired under the "
+                    "client's retry executor, but "
+                    "GETHSHARDING_CLIENT_RETRIES is unset/0 — injected "
+                    "mainchain faults will surface to the actors "
+                    "unretried")
     node = ShardNode(
         actor=args.actor,
         shard_id=args.shardid,
@@ -357,12 +412,13 @@ def run_sharding_node(args) -> int:
         hub=hub,
         serving=args.serving,
         serving_config=serving_config,
+        chaos=chaos_schedule,
     )
     if hub is not None:
         # the node's public identity in the relay's peer table
         hub.account = node.client.account().hex_str
     # dev mode: fund the node account so --deposit can stake
-    backend.fund(node.client.account(), 2000 * ETHER)
+    raw_backend.fund(node.client.account(), 2000 * ETHER)
 
     log = logging.getLogger("sharding.node")
     log.info("Starting sharding node: actor=%s shard=%d account=%s",
@@ -410,10 +466,10 @@ def run_sharding_node(args) -> int:
             time.sleep(args.blocktime)
             if args.endpoint:
                 continue  # the chain process owns block production
-            block = backend.commit()
+            block = raw_backend.commit()
             if block.number % config.period_length == 0:
                 log.info("period %d sealed (block %d)",
-                         backend.current_period(), block.number)
+                         raw_backend.current_period(), block.number)
     except KeyboardInterrupt:
         log.info("interrupt received, shutting down")
     finally:

@@ -77,14 +77,20 @@ class StatusServer(Service):
             "restarts": dict(node.restarts),
         }
         # the serving tier's health at a glance (--serving): queue
-        # depths, coalesced batch sizes, shed counts — the /metrics
-        # snapshot filtered to the serving/ namespace so an operator
-        # reads backpressure state off /status without grepping
-        serving = {name: snap
-                   for name, snap in DEFAULT_REGISTRY.snapshot().items()
+        # depths, coalesced batch sizes, shed counts — and the
+        # resilience layer's (breaker state, retry/giveup, watchdog,
+        # journal, chaos counters) — the /metrics snapshot filtered by
+        # namespace so an operator reads backpressure + failover state
+        # off /status without grepping
+        snapshot = DEFAULT_REGISTRY.snapshot()
+        serving = {name: snap for name, snap in snapshot.items()
                    if name.startswith("serving/")}
         if serving:
             payload["serving"] = serving
+        resilience = {name: snap for name, snap in snapshot.items()
+                      if name.startswith("resilience/")}
+        if resilience:
+            payload["resilience"] = resilience
         return payload
 
     def metrics_payload(self) -> dict:

@@ -1,0 +1,236 @@
+"""Deterministic chaos injection: seeded failure schedules at the seams.
+
+The reference hardens with hand-written doubles (faultyReader /
+faultyCaller subclasses per test); this module replaces that with ONE
+reusable injection surface driven by a seeded, replayable schedule:
+
+- **mainchain-call seam** — ``wrap(backend, schedule, "mainchain")``
+  puts a fault-injecting proxy in front of a chain backend, UNDER the
+  `SMCClient` retry executor (so retry-then-succeed paths are real);
+  ``wrap(client, schedule, "client")`` fronts the client itself for
+  faults the backend never sees (keystore signs);
+- **backend-op seam** — `ChaosSigBackend` fronts any `SigBackend`;
+  scheduled ``backend.<op>`` entries raise `InjectedFault` (a device
+  fault the failover breaker counts), scheduled ``dispatch.<op>``
+  entries HANG for `hang_s` seconds (a wedged dispatch the watchdog
+  must catch);
+- the schedule itself is pure decision logic: per-seam call counters
+  plus a seed, so the SAME spec replays the SAME failure timeline in
+  tests, `bench.py --chaos`, and a devnet node booted with
+  ``--chaos`` — no `random` module state leaks between runs.
+
+`InjectedFault` subclasses `ConnectionError` deliberately: injected
+faults model transient infrastructure failure, the class the retry
+policies treat as retryable.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from gethsharding_tpu import metrics
+from gethsharding_tpu.sigbackend import SigBackend
+
+
+class InjectedFault(ConnectionError):
+    """A deterministically scheduled failure (retryable by design)."""
+
+
+class ChaosSchedule:
+    """Seeded per-seam failure schedule.
+
+    ``rules`` maps a seam name (e.g. ``"mainchain.collation_record"``,
+    ``"backend.bls_verify_committees"``, ``"dispatch.ecrecover_addresses"``)
+    — or a bare seam prefix (``"mainchain"``) matching every op under
+    it — to one of:
+
+    - ``True``            fail every call;
+    - ``int n``           fail the first n calls (then heal — the
+                          retry-then-succeed / breaker-recovery shape);
+    - ``float r in (0,1)``  fail each call with probability r, decided
+                          by a hash of (seed, seam, call index) so the
+                          verdict for call k never depends on how many
+                          other seams fired;
+    - ``callable(idx)``   arbitrary predicate on the per-seam call index.
+    """
+
+    def __init__(self, seed: int = 0, rules: Optional[Dict] = None):
+        self.seed = seed
+        self.rules = dict(rules or {})
+        self.injected: Dict[str, int] = {}
+        self._counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._m_injected = metrics.counter("resilience/chaos/injected")
+
+    def _rule_for(self, seam: str):
+        rule = self.rules.get(seam)
+        if rule is None and "." in seam:
+            rule = self.rules.get(seam.split(".", 1)[0])
+        return rule
+
+    def has_rule(self, seam: str) -> bool:
+        """True when a rule (exact or bare-prefix) names this seam."""
+        rule = self._rule_for(seam)
+        return rule is not None and rule is not False
+
+    def should_fail(self, seam: str) -> bool:
+        """Consume one call slot on `seam`; True = inject."""
+        with self._lock:
+            idx = self._counts.get(seam, 0)
+            self._counts[seam] = idx + 1
+        rule = self._rule_for(seam)
+        if rule is None or rule is False:
+            return False
+        if rule is True:
+            verdict = True
+        elif isinstance(rule, bool):  # pragma: no cover - covered above
+            verdict = rule
+        elif isinstance(rule, int):
+            verdict = idx < rule
+        elif isinstance(rule, float):
+            verdict = random.Random(
+                f"{self.seed}:{seam}:{idx}").random() < rule
+        else:
+            verdict = bool(rule(idx))
+        if verdict:
+            with self._lock:
+                self.injected[seam] = self.injected.get(seam, 0) + 1
+            self._m_injected.inc()
+        return verdict
+
+    def fire(self, seam: str) -> None:
+        """Raise `InjectedFault` when the schedule says this call fails."""
+        if self.should_fail(seam):
+            raise InjectedFault(
+                f"chaos: injected fault at {seam} "
+                f"(call {self._counts[seam] - 1}, seed {self.seed})")
+
+    def calls(self, seam: str) -> int:
+        with self._lock:
+            return self._counts.get(seam, 0)
+
+
+def parse_spec(spec: str) -> ChaosSchedule:
+    """Parse the CLI/bench chaos spec string.
+
+    ``"seed=7,backend.bls_verify_committees=2,mainchain.collation_record=0.3,client.sign=always"``
+    — `seed=` names the schedule seed; every other entry is a seam
+    rule: ``always`` -> True, a value containing ``.`` -> float rate,
+    otherwise -> int first-n.
+    """
+    seed = 0
+    rules: Dict = {}
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        if "=" not in part:
+            raise ValueError(f"chaos spec entry {part!r} is not key=value")
+        key, value = (s.strip() for s in part.split("=", 1))
+        if key == "seed":
+            seed = int(value)
+        elif value == "always":
+            rules[key] = True
+        elif "." in value:
+            rules[key] = float(value)
+        else:
+            rules[key] = int(value)
+    return ChaosSchedule(seed=seed, rules=rules)
+
+
+class _ChaosProxy:
+    """Attribute proxy injecting scheduled faults in front of every
+    public method of `target` (the faultyReader/faultyCaller doubles,
+    generalized). Non-callable attributes and private names pass
+    through; `overrides` replaces whole methods for degraded-backend
+    doubles (e.g. a backend without the batched committee view)."""
+
+    def __init__(self, target, schedule: ChaosSchedule, seam_prefix: str,
+                 overrides: Optional[Dict[str, Callable]] = None):
+        self._target = target
+        self._schedule = schedule
+        self._seam_prefix = seam_prefix
+        self._overrides = overrides or {}
+
+    def __getattr__(self, name: str):
+        override = self._overrides.get(name)
+        if override is not None:
+            return override
+        attr = getattr(self._target, name)
+        if name.startswith("_"):
+            return attr
+        schedule, seam = self._schedule, f"{self._seam_prefix}.{name}"
+        if not callable(attr):
+            # property-backed reads (e.g. mainchain.block_number) are
+            # injectable too, but only when a rule NAMES them — plain
+            # data passthroughs must not consume schedule slots
+            if schedule.has_rule(seam):
+                schedule.fire(seam)
+            return attr
+
+        def chaotic(*args, **kwargs):
+            schedule.fire(seam)
+            return attr(*args, **kwargs)
+
+        return chaotic
+
+
+def wrap(target, schedule: ChaosSchedule, seam_prefix: str,
+         overrides: Optional[Dict[str, Callable]] = None):
+    """Front `target` with scheduled ``<seam_prefix>.<method>`` faults."""
+    return _ChaosProxy(target, schedule, seam_prefix, overrides)
+
+
+def unwired_seams(schedule: ChaosSchedule,
+                  wired: Tuple[str, ...]) -> List[str]:
+    """Rules whose seam prefix is not in `wired`: a spec entry the
+    caller never routes through an injector fires nothing, so the
+    experiment silently tests less than the operator asked for — the
+    caller should warn (or refuse) rather than stay quiet."""
+    return sorted(seam for seam in schedule.rules
+                  if seam.split(".", 1)[0] not in wired)
+
+
+class ChaosSigBackend(SigBackend):
+    """`SigBackend` front injecting device faults and dispatch hangs.
+
+    ``backend.<op>`` schedule entries raise `InjectedFault` before the
+    inner call; ``dispatch.<op>`` entries sleep `hang_s` seconds first
+    — when this backend sits under the serving tier, that wedges the
+    dispatch thread exactly like a hung device call, which is the
+    watchdog's prey."""
+
+    def __init__(self, inner: SigBackend, schedule: ChaosSchedule,
+                 hang_s: float = 30.0):
+        self.inner = inner
+        self.schedule = schedule
+        self.hang_s = hang_s
+        self.name = f"chaos+{inner.name}"
+
+    def _op(self, op: str, *args, **kwargs):
+        if self.schedule.should_fail(f"dispatch.{op}"):
+            time.sleep(self.hang_s)
+        self.schedule.fire(f"backend.{op}")
+        return getattr(self.inner, op)(*args, **kwargs)
+
+    def ecrecover_addresses(self, digests, sigs65):
+        return self._op("ecrecover_addresses", digests, sigs65)
+
+    def bls_verify_aggregates(self, messages, agg_sigs, agg_pks):
+        return self._op("bls_verify_aggregates", messages, agg_sigs,
+                        agg_pks)
+
+    def bls_verify_committees(self, messages, sig_rows, pk_rows,
+                              pk_row_keys=None):
+        return self._op("bls_verify_committees", messages, sig_rows,
+                        pk_rows, pk_row_keys=pk_row_keys)
+
+    def bls_verify_committees_async(self, messages, sig_rows, pk_rows,
+                                    pk_row_keys=None):
+        # fire at submit time: a fault lands where the real device
+        # raises (the staged launch), and a hang wedges the submitter
+        if self.schedule.should_fail("dispatch.bls_verify_committees"):
+            time.sleep(self.hang_s)
+        self.schedule.fire("backend.bls_verify_committees")
+        return self.inner.bls_verify_committees_async(
+            messages, sig_rows, pk_rows, pk_row_keys=pk_row_keys)

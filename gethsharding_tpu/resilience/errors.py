@@ -1,0 +1,49 @@
+"""The resilience layer's error vocabulary.
+
+Kept in a leaf module so infrastructure that FAILS work (the serving
+dispatcher, the watchdog) and infrastructure that RETRIES it (the
+policy executors) can share one vocabulary without importing each
+other. `serving/pipeline.py` imports from here; nothing here imports
+anything.
+"""
+
+from __future__ import annotations
+
+
+class ResilienceError(RuntimeError):
+    """Base class for the resilience layer's own failure signals."""
+
+
+class DeadlineExceeded(ResilienceError):
+    """An in-flight operation overran its deadline and was abandoned.
+
+    Raised into the futures of a batch whose device dispatch the
+    watchdog declared hung. Callers already handle errored batches
+    (the serving tier fails a batch's futures on any dispatch error);
+    the distinct type lets a failover backend count it as a device
+    fault rather than a caller mistake.
+    """
+
+
+class DispatcherClosed(ResilienceError):
+    """Work was still queued (or in flight) when the dispatcher shut
+    down; its futures are failed with this instead of hanging."""
+
+
+class TransientError(Exception):
+    """A failure the caller expects to succeed on retry.
+
+    Seam adapters (netstore fetch misses, collation-body waits) raise
+    subclasses of this so the default `RetryPolicy.retryable` tuple
+    picks them up without widening to bare Exception.
+    """
+
+
+class FetchAborted(Exception):
+    """A poll-under-retry seam is stopping mid-fetch.
+
+    Deliberately NOT transient (plain Exception, not TransientError):
+    the retry executor must abort immediately instead of backing off
+    and re-polling against a shutting-down service. Raised by
+    `policy.poll_probe` when the owning service's `wait` reports stop.
+    """

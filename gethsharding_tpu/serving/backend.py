@@ -49,12 +49,17 @@ class ServingConfig:
       policy applies.
     - ``policy``: ``block`` (callers absorb device pace) or ``shed``
       (fast `ServingOverloadError`, counted).
+    - ``watchdog_s``: dispatch watchdog deadline — a device call that
+      wedges the dispatch thread longer than this fails its batch's
+      futures with `resilience.DeadlineExceeded` and the dispatcher
+      restarts on a fresh thread (0 = watchdog off).
     """
 
     max_batch: int = 128
     flush_us: float = 500.0
     queue_cap: int = 4096
     policy: str = "block"
+    watchdog_s: float = 0.0
 
 
 class ServingSigBackend(SigBackend):
@@ -77,6 +82,7 @@ class ServingSigBackend(SigBackend):
             flush_us=self.config.flush_us,
             queue_cap=self.config.queue_cap,
             policy=self.config.policy,
+            watchdog_s=self.config.watchdog_s,
             registry=registry,
         )
 

@@ -100,6 +100,7 @@ class MicroBatcher:
     def __init__(self, inner, max_batch: int = 128,
                  flush_us: float = 500.0, queue_cap: int = 4096,
                  policy: str = "block",
+                 watchdog_s: float = 0.0,
                  registry: metrics.Registry = metrics.DEFAULT_REGISTRY):
         from gethsharding_tpu.sigbackend import bucket_size
 
@@ -119,7 +120,19 @@ class MicroBatcher:
                                max_batch=self.max_batch, flush_us=flush_us)
             for op in SERVING_OPS
         }
-        self._dispatcher = PipelinedDispatcher()
+        self._dispatcher = PipelinedDispatcher(registry=registry)
+        # watchdog_s > 0 arms the dispatch watchdog: a device call that
+        # wedges the dispatch thread past the deadline fails its batch's
+        # futures with DeadlineExceeded and a fresh thread takes over —
+        # the hung-device single point of failure the resilience layer
+        # exists for (lazy import: healthy nodes without the knob never
+        # load the monitor)
+        self._watchdog = None
+        if watchdog_s > 0:
+            from gethsharding_tpu.resilience.watchdog import DispatchWatchdog
+
+            self._watchdog = DispatchWatchdog(
+                self._dispatcher, deadline_s=watchdog_s, registry=registry)
         self._flushers: List[threading.Thread] = []
         self._closed = False
         for op in SERVING_OPS:
@@ -214,7 +227,9 @@ class MicroBatcher:
                         request.t_dispatch = t_assembled
                 self._dispatcher.submit(
                     lambda batch=batch, cols=cols, rows=rows, reason=reason:
-                    self._run_batch(op, batch, cols, rows, reason))
+                    self._run_batch(op, batch, cols, rows, reason),
+                    fail=lambda exc, batch=batch:
+                    self._fail_batch(batch, exc))
             except Exception as exc:  # noqa: BLE001 - a malformed batch
                 # must fail ITS futures, not kill the op's only consumer
                 # (a dead flusher would hang every later caller forever)
@@ -244,8 +259,7 @@ class MicroBatcher:
                         request.t_done = t_done
                         self._emit_request_trace(op, request, reason, rows,
                                                  error=repr(exc))
-            for request in batch:
-                request.future.set_exception(exc)
+            self._fail_batch(batch, exc)
             return
         self.dispatch_counts[op] += 1
         met.dispatches.inc()
@@ -259,8 +273,21 @@ class MicroBatcher:
                     self._emit_request_trace(op, request, reason, rows)
         offset = 0
         for request in batch:
-            request.future.set_result(out[offset:offset + request.rows])
+            # done() guard: the watchdog (or shutdown) may have failed
+            # this batch's futures already — a late device completion
+            # must not raise InvalidStateError over them
+            if not request.future.done():
+                request.future.set_result(out[offset:offset + request.rows])
             offset += request.rows
+
+    @staticmethod
+    def _fail_batch(batch: List[Request], exc: BaseException) -> None:
+        """Fail every still-pending future in `batch` — the shared
+        failure channel of the dispatch error path, the watchdog abort
+        and the drain-and-fail shutdown."""
+        for request in batch:
+            if not request.future.done():
+                request.future.set_exception(exc)
 
     def _emit_request_trace(self, op: str, request: Request, reason: str,
                             batch_rows: int,
@@ -316,6 +343,10 @@ class MicroBatcher:
             queue.close()
         for thread in self._flushers:
             thread.join(timeout=10.0)
+        if self._watchdog is not None:
+            # the watchdog first: a restart racing the dispatcher's own
+            # drain-and-fail close would fail batches twice
+            self._watchdog.close()
         self._dispatcher.close(wait=True)
 
     # -- observability -----------------------------------------------------

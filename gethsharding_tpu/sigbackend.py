@@ -20,6 +20,13 @@ the CLI exposes ``--sigbackend``.
   request-coalescing serving tier (``gethsharding_tpu/serving/``) —
   concurrent small calls from many threads share device dispatches;
   the CLI's ``--serving`` flag wires the same wrapper.
+- ``failover-*``: any of the above as the PRIMARY behind a circuit
+  breaker with the scalar ``python`` backend as the always-sound
+  fallback (``gethsharding_tpu/resilience/breaker.py``): consecutive
+  device faults or watchdog timeouts trip the breaker open, calls are
+  served scalar while open, and a half-open differential spot-check
+  re-promotes the accelerated path only when it agrees with the
+  fallback byte-for-byte.
 """
 
 from __future__ import annotations
@@ -838,18 +845,37 @@ def _serving_factory(inner_name: str):
     return build
 
 
+def _failover_factory(primary_name: str):
+    """Factory for the breaker-guarded wrappers ('failover-<primary>'):
+    the primary stays the registry singleton; the scalar python backend
+    is the always-available fallback. Lazy import: only nodes that opt
+    into failover load the resilience layer."""
+    def build() -> SigBackend:
+        from gethsharding_tpu.resilience.breaker import FailoverSigBackend
+
+        return FailoverSigBackend(get_backend(primary_name),
+                                  get_backend("python"))
+
+    return build
+
+
 _BACKENDS = {
     "python": PythonSigBackend,
     "jax": JaxSigBackend,
     "serving-python": _serving_factory("python"),
     "serving-jax": _serving_factory("jax"),
+    "failover-python": _failover_factory("python"),
+    "failover-jax": _failover_factory("jax"),
+    "failover-serving-python": _failover_factory("serving-python"),
+    "failover-serving-jax": _failover_factory("serving-jax"),
 }
 _cache: dict = {}
 
 
 def get_backend(name: str = "python") -> SigBackend:
-    """Backend registry: 'python' (scalar host), 'jax' (batched TPU), or
-    the 'serving-*' coalescing wrappers over either."""
+    """Backend registry: 'python' (scalar host), 'jax' (batched TPU),
+    the 'serving-*' coalescing wrappers, or the 'failover-*'
+    breaker-guarded wrappers over any of them."""
     if name not in _BACKENDS:
         raise ValueError(
             f"unknown sigbackend {name!r}; choose from {sorted(_BACKENDS)}")

@@ -33,8 +33,15 @@ from typing import Optional
 
 from gethsharding_tpu.actors.base import Service
 from gethsharding_tpu.p2p.service import Message, P2PServer
+from gethsharding_tpu.resilience.errors import FetchAborted, TransientError
+from gethsharding_tpu.resilience.policy import (POLL_MISS, RetryExecutor,
+                                                RetryPolicy, poll_probe)
 from gethsharding_tpu.storage.chunker import (
     CHUNK_SIZE, ChunkStore, ChunkStoreError, KEY_SIZE, chunk_key)
+
+
+class _ChunkMiss(TransientError):
+    """No peer delivered the chunk within one fetch attempt."""
 
 
 @dataclass(frozen=True)
@@ -62,12 +69,28 @@ class NetStore(Service):
     def __init__(self, store: Optional[ChunkStore] = None,
                  p2p: Optional[P2PServer] = None,
                  poll_interval: float = 0.02,
-                 fetch_timeout: float = 3.0):
+                 fetch_timeout: float = 3.0,
+                 fetch_attempts: int = 3):
         super().__init__()
         self.store = store if store is not None else ChunkStore()
         self.p2p = p2p
         self.poll_interval = poll_interval
         self.fetch_timeout = fetch_timeout
+        # network-fetch retry seam (resilience/policy): each attempt
+        # RE-BROADCASTS the chunk request — a dropped request frame or a
+        # briefly partitioned holder costs one capped backoff instead of
+        # failing the whole retrieval; retries/giveups are counted under
+        # resilience/retry/netstore/*. The attempts SHARE the
+        # fetch_timeout budget (per-attempt wait = timeout / attempts),
+        # so callers that tuned fetch_timeout keep their worst-case
+        # latency — the retries buy re-broadcasts, not extra waiting.
+        self._attempt_timeout = fetch_timeout / max(1, fetch_attempts)
+        self._fetch_retry = RetryExecutor(
+            "netstore",
+            RetryPolicy(attempts=max(1, fetch_attempts),
+                        base_s=poll_interval, cap_s=0.25,
+                        deadline_s=fetch_timeout,
+                        retryable=(_ChunkMiss,)))
         self.chunks_served = 0
         self.chunks_fetched = 0
         self.deliveries_rejected = 0
@@ -142,7 +165,9 @@ class NetStore(Service):
     # -- fetching side -----------------------------------------------------
 
     def get_chunk(self, key: bytes) -> tuple:
-        """(span, payload) — local store first, then the network."""
+        """(span, payload) — local store first, then the network (each
+        retry attempt re-broadcasts the request under the fetch retry
+        policy)."""
         try:
             return self.store.chunk(key)
         except ChunkStoreError:
@@ -150,24 +175,28 @@ class NetStore(Service):
         if self.p2p is None or self.stopped():
             raise ChunkStoreError(f"missing chunk {key.hex()} (offline)")
         key = bytes(key)
+
+        def attempt() -> tuple:
+            self.p2p.broadcast(ChunkRequest(key=key))
+            got = poll_probe(
+                lambda: self.store.chunk(key), self.wait,
+                interval_s=self.poll_interval,
+                polls=int(self._attempt_timeout / self.poll_interval),
+                not_ready=(ChunkStoreError,))
+            if got is POLL_MISS:
+                raise _ChunkMiss(f"chunk {key.hex()} not delivered")
+            return got
+
         with self._fetch_lock:
             self._fetching.add(key)
         try:
-            self.p2p.broadcast(ChunkRequest(key=key))
-            waited = 0.0
-            while waited < self.fetch_timeout:
-                if self.wait(self.poll_interval):
-                    break  # service stopping
-                waited += self.poll_interval
-                try:
-                    return self.store.chunk(key)
-                except ChunkStoreError:
-                    continue
+            return self._fetch_retry.call(attempt)
+        except (_ChunkMiss, FetchAborted):
+            raise ChunkStoreError(
+                f"chunk {key.hex()} unavailable on the network") from None
         finally:
             with self._fetch_lock:
                 self._fetching.discard(key)
-        raise ChunkStoreError(
-            f"chunk {key.hex()} unavailable on the network")
 
     def store_content(self, data: bytes) -> bytes:
         """Publish content locally; peers pull chunks on demand (the

@@ -75,6 +75,70 @@ assert jx.last_wire["g2_wire_bytes"] == 0, jx.last_wire  # warm = resident
 print("resident/overlap smoke OK:", jx.last_wire)
 PYEOF
 
+# -- chaos/failover smoke: a devnet-style notary rides a seeded failure
+# schedule end-to-end — injected device faults mid-audit must trip the
+# breaker, every period's votes must land on the scalar fallback, the
+# breaker must re-close through a matching differential probe, and the
+# breaker counters must appear in the Prometheus exposition
+echo "== chaos failover smoke"
+JAX_PLATFORMS=cpu python - <<'PYEOF' || fail=1
+import time
+
+from gethsharding_tpu.actors.notary import Notary
+from gethsharding_tpu.actors.proposer import create_collation
+from gethsharding_tpu.core.shard import Shard
+from gethsharding_tpu.core.types import Transaction
+from gethsharding_tpu.db.kv import MemoryKV
+from gethsharding_tpu.mainchain.client import SMCClient
+from gethsharding_tpu.metrics import prometheus_text
+from gethsharding_tpu.params import Config, ETHER
+from gethsharding_tpu.resilience.breaker import (
+    CLOSED, CircuitBreaker, FailoverSigBackend)
+from gethsharding_tpu.resilience.chaos import (ChaosSchedule,
+                                               ChaosSigBackend, parse_spec)
+from gethsharding_tpu.sigbackend import PythonSigBackend
+from gethsharding_tpu.smc.chain import SimulatedMainchain
+
+config = Config(quorum_size=1, period_length=4)
+backend = SimulatedMainchain(config=config)
+client = SMCClient(backend=backend, config=config)
+backend.fund(client.account(), 2000 * ETHER)
+schedule = parse_spec("seed=7,backend.bls_verify_committees=2")
+breaker = CircuitBreaker(name="sigbackend", fault_threshold=1,
+                         reset_s=0.005)
+failover = FailoverSigBackend(
+    ChaosSigBackend(PythonSigBackend(), schedule),
+    PythonSigBackend(), breaker=breaker)
+notary = Notary(client=client, shard=Shard(0, MemoryKV()), config=config,
+                deposit_flag=True, all_shards=False, sig_backend=failover)
+notary.start()
+backend.fast_forward(1)
+periods = []
+for _ in range(5):
+    period = backend.current_period()
+    collation = create_collation(
+        client, 0, period, [Transaction(nonce=period, payload=b"c")])
+    notary.shard.save_collation(collation)
+    client.add_header(0, period, collation.header.chunk_root,
+                      collation.header.proposer_signature)
+    while backend.current_period() == period:
+        backend.commit()
+    periods.append(period)
+    time.sleep(0.01)
+notary.stop()
+assert notary.votes_submitted == len(periods), notary.errors
+assert backend.last_approved_collation(0) == periods[-1]  # on fallback
+assert schedule.injected.get("backend.bls_verify_committees") == 2
+assert breaker.state == CLOSED, breaker.state_name  # probed + re-closed
+prom = prometheus_text()
+for needle in ("gethsharding_resilience_breaker_sigbackend_trips_total",
+               "gethsharding_resilience_breaker_sigbackend_closes_total",
+               "gethsharding_resilience_breaker_sigbackend_state"):
+    assert needle in prom, needle
+print("chaos failover smoke OK: periods", periods,
+      "injected", schedule.injected)
+PYEOF
+
 for f in tests/test_*.py; do
     echo "== $f"
     python -m pytest "$f" -q --no-header || fail=1

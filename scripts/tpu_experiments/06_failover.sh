@@ -1,0 +1,12 @@
+#!/bin/bash
+# Breaker failover under deterministic chaos with the REAL device path
+# as the primary: seeded injected faults hit the jax backend, every
+# call must still answer correctly from the scalar fallback, and the
+# breaker must ride the full open -> half-open differential probe ->
+# closed cycle (breaker_reclosed). The availability number is the
+# paper's always-vote contract measured under failure.
+cd /root/repo || exit 1
+env GETHSHARDING_BENCH_CHAOS_BACKEND=jax GETHSHARDING_CHAOS_RATE=0.3 \
+    GETHSHARDING_BENCH_CHAOS_CALLS=45 \
+  timeout 1800 python bench.py --chaos >"$1.out" 2>"$1.err"
+grep -q chaos_availability "$1.out" && grep -q '"breaker_reclosed": true' "$1.out"

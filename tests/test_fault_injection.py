@@ -1,15 +1,24 @@
-"""Fault-injection doubles at the mainchain interface seams + log/error
+"""Fault injection at the mainchain interface seams + log/error
 assertions — the reference's faultyReader/faultyCaller pattern
 (`sharding/syncer/service_test.go:66`, `simulator/service_test.go:115`)
 with `LogHandler.VerifyLogMsg`-style assertions
 (`sharding/internal/log_helper.go:12,41`) mapped onto the Service error
-funnel and the logging records."""
+funnel and the logging records.
+
+Since the resilience layer, the doubles ride the REUSABLE injection
+surface (`gethsharding_tpu/resilience/chaos.py`) instead of ad-hoc
+`SMCClient` subclasses: `faulty_client` fronts a client with a seeded
+`ChaosSchedule` at the ``client.<op>`` seam, and the retry/breaker
+tests inject at the ``mainchain.<op>`` / ``backend.<op>`` seams to
+exercise retry-then-succeed, retry-exhausted, and breaker-open paths.
+"""
 
 import logging
 import time
 
 import pytest
 
+from gethsharding_tpu import metrics
 from gethsharding_tpu.actors import Notary, Proposer, Simulator, Syncer, TXPool
 from gethsharding_tpu.core.shard import Shard
 from gethsharding_tpu.core.types import Transaction
@@ -21,6 +30,11 @@ from gethsharding_tpu.p2p.messages import (
 )
 from gethsharding_tpu.p2p.service import Hub, P2PServer
 from gethsharding_tpu.params import Config, ETHER
+from gethsharding_tpu.resilience.breaker import (
+    OPEN, CircuitBreaker, FailoverSigBackend)
+from gethsharding_tpu.resilience.chaos import ChaosSchedule, InjectedFault, wrap
+from gethsharding_tpu.resilience.policy import RetryPolicy
+from gethsharding_tpu.sigbackend import PythonSigBackend
 from gethsharding_tpu.smc.chain import SimulatedMainchain
 from gethsharding_tpu.utils.hexbytes import Address20, Hash32
 
@@ -34,39 +48,15 @@ def wait_until(predicate, timeout=5.0, step=0.02):
     return predicate()
 
 
-class FaultyClient(SMCClient):
-    """Role-interface double that fails selected operations — the
-    faultyReader/faultyCaller/faultySigner seams."""
-
-    def __init__(self, *args, fail=(), **kwargs):
-        super().__init__(*args, **kwargs)
-        self.fail = set(fail)
-
-    def _maybe(self, op):
-        if op in self.fail:
-            raise RuntimeError(f"injected {op} fault")
-
-    def sign(self, digest):
-        self._maybe("sign")
-        return super().sign(digest)
-
-    def collation_record(self, shard_id, period):
-        self._maybe("collation_record")
-        return super().collation_record(shard_id, period)
-
-    def block_by_number(self, number=None):
-        self._maybe("block_by_number")
-        return super().block_by_number(number)
-
-    def get_notary_in_committee(self, shard_id, sender=None):
-        self._maybe("get_notary_in_committee")
-        return super().get_notary_in_committee(shard_id, sender)
-
-    def committee_context(self):
-        self._maybe("committee_context")
-        if "no_committee_context" in self.fail:
-            return None  # backend without the batched view
-        return super().committee_context()
+def faulty_client(backend=None, fail=(), overrides=None, **kwargs):
+    """The faultyReader/faultyCaller/faultySigner double, rebuilt on the
+    chaos injection surface: every op named in `fail` raises
+    `InjectedFault` on EVERY call (rule True); `overrides` swaps whole
+    methods for degraded-backend doubles."""
+    client = SMCClient(backend=backend, **kwargs)
+    schedule = ChaosSchedule(
+        rules={f"client.{op}": True for op in fail})
+    return wrap(client, schedule, "client", overrides=overrides)
 
 
 def shard_fixture():
@@ -77,7 +67,7 @@ def test_syncer_faulty_signer_records_and_logs(caplog):
     """A failing keystore Sign on the response path must surface as a
     recorded service error AND a log line (not a crash, not silence)."""
     backend = SimulatedMainchain()
-    client = FaultyClient(backend=backend, fail={"sign"})
+    client = faulty_client(backend=backend, fail={"sign"})
     hub = Hub()
     p2p = P2PServer(hub=hub)
     p2p.start()
@@ -131,9 +121,9 @@ def test_notary_faulty_committee_caller_records_head_error():
     config = Config(quorum_size=1)
     backend = SimulatedMainchain(config=config)
     # fail the batched sampling view AND the per-shard fallback
-    client = FaultyClient(backend=backend, config=config,
-                          fail={"committee_context",
-                                "get_notary_in_committee"})
+    client = faulty_client(backend=backend, config=config,
+                           fail={"committee_context",
+                                 "get_notary_in_committee"})
     backend.fund(client.account(), 2000 * ETHER)
     notary = Notary(client=client, shard=shard_fixture(), config=config,
                     deposit_flag=True)
@@ -152,8 +142,8 @@ def test_notary_faulty_committee_caller_records_head_error():
 def test_proposer_faulty_signer_records_error():
     config = Config(quorum_size=1)
     backend = SimulatedMainchain(config=config)
-    client = FaultyClient(backend=backend, config=config,
-                          fail={"sign"})
+    client = faulty_client(backend=backend, config=config,
+                           fail={"sign"})
     txpool = TXPool(simulate_interval=None)
     proposer = Proposer(client=client, txpool=txpool, shard=shard_fixture(),
                         config=config)
@@ -173,8 +163,8 @@ def test_proposer_faulty_signer_records_error():
 def test_simulator_faulty_record_fetcher_records_error():
     config = Config(quorum_size=1)
     backend = SimulatedMainchain(config=config)
-    client = FaultyClient(backend=backend, config=config,
-                          fail={"collation_record"})
+    client = faulty_client(backend=backend, config=config,
+                           fail={"collation_record"})
     backend.fast_forward(1)
     hub = Hub()
     p2p = P2PServer(hub=hub)
@@ -195,8 +185,8 @@ def test_notary_falls_back_to_per_shard_view_without_context():
     reference's per-shard calls, and votes still land."""
     config = Config(quorum_size=1)
     backend = SimulatedMainchain(config=config)
-    client = FaultyClient(backend=backend, config=config,
-                          fail={"no_committee_context"})
+    client = faulty_client(backend=backend, config=config,
+                           overrides={"committee_context": lambda: None})
     backend.fund(client.account(), 2000 * ETHER)
     notary = Notary(client=client, shard=shard_fixture(), config=config,
                     deposit_flag=True, all_shards=False)
@@ -221,3 +211,102 @@ def test_notary_falls_back_to_per_shard_view_without_context():
         assert approved, notary.errors
     finally:
         notary.stop()
+
+
+# -- the retry and breaker paths over the same injection surface -------------
+
+
+def test_notary_retry_then_succeed_under_transient_chaos(caplog):
+    """A transient mainchain fault UNDER the client's retry executor is
+    absorbed: the head loop completes with zero recorded errors, the
+    retry counter shows the weather happened."""
+    retries = metrics.DEFAULT_REGISTRY.counter(
+        "resilience/retry/mainchain/retries")
+    retries_before = retries.value
+    config = Config(quorum_size=1)
+    backend = SimulatedMainchain(config=config)
+    # the first 2 notary_registry reads fail, then heal — inject at the
+    # mainchain seam so the retry executor actually sees the fault
+    schedule = ChaosSchedule(seed=1, rules={"mainchain.notary_registry": 2})
+    client = SMCClient(
+        backend=wrap(backend, schedule, "mainchain"), config=config,
+        retry_policy=RetryPolicy(attempts=4, base_s=0.001, jitter=0.0))
+    backend.fund(client.account(), 2000 * ETHER)
+    notary = Notary(client=client, shard=shard_fixture(), config=config,
+                    deposit_flag=True, all_shards=False)
+    with caplog.at_level(logging.ERROR):
+        notary.start()
+        try:
+            backend.fast_forward(1)
+        finally:
+            notary.stop()
+    assert schedule.injected.get("mainchain.notary_registry") == 2
+    assert retries.value >= retries_before + 2
+    assert not notary.errors, notary.errors  # the faults never surfaced
+    assert not any("notarize failed" in rec.message
+                   for rec in caplog.records)
+
+
+def test_notary_retry_exhausted_surfaces_and_logs(caplog):
+    """A PERSISTENT mainchain fault exhausts the retry ladder: the last
+    InjectedFault surfaces through the head-loop error funnel with a
+    log line, and the giveup counter ticks."""
+    giveups = metrics.DEFAULT_REGISTRY.counter(
+        "resilience/retry/mainchain/giveups")
+    giveups_before = giveups.value
+    config = Config(quorum_size=1)
+    backend = SimulatedMainchain(config=config)
+    schedule = ChaosSchedule(rules={"mainchain.notary_registry": True})
+    client = SMCClient(
+        backend=wrap(backend, schedule, "mainchain"), config=config,
+        retry_policy=RetryPolicy(attempts=3, base_s=0.001, jitter=0.0))
+    backend.fund(client.account(), 2000 * ETHER)
+    notary = Notary(client=client, shard=shard_fixture(), config=config,
+                    deposit_flag=False)
+    with caplog.at_level(logging.ERROR):
+        notary.start()
+        try:
+            backend.fast_forward(1)
+            assert wait_until(lambda: len(notary.errors) >= 1)
+        finally:
+            notary.stop()
+    assert giveups.value > giveups_before
+    assert any("notarize failed at head" in e and "injected fault" in e
+               for e in notary.errors)
+    assert any("injected fault" in rec.message for rec in caplog.records)
+    # each schedule-hit call was tried `attempts` times before giving up
+    assert schedule.injected["mainchain.notary_registry"] >= 3
+
+
+def test_breaker_open_path_under_chaos_backend_logs_and_serves(caplog):
+    """Persistent backend-seam faults trip the failover breaker open
+    (logged), and calls keep answering from the scalar fallback."""
+    from gethsharding_tpu.resilience.chaos import ChaosSigBackend
+
+    registry = metrics.Registry()
+    schedule = ChaosSchedule(rules={"backend.ecrecover_addresses": True})
+    breaker = CircuitBreaker(name="fi", fault_threshold=2, reset_s=60,
+                             registry=registry)
+    backend = FailoverSigBackend(
+        ChaosSigBackend(PythonSigBackend(), schedule),
+        PythonSigBackend(), breaker=breaker, registry=registry)
+    rows = ([b"\x11" * 32] * 2, [b"\x22" * 65] * 2)
+    want = PythonSigBackend().ecrecover_addresses(*rows)
+    with caplog.at_level(logging.WARNING, logger="resilience.breaker"):
+        for _ in range(4):
+            assert backend.ecrecover_addresses(*rows) == want
+    assert breaker.state == OPEN
+    assert registry.counter("resilience/breaker/fi/trips").value == 1
+    assert any("breaker fi open" in rec.message for rec in caplog.records)
+    # open = the primary (and its chaos) is no longer consulted
+    calls_at_trip = schedule.calls("backend.ecrecover_addresses")
+    backend.ecrecover_addresses(*rows)
+    assert schedule.calls("backend.ecrecover_addresses") == calls_at_trip
+
+
+def test_injected_fault_is_retryable_by_contract():
+    """The chaos layer's faults must stay inside the retry policies'
+    transient set — the whole surface composes through this."""
+    assert issubclass(InjectedFault, ConnectionError)
+    policy = RetryPolicy()
+    assert any(issubclass(InjectedFault, cls) for cls in policy.retryable)
