@@ -890,6 +890,151 @@ def _chaos_platform(primary_name: str) -> str:
     return jax.devices()[0].platform
 
 
+# == data-availability sampling (bench.py --das) ===========================
+
+
+def measure_das() -> dict:
+    """Full-fetch vs sampled availability: bytes per collation, plus
+    batched sample-verify throughput.
+
+    Part 1 is the END-TO-END acceptance run: a proposer publishes
+    erasure-extended bodies, a notary in sampled DA mode votes across
+    several periods over a live shardp2p hub, and the harness asserts
+    (a) not one CollationBodyRequest left the notary and (b) fetched
+    bytes per collation stay within k·chunk_size + proof overhead —
+    against the full-fetch baseline of body_size bytes per collation.
+
+    Part 2 measures `das_verify_samples` rows/sec: the scalar python
+    reference vs the batched backend (GETHSHARDING_BENCH_DAS_BACKEND,
+    default jax), verdict-checked bit-for-bit. Hermetic on CPU; the
+    07_das probe runs the same thing against the real chip."""
+    import random as _random
+
+    from gethsharding_tpu.actors.notary import Notary
+    from gethsharding_tpu.actors.proposer import create_collation
+    from gethsharding_tpu.core.shard import Shard
+    from gethsharding_tpu.core.types import Transaction
+    from gethsharding_tpu.das.erasure import DAS_CHUNK_SIZE, extend_body
+    from gethsharding_tpu.das.proofs import (MAX_PROOF_DEPTH, chunk_leaf,
+                                             merkle_levels, merkle_proof)
+    from gethsharding_tpu.das.sampler import detection_probability
+    from gethsharding_tpu.das.service import DASService
+    from gethsharding_tpu.db.kv import MemoryKV
+    from gethsharding_tpu.mainchain.client import SMCClient
+    from gethsharding_tpu.p2p.messages import CollationBodyRequest
+    from gethsharding_tpu.p2p.service import Hub, P2PServer
+    from gethsharding_tpu.params import Config, ETHER
+    from gethsharding_tpu.sigbackend import get_backend
+    from gethsharding_tpu.smc.chain import SimulatedMainchain
+
+    body_size = int(os.environ.get("GETHSHARDING_BENCH_DAS_BODY",
+                                   str(256 * 1024)))
+    k_samples = int(os.environ.get("GETHSHARDING_BENCH_DAS_SAMPLES", "16"))
+    n_periods = int(os.environ.get("GETHSHARDING_BENCH_DAS_PERIODS", "3"))
+    backend_name = os.environ.get("GETHSHARDING_BENCH_DAS_BACKEND", "jax")
+
+    # -- part 1: the sampled-notary acceptance run -------------------------
+    config = Config(quorum_size=1, period_length=4)
+    chain = SimulatedMainchain(config=config)
+    prop_client = SMCClient(backend=chain, config=config)
+    not_client = SMCClient(backend=chain, config=config)
+    chain.fund(prop_client.account(), 2000 * ETHER)
+    chain.fund(not_client.account(), 2000 * ETHER)
+    hub = Hub()
+    watch = P2PServer(hub)
+    watch.start()  # must be hub-attached or broadcasts never reach it
+    body_watch = watch.subscribe(CollationBodyRequest)
+    svc_prop = DASService(client=prop_client, p2p=P2PServer(hub),
+                          samples=k_samples)
+    svc_not = DASService(client=not_client, p2p=P2PServer(hub),
+                         samples=k_samples)
+    svc_prop.start()
+    svc_not.start()
+    notary = Notary(client=not_client, shard=Shard(0, MemoryKV()),
+                    p2p=svc_not.p2p, config=config, deposit_flag=True,
+                    all_shards=False, sig_backend=get_backend("python"),
+                    das=svc_not, da_mode="sampled")
+    notary.start()
+    chain.fast_forward(1)
+    rng = _random.Random(1)
+    try:
+        for _ in range(n_periods):
+            period = chain.current_period()
+            collation = create_collation(
+                prop_client, 0, period,
+                [Transaction(nonce=period,
+                             payload=bytes(rng.randrange(256)
+                                           for _ in range(body_size)))])
+            svc_prop.publish(0, period, collation.header.chunk_root,
+                             collation.body)
+            prop_client.add_header(0, period,
+                                   collation.header.chunk_root,
+                                   collation.header.proposer_signature)
+            chain.commit()
+            notary.notarize_collations(head=chain.block_number)
+            while chain.current_period() == period:
+                chain.commit()
+        assert notary.votes_submitted == n_periods, notary.errors
+        assert body_watch.try_get() is None, \
+            "a CollationBodyRequest left the sampled notary"
+        sampled_bytes = svc_not.bytes_fetched / n_periods
+        budget = k_samples * (DAS_CHUNK_SIZE + 32 * MAX_PROOF_DEPTH + 40)
+        assert sampled_bytes <= budget, (sampled_bytes, budget)
+    finally:
+        notary.stop()
+        svc_prop.stop()
+        svc_not.stop()
+        watch.stop()
+
+    # -- part 2: batched verify throughput ---------------------------------
+    xb = extend_body(bytes(rng.randrange(256)
+                           for _ in range(body_size)), 0.5)
+    levels = merkle_levels([chunk_leaf(c) for c in xb.chunks])
+    das_root = levels[-1][0]
+    rows = int(os.environ.get("GETHSHARDING_BENCH_DAS_ROWS", "128"))
+    idx = [rng.randrange(xb.n) for _ in range(rows)]
+    chunks = [xb.chunks[i] for i in idx]
+    prfs = [merkle_proof(levels, i) for i in idx]
+    roots = [das_root] * rows
+    scalar = get_backend("python")
+    batched = get_backend(backend_name)
+    want = scalar.das_verify_samples(chunks, idx, prfs, roots)
+    assert all(want)
+    got = batched.das_verify_samples(chunks, idx, prfs, roots)  # compile
+    assert got == want, "batched verdicts diverge from scalar"
+    iters = 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        batched.das_verify_samples(chunks, idx, prfs, roots)
+    batched_s = (time.perf_counter() - t0) / iters
+    t0 = time.perf_counter()
+    scalar.das_verify_samples(chunks, idx, prfs, roots)
+    scalar_s = time.perf_counter() - t0
+    ledger = getattr(batched, "last_wire", None) or {}
+
+    import jax
+
+    return {
+        "platform": jax.devices()[0].platform,
+        "body_bytes": body_size,
+        "k_samples": k_samples,
+        "periods": n_periods,
+        "votes": n_periods,
+        "full_fetch_bytes_per_collation": body_size,
+        "sampled_bytes_per_collation": round(sampled_bytes, 1),
+        "bytes_ratio": round(sampled_bytes / body_size, 4),
+        "sample_budget_bytes": budget,
+        "detection_probability": round(
+            detection_probability(k_samples, xb.n, xb.k), 6),
+        "verify_rows": rows,
+        "verify_backend": backend_name,
+        "verify_rows_per_sec": round(rows / batched_s, 1),
+        "scalar_rows_per_sec": round(rows / scalar_s, 1),
+        "verify_speedup": round(scalar_s / batched_s, 3),
+        "sample_wire_bytes_per_dispatch": ledger.get("sample_wire_bytes"),
+    }
+
+
 # == autotune orchestration ================================================
 
 
@@ -1197,6 +1342,27 @@ def main() -> None:
             "vs_baseline": stats["chaos_availability"],
             "extra": {k: v for k, v in stats.items()
                       if k != "chaos_availability"},
+        }))
+        return
+
+    if "--das" in sys.argv:
+        # data-availability sampling: full-fetch vs sampled bytes per
+        # collation (the bandwidth->compute trade), with the batched
+        # sample-verify throughput riding in the extras. The run IS the
+        # acceptance check: zero body fetches, bytes within the
+        # k-sample budget, batched verdicts == scalar.
+        stats = measure_das()
+        print(json.dumps({
+            "metric": "das_sampled_bytes_per_collation",
+            "value": stats["sampled_bytes_per_collation"],
+            "unit": (f"bytes fetched per {stats['body_bytes']}-byte "
+                     f"collation at k={stats['k_samples']} sampled "
+                     f"chunks (full fetch: "
+                     f"{stats['full_fetch_bytes_per_collation']} B; "
+                     f"{stats['platform']})"),
+            "vs_baseline": stats["bytes_ratio"],
+            "extra": {key: val for key, val in stats.items()
+                      if key != "sampled_bytes_per_collation"},
         }))
         return
 

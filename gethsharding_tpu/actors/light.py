@@ -38,10 +38,15 @@ class LightClient(Service):
     name = "light"
     supervisable = True
 
-    def __init__(self, client: SMCClient, p2p: P2PServer):
+    def __init__(self, client: SMCClient, p2p: P2PServer, das=None):
         super().__init__()
         self.client = client
         self.p2p = p2p
+        # DAS face (gethsharding_tpu/das): when a DASService is
+        # attached, `das_check` samples whole erasure-extended chunks
+        # against the proposer's commitment — the chunk-granular,
+        # parity-aware successor of the per-byte `availability_check`
+        self.das = das
         self.samples_verified = 0
         self.proofs_rejected = 0
         self._sub = None
@@ -180,3 +185,50 @@ class LightClient(Service):
         got, _ = self._sample(root, shard_id, period, sorted(indices),
                               timeout)
         return all(got.get(i) is not None for i in indices)
+
+    # -- erasure-coded DAS (gethsharding_tpu/das) --------------------------
+
+    def das_check(self, shard_id: int, period: int,
+                  k: Optional[int] = None,
+                  seed: Optional[bytes] = None) -> bool:
+        """Chunk-granular data-availability sampling against the
+        proposer's erasure-extension commitment.
+
+        Fetches the signed commitment (validated against the
+        SMC-anchored record: chunk_root binding + proposer signature),
+        draws k indices from a FRESH random seed (a light client's
+        selection must not be precomputable — `das/sampler.py`
+        documents the soundness split), pulls chunk+proof samples over
+        shardp2p and verifies them with the scalar reference (a light
+        client has no device). True iff every sampled chunk proves."""
+        if self.das is None:
+            raise RuntimeError("light client has no DAS service attached")
+        import secrets
+
+        from gethsharding_tpu.das.proofs import verify_sample
+        from gethsharding_tpu.das.sampler import sample_indices
+
+        record = self.client.collation_record(shard_id, period)
+        if record is None:
+            return False
+        with self.m_sample_latency.time():
+            commitment = self.das.fetch_commitment(
+                shard_id, period, record.chunk_root, record.proposer)
+            if commitment is None:
+                return False
+            if seed is None:
+                seed = secrets.token_bytes(32)
+            k = self.das.samples if k is None else k
+            indices = sample_indices(
+                keccak256(seed + bytes(commitment.das_root)), k,
+                commitment.n)
+            got = self.das.fetch_samples(commitment, indices)
+            verdicts = []
+            for index in indices:
+                chunk, proof = got.get(index, (b"", ()))
+                verdicts.append(verify_sample(commitment.das_root,
+                                              index, chunk, proof))
+            self.samples_verified += sum(verdicts)
+            self.proofs_rejected += len(verdicts) - sum(verdicts)
+            self.das.note_verdicts(verdicts)
+        return bool(verdicts) and all(verdicts)

@@ -52,11 +52,27 @@ class Notary(Service):
                  all_shards: bool = True,
                  sig_backend: Optional[SigBackend] = None,
                  mirror=None,
-                 journal=None):
+                 journal=None,
+                 das=None,
+                 da_mode: str = "full"):
         super().__init__()
         self.client = client
         self.shard = shard
         self.p2p = p2p
+        # data-availability sampling (--da-mode=sampled + a DASService):
+        # the availability verdict comes from k sampled chunk proofs
+        # verified in ONE batched das_verify_samples dispatch across all
+        # candidate shards — the notary never fetches a collation body
+        self.das = das
+        self.da_mode = da_mode
+        # positive sampled verdicts are cached per (shard, period): a
+        # collation's chunks are immutable content, so once k samples
+        # verified, re-entering the head loop (or the windback walk)
+        # must NOT re-fetch k chunks — the acceptance bound is
+        # k·chunk_size + proof overhead PER COLLATION. Negative
+        # verdicts are never cached (late-arriving samples may still
+        # flip them). Bounded by pruning below _DA_CACHE_MAX.
+        self._da_verdicts: dict = {}
         # crash-safe vote journal (resilience/journal.VoteJournal): a
         # restarted notary recovers its submitted (shard, period) votes
         # and the audit high-water mark on on_start, so it neither
@@ -333,7 +349,14 @@ class Notary(Service):
                 for (shard_id, _, _), good in zip(signed, results):
                     sig_ok[shard_id] = good
 
-        # phase 3: availability checks + signed vote submission per shard
+        # phase 3: availability checks + signed vote submission per shard.
+        # In sampled mode the checks happen FIRST, for every candidate at
+        # once: k samples × all shards marshalled into ONE batched
+        # das_verify_samples dispatch (the samples × shards plane), so
+        # per-shard submit_vote reads a precomputed verdict instead of
+        # issuing its own dispatch-of-k
+        sampled_ok = (self._sampled_verdicts(candidates)
+                      if self._sampled() else None)
         with tracing.span("notary/vote", candidates=len(candidates)):
             for shard_id, p, record in candidates:
                 if record.signature and not sig_ok.get(shard_id, False):
@@ -343,8 +366,11 @@ class Notary(Service):
                         f"period {p}")
                     continue
                 with self.m_validate_latency.time():
-                    self.submit_vote(shard_id, p, record,
-                                     proposer_sig_checked=True)
+                    self.submit_vote(
+                        shard_id, p, record, proposer_sig_checked=True,
+                        availability=(None if sampled_ok is None
+                                      else sampled_ok.get(shard_id,
+                                                          False)))
 
     def _eligible_shards(self, shard_ids, snap=None) -> List[int]:
         """Committee eligibility for ALL shards from one sampling-context
@@ -387,7 +413,8 @@ class Notary(Service):
     # -- voting (notary.go:413 submitVote) ---------------------------------
 
     def submit_vote(self, shard_id: int, period: int, record,
-                    proposer_sig_checked: bool = False) -> bool:
+                    proposer_sig_checked: bool = False,
+                    availability: Optional[bool] = None) -> bool:
         registry = self.client.notary_registry()
         if registry is None or not registry.deposited:
             self.record_error("cannot vote: not a deposited notary")
@@ -428,10 +455,21 @@ class Notary(Service):
                     f"period {period}")
                 return False
 
-        # data-availability check against the local shardDB; fetch the body
-        # over shardp2p when missing (the reference's syncer round-trip)
+        # data-availability check: full mode checks the local shardDB and
+        # fetches the body over shardp2p when missing (the reference's
+        # syncer round-trip); sampled mode (--da-mode=sampled) verifies k
+        # sampled chunk proofs against the proposer's erasure-extension
+        # commitment instead — zero body bytes. The period flow passes a
+        # precomputed batched verdict via `availability`; direct callers
+        # compute their own here.
         with tracing.span("notary/verify", shard=shard_id):
-            if not self._check_availability(shard_id, period, record):
+            if availability is None:
+                availability = (
+                    self._check_sampled(shard_id, period, record)
+                    if self._sampled()
+                    else self._check_availability(shard_id, period,
+                                                  record))
+            if not availability:
                 self.record_error(
                     f"collation body unavailable for shard {shard_id} "
                     f"period {period}"
@@ -766,6 +804,90 @@ class Notary(Service):
             for got, rec in zip(recovered, records)
         ]
 
+    # -- data-availability sampling (--da-mode=sampled) --------------------
+
+    def _sampled(self) -> bool:
+        return self.da_mode == "sampled" and self.das is not None
+
+    def _sampled_verdicts(self, candidates) -> dict:
+        """Availability verdicts for many (shard, period, record) rows
+        from ONE batched `das_verify_samples` dispatch.
+
+        Per candidate: fetch the proposer's commitment + the notary's k
+        deterministic sampled (chunk, proof) rows over shardp2p
+        (das/service.collect_rows — retry + chaos seams inside), then
+        verify EVERY candidate's samples in a single sig-backend call
+        (with sigbackend 'jax': one keccak-lane dispatch over samples ×
+        shards). A shard is available iff its commitment resolved and
+        every one of its samples verified; missing samples were
+        synthesized as invalid rows, so they fail loudly rather than
+        shrink k."""
+        verdicts = {}
+        fresh = []
+        account = bytes(self.client.account())
+        for shard_id, period, record in candidates:
+            if self._da_verdicts.get((shard_id, period)):
+                verdicts[shard_id] = True  # immutable content: cached
+                continue
+            fresh.append((shard_id, period, record))
+        # fire every candidate's commitment request up front so the
+        # serial per-shard collect below mostly finds parked responses
+        # instead of paying a broadcast round trip per shard
+        if fresh:
+            self.das.prefetch_commitments(
+                [(shard_id, period) for shard_id, period, _ in fresh])
+        collected = []
+        for shard_id, period, record in fresh:
+            rows = self.das.collect_rows(shard_id, period, record,
+                                         account)
+            collected.append((shard_id, period, rows))
+        chunks, indices, proofs, roots = [], [], [], []
+        spans = {}
+        for shard_id, _, rows in collected:
+            if rows is None:
+                continue
+            start = len(chunks)
+            chunks.extend(rows["chunks"])
+            indices.extend(rows["indices"])
+            proofs.extend(rows["proofs"])
+            roots.extend(rows["roots"])
+            spans[shard_id] = (start, len(chunks))
+        ok: list = []
+        if chunks:
+            with tracing.span("notary/das_verify", rows=len(chunks),
+                              shards=len(spans)):
+                ok = self.sig_backend.das_verify_samples(
+                    chunks, indices, proofs, roots)
+        for shard_id, period, rows in collected:
+            if rows is None:
+                verdicts[shard_id] = False  # no commitment: unavailable
+                continue
+            start, end = spans[shard_id]
+            row_ok = ok[start:end]
+            self.das.note_verdicts(row_ok)
+            good = bool(row_ok) and all(row_ok)
+            verdicts[shard_id] = good
+            if good:
+                self._da_verdicts[(shard_id, period)] = True
+        if len(self._da_verdicts) > self._DA_CACHE_MAX:
+            # prune oldest periods first: closed periods stop being
+            # re-checked once the head loop moves on anyway
+            for key in sorted(self._da_verdicts,
+                              key=lambda sp: sp[1])[:len(self._da_verdicts)
+                                                    - self._DA_CACHE_MAX]:
+                del self._da_verdicts[key]
+        return verdicts
+
+    # one verdict per (shard, period): 100 shards x a 40-period horizon
+    # fits with room; entries are a bool each
+    _DA_CACHE_MAX = 4096
+
+    def _check_sampled(self, shard_id: int, period: int, record) -> bool:
+        """The single-shard sampled check (direct submit_vote callers;
+        the period flow batches across shards instead)."""
+        return self._sampled_verdicts(
+            [(shard_id, period, record)]).get(shard_id, False)
+
     def _check_windback(self, shard_id: int, period: int) -> bool:
         """Enforced windback: verify availability of the last
         `config.windback_depth` periods' collations on this shard chain
@@ -795,7 +917,12 @@ class Notary(Service):
             if record is None:
                 continue  # no collation that period: nothing to hold
             self.m_windback_checks.inc()
-            if not self._check_availability(shard_id, prior, record):
+            # sampled mode holds the windback by proof too: prior
+            # periods are re-sampled, never body-fetched
+            held = (self._check_sampled(shard_id, prior, record)
+                    if self._sampled()
+                    else self._check_availability(shard_id, prior, record))
+            if not held:
                 self.record_error(
                     f"windback: collation body unavailable for shard "
                     f"{shard_id} period {prior}; refusing to vote")
@@ -828,7 +955,12 @@ class Notary(Service):
         """Fire the body request for a not-yet-local collation NOW so
         the responding syncer's round trip runs concurrently with
         whatever this thread overlaps it with; `_check_availability`
-        remains the authoritative (polling) gate."""
+        remains the authoritative (polling) gate. In sampled DA mode
+        this is a no-op — the whole point is that NO body request ever
+        leaves a sampled notary (the sampled check fetches k
+        chunks+proofs in phase 3 instead)."""
+        if self._sampled():
+            return
         self._availability_probe(shard_id, period, record)
 
     def _check_availability(self, shard_id: int, period: int, record) -> bool:
@@ -870,6 +1002,11 @@ class Notary(Service):
         )
 
     def _set_canonical(self, shard_id: int, period: int, record) -> None:
+        if self._sampled():
+            # a sampled notary verified availability by proof — it holds
+            # no body, and the shardDB canonical index requires one.
+            # Body-holding nodes (proposer, observer) index canonical.
+            return
         header = self._reconstruct_header(shard_id, period, record)
         try:
             if self.shard.shard_id == shard_id:

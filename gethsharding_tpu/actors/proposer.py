@@ -57,14 +57,21 @@ class Proposer(Service):
 
     def __init__(self, client: SMCClient, txpool: TXPool, shard: Shard,
                  config: Config = DEFAULT_CONFIG,
-                 poll_interval: float = 0.05):
+                 poll_interval: float = 0.05,
+                 das=None):
         super().__init__()
         self.client = client
         self.txpool = txpool
         self.shard = shard
         self.config = config
         self.poll_interval = poll_interval
+        # data-availability sampling (gethsharding_tpu/das): when a
+        # DASService is attached, every created collation is erasure-
+        # extended and its parity chunks + signed commitment published,
+        # so sampled notaries can vote without fetching the body
+        self.das = das
         self.collations_proposed = 0
+        self.das_published = 0
         self._sub = None
 
     def on_start(self) -> None:
@@ -110,6 +117,19 @@ class Proposer(Service):
                 # persist locally regardless; only one header per
                 # (shard, period) can go on-chain (service.go:93)
                 self.shard.save_collation(collation)
+            if self.das is not None:
+                # extend + publish BEFORE addHeader: by the time the
+                # header is on-chain, sampled notaries can already pull
+                # the commitment and chunks. A publish failure (e.g. an
+                # injected das.parity_publish fault) must not lose the
+                # collation itself — full-fetch peers still serve it.
+                try:
+                    self.das.publish(collation.header.shard_id, period,
+                                     collation.header.chunk_root,
+                                     collation.body)
+                    self.das_published += 1
+                except Exception as exc:  # noqa: BLE001 - chaos seam
+                    self.record_error(f"das publish failed: {exc}")
             self.collations_proposed += 1
             self.log.info(
                 "Saved collation with header hash %s",

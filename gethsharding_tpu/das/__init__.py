@@ -1,0 +1,76 @@
+"""Data-availability sampling (DAS): erasure-coded collation bodies,
+sampled-chunk proofs, and the wiring that turns the notary's
+availability vote from a full-body download into k batched on-device
+proof checks.
+
+The phase-1 notary (the reference and our seed) votes availability by
+fetching the WHOLE collation body over shardp2p — availability is
+bandwidth-bound and the device never sees it. Following "Polynomial
+Multiproofs for Scalable Data Availability Sampling in Blockchain
+Light Clients" (PAPERS.md), this package replaces that workload shape:
+
+- ``erasure``  — systematic Reed–Solomon extension of bodies over
+  GF(2^8), chunk-aligned to the 4096-byte storage chunk so parity
+  chunks are ordinary netstore chunks (content-addressed through the
+  existing ``storage/chunker`` + ``storage/bmt`` key derivation), with
+  decode-from-any-k recovery;
+- ``sampler``  — seeded deterministic per-(notary, shard, period)
+  sample-index selection plus the soundness accounting (withholding-
+  detection probability as a function of k);
+- ``proofs``   — the DAS commitment (a binary merkle tree over the
+  extended blob's chunk keys), scalar sample-proof verification (the
+  differential reference), and the fixed-shape plane marshalling the
+  batched ``das_verify_samples`` sig-backend op dispatches through
+  ``sigbackend``/``serving``;
+- ``service``  — the actor-facing ``DASService``: proposers extend and
+  publish, notaries in ``--da-mode=sampled`` fetch only k
+  chunks+proofs (retry + chaos seams included), light clients sample
+  with scalar verification, and the ``shard_getSample`` /
+  ``shard_daStatus`` RPC surface serves from it.
+"""
+
+from gethsharding_tpu.das.erasure import (  # noqa: F401
+    DAS_CHUNK_SIZE,
+    ErasureError,
+    ExtendedBody,
+    MAX_TOTAL_CHUNKS,
+    extend_body,
+    recover_body,
+    rs_decode,
+    rs_encode,
+)
+from gethsharding_tpu.das.proofs import (  # noqa: F401
+    MAX_PROOF_DEPTH,
+    chunk_leaf,
+    merkle_levels,
+    merkle_proof,
+    merkle_root,
+    verify_sample,
+)
+from gethsharding_tpu.das.sampler import (  # noqa: F401
+    detection_probability,
+    sample_indices,
+    sample_seed,
+    soundness_table,
+)
+
+__all__ = [
+    "DAS_CHUNK_SIZE",
+    "ErasureError",
+    "ExtendedBody",
+    "MAX_PROOF_DEPTH",
+    "MAX_TOTAL_CHUNKS",
+    "chunk_leaf",
+    "detection_probability",
+    "extend_body",
+    "merkle_levels",
+    "merkle_proof",
+    "merkle_root",
+    "recover_body",
+    "rs_decode",
+    "rs_encode",
+    "sample_indices",
+    "sample_seed",
+    "soundness_table",
+    "verify_sample",
+]

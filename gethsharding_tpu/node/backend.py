@@ -52,9 +52,15 @@ class ShardNode:
                  http_port: Optional[int] = None,
                  serving: bool = False,
                  serving_config=None,
-                 chaos=None):
+                 chaos=None,
+                 da_mode: str = "full",
+                 da_samples: int = 16,
+                 da_parity: float = 0.5):
         if actor not in self.ACTORS:
             raise ValueError(f"unknown actor {actor!r}; pick from {self.ACTORS}")
+        if da_mode not in ("full", "sampled"):
+            raise ValueError(f"unknown da_mode {da_mode!r}; "
+                             "pick 'full' or 'sampled'")
         self.actor = actor
         self.shard_id = shard_id
         self.config = config
@@ -151,13 +157,38 @@ class ShardNode:
         self._register_factory(
             lambda: StateMirror(client=client, shard_db=shard_db.db))
 
+        # data-availability sampling plane (--da-mode=sampled): a
+        # NetStore (body-holding actors only — parity chunks are
+        # ordinary content-addressed chunks peers can pull) plus the
+        # DASService every actor shares: proposers publish extended
+        # bodies through it, sampled notaries fetch k chunks+proofs,
+        # light clients das_check. Registered BEFORE the actors so the
+        # factories can close over it.
+        self.da_mode = da_mode
+        self.das_service = None
+        das = None
+        if da_mode == "sampled":
+            from gethsharding_tpu.das.service import DASService
+            from gethsharding_tpu.storage.netstore import NetStore
+
+            store = None
+            if actor != "light":
+                netstore = NetStore(p2p=p2p)
+                self._register(netstore)
+                store = netstore.store
+            das = DASService(client=client, p2p=p2p, store=store,
+                             parity_ratio=da_parity, samples=da_samples,
+                             chaos=chaos)
+            self._register(das)
+            self.das_service = das
+
         if actor == "proposer":
             txpool = TXPool(simulate_interval=txpool_interval,
                             sig_backend=self._sig_backend_obj)
             self._register(txpool)
             self._register_factory(
                 lambda: Proposer(client=client, txpool=txpool,
-                                 shard=shard, config=config))
+                                 shard=shard, config=config, das=das))
         elif actor == "notary":
             # crash-safe vote journal through the node's OWN shard KV
             # (a --datadir node gets SQLite durability for free); the
@@ -173,14 +204,15 @@ class ShardNode:
                                config=config, deposit_flag=deposit,
                                sig_backend=node_sig_backend(),
                                mirror=self.service(StateMirror),
-                               journal=journal))
+                               journal=journal,
+                               das=das, da_mode=da_mode))
         elif actor == "light":
             # the les/light role: no shard data, SMC-anchored proof-
             # verified sampling over shardp2p (actors/light.py)
             from gethsharding_tpu.actors.light import LightClient
 
             self._register_factory(
-                lambda: LightClient(client=client, p2p=p2p))
+                lambda: LightClient(client=client, p2p=p2p, das=das))
         else:
             self._register_factory(
                 lambda: Observer(client=client, shard=shard,

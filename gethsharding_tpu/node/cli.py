@@ -91,6 +91,26 @@ def build_parser() -> argparse.ArgumentParser:
                                "thread longer than this fails its batch "
                                "with DeadlineExceeded and the dispatcher "
                                "restarts (0 = off)")
+    sharding.add_argument("--da-mode", default="full",
+                          choices=("full", "sampled"),
+                          help="data-availability mode: 'full' fetches "
+                               "whole collation bodies before voting "
+                               "(the reference behavior); 'sampled' "
+                               "erasure-extends bodies (proposer) and "
+                               "votes on k sampled chunk proofs "
+                               "verified in one batched device "
+                               "dispatch (notary) — zero body bytes "
+                               "(gethsharding_tpu/das/)")
+    sharding.add_argument("--da-samples", type=int, default=16,
+                          help="sampled DA: chunks sampled per "
+                               "(shard, period) availability check "
+                               "(the k of the soundness table in "
+                               "README 'Data availability sampling')")
+    sharding.add_argument("--da-parity", type=float, default=0.5,
+                          help="sampled DA: parity chunks as a ratio "
+                               "of data chunks in the Reed-Solomon "
+                               "extension (0.5 = body recoverable "
+                               "from any 2/3 of the extended chunks)")
     sharding.add_argument("--chaos", default="",
                           metavar="SPEC",
                           help="deterministic chaos schedule, e.g. "
@@ -374,12 +394,16 @@ def run_sharding_node(args) -> int:
         from gethsharding_tpu.resilience import chaos as chaos_mod
 
         chaos_schedule = chaos_mod.parse_spec(args.chaos)
-        for seam in chaos_mod.unwired_seams(
-                chaos_schedule, ("mainchain", "backend", "dispatch")):
+        # the das.* seams (sample fetch, commitment fetch, parity
+        # publish) only exist on a node running the sampled DA plane
+        wired = ("mainchain", "backend", "dispatch")
+        if args.da_mode == "sampled":
+            wired = wired + ("das",)
+        for seam in chaos_mod.unwired_seams(chaos_schedule, wired):
             logging.getLogger("sharding.node").warning(
                 "chaos rule %r targets a seam this node never wraps "
-                "(wired: mainchain.*, backend.*, dispatch.*) — it will "
-                "inject nothing", seam)
+                "(wired: %s) — it will inject nothing", seam,
+                ", ".join(f"{w}.*" for w in wired))
         if any(seam == "mainchain" or seam.startswith("mainchain.")
                for seam in chaos_schedule.rules):
             # mainchain-call seam: the fault proxy fronts the chain
@@ -413,6 +437,9 @@ def run_sharding_node(args) -> int:
         serving=args.serving,
         serving_config=serving_config,
         chaos=chaos_schedule,
+        da_mode=args.da_mode,
+        da_samples=args.da_samples,
+        da_parity=args.da_parity,
     )
     if hub is not None:
         # the node's public identity in the relay's peer table

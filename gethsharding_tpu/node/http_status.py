@@ -91,6 +91,12 @@ class StatusServer(Service):
                       if name.startswith("resilience/")}
         if resilience:
             payload["resilience"] = resilience
+        # the DAS plane at a glance (--da-mode=sampled): published
+        # blobs, samples served/fetched/verified, failures, wire bytes
+        das = {name: snap for name, snap in snapshot.items()
+               if name.startswith("das/")}
+        if das:
+            payload["das"] = das
         return payload
 
     def metrics_payload(self) -> dict:

@@ -51,3 +51,52 @@ class ChunkProofResponse:
     index: int
     proof: tuple  # tuple[bytes, ...]
     body_len: int = 0
+
+
+# -- data-availability sampling (gethsharding_tpu/das) ----------------------
+
+
+@dataclass(frozen=True)
+class DASCommitmentRequest:
+    """Who holds the DAS commitment for this (shard, period)?"""
+
+    shard_id: int
+    period: int
+
+
+@dataclass(frozen=True)
+class DASCommitmentResponse:
+    """The proposer's erasure-extension commitment: the DAS merkle
+    root over the extended blob's netstore chunk keys, the code shape
+    (k data of n total chunks), the exact body length, and the
+    proposer's signature binding all of it to the on-chain chunk_root
+    (das/service.commitment_digest)."""
+
+    shard_id: int
+    period: int
+    chunk_root: Hash32
+    das_root: bytes
+    k: int
+    n: int
+    body_len: int
+    signature: bytes = b""
+
+
+@dataclass(frozen=True)
+class DASampleRequest:
+    """Sampled-chunk pull: the requester wants chunks `indices` of the
+    blob committed at `das_root`, each with its inclusion proof."""
+
+    das_root: bytes
+    indices: tuple  # tuple[int, ...]
+
+
+@dataclass(frozen=True)
+class DASampleResponse:
+    """One sampled chunk + its sibling path to `das_root` — the unit a
+    notary feeds the batched `das_verify_samples` dispatch."""
+
+    das_root: bytes
+    index: int
+    chunk: bytes
+    proof: tuple  # tuple[bytes, ...]

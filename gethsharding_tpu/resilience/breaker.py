@@ -404,6 +404,10 @@ class FailoverSigBackend(SigBackend):
         return self._call("bls_verify_committees", messages, sig_rows,
                           pk_rows, pk_row_keys=pk_row_keys)
 
+    def das_verify_samples(self, chunks, indices, proofs, roots):
+        return self._call("das_verify_samples", chunks, indices, proofs,
+                          roots)
+
     def bls_verify_committees_async(self, messages, sig_rows, pk_rows,
                                     pk_row_keys=None):
         """The overlapped-audit face: primary-routed submits stay

@@ -225,6 +225,10 @@ class ChaosSigBackend(SigBackend):
         return self._op("bls_verify_committees", messages, sig_rows,
                         pk_rows, pk_row_keys=pk_row_keys)
 
+    def das_verify_samples(self, chunks, indices, proofs, roots):
+        return self._op("das_verify_samples", chunks, indices, proofs,
+                        roots)
+
     def bls_verify_committees_async(self, messages, sig_rows, pk_rows,
                                     pk_row_keys=None):
         # fire at submit time: a fault lands where the real device

@@ -141,6 +141,34 @@ def enc_p2p(data) -> tuple:
             "proof": [enc_bytes(node) for node in data.proof],
             "bodyLen": data.body_len,
         }
+    if isinstance(data, m.DASCommitmentRequest):
+        return "DASCommitmentRequest", {
+            "shardId": data.shard_id,
+            "period": data.period,
+        }
+    if isinstance(data, m.DASCommitmentResponse):
+        return "DASCommitmentResponse", {
+            "shardId": data.shard_id,
+            "period": data.period,
+            "chunkRoot": enc_bytes(data.chunk_root),
+            "dasRoot": enc_bytes(data.das_root),
+            "k": data.k,
+            "n": data.n,
+            "bodyLen": data.body_len,
+            "signature": enc_bytes(data.signature),
+        }
+    if isinstance(data, m.DASampleRequest):
+        return "DASampleRequest", {
+            "dasRoot": enc_bytes(data.das_root),
+            "indices": list(data.indices),
+        }
+    if isinstance(data, m.DASampleResponse):
+        return "DASampleResponse", {
+            "dasRoot": enc_bytes(data.das_root),
+            "index": data.index,
+            "chunk": enc_bytes(data.chunk),
+            "proof": [enc_bytes(node) for node in data.proof],
+        }
     from gethsharding_tpu.p2p.whisper import Envelope
 
     if isinstance(data, Envelope):
@@ -216,6 +244,34 @@ def dec_p2p(kind: str, payload: dict):
             index=payload["index"],
             proof=tuple(dec_bytes(node) for node in payload["proof"]),
             body_len=payload.get("bodyLen", 0),
+        )
+    if kind == "DASCommitmentRequest":
+        return m.DASCommitmentRequest(
+            shard_id=int(payload["shardId"]),
+            period=int(payload["period"]),
+        )
+    if kind == "DASCommitmentResponse":
+        return m.DASCommitmentResponse(
+            shard_id=int(payload["shardId"]),
+            period=int(payload["period"]),
+            chunk_root=Hash32(dec_bytes(payload["chunkRoot"])),
+            das_root=dec_bytes(payload["dasRoot"]),
+            k=int(payload["k"]),
+            n=int(payload["n"]),
+            body_len=int(payload["bodyLen"]),
+            signature=dec_bytes(payload["signature"]),
+        )
+    if kind == "DASampleRequest":
+        return m.DASampleRequest(
+            das_root=dec_bytes(payload["dasRoot"]),
+            indices=tuple(int(i) for i in payload["indices"]),
+        )
+    if kind == "DASampleResponse":
+        return m.DASampleResponse(
+            das_root=dec_bytes(payload["dasRoot"]),
+            index=int(payload["index"]),
+            chunk=dec_bytes(payload["chunk"]),
+            proof=tuple(dec_bytes(node) for node in payload["proof"]),
         )
     if kind == "WhisperEnvelope":
         from gethsharding_tpu.p2p.whisper import Envelope

@@ -44,8 +44,13 @@ class RPCServer:
 
     def __init__(self, backend: SimulatedMainchain,
                  host: str = "127.0.0.1", port: int = 0,
-                 sig_backend=None):
+                 sig_backend=None, das=None):
         self.backend = backend
+        # data-availability sampling provider (a das.service.DASService,
+        # or anything with get_sample/da_status): backs the light-client
+        # sample surface `shard_getSample` / `shard_daStatus`. None =
+        # this process holds no blobs; the methods answer "unknown".
+        self._das = das
         self._subscribers: dict = {}  # wfile -> (lock, peer id)
         self._sub_lock = threading.Lock()
         # verification serving seam: handler threads SUBMIT signature
@@ -369,6 +374,58 @@ class RPCServer:
             return None
         return {"dispatches": dict(serving.batcher.dispatch_counts),
                 "shed": serving.batcher.shed_counts()}
+
+    # -- data-availability sampling (the light-client sample surface) ------
+
+    def rpc_getSample(self, shard_id, period, indices):
+        """Sampled chunks + inclusion proofs for (shard, period) from
+        this process's DAS provider — the RPC (light-client) face of
+        the shardp2p DASampleRequest flow: a client that can reach no
+        sampling peers still gets proof-carrying samples it verifies
+        locally against the returned commitment. None when no provider
+        holds the blob."""
+        if self._das is None:
+            return None
+        from gethsharding_tpu.das.service import MAX_SAMPLE_INDICES
+
+        status = self._das.da_status(int(shard_id), int(period))
+        if not status.get("known"):
+            return None
+        samples = []
+        # same per-request cap as the p2p serving side
+        for index in list(indices)[:MAX_SAMPLE_INDICES]:
+            sample = self._das.get_sample(int(shard_id), int(period),
+                                          int(index))
+            if sample is None:
+                continue
+            samples.append({
+                "index": sample["index"],
+                "chunk": codec.enc_bytes(sample["chunk"]),
+                "proof": [codec.enc_bytes(node)
+                          for node in sample["proof"]],
+            })
+        commitment = self._das.commitment(int(shard_id), int(period))
+        return {
+            "dasRoot": codec.enc_bytes(commitment.das_root),
+            "chunkRoot": codec.enc_bytes(commitment.chunk_root),
+            "k": commitment.k,
+            "n": commitment.n,
+            "bodyLen": commitment.body_len,
+            "signature": codec.enc_bytes(commitment.signature),
+            "samples": samples,
+        }
+
+    def rpc_daStatus(self, shard_id, period):
+        """Is a DAS commitment known for (shard, period), and what
+        shape is the erasure extension? `known: false` with
+        `provider: false` means this process runs no DAS plane at
+        all."""
+        if self._das is None:
+            return {"known": False, "provider": False,
+                    "shard_id": int(shard_id), "period": int(period)}
+        status = self._das.da_status(int(shard_id), int(period))
+        status["provider"] = True
+        return status
 
     # transactions
 

@@ -140,6 +140,13 @@ class ServingSigBackend(SigBackend):
                                        sig_rows, pk_rows,
                                        pk_row_keys=pk_row_keys))
 
+    def das_verify_samples(self, chunks, indices, proofs, roots):
+        """The DAS sample-verdict op over the coalescing tier: many
+        notaries'/RPC handlers' k-sample batches share one samples ×
+        shards keccak dispatch."""
+        return self._await(self.submit("das_verify_samples", chunks,
+                                       indices, proofs, roots))
+
     def bls_verify_committees_async(self, messages, sig_rows, pk_rows,
                                     pk_row_keys=None):
         """The overlapped-notary face over the serving tier: the

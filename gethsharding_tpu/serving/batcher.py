@@ -55,13 +55,14 @@ from gethsharding_tpu.serving.queue import (
 
 # the SigBackend batch API surface the serving tier coalesces
 SERVING_OPS = ("ecrecover_addresses", "bls_verify_aggregates",
-               "bls_verify_committees")
+               "bls_verify_committees", "das_verify_samples")
 
 # registry-friendly short labels
 _OP_LABELS = {
     "ecrecover_addresses": "ecrecover",
     "bls_verify_aggregates": "bls_aggregate",
     "bls_verify_committees": "bls_committee",
+    "das_verify_samples": "das_verify",
 }
 
 # batch-row histogram buckets: the quarter-pow2 ladder the backend pads

@@ -147,6 +147,23 @@ class SigBackend:
         future.result()  # scalar path: already computed; mark resolved
         return future
 
+    def das_verify_samples(
+            self,
+            chunks: Sequence[bytes],
+            indices: Sequence[int],
+            proofs: Sequence[Sequence[bytes]],
+            roots: Sequence[bytes]) -> List[bool]:
+        """Verify one DAS sample per row: does `chunks[i]` sit at leaf
+        `indices[i]` of the commitment tree rooted at `roots[i]`, per
+        the sibling path `proofs[i]`? (das/proofs.py defines the leaf
+        as the chunk's netstore address, so the per-row work is a full
+        BMT recompute + path fold — keccak lanes.) Malformed rows
+        (wrong chunk size, bad index, over-deep or ragged proofs) are
+        False, never an exception: a hostile sample response must cost
+        a verdict, not a batch. The jax backend runs the whole batch as
+        ONE fixed-shape keccak dispatch over samples × shards."""
+        raise NotImplementedError
+
 
 class PythonSigBackend(SigBackend):
     """Scalar host crypto — parity baseline."""
@@ -176,6 +193,13 @@ class PythonSigBackend(SigBackend):
                 bytes(m), bls.bls_aggregate_sigs(sigs), list(pks))
             for m, sigs, pks in zip(messages, sig_rows, pk_rows)
         ]
+
+    def das_verify_samples(self, chunks, indices, proofs, roots):
+        # lazy import: the das package is optional workload surface,
+        # not a dependency of every scalar control plane
+        from gethsharding_tpu.das.proofs import verify_samples
+
+        return verify_samples(chunks, indices, proofs, roots)
 
 
 class JaxSigBackend(SigBackend):
@@ -368,6 +392,50 @@ class JaxSigBackend(SigBackend):
         pull."""
         return self._committee_submit(messages, sig_rows, pk_rows,
                                       pk_row_keys)
+
+    def das_verify_samples(self, chunks, indices, proofs, roots):
+        """One batched keccak dispatch for the whole sample batch: BMT
+        recompute of every chunk (128 leaf lanes + 7 pair levels) +
+        path fold, `vmap`-shaped over samples × shards. Verdicts are
+        bit-identical to the scalar reference because every malformed-
+        row rejection is folded into the `valid` plane at marshal time
+        (das/proofs.marshal_samples)."""
+        import numpy as np
+
+        from gethsharding_tpu.das import proofs as das_proofs
+
+        jnp = self._jnp
+        n = len(chunks)
+        if n == 0:
+            self.last_wire = None
+            return []
+        bucket = self._bucket(n)
+        fresh = self._note_shape("das_verify", bucket)
+        st = das_proofs.marshal_samples(chunks, indices, proofs, roots,
+                                        bucket)
+        planes = (st["chunks"], st["sibs"], st["bits"], st["levels"],
+                  st["roots"], st["valid"])
+        sample_bytes = sum(int(p.nbytes) for p in planes)
+        # the per-dispatch wire ledger (same contract as the committee
+        # path: pure nbytes arithmetic, no device sync) — the sample
+        # planes ARE this dispatch's host->device bytes
+        self.last_wire = {"op": "das_verify_samples",
+                          "wire_bytes": sample_bytes,
+                          "sample_wire_bytes": sample_bytes,
+                          "rows": n, "bucket": bucket, "wire": self._wire}
+        self._m_wire_bytes.inc(sample_bytes)
+        tracing.tag_current_add(wire_bytes=sample_bytes,
+                                sample_wire_bytes=sample_bytes)
+        tracer = tracing.TRACER
+        t0 = time.monotonic() if tracer.enabled else 0.0
+        out = das_proofs.batch_verifier()(*(jnp.asarray(p) for p in planes))
+        res = [bool(b) for b in np.asarray(out)[:n]]
+        if tracer.enabled:
+            tracer.record("jax/das_verify_dispatch", t0, time.monotonic(),
+                          tags={"rows": n, "bucket": bucket,
+                                "compile": "miss" if fresh else "hit",
+                                "sample_wire_bytes": sample_bytes})
+        return res
 
     # -- the staged committee path -----------------------------------------
     # marshal (host limbs + cache resolution) -> transfer (host->device)

@@ -75,6 +75,31 @@ assert jx.last_wire["g2_wire_bytes"] == 0, jx.last_wire  # warm = resident
 print("resident/overlap smoke OK:", jx.last_wire)
 PYEOF
 
+# -- DAS smoke: erasure-extend a body, publish, sampled-vote end-to-end
+# on hermetic CPU — batched das_verify_samples must agree with the
+# scalar reference bit-for-bit, the sampled notary must vote with ZERO
+# body fetches inside the k-sample byte budget, and the das counters
+# must reach the Prometheus exposition
+echo "== DAS smoke"
+JAX_PLATFORMS=cpu GETHSHARDING_BENCH_DAS_BODY=65536 \
+GETHSHARDING_BENCH_DAS_PERIODS=2 GETHSHARDING_BENCH_DAS_ROWS=32 \
+    python bench.py --das >/tmp/_das_smoke.json || fail=1
+grep -q '"votes": 2' /tmp/_das_smoke.json || {
+    echo "DAS smoke FAILED: sampled notary did not vote every period"
+    cat /tmp/_das_smoke.json; fail=1; }
+JAX_PLATFORMS=cpu python - <<'PYEOF' || fail=1
+from gethsharding_tpu import metrics
+from gethsharding_tpu.metrics import prometheus_text
+
+metrics.counter("das/samples_verified").inc(3)
+metrics.counter("das/sample_failures").inc(0)
+text = prometheus_text()
+for needle in ("gethsharding_das_samples_verified_total",
+               "gethsharding_das_sample_failures_total"):
+    assert needle in text, needle
+print("DAS prometheus exposition OK")
+PYEOF
+
 # -- chaos/failover smoke: a devnet-style notary rides a seeded failure
 # schedule end-to-end — injected device faults mid-audit must trip the
 # breaker, every period's votes must land on the scalar fallback, the

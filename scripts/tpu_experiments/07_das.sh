@@ -1,0 +1,15 @@
+#!/bin/bash
+# Data-availability sampling on the real chip: the sampled-notary
+# acceptance run (zero body fetches, bytes within the k-sample budget)
+# plus batched das_verify_samples throughput — the keccak-lane dispatch
+# (BMT recompute + path fold over samples x shards) that is
+# emulation-bound on hermetic CPU and only shows its real rows/sec on
+# the TPU VPU. Success: the acceptance asserts held (bench exits 0,
+# votes == periods) AND the metric line reports a tpu platform.
+cd /root/repo || exit 1
+env GETHSHARDING_BENCH_DAS_BODY=1048576 \
+    GETHSHARDING_BENCH_DAS_SAMPLES=16 \
+    GETHSHARDING_BENCH_DAS_ROWS=512 \
+  timeout 4800 python bench.py --das >"$1.out" 2>"$1.err"
+grep -q '"platform": "tpu' "$1.out" \
+  && grep -q '"metric": "das_sampled_bytes_per_collation"' "$1.out"

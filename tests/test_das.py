@@ -1,0 +1,716 @@
+"""Data-availability sampling: erasure code, proofs, batched op, wiring.
+
+The acceptance contracts under test:
+
+- RS encode -> drop ANY n-k chunks -> decode reproduces the body;
+- batched `das_verify_samples` agrees bit-for-bit with the scalar
+  python reference across randomized bodies, withheld chunks, and
+  corrupted proofs — including through the serving and failover
+  backends;
+- a notary in sampled DA mode reaches availability votes with ZERO
+  full-body fetches, within the k·chunk_size + proof-overhead byte
+  budget per collation, and REFUSES to vote when a sampled chunk is
+  corrupted;
+- the das.* chaos seams inject (and the retry ladder absorbs) faults,
+  and a spec naming them on a node that never wired them is reported
+  by `unwired_seams`;
+- the `shard_getSample` / `shard_daStatus` RPC surface serves
+  proof-carrying samples a light client can verify locally.
+"""
+
+import itertools
+import os
+import random
+
+import pytest
+
+from gethsharding_tpu.das import erasure, proofs, sampler
+from gethsharding_tpu.das.erasure import (DAS_CHUNK_SIZE, ErasureError,
+                                          extend_body, recover_body,
+                                          rs_decode, rs_encode)
+from gethsharding_tpu.das.proofs import (MAX_PROOF_DEPTH, chunk_leaf,
+                                         merkle_levels, merkle_proof,
+                                         verify_sample, verify_samples)
+from gethsharding_tpu.das.service import (DASService, commitment_digest,
+                                          verify_commitment)
+from gethsharding_tpu.sigbackend import get_backend
+
+
+# -- the erasure code ------------------------------------------------------
+
+
+def test_gf_tables_roundtrip():
+    for a in range(1, 256):
+        assert erasure.gf_mul(a, erasure.gf_inv(a)) == 1
+    assert erasure.gf_mul(0, 77) == 0
+    assert erasure.gf_mul(77, 1) == 77
+    with pytest.raises(ZeroDivisionError):
+        erasure.gf_inv(0)
+
+
+@pytest.mark.parametrize("k,m", [(1, 1), (2, 1), (3, 2), (4, 4)])
+def test_rs_any_k_of_n_roundtrip(k, m):
+    """Drop EVERY possible n-k subset; any k survivors reconstruct."""
+    rng = random.Random(k * 100 + m)
+    chunks = [bytes(rng.randrange(256) for _ in range(48))
+              for _ in range(k)]
+    ext = rs_encode(chunks, m)
+    assert ext[:k] == chunks  # systematic
+    n = k + m
+    for drop in itertools.combinations(range(n), m):
+        shares = {i: ext[i] for i in range(n) if i not in drop}
+        assert rs_decode(shares, k, n) == chunks, drop
+
+
+def test_rs_too_few_shares_is_an_error():
+    ext = rs_encode([b"\x01" * 16, b"\x02" * 16], 2)
+    with pytest.raises(ErasureError):
+        rs_decode({0: ext[0]}, 2, 4)
+
+
+@pytest.mark.parametrize("size", [
+    0, 1, DAS_CHUNK_SIZE - 1, DAS_CHUNK_SIZE, DAS_CHUNK_SIZE + 1,
+    3 * DAS_CHUNK_SIZE + 117,
+])
+def test_extend_recover_body_roundtrip(size):
+    body = os.urandom(size)
+    xb = extend_body(body)
+    assert xb.n > xb.k >= 1
+    assert all(len(c) == DAS_CHUNK_SIZE for c in xb.chunks)
+    # drop the maximum survivable set: n - k arbitrary chunks
+    rng = random.Random(size)
+    keep = sorted(rng.sample(range(xb.n), xb.k))
+    shares = {i: xb.chunks[i] for i in keep}
+    assert recover_body(shares, xb.k, xb.n, xb.body_len) == body
+
+
+def test_extend_body_caps_total_chunks():
+    with pytest.raises(ErasureError):
+        extend_body(b"\x00" * (200 * DAS_CHUNK_SIZE), parity_ratio=0.5)
+
+
+# -- the sampler + soundness accounting ------------------------------------
+
+
+def test_sampler_is_deterministic_distinct_and_in_range():
+    seed = sampler.sample_seed(b"\xaa" * 20, 5, 17, b"\x01" * 32)
+    got = sampler.sample_indices(seed, 16, 96)
+    assert got == sampler.sample_indices(seed, 16, 96)
+    assert len(got) == 16 == len(set(got))
+    assert all(0 <= i < 96 for i in got)
+    # a different notary samples a different set
+    other = sampler.sample_indices(
+        sampler.sample_seed(b"\xbb" * 20, 5, 17, b"\x01" * 32), 16, 96)
+    assert got != other
+    # degenerate shapes
+    assert sampler.sample_indices(seed, 99, 7) == list(range(7))
+    assert sampler.sample_indices(seed, 4, 0) == []
+
+
+def test_detection_probability_accounting():
+    # n=4, k_data=2: the minimal adversary withholds 3, leaving 1
+    # available; one sample misses with 1/4 -> detects with 3/4
+    assert abs(sampler.detection_probability(1, 4, 2) - 0.75) < 1e-12
+    # monotone in k and in checkers
+    p8 = sampler.detection_probability(8, 96, 64)
+    p16 = sampler.detection_probability(16, 96, 64)
+    assert p16 > p8
+    committee = sampler.detection_probability(8, 96, 64, checkers=5)
+    assert committee > p8
+    rows = sampler.soundness_table(96, 64, ks=(4, 8), checkers=3)
+    assert rows[0]["k"] == 4 and "p_detect_committee" in rows[0]
+    with pytest.raises(ValueError):
+        sampler.detection_probability(4, 0, 0)
+
+
+# -- scalar proofs ---------------------------------------------------------
+
+
+def _committed_blob(size=30000, seed=7):
+    rng = random.Random(seed)
+    body = bytes(rng.randrange(256) for _ in range(size))
+    xb = extend_body(body)
+    levels = merkle_levels([chunk_leaf(c) for c in xb.chunks])
+    return body, xb, levels, levels[-1][0]
+
+
+def test_scalar_sample_proofs_roundtrip_and_reject():
+    _, xb, levels, root = _committed_blob()
+    for i in range(xb.n):
+        proof = merkle_proof(levels, i)
+        assert len(proof) <= MAX_PROOF_DEPTH
+        assert verify_sample(root, i, xb.chunks[i], proof)
+        # tampered chunk, wrong index, truncated proof: all fail
+        bad = bytes([xb.chunks[i][0] ^ 1]) + xb.chunks[i][1:]
+        assert not verify_sample(root, i, bad, proof)
+        assert not verify_sample(root, (i + 1) % xb.n, xb.chunks[i],
+                                 proof)
+        if proof:
+            assert not verify_sample(root, i, xb.chunks[i], proof[:-1])
+    # malformed rows are verdicts, never exceptions
+    proof0 = merkle_proof(levels, 0)
+    assert not verify_sample(root, 0, xb.chunks[0][:-1], proof0)
+    assert not verify_sample(root, -1, xb.chunks[0], proof0)
+    assert not verify_sample(root, 0, xb.chunks[0],
+                             (b"\x00" * 31,) + proof0[1:])
+    assert not verify_sample(root, 0, xb.chunks[0],
+                             proof0 + (b"\x00" * 32,) * MAX_PROOF_DEPTH)
+    assert not verify_sample(b"\x01" * 32, 0, xb.chunks[0], proof0)
+    assert not verify_sample(root, "zero", xb.chunks[0], proof0)
+
+
+def test_single_chunk_tree_has_empty_proof():
+    xb = extend_body(b"tiny", parity_ratio=0.01)  # k=1, parity>=1 -> n=2
+    levels = merkle_levels([chunk_leaf(c) for c in xb.chunks])
+    root = levels[-1][0]
+    proof = merkle_proof(levels, 0)
+    assert len(proof) == 1  # n=2 -> depth-1 tree
+    assert verify_sample(root, 0, xb.chunks[0], proof)
+
+
+# -- the batched op, through every backend layer ---------------------------
+
+
+def _sample_rows(with_faults=True, seed=13):
+    """(chunks, indices, proofs, roots) rows: valid samples from two
+    distinct blobs, plus (optionally) every malformed-row class."""
+    rng = random.Random(seed)
+    rows = []
+    for blob_seed in (seed, seed + 1):
+        _, xb, levels, root = _committed_blob(
+            size=9000 + 7000 * (blob_seed % 2), seed=blob_seed)
+        for i in rng.sample(range(xb.n), min(4, xb.n)):
+            rows.append((xb.chunks[i], i, merkle_proof(levels, i), root))
+    if with_faults:
+        _, xb, levels, root = _committed_blob(seed=seed + 2)
+        good = merkle_proof(levels, 1)
+        tampered = bytes([xb.chunks[1][0] ^ 0xFF]) + xb.chunks[1][1:]
+        rows += [
+            (tampered, 1, good, root),                       # corrupted
+            (b"", 1, (), root),                              # withheld
+            (xb.chunks[1], 1, good[:-1], root),              # truncated
+            (xb.chunks[1], 1, (b"\x00" * 31,) + good[1:], root),  # ragged
+            (xb.chunks[1], 1,
+             good + (b"\x00" * 32,) * MAX_PROOF_DEPTH, root),  # too deep
+            (xb.chunks[1], 2, good, root),                   # wrong index
+            (xb.chunks[1], 1 << 20, good, root),             # out of tree
+            (xb.chunks[1], 1, good, b"\x02" * 32),           # wrong root
+        ]
+    return tuple(map(list, zip(*rows)))
+
+
+def test_das_verify_samples_scalar_vs_jax_bit_for_bit():
+    chunks, indices, prfs, roots = _sample_rows()
+    want = get_backend("python").das_verify_samples(
+        chunks, indices, prfs, roots)
+    assert want.count(False) == 8 and want.count(True) == 8
+    jax_backend = get_backend("jax")
+    got = jax_backend.das_verify_samples(chunks, indices, prfs, roots)
+    assert got == want
+    # the per-dispatch wire ledger records the sample plane bytes
+    ledger = jax_backend.last_wire
+    assert ledger["op"] == "das_verify_samples"
+    assert ledger["sample_wire_bytes"] == ledger["wire_bytes"] > 0
+    assert ledger["rows"] == len(chunks)
+    # empty batch: no dispatch, clean ledger
+    assert jax_backend.das_verify_samples([], [], [], []) == []
+    assert jax_backend.last_wire is None
+
+
+def test_das_verify_samples_through_serving_and_failover():
+    from gethsharding_tpu.resilience.breaker import FailoverSigBackend
+    from gethsharding_tpu.serving import ServingSigBackend
+    from gethsharding_tpu.serving.batcher import SERVING_OPS
+
+    assert "das_verify_samples" in SERVING_OPS
+    chunks, indices, prfs, roots = _sample_rows()
+    want = get_backend("python").das_verify_samples(
+        chunks, indices, prfs, roots)
+    serving = ServingSigBackend(get_backend("jax"))
+    try:
+        assert serving.das_verify_samples(chunks, indices, prfs,
+                                          roots) == want
+        assert serving.batcher.dispatch_counts["das_verify_samples"] == 1
+    finally:
+        serving.close()
+    failover = FailoverSigBackend(get_backend("jax"),
+                                  get_backend("python"))
+    assert failover.das_verify_samples(chunks, indices, prfs,
+                                       roots) == want
+
+
+def test_das_verify_samples_failover_rides_through_faults():
+    """An injected das_verify_samples device fault is served from the
+    scalar fallback with identical verdicts."""
+    from gethsharding_tpu.metrics import Registry
+    from gethsharding_tpu.resilience.breaker import (CircuitBreaker,
+                                                     FailoverSigBackend)
+    from gethsharding_tpu.resilience.chaos import (ChaosSchedule,
+                                                   ChaosSigBackend)
+
+    chunks, indices, prfs, roots = _sample_rows()
+    want = get_backend("python").das_verify_samples(
+        chunks, indices, prfs, roots)
+    schedule = ChaosSchedule(
+        seed=3, rules={"backend.das_verify_samples": 2})
+    registry = Registry()
+    backend = FailoverSigBackend(
+        ChaosSigBackend(get_backend("python"), schedule),
+        get_backend("python"),
+        breaker=CircuitBreaker(name="das-test", fault_threshold=1,
+                               reset_s=0.001, registry=registry),
+        registry=registry)
+    for _ in range(4):  # fault, open, probe, re-closed
+        assert backend.das_verify_samples(chunks, indices, prfs,
+                                          roots) == want
+    assert schedule.injected["backend.das_verify_samples"] >= 1
+
+
+# -- the service: publish / serve / fetch over shardp2p --------------------
+
+
+def _service_pair(samples=6, **kwargs):
+    from gethsharding_tpu.mainchain.client import SMCClient
+    from gethsharding_tpu.p2p.service import Hub, P2PServer
+    from gethsharding_tpu.params import Config
+    from gethsharding_tpu.smc.chain import SimulatedMainchain
+
+    config = Config()
+    chain = SimulatedMainchain(config=config)
+    hub = Hub()
+    out = []
+    for _ in range(2):
+        client = SMCClient(backend=chain, config=config)
+        svc = DASService(client=client, p2p=P2PServer(hub),
+                         samples=samples, fetch_timeout=1.0,
+                         fetch_attempts=2, **kwargs)
+        svc.start()
+        out.append((client, svc))
+    return chain, out
+
+
+class _Record:
+    def __init__(self, chunk_root, proposer):
+        self.chunk_root = chunk_root
+        self.proposer = proposer
+
+
+def test_service_publish_fetch_verify_end_to_end():
+    from gethsharding_tpu.utils.hexbytes import Hash32
+
+    chain, ((prop_client, svc_prop), (not_client, svc_not)) = \
+        _service_pair()
+    try:
+        body = os.urandom(21000)
+        root32 = Hash32(b"\x07" * 32)
+        commitment = svc_prop.publish(2, 5, root32, body)
+        assert verify_commitment(commitment, prop_client.account())
+        record = _Record(root32, prop_client.account())
+        rows = svc_not.collect_rows(2, 5, record,
+                                    bytes(not_client.account()))
+        assert rows is not None and len(rows["chunks"]) == 6
+        ok = get_backend("python").das_verify_samples(
+            rows["chunks"], rows["indices"], rows["proofs"],
+            rows["roots"])
+        assert all(ok)
+        assert svc_not.note_verdicts(ok) == 0
+        # fetched bytes stay within the k-sample budget
+        assert svc_not.bytes_fetched <= 6 * (DAS_CHUNK_SIZE
+                                             + 32 * MAX_PROOF_DEPTH + 40)
+        # wrong proposer: the commitment is rejected, never returned
+        svc_not._commitments.clear()
+        impostor = _Record(root32, not_client.account())
+        assert svc_not.fetch_commitment(2, 5, root32,
+                                        impostor.proposer) is None
+        assert svc_not.m_commitments_rejected.value >= 1
+    finally:
+        for _, svc in ((None, svc_prop), (None, svc_not)):
+            svc.stop()
+
+
+def test_service_withheld_and_corrupted_chunks_fail_the_check():
+    from dataclasses import replace
+
+    from gethsharding_tpu.utils.hexbytes import Hash32
+
+    chain, ((prop_client, svc_prop), (not_client, svc_not)) = \
+        _service_pair()
+    try:
+        body = os.urandom(15000)
+        root32 = Hash32(b"\x09" * 32)
+        commitment = svc_prop.publish(1, 3, root32, body)
+        record = _Record(root32, prop_client.account())
+        das_root = bytes(commitment.das_root)
+
+        # CORRUPTED PARITY: the publisher serves a tampered parity
+        # chunk for the signed commitment — its recomputed leaf no
+        # longer folds to das_root, so the sample verdict is False
+        xb, levels = svc_prop._blobs[das_root]
+        tampered = list(xb.chunks)
+        tampered[-1] = b"\xee" * DAS_CHUNK_SIZE  # last chunk IS parity
+        svc_prop._blobs[das_root] = (replace(xb,
+                                             chunks=tuple(tampered)),
+                                     levels)
+        rows = svc_not.collect_rows(1, 3, record,
+                                    bytes(not_client.account()))
+        assert rows is not None
+        # force the corrupted index into the sampled set
+        rows["chunks"].append(tampered[-1])
+        rows["indices"].append(xb.n - 1)
+        rows["proofs"].append(merkle_proof(levels, xb.n - 1))
+        rows["roots"].append(das_root)
+        ok = get_backend("python").das_verify_samples(
+            rows["chunks"], rows["indices"], rows["proofs"],
+            rows["roots"])
+        assert ok[-1] is False  # the corrupted chunk is detected
+        assert svc_not.note_verdicts(ok) >= 1
+
+        # WITHHELD: the publisher forgets the blob entirely — samples
+        # never arrive, collect_rows synthesizes invalid rows, and the
+        # whole check fails instead of silently shrinking k
+        svc_not._recv_samples.clear()
+        del svc_prop._blobs[das_root]
+        rows = svc_not.collect_rows(1, 3, record,
+                                    bytes(not_client.account()))
+        assert rows is not None  # the commitment is still known
+        ok = verify_samples(rows["chunks"], rows["indices"],
+                            rows["proofs"], rows["roots"])
+        assert not any(ok)
+    finally:
+        svc_prop.stop()
+        svc_not.stop()
+
+
+def test_sample_admission_rejects_garbage_first_responder():
+    """Content-verified delivery: a hostile peer that answers a sample
+    request FIRST with garbage must not occupy the slot — the honest
+    response behind it still lands, and the garbage costs a counter."""
+    from gethsharding_tpu.p2p.messages import DASampleResponse
+    from gethsharding_tpu.p2p.service import Message, Peer
+    from gethsharding_tpu.utils.hexbytes import Hash32
+
+    chain, ((prop_client, svc_prop), (not_client, svc_not)) = \
+        _service_pair()
+    try:
+        commitment = svc_prop.publish(3, 1, Hash32(b"\x0d" * 32),
+                                      os.urandom(9000))
+        root = bytes(commitment.das_root)
+        xb, levels = svc_prop._blobs[root]
+        key = (root, 0)
+        svc_not._want_samples.add(key)
+        hostile = Message(Peer(99), DASampleResponse(
+            das_root=root, index=0, chunk=b"\xaa" * DAS_CHUNK_SIZE,
+            proof=merkle_proof(levels, 0)))
+        svc_not._on_sample_response(hostile)
+        assert key not in svc_not._recv_samples  # garbage NOT admitted
+        assert svc_not.m_samples_rejected.value >= 1
+        honest = Message(Peer(1), DASampleResponse(
+            das_root=root, index=0, chunk=xb.chunks[0],
+            proof=merkle_proof(levels, 0)))
+        svc_not._on_sample_response(honest)
+        assert svc_not._recv_samples[key][0] == xb.chunks[0]
+    finally:
+        svc_prop.stop()
+        svc_not.stop()
+
+
+def test_commitment_admission_forged_first_does_not_shadow():
+    """A forged commitment response that wins the race must not evict
+    the genuine one: both park, validation picks the genuine one."""
+    from dataclasses import replace as dc_replace
+
+    from gethsharding_tpu.p2p.messages import DASCommitmentResponse
+    from gethsharding_tpu.p2p.service import Message, Peer
+    from gethsharding_tpu.utils.hexbytes import Hash32
+
+    chain, ((prop_client, svc_prop), (not_client, svc_not)) = \
+        _service_pair()
+    try:
+        root32 = Hash32(b"\x0e" * 32)
+        commitment = svc_prop.publish(4, 2, root32, os.urandom(9000))
+        genuine = DASCommitmentResponse(
+            shard_id=4, period=2, chunk_root=commitment.chunk_root,
+            das_root=commitment.das_root, k=commitment.k,
+            n=commitment.n, body_len=commitment.body_len,
+            signature=commitment.signature)
+        forged = dc_replace(genuine, das_root=b"\x66" * 32)
+        key = (4, 2)
+        svc_not._want_commitments.add(key)
+        svc_not._on_commitment_response(Message(Peer(99), forged))
+        svc_not._on_commitment_response(Message(Peer(1), genuine))
+        got = svc_not.fetch_commitment(4, 2, root32,
+                                       prop_client.account())
+        assert got is not None
+        assert bytes(got.das_root) == bytes(commitment.das_root)
+        assert svc_not.m_commitments_rejected.value >= 1
+    finally:
+        svc_prop.stop()
+        svc_not.stop()
+
+
+def test_chaos_das_seams_inject_and_retries_absorb():
+    """A das.sample_fetch=1 rule faults the FIRST fetch attempt; the
+    retry ladder re-broadcasts and the check still completes. The
+    das.parity_publish seam faults the publish itself."""
+    from gethsharding_tpu.resilience.chaos import parse_spec
+    from gethsharding_tpu.utils.hexbytes import Hash32
+
+    schedule = parse_spec(
+        "seed=5,das.sample_fetch=1,das.parity_publish=1")
+    chain, ((prop_client, svc_prop), (not_client, svc_not)) = \
+        _service_pair()
+    svc_prop.chaos = schedule
+    svc_not.chaos = schedule
+    try:
+        root32 = Hash32(b"\x0c" * 32)
+        # first publish faults at the parity_publish seam
+        with pytest.raises(ConnectionError):
+            svc_prop.publish(0, 2, root32, b"x" * 9000)
+        commitment = svc_prop.publish(0, 2, root32, b"x" * 9000)
+        record = _Record(root32, prop_client.account())
+        rows = svc_not.collect_rows(0, 2, record,
+                                    bytes(not_client.account()))
+        assert rows is not None
+        assert all(verify_samples(rows["chunks"], rows["indices"],
+                                  rows["proofs"], rows["roots"]))
+        assert schedule.injected.get("das.sample_fetch") == 1
+        assert schedule.injected.get("das.parity_publish") == 1
+    finally:
+        svc_prop.stop()
+        svc_not.stop()
+
+
+def test_chaos_unwired_das_seams_are_reported():
+    """A chaos spec naming das.* seams on a node that never wires the
+    das injector must be surfaced, not silently inert — the CLI warns
+    from exactly this list."""
+    from gethsharding_tpu.resilience.chaos import parse_spec, unwired_seams
+
+    schedule = parse_spec(
+        "seed=1,das.sample_fetch=2,backend.ecrecover_addresses=1")
+    # a --da-mode=full node wires only the classic three
+    assert unwired_seams(schedule, ("mainchain", "backend",
+                                    "dispatch")) == ["das.sample_fetch"]
+    # a --da-mode=sampled node wires das.* too: nothing unwired
+    assert unwired_seams(schedule, ("mainchain", "backend", "dispatch",
+                                    "das")) == []
+
+
+# -- the notary in sampled mode --------------------------------------------
+
+
+def _sampled_network(body_size=9000, samples=5, tamper=False):
+    from gethsharding_tpu.actors.notary import Notary
+    from gethsharding_tpu.actors.proposer import create_collation
+    from gethsharding_tpu.core.shard import Shard
+    from gethsharding_tpu.core.types import Transaction
+    from gethsharding_tpu.db.kv import MemoryKV
+    from gethsharding_tpu.mainchain.client import SMCClient
+    from gethsharding_tpu.p2p.messages import CollationBodyRequest
+    from gethsharding_tpu.p2p.service import Hub, P2PServer
+    from gethsharding_tpu.params import Config, ETHER
+    from gethsharding_tpu.smc.chain import SimulatedMainchain
+
+    config = Config(quorum_size=1, period_length=4)
+    chain = SimulatedMainchain(config=config)
+    prop_client = SMCClient(backend=chain, config=config)
+    not_client = SMCClient(backend=chain, config=config)
+    chain.fund(prop_client.account(), 2000 * ETHER)
+    chain.fund(not_client.account(), 2000 * ETHER)
+    hub = Hub()
+    watch = P2PServer(hub)
+    watch.start()  # must be hub-attached or broadcasts never reach it
+    body_watch = watch.subscribe(CollationBodyRequest)
+    svc_prop = DASService(client=prop_client, p2p=P2PServer(hub),
+                          samples=samples, fetch_timeout=1.0,
+                          fetch_attempts=2)
+    svc_not = DASService(client=not_client, p2p=P2PServer(hub),
+                         samples=samples, fetch_timeout=1.0,
+                         fetch_attempts=2)
+    svc_prop.start()
+    svc_not.start()
+    notary = Notary(client=not_client, shard=Shard(0, MemoryKV()),
+                    p2p=svc_not.p2p, config=config, deposit_flag=True,
+                    all_shards=False, sig_backend=get_backend("python"),
+                    das=svc_not, da_mode="sampled")
+    notary.start()
+    chain.fast_forward(1)
+
+    prop_shard = Shard(0, MemoryKV())
+    periods = []
+    rng = random.Random(body_size)
+    for _ in range(2):
+        period = chain.current_period()
+        collation = create_collation(
+            prop_client, 0, period,
+            [Transaction(nonce=period,
+                         payload=bytes(rng.randrange(256)
+                                       for _ in range(body_size)))])
+        prop_shard.save_collation(collation)
+        commitment = svc_prop.publish(0, period,
+                                      collation.header.chunk_root,
+                                      collation.body)
+        if tamper:
+            from dataclasses import replace
+
+            root = bytes(commitment.das_root)
+            xb, levels = svc_prop._blobs[root]
+            chunks = [b"\xbb" * DAS_CHUNK_SIZE for _ in xb.chunks]
+            svc_prop._blobs[root] = (replace(xb, chunks=tuple(chunks)),
+                                     levels)
+        prop_client.add_header(0, period, collation.header.chunk_root,
+                               collation.header.proposer_signature)
+        chain.commit()
+        notary.notarize_collations(head=chain.block_number)
+        periods.append(period)
+        while chain.current_period() == period:
+            chain.commit()
+    services = (notary, svc_prop, svc_not, watch)
+    return chain, notary, svc_not, body_watch, periods, services
+
+
+def test_notary_sampled_mode_votes_with_zero_body_fetches():
+    chain, notary, svc_not, body_watch, periods, services = \
+        _sampled_network()
+    try:
+        assert notary.votes_submitted == len(periods), notary.errors
+        # THE acceptance bar: not one CollationBodyRequest left the
+        # sampled notary
+        assert body_watch.try_get() is None
+        # and the sampled bytes stayed within the per-collation budget
+        per_collation = svc_not.bytes_fetched / len(periods)
+        assert per_collation <= 5 * (DAS_CHUNK_SIZE
+                                     + 32 * MAX_PROOF_DEPTH + 40)
+        # quorum reached on sampled votes alone
+        assert chain.last_approved_collation(0) == periods[-1]
+    finally:
+        for svc in services:
+            svc.stop()
+
+
+def test_notary_sampled_mode_refuses_corrupted_blobs():
+    """Every served chunk is garbage (commitment signed over the real
+    blob): sample proofs fail, the notary votes on NOTHING."""
+    chain, notary, svc_not, body_watch, periods, services = \
+        _sampled_network(tamper=True)
+    try:
+        assert notary.votes_submitted == 0
+        assert any("unavailable" in e for e in notary.errors)
+        assert body_watch.try_get() is None  # still zero body fetches
+    finally:
+        for svc in services:
+            svc.stop()
+
+
+# -- RPC + light client ----------------------------------------------------
+
+
+def test_rpc_get_sample_and_da_status():
+    from gethsharding_tpu.mainchain.client import SMCClient
+    from gethsharding_tpu.params import Config
+    from gethsharding_tpu.rpc import codec
+    from gethsharding_tpu.rpc.server import RPCServer
+    from gethsharding_tpu.smc.chain import SimulatedMainchain
+    from gethsharding_tpu.utils.hexbytes import Hash32
+
+    config = Config()
+    chain = SimulatedMainchain(config=config)
+    client = SMCClient(backend=chain, config=config)
+    provider = DASService(client=client)  # local-only: no p2p
+    provider.start()
+    server = RPCServer(chain, das=provider)
+    server.start()  # stop() blocks unless serve_forever is running
+    try:
+        # no commitment yet
+        assert server.rpc_daStatus(0, 1) == {
+            "known": False, "shard_id": 0, "period": 1,
+            "provider": True}
+        assert server.rpc_getSample(0, 1, [0]) is None
+        commitment = provider.publish(0, 1, Hash32(b"\x03" * 32),
+                                      os.urandom(12000))
+        status = server.rpc_daStatus(0, 1)
+        assert status["known"] and status["provider"]
+        assert status["k"] == commitment.k and status["n"] == commitment.n
+        got = server.rpc_getSample(0, 1, [0, commitment.n - 1, 999])
+        assert got["k"] == commitment.k
+        assert len(got["samples"]) == 2  # 999 is out of range
+        for sample in got["samples"]:
+            assert verify_sample(
+                codec.dec_bytes(got["dasRoot"]), sample["index"],
+                codec.dec_bytes(sample["chunk"]),
+                [codec.dec_bytes(node) for node in sample["proof"]])
+        # a provider-less server answers "no provider", never raises
+        bare = RPCServer(chain)
+        bare.start()
+        try:
+            assert bare.rpc_daStatus(0, 1)["provider"] is False
+            assert bare.rpc_getSample(0, 1, [0]) is None
+        finally:
+            bare.stop()
+    finally:
+        server.stop()
+        provider.stop()
+
+
+def test_light_client_das_check_over_p2p():
+    from gethsharding_tpu.actors.light import LightClient
+    from gethsharding_tpu.actors.proposer import create_collation
+    from gethsharding_tpu.core.shard import Shard
+    from gethsharding_tpu.core.types import Transaction
+    from gethsharding_tpu.db.kv import MemoryKV
+    from gethsharding_tpu.mainchain.client import SMCClient
+    from gethsharding_tpu.p2p.service import Hub, P2PServer
+    from gethsharding_tpu.params import Config, ETHER
+    from gethsharding_tpu.smc.chain import SimulatedMainchain
+
+    config = Config(period_length=4)
+    chain = SimulatedMainchain(config=config)
+    prop_client = SMCClient(backend=chain, config=config)
+    light_client_smc = SMCClient(backend=chain, config=config)
+    chain.fund(prop_client.account(), 2000 * ETHER)
+    hub = Hub()
+    svc_prop = DASService(client=prop_client, p2p=P2PServer(hub),
+                          samples=4, fetch_timeout=1.0)
+    svc_light = DASService(client=light_client_smc, p2p=P2PServer(hub),
+                           samples=4, fetch_timeout=1.0,
+                           fetch_attempts=2)
+    svc_prop.start()
+    svc_light.start()
+    light = LightClient(client=light_client_smc, p2p=svc_light.p2p,
+                        das=svc_light)
+    light.start()
+    try:
+        chain.fast_forward(1)
+        period = chain.current_period()
+        shard = Shard(0, MemoryKV())
+        collation = create_collation(
+            prop_client, 0, period,
+            [Transaction(nonce=1, payload=os.urandom(13000))])
+        shard.save_collation(collation)
+        svc_prop.publish(0, period, collation.header.chunk_root,
+                         collation.body)
+        prop_client.add_header(0, period, collation.header.chunk_root,
+                               collation.header.proposer_signature)
+        chain.commit()
+        assert light.das_check(0, period, seed=b"\x42" * 32) is True
+        assert light.samples_verified >= 4
+        # an unknown period fails closed
+        assert light.das_check(0, period + 7) is False
+    finally:
+        light.stop()
+        svc_prop.stop()
+        svc_light.stop()
+
+
+def test_das_counters_reach_prometheus_exposition():
+    from gethsharding_tpu import metrics
+    from gethsharding_tpu.metrics import prometheus_text
+
+    metrics.counter("das/samples_verified").inc(0)
+    metrics.counter("das/sample_failures").inc(0)
+    metrics.counter("das/sample_wire_bytes").inc(0)
+    text = prometheus_text()
+    for needle in ("gethsharding_das_samples_verified_total",
+                   "gethsharding_das_sample_failures_total",
+                   "gethsharding_das_sample_wire_bytes_total"):
+        assert needle in text, needle

@@ -70,6 +70,56 @@ def test_chunker_roundtrip(size):
         assert store.store(data[:-1] + b"\x00") != root or data[-1:] == b"\x00"
 
 
+@pytest.mark.parametrize("size", [0, 1, 31, 32])
+def test_bmt_proof_single_segment_chunks(size):
+    """A chunk of at most one segment: the proof is the empty path and
+    the segment IS the (possibly partial, possibly empty) data."""
+    data = os.urandom(size)
+    root = bmt_hash(data)
+    segment, path = bmt_proof(data, 0)
+    assert segment == data
+    assert path == []
+    assert bmt_verify(root, segment, path)
+    # the first out-of-range index must raise, not return a bogus proof
+    with pytest.raises(BMTError):
+        bmt_proof(data, 1)
+    # a forged single-segment value fails (empty data has no forgery
+    # with the same length-0 segment)
+    if size:
+        forged = bytes([segment[0] ^ 1]) + segment[1:]
+        assert not bmt_verify(root, forged, path)
+
+
+@pytest.mark.parametrize("size", [
+    33, 63, 65, 95, 97, 129, 4064, 4065, 4095,
+])
+def test_bmt_proof_final_partial_segment(size):
+    """EVERY segment of a partial-tail chunk proves — especially the
+    final partial one — and the first index past the tail raises. The
+    proof boundary is the exact segment count, no off-by-one in either
+    direction."""
+    data = os.urandom(size)
+    root = bmt_hash(data)
+    n_segments = (size + SEGMENT_SIZE - 1) // SEGMENT_SIZE
+    for index in range(n_segments):
+        segment, path = bmt_proof(data, index)
+        assert segment == data[index * SEGMENT_SIZE:
+                               (index + 1) * SEGMENT_SIZE]
+        assert bmt_verify(root, segment, path)
+    # the final segment is partial by construction for these sizes
+    tail, tail_path = bmt_proof(data, n_segments - 1)
+    assert 0 < len(tail) < SEGMENT_SIZE or size % SEGMENT_SIZE == 0
+    # a partial tail padded to a full segment must NOT verify (the raw
+    # short leaf is the hashed domain, zero-padding changes the hash)
+    if len(tail) < SEGMENT_SIZE:
+        padded = tail + b"\x00" * (SEGMENT_SIZE - len(tail))
+        assert not bmt_verify(root, padded, tail_path)
+    with pytest.raises(BMTError):
+        bmt_proof(data, n_segments)
+    with pytest.raises(BMTError):
+        bmt_proof(data, -1)
+
+
 def test_bmt_interior_preimage_forgery_is_rejected():
     """Leaf/interior domain separation: an interior node's 64-byte
     preimage presented as a 'segment' with a truncated path must NOT
