@@ -800,7 +800,15 @@ def measure_chaos() -> dict:
     full cycle (trips, probes, re-close) under the schedule. Hermetic
     by default (python primary); GETHSHARDING_BENCH_CHAOS_BACKEND=jax
     runs the real device path on an accelerator (the 06_failover
-    probe)."""
+    probe).
+
+    GETHSHARDING_CHAOS_MODE=corrupt switches the schedule to SILENT
+    corruption (wrong answers, no exceptions) with the soundness
+    spot-checker (rate GETHSHARDING_SOUNDNESS_RATE) composed inside
+    the failover slot; the report's detected-vs-undetected corruption
+    counts say how much of the injected corruption the audit caught
+    (detected corruption is served from the fallback and stays
+    correct; undetected corruption is a wrong answer)."""
     from gethsharding_tpu.crypto import secp256k1 as ecdsa
     from gethsharding_tpu.crypto.keccak import keccak256
     from gethsharding_tpu.metrics import Registry
@@ -816,6 +824,7 @@ def measure_chaos() -> dict:
     rows = int(os.environ.get("GETHSHARDING_BENCH_CHAOS_ROWS", "8"))
     primary_name = os.environ.get("GETHSHARDING_BENCH_CHAOS_BACKEND",
                                   "python")
+    mode = os.environ.get("GETHSHARDING_CHAOS_MODE", "fault")
     import random
 
     # faults only for the first 2/3 of the run: the tail is the recovery
@@ -827,13 +836,22 @@ def measure_chaos() -> dict:
                 and random.Random(f"{seed}:bench:{idx}").random() < rate)
 
     schedule = ChaosSchedule(
-        seed=seed, rules={"backend.ecrecover_addresses": fault_rule})
+        seed=seed, rules={"backend.ecrecover_addresses": fault_rule},
+        modes=({"backend.ecrecover_addresses": "corrupt"}
+               if mode == "corrupt" else None))
     registry = Registry()
     breaker = CircuitBreaker(name="bench", fault_threshold=2,
                              reset_s=0.002, registry=registry)
+    primary = ChaosSigBackend(get_backend(primary_name), schedule)
+    if mode == "corrupt":
+        # silent corruption is invisible to the breaker's exception
+        # path: only the spot-checker can turn it into a fault
+        from gethsharding_tpu.resilience.soundness import (
+            SpotCheckSigBackend)
+
+        primary = SpotCheckSigBackend(primary, registry=registry)
     backend = FailoverSigBackend(
-        ChaosSigBackend(get_backend(primary_name), schedule),
-        PythonSigBackend(), breaker=breaker, registry=registry)
+        primary, PythonSigBackend(), breaker=breaker, registry=registry)
 
     batches = []
     for b in range(calls):
@@ -862,16 +880,26 @@ def measure_chaos() -> dict:
     def count(metric: str) -> int:
         return registry.counter(f"resilience/breaker/bench/{metric}").value
 
+    injected = schedule.injected.get("backend.ecrecover_addresses", 0)
+    # corrupt-mode accounting: a corruption the spot-checker caught
+    # became a SoundnessViolation (served correct from the fallback);
+    # one it missed is a silently wrong answer
+    detected = registry.counter(
+        "resilience/soundness/ecrecover_addresses/mismatches").value
+    undetected = answered - correct if mode == "corrupt" else 0
     return {
         "primary": primary_name,
         "seed": seed,
         "rate": rate,
+        "mode": mode,
         "calls": calls,
         "rows": rows,
         "chaos_availability": round(correct / calls, 4),
         "answered": answered,
-        "injected_faults": schedule.injected.get(
-            "backend.ecrecover_addresses", 0),
+        "injected_faults": injected if mode != "corrupt" else 0,
+        "corruptions_injected": injected if mode == "corrupt" else 0,
+        "corruptions_detected": detected,
+        "corruptions_undetected": undetected,
         "breaker_trips": count("trips"),
         "breaker_probes": count("probes"),
         "breaker_closes": count("closes"),
@@ -888,6 +916,137 @@ def _chaos_platform(primary_name: str) -> str:
     import jax
 
     return jax.devices()[0].platform
+
+
+def measure_soundness() -> dict:
+    """The continuous soundness audit's two acceptance numbers in one
+    run (bench.py --soundness):
+
+    1. **Overhead** at the DEFAULT sample rate: the audit work per
+       dispatch (always-on invariant sweep + rate-amortized sampled
+       scalar re-verification) measured directly against the cost of a
+       real-signature ecrecover dispatch — asserted <2%, the same
+       budget-guard shape as the tracing and closed-breaker guards.
+    2. **Closed-loop detection**: an every-dispatch silent corruptor
+       (chaos mode=corrupt — wrong answers, no exceptions) must trip
+       the failover breaker within the dispatch budget
+       `dispatches_to_detect` predicts at 99.9% confidence.
+
+    Hermetic by default (python primary);
+    GETHSHARDING_BENCH_SOUNDNESS_BACKEND=jax times the real device
+    dispatch (the 08_soundness probe)."""
+    from gethsharding_tpu.crypto import secp256k1 as ecdsa
+    from gethsharding_tpu.crypto.keccak import keccak256
+    from gethsharding_tpu.metrics import Registry
+    from gethsharding_tpu.resilience.breaker import (
+        OPEN, CircuitBreaker, FailoverSigBackend)
+    from gethsharding_tpu.resilience.chaos import (ChaosSchedule,
+                                                   ChaosSigBackend)
+    from gethsharding_tpu.resilience.soundness import (
+        DEFAULT_RATE, DEFAULT_ROWS, SpotCheckSigBackend,
+        detection_probability, dispatches_to_detect, soundness_table)
+    from gethsharding_tpu.sigbackend import PythonSigBackend, get_backend
+
+    seed = int(os.environ.get("GETHSHARDING_SOUNDNESS_SEED", "0"))
+    rows = int(os.environ.get("GETHSHARDING_BENCH_SOUNDNESS_ROWS", "32"))
+    primary_name = os.environ.get("GETHSHARDING_BENCH_SOUNDNESS_BACKEND",
+                                  "python")
+    primary = get_backend(primary_name)
+
+    # -- part 1: audit overhead against a real-signature dispatch ----------
+    digests, sigs = [], []
+    for r in range(rows):
+        priv = int.from_bytes(
+            keccak256(b"soundness-%d" % r), "big") % ecdsa.N
+        digest = keccak256(b"soundness-msg-%d" % r)
+        digests.append(digest)
+        sigs.append(ecdsa.sign(digest, priv).to_bytes65())
+    cols = (digests, sigs)
+
+    reps = 2 if primary_name == "python" else 8
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = primary.ecrecover_addresses(digests, sigs)
+    per_dispatch_s = (time.perf_counter() - t0) / reps
+
+    spot = SpotCheckSigBackend(primary, rate=DEFAULT_RATE,
+                               rows=DEFAULT_ROWS, seed=seed,
+                               registry=Registry())
+    m = 50
+    t0 = time.perf_counter()
+    for _ in range(m):
+        spot._check_invariants("ecrecover_addresses", cols, out)
+        spot._tick("ecrecover_addresses")
+    invariant_s = (time.perf_counter() - t0) / m
+    k = 3
+    t0 = time.perf_counter()
+    for i in range(k):
+        spot._spot_check("ecrecover_addresses", cols, out, idx=i)
+    spotcheck_s = (time.perf_counter() - t0) / k
+    # what one dispatch pays on average: the always-on sweep plus the
+    # rate-amortized sampled re-verification
+    audit_s = invariant_s + DEFAULT_RATE * spotcheck_s
+    overhead_pct = 100.0 * audit_s / per_dispatch_s
+    assert overhead_pct < 2.0, (
+        f"soundness audit overhead {overhead_pct:.3f}% of a "
+        f"{rows}-row dispatch ({audit_s * 1e6:.1f}us vs "
+        f"{per_dispatch_s * 1e6:.1f}us) breaches the 2% budget")
+
+    # -- part 2: closed-loop detection within the predicted budget ---------
+    # an ambient GETHSHARDING_SOUNDNESS_RATE=0 (the node's off switch)
+    # must not crash the closed loop — detection at rate 0 has no
+    # budget, so the run falls back to the demonstration rate
+    check_rate = float(os.environ.get("GETHSHARDING_SOUNDNESS_RATE",
+                                      "0.25") or 0)
+    if check_rate <= 0:
+        check_rate = 0.25
+    chaos_rows = 8
+    budget = dispatches_to_detect(check_rate, DEFAULT_ROWS, chaos_rows,
+                                  corrupt_rows=1, confidence=0.999)
+    schedule = ChaosSchedule(
+        seed=seed, rules={"backend.ecrecover_addresses": True},
+        modes={"backend.ecrecover_addresses": "corrupt"})
+    registry = Registry()
+    breaker = CircuitBreaker(name="soundness", fault_threshold=1,
+                             reset_s=60.0, registry=registry)
+    backend = FailoverSigBackend(
+        SpotCheckSigBackend(ChaosSigBackend(PythonSigBackend(), schedule),
+                            rate=check_rate, rows=DEFAULT_ROWS, seed=seed,
+                            registry=registry),
+        PythonSigBackend(), breaker=breaker, registry=registry)
+    garbage = ([b"\x11" * 32] * chaos_rows, [b"\x22" * 65] * chaos_rows)
+    dispatches_to_trip = None
+    for i in range(budget):
+        backend.ecrecover_addresses(*garbage)
+        if breaker.state == OPEN:
+            dispatches_to_trip = i + 1
+            break
+    detected = dispatches_to_trip is not None
+    assert detected, (
+        f"silent corruption NOT detected within the predicted "
+        f"{budget}-dispatch budget (rate {check_rate}, "
+        f"{DEFAULT_ROWS}/{chaos_rows} rows)")
+
+    return {
+        "primary": primary_name,
+        "rows": rows,
+        "overhead_pct": round(overhead_pct, 4),
+        "default_rate": DEFAULT_RATE,
+        "rows_per_check": DEFAULT_ROWS,
+        "per_dispatch_us": round(per_dispatch_s * 1e6, 1),
+        "audit_us_per_dispatch": round(audit_s * 1e6, 2),
+        "invariant_us": round(invariant_s * 1e6, 2),
+        "spot_check_us": round(spotcheck_s * 1e6, 1),
+        "detection_rate": check_rate,
+        "dispatches_to_trip": dispatches_to_trip,
+        "predicted_budget_p999": budget,
+        "p_detect_per_dispatch": round(detection_probability(
+            check_rate, DEFAULT_ROWS, chaos_rows), 4),
+        "soundness_mismatches": registry.counter(
+            "resilience/soundness/ecrecover_addresses/mismatches").value,
+        "soundness_table_64": soundness_table(64, DEFAULT_ROWS),
+        "platform": _chaos_platform(primary_name),
+    }
 
 
 # == data-availability sampling (bench.py --das) ===========================
@@ -1331,17 +1490,43 @@ def main() -> None:
         # primary faults; extras carry the breaker's full open ->
         # half-open-probe -> closed cycle counters
         stats = measure_chaos()
+        injected_desc = (
+            f"{stats['corruptions_injected']} silent corruptions "
+            f"({stats['corruptions_detected']} detected)"
+            if stats["mode"] == "corrupt"
+            else f"{stats['injected_faults']} injected faults")
         print(json.dumps({
             "metric": "chaos_availability",
             "value": stats["chaos_availability"],
             "unit": (f"fraction of {stats['calls']} calls answered "
                      f"correctly under seeded chaos (rate "
-                     f"{stats['rate']}, {stats['injected_faults']} "
-                     f"injected faults, {stats['primary']} primary, "
+                     f"{stats['rate']}, {injected_desc}, "
+                     f"{stats['primary']} primary, "
                      f"{stats['platform']})"),
             "vs_baseline": stats["chaos_availability"],
             "extra": {k: v for k, v in stats.items()
                       if k != "chaos_availability"},
+        }))
+        return
+
+    if "--soundness" in sys.argv:
+        # the continuous integrity audit's two acceptance numbers:
+        # audit overhead per dispatch (asserted <2% at the default
+        # sample rate) and closed-loop silent-corruption detection
+        # within the dispatch budget detection_probability predicts
+        stats = measure_soundness()
+        print(json.dumps({
+            "metric": "soundness_overhead_pct",
+            "value": stats["overhead_pct"],
+            "unit": (f"% of a {stats['rows']}-row ecrecover dispatch "
+                     f"spent on the soundness audit at rate "
+                     f"{stats['default_rate']} (corruption tripped the "
+                     f"breaker in {stats['dispatches_to_trip']} of the "
+                     f"predicted {stats['predicted_budget_p999']} "
+                     f"dispatches, {stats['platform']})"),
+            "vs_baseline": round(stats["overhead_pct"] / 2.0, 4),
+            "extra": {k: v for k, v in stats.items()
+                      if k != "overhead_pct"},
         }))
         return
 

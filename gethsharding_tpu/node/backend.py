@@ -53,6 +53,7 @@ class ShardNode:
                  serving: bool = False,
                  serving_config=None,
                  chaos=None,
+                 soundness_rate: Optional[float] = None,
                  da_mode: str = "full",
                  da_samples: int = 16,
                  da_parity: float = 0.5):
@@ -65,14 +66,20 @@ class ShardNode:
         self.shard_id = shard_id
         self.config = config
         # backend composition, innermost out (each layer optional):
-        #   device backend -> chaos injection -> serving tier -> failover
+        #   device backend -> chaos injection -> serving tier ->
+        #   soundness spot-check -> failover
         # The chaos wrapper sits where real device faults originate; the
         # failover breaker sits OUTSIDE the serving tier so watchdog
         # DeadlineExceeded failures surfacing from serving futures count
-        # as primary faults and trip it. One instance node-wide: one
-        # admission queue per device, one breaker per node.
+        # as primary faults and trip it; the soundness spot-checker sits
+        # between them — outside chaos+serving so it audits exactly what
+        # a (possibly silently corrupting) device delivered through the
+        # coalescing tier, inside failover so a SoundnessViolation is a
+        # primary fault that trips the breaker. One instance node-wide:
+        # one admission queue per device, one breaker per node.
         self._serving_backend = None
         self._sig_backend_obj = None
+        self.soundness_backend = None
         failover = sig_backend.startswith("failover-")
         inner_name = sig_backend[len("failover-"):] if failover \
             else sig_backend
@@ -93,6 +100,18 @@ class ShardNode:
                 else get_backend(inner_name),
                 config=serving_config or ServingConfig())
             self._serving_backend = composed
+        if soundness_rate is None:
+            soundness_rate = float(
+                os.environ.get("GETHSHARDING_SOUNDNESS_RATE", "0") or 0)
+        if soundness_rate > 0:
+            from gethsharding_tpu.resilience.soundness import (
+                SpotCheckSigBackend)
+
+            composed = SpotCheckSigBackend(
+                composed if composed is not None
+                else get_backend(inner_name),
+                rate=soundness_rate)
+            self.soundness_backend = composed
         if failover:
             from gethsharding_tpu.resilience.breaker import (
                 FailoverSigBackend)

@@ -119,7 +119,22 @@ def build_parser() -> argparse.ArgumentParser:
                                "failure injection at the sig-backend and "
                                "mainchain-call seams (resilience/chaos.py; "
                                "pair with --sigbackend failover-* to watch "
-                               "the breaker ride through it)")
+                               "the breaker ride through it); a "
+                               "'backend.*:mode=corrupt' entry injects "
+                               "SILENT corruption (wrong results, no "
+                               "exception) — pair with --soundness-rate "
+                               "to watch the spot-checker catch it")
+    sharding.add_argument("--soundness-rate", type=float, default=None,
+                          metavar="RATE",
+                          help="continuous integrity audit: spot-check "
+                               "this fraction of sig-backend dispatches "
+                               "by re-verifying a seeded-random row "
+                               "subset against the scalar reference "
+                               "(resilience/soundness.py; default off, "
+                               "or GETHSHARDING_SOUNDNESS_RATE; a "
+                               "detected mismatch is a primary fault — "
+                               "pair with --sigbackend failover-* so "
+                               "silent corruption trips the breaker)")
     sharding.add_argument("--verbosity", default="info",
                           choices=("debug", "info", "warning", "error"))
     sharding.add_argument("--metrics", action="store_true",
@@ -388,12 +403,34 @@ def run_sharding_node(args) -> int:
             policy=args.serving_policy,
             watchdog_s=args.serving_watchdog_s,
         )
+    soundness_rate = args.soundness_rate
+    if soundness_rate is None:
+        soundness_rate = float(
+            os.environ.get("GETHSHARDING_SOUNDNESS_RATE", "0") or 0)
+    if soundness_rate > 0 and not args.sigbackend.startswith("failover-"):
+        logging.getLogger("sharding.node").warning(
+            "--soundness-rate without --sigbackend failover-*: a "
+            "spot-check violation will RAISE into the calling actor "
+            "instead of tripping a breaker onto the scalar fallback — "
+            "silent corruption becomes loud, but nothing fails over")
     chaos_schedule = None
     raw_backend = backend
     if args.chaos:
         from gethsharding_tpu.resilience import chaos as chaos_mod
 
         chaos_schedule = chaos_mod.parse_spec(args.chaos)
+        if soundness_rate <= 0 and any(
+                mode == "corrupt"
+                for mode in chaos_schedule.modes.values()):
+            # silent corruption with nothing watching: the injected
+            # wrong verdicts flow straight into consensus undetected —
+            # the experiment tests nothing the operator can observe
+            logging.getLogger("sharding.node").warning(
+                "--chaos has mode=corrupt rules but the soundness "
+                "spot-checker is off (--soundness-rate 0) — injected "
+                "silent corruption will NOT be detected; pair with "
+                "--soundness-rate (and --sigbackend failover-*) to "
+                "watch it tripped")
         # the das.* seams (sample fetch, commitment fetch, parity
         # publish) only exist on a node running the sampled DA plane
         wired = ("mainchain", "backend", "dispatch")
@@ -437,6 +474,7 @@ def run_sharding_node(args) -> int:
         serving=args.serving,
         serving_config=serving_config,
         chaos=chaos_schedule,
+        soundness_rate=soundness_rate,
         da_mode=args.da_mode,
         da_samples=args.da_samples,
         da_parity=args.da_parity,

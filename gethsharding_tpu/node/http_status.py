@@ -91,6 +91,14 @@ class StatusServer(Service):
                       if name.startswith("resilience/")}
         if resilience:
             payload["resilience"] = resilience
+        # the continuous soundness audit at a glance (--soundness-rate):
+        # the configured knobs plus what they buy — per-dispatch
+        # detection probability and the 99%-confidence dispatch budget
+        # (the raw check/mismatch counters already ride the resilience
+        # section above)
+        soundness = getattr(node, "soundness_backend", None)
+        if soundness is not None:
+            payload["soundness"] = soundness.describe()
         # the DAS plane at a glance (--da-mode=sampled): published
         # blobs, samples served/fetched/verified, failures, wire bytes
         das = {name: snap for name, snap in snapshot.items()

@@ -17,7 +17,14 @@ mainchain bridge (ISSUE 5):
   + audit high-water mark through `db/kv`, replayed on notary start;
 - ``chaos.py``    — seeded, deterministic failure schedules injectable
   at the backend-op, mainchain-call and dispatch seams (tests,
-  ``bench.py --chaos``, ``--chaos`` on the node CLI).
+  ``bench.py --chaos``, ``--chaos`` on the node CLI), including the
+  silent-corruption ``mode=corrupt`` rules;
+- ``soundness.py`` — `SpotCheckSigBackend`: continuous statistically-
+  sound integrity audit of the fast path — sampled random-row
+  re-verification against the scalar reference plus an always-on
+  verdict-plane invariant check; a detected disagreement raises
+  `SoundnessViolation` into the breaker's fault path
+  (``--soundness-rate``, ``GETHSHARDING_SOUNDNESS_RATE``).
 
 Submodules are imported lazily (PEP 562): `errors`/`policy` are leaf
 modules safe for the serving tier and mainchain client to import
@@ -32,6 +39,7 @@ from gethsharding_tpu.resilience.errors import (
     DispatcherClosed,
     FetchAborted,
     ResilienceError,
+    SoundnessViolation,
     TransientError,
 )
 
@@ -50,11 +58,16 @@ _LAZY = {
     "InjectedFault": ("chaos", "InjectedFault"),
     "parse_spec": ("chaos", "parse_spec"),
     "wrap": ("chaos", "wrap"),
+    "SpotCheckSigBackend": ("soundness", "SpotCheckSigBackend"),
+    "detection_probability": ("soundness", "detection_probability"),
+    "dispatches_to_detect": ("soundness", "dispatches_to_detect"),
+    "soundness_table": ("soundness", "soundness_table"),
 }
 
 __all__ = [
     "DeadlineExceeded", "DispatcherClosed", "FetchAborted",
-    "ResilienceError", "TransientError", *sorted(_LAZY),
+    "ResilienceError", "SoundnessViolation", "TransientError",
+    *sorted(_LAZY),
 ]
 
 

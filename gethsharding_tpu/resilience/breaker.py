@@ -46,6 +46,7 @@ from concurrent.futures import Future
 from typing import Callable, Optional
 
 from gethsharding_tpu import metrics, tracing
+from gethsharding_tpu.resilience.errors import SoundnessViolation
 from gethsharding_tpu.sigbackend import SigBackend, VerdictFuture
 
 log = logging.getLogger("resilience.breaker")
@@ -377,6 +378,14 @@ class FailoverSigBackend(SigBackend):
                     # closed path: conclude the probe without a fault
                     # or a fresh cooldown so the next call re-probes
                     self.breaker.probe_aborted("primary shed the probe")
+                elif isinstance(exc, SoundnessViolation):
+                    # the spot-checker inside the primary slot already
+                    # compared against the same scalar truth this probe
+                    # would have: that IS the differential verdict.
+                    # Count it once, on probe_mismatches — not also as
+                    # a primary fault (no double-accounting).
+                    self.breaker.probe_failed(mismatch=True,
+                                              detail=repr(exc))
                 else:
                     self.breaker.probe_failed(mismatch=False,
                                               detail=repr(exc))

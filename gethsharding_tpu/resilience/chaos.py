@@ -13,7 +13,12 @@ reusable injection surface driven by a seeded, replayable schedule:
   scheduled ``backend.<op>`` entries raise `InjectedFault` (a device
   fault the failover breaker counts), scheduled ``dispatch.<op>``
   entries HANG for `hang_s` seconds (a wedged dispatch the watchdog
-  must catch);
+  must catch); a ``backend.<op>`` seam tagged ``mode=corrupt``
+  (``"backend.bls_verify_committees:mode=corrupt"`` in a spec, or the
+  whole plane via ``"backend.*:mode=corrupt"``) raises NOTHING —
+  scheduled calls return a seeded, silently CORRUPTED result (verdict
+  bits flipped, a recovered address perturbed), the failure class only
+  the soundness spot-checker (`resilience/soundness.py`) can catch;
 - the schedule itself is pure decision logic: per-seam call counters
   plus a seed, so the SAME spec replays the SAME failure timeline in
   tests, `bench.py --chaos`, and a devnet node booted with
@@ -39,6 +44,12 @@ class InjectedFault(ConnectionError):
     """A deterministically scheduled failure (retryable by design)."""
 
 
+# a seam rule's failure mode: "fault" raises InjectedFault (the loud
+# default), "corrupt" silently perturbs the result (backend.* seams
+# only — the silent-corruption chaos the soundness audit must catch)
+MODES = ("fault", "corrupt")
+
+
 class ChaosSchedule:
     """Seeded per-seam failure schedule.
 
@@ -55,11 +66,33 @@ class ChaosSchedule:
                           verdict for call k never depends on how many
                           other seams fired;
     - ``callable(idx)``   arbitrary predicate on the per-seam call index.
+
+    ``modes`` maps a seam (same exact-or-bare-prefix resolution) to a
+    failure mode from `MODES`; unmapped seams default to ``"fault"``.
+    The schedule stays pure decision logic — `mode_for` only REPORTS
+    the mode, the injector at the seam acts on it.
     """
 
-    def __init__(self, seed: int = 0, rules: Optional[Dict] = None):
+    def __init__(self, seed: int = 0, rules: Optional[Dict] = None,
+                 modes: Optional[Dict[str, str]] = None):
         self.seed = seed
         self.rules = dict(rules or {})
+        self.modes = dict(modes or {})
+        for seam, mode in self.modes.items():
+            if mode not in MODES:
+                raise ValueError(
+                    f"unknown chaos mode {mode!r} for seam {seam!r}; "
+                    f"choose from {MODES}")
+            if mode == "corrupt" and seam != "backend" \
+                    and not seam.startswith("backend."):
+                # only the backend-op seam has a result to corrupt;
+                # accepting corrupt on mainchain.*/dispatch.* would
+                # silently degrade to every-call LOUD faults — the
+                # opposite of what the operator asked to test
+                raise ValueError(
+                    f"mode=corrupt is only supported on backend.* seams, "
+                    f"not {seam!r} (mainchain/dispatch seams have no "
+                    f"result plane to corrupt)")
         self.injected: Dict[str, int] = {}
         self._counts: Dict[str, int] = {}
         self._lock = threading.Lock()
@@ -76,14 +109,29 @@ class ChaosSchedule:
         rule = self._rule_for(seam)
         return rule is not None and rule is not False
 
+    def mode_for(self, seam: str) -> str:
+        """The seam's failure mode (exact match wins over bare prefix;
+        default "fault")."""
+        mode = self.modes.get(seam)
+        if mode is None and "." in seam:
+            mode = self.modes.get(seam.split(".", 1)[0])
+        return mode or "fault"
+
     def should_fail(self, seam: str) -> bool:
         """Consume one call slot on `seam`; True = inject."""
+        return self.decide(seam)[0]
+
+    def decide(self, seam: str) -> Tuple[bool, int]:
+        """Consume one call slot on `seam`; returns (inject?, index).
+        The index makes corruption REPLAYABLE: a corrupt-mode injector
+        seeds its perturbation from (seed, seam, index), so the same
+        spec flips the same bits in the same calls every run."""
         with self._lock:
             idx = self._counts.get(seam, 0)
             self._counts[seam] = idx + 1
         rule = self._rule_for(seam)
         if rule is None or rule is False:
-            return False
+            return False, idx
         if rule is True:
             verdict = True
         elif isinstance(rule, bool):  # pragma: no cover - covered above
@@ -99,7 +147,7 @@ class ChaosSchedule:
             with self._lock:
                 self.injected[seam] = self.injected.get(seam, 0) + 1
             self._m_injected.inc()
-        return verdict
+        return verdict, idx
 
     def fire(self, seam: str) -> None:
         """Raise `InjectedFault` when the schedule says this call fails."""
@@ -120,22 +168,51 @@ def parse_spec(spec: str) -> ChaosSchedule:
     — `seed=` names the schedule seed; every other entry is a seam
     rule: ``always`` -> True, a value containing ``.`` -> float rate,
     otherwise -> int first-n.
+
+    A ``<seam>:mode=corrupt`` entry tags the seam's failure mode
+    (``backend.ecrecover_addresses:mode=corrupt``); a mode entry with
+    no rule of its own defaults the seam's rule to every-call. A seam
+    written ``backend.*`` is the bare prefix ``backend`` (every op
+    under it). Malformed mode entries fail fast with the offending
+    token — a typo'd mode silently injecting nothing (or loudly
+    instead of silently) would test less than the operator asked for.
     """
     seed = 0
     rules: Dict = {}
+    modes: Dict[str, str] = {}
+    mode_only: List[str] = []
     for part in filter(None, (p.strip() for p in spec.split(","))):
         if "=" not in part:
             raise ValueError(f"chaos spec entry {part!r} is not key=value")
         key, value = (s.strip() for s in part.split("=", 1))
+        if key.endswith(".*"):  # backend.* == the bare prefix rule
+            key = key[:-2]
         if key == "seed":
             seed = int(value)
+        elif ":" in key:
+            seam, attr = (s.strip() for s in key.split(":", 1))
+            if seam.endswith(".*"):
+                seam = seam[:-2]
+            if attr != "mode":
+                raise ValueError(
+                    f"chaos spec entry {part!r}: unknown seam attribute "
+                    f"{attr!r} (only 'mode' is supported)")
+            if value not in MODES:
+                raise ValueError(
+                    f"chaos spec entry {part!r}: unknown mode {value!r}; "
+                    f"choose from {MODES}")
+            modes[seam] = value
+            mode_only.append(seam)
         elif value == "always":
             rules[key] = True
         elif "." in value:
             rules[key] = float(value)
         else:
             rules[key] = int(value)
-    return ChaosSchedule(seed=seed, rules=rules)
+    for seam in mode_only:
+        # a mode entry alone means "every call, in that mode"
+        rules.setdefault(seam, True)
+    return ChaosSchedule(seed=seed, rules=rules, modes=modes)
 
 
 class _ChaosProxy:
@@ -198,7 +275,11 @@ class ChaosSigBackend(SigBackend):
     inner call; ``dispatch.<op>`` entries sleep `hang_s` seconds first
     — when this backend sits under the serving tier, that wedges the
     dispatch thread exactly like a hung device call, which is the
-    watchdog's prey."""
+    watchdog's prey. A ``backend.<op>`` seam in ``mode=corrupt``
+    raises nothing: scheduled calls run the real op and then silently
+    perturb its result (seeded by (seed, seam, call index), so the
+    same spec corrupts the same rows every run) — the silent-
+    corruption failure class the soundness spot-checker exists for."""
 
     def __init__(self, inner: SigBackend, schedule: ChaosSchedule,
                  hang_s: float = 30.0):
@@ -207,10 +288,47 @@ class ChaosSigBackend(SigBackend):
         self.hang_s = hang_s
         self.name = f"chaos+{inner.name}"
 
+    def _corrupt_result(self, op: str, out, idx: int):
+        """Silently wrong, never loud: flip one row's verdict bit, or
+        perturb one recovered address (valid -> near-miss bytes,
+        invalid -> fabricated address). Callers skip empty batches
+        before consuming a schedule slot (nothing to corrupt without
+        changing the row count, which would be a LOUD shape error);
+        the guard here is defensive only."""
+        out = list(out)
+        if not out:  # pragma: no cover - callers skip empty batches
+            return out
+        rng = random.Random(
+            f"{self.schedule.seed}:corrupt:{op}:{idx}")
+        row = rng.randrange(len(out))
+        if op == "ecrecover_addresses":
+            from gethsharding_tpu.utils.hexbytes import Address20
+
+            addr = out[row]
+            if addr is None:
+                out[row] = Address20(rng.randbytes(20))
+            else:
+                flipped = bytes(addr[:-1]) + bytes([addr[-1] ^ 0x01])
+                out[row] = Address20(flipped)
+        else:
+            out[row] = not bool(out[row])
+        return out
+
     def _op(self, op: str, *args, **kwargs):
         if self.schedule.should_fail(f"dispatch.{op}"):
             time.sleep(self.hang_s)
-        self.schedule.fire(f"backend.{op}")
+        seam = f"backend.{op}"
+        if self.schedule.mode_for(seam) == "corrupt":
+            rows = len(args[0]) if args else 0
+            if rows == 0:
+                # nothing to corrupt: off the books, so the schedule's
+                # injected count stays equal to results actually
+                # corrupted (fault mode still raises on empty batches)
+                return getattr(self.inner, op)(*args, **kwargs)
+            inject, idx = self.schedule.decide(seam)
+            out = getattr(self.inner, op)(*args, **kwargs)
+            return self._corrupt_result(op, out, idx) if inject else out
+        self.schedule.fire(seam)
         return getattr(self.inner, op)(*args, **kwargs)
 
     def ecrecover_addresses(self, digests, sigs65):
@@ -235,6 +353,20 @@ class ChaosSigBackend(SigBackend):
         # raises (the staged launch), and a hang wedges the submitter
         if self.schedule.should_fail("dispatch.bls_verify_committees"):
             time.sleep(self.hang_s)
-        self.schedule.fire("backend.bls_verify_committees")
+        seam = "backend.bls_verify_committees"
+        if self.schedule.mode_for(seam) == "corrupt":
+            # corruption lands at PULL time, where a silently wrong
+            # device plane would materialize — the submit stays async
+            inject, idx = ((False, 0) if len(messages) == 0
+                           else self.schedule.decide(seam))
+            inner = self.inner.bls_verify_committees_async(
+                messages, sig_rows, pk_rows, pk_row_keys=pk_row_keys)
+            if not inject:
+                return inner
+            from gethsharding_tpu.sigbackend import VerdictFuture
+
+            return VerdictFuture(lambda: self._corrupt_result(
+                "bls_verify_committees", inner.result(), idx))
+        self.schedule.fire(seam)
         return self.inner.bls_verify_committees_async(
             messages, sig_rows, pk_rows, pk_row_keys=pk_row_keys)

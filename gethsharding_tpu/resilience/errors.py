@@ -30,6 +30,23 @@ class DispatcherClosed(ResilienceError):
     down; its futures are failed with this instead of hanging."""
 
 
+class SoundnessViolation(ResilienceError):
+    """The primary backend returned a result the soundness audit
+    rejects: a randomized spot-check row disagreed with the scalar
+    reference, or the always-on verdict-plane invariant check failed
+    (wrong row count, out-of-domain verdict, an empty committee row
+    verifying True).
+
+    This is SILENT corruption made loud: the device path raised
+    nothing, the answer was simply wrong. A `ResilienceError` (not a
+    ValueError/TypeError) on purpose — the failover face must count it
+    as a primary fault so the breaker trips on a corrupting device
+    exactly as it does on a crashing one, and during a half-open
+    differential probe it counts as a probe MISMATCH (the spot-check
+    compared against the same scalar truth the probe would have).
+    """
+
+
 class TransientError(Exception):
     """A failure the caller expects to succeed on retry.
 

@@ -70,9 +70,16 @@ class ServingSigBackend(SigBackend):
     def __init__(self, inner: SigBackend,
                  config: Optional[ServingConfig] = None,
                  registry: metrics.Registry = metrics.DEFAULT_REGISTRY):
-        if isinstance(inner, ServingSigBackend):
-            raise ValueError("refusing to nest serving backends: one "
-                             "admission tier per device")
+        # one admission tier per device — including a serving backend
+        # hiding under thin wrappers (the soundness spot-checker, a
+        # chaos front): walk the .inner chain so the guard can't be
+        # defeated by composition order
+        probe, hops = inner, 0
+        while probe is not None and hops < 8:
+            if isinstance(probe, ServingSigBackend):
+                raise ValueError("refusing to nest serving backends: one "
+                                 "admission tier per device")
+            probe, hops = getattr(probe, "inner", None), hops + 1
         self.inner = inner
         self.config = config or ServingConfig()
         self.name = f"serving+{inner.name}"

@@ -27,6 +27,13 @@ the CLI exposes ``--sigbackend``.
   served scalar while open, and a half-open differential spot-check
   re-promotes the accelerated path only when it agrees with the
   fallback byte-for-byte.
+- the soundness spot-checker
+  (``gethsharding_tpu/resilience/soundness.py``, ``--soundness-rate``)
+  composes between them: a drop-in wrapper re-verifying a seeded-
+  random row subset of a sampled fraction of dispatches against the
+  scalar reference, so a device that silently returns WRONG verdicts
+  (no exception to catch) still trips the breaker via
+  `SoundnessViolation` within a quantifiable number of dispatches.
 """
 
 from __future__ import annotations

@@ -164,6 +164,47 @@ print("chaos failover smoke OK: periods", periods,
       "injected", schedule.injected)
 PYEOF
 
+# -- soundness smoke: silent corruption (chaos mode=corrupt — wrong
+# answers, NO exception from the device path) must trip the breaker
+# through the spot-checker, every answer must still come back correct
+# from the scalar fallback, and the soundness counters must reach the
+# Prometheus exposition
+echo "== soundness smoke"
+JAX_PLATFORMS=cpu python - <<'PYEOF' || fail=1
+from gethsharding_tpu.metrics import DEFAULT_REGISTRY, prometheus_text
+from gethsharding_tpu.resilience.breaker import (
+    OPEN, CircuitBreaker, FailoverSigBackend)
+from gethsharding_tpu.resilience.chaos import ChaosSigBackend, parse_spec
+from gethsharding_tpu.resilience.soundness import SpotCheckSigBackend
+from gethsharding_tpu.sigbackend import PythonSigBackend
+
+schedule = parse_spec("seed=7,backend.ecrecover_addresses:mode=corrupt")
+breaker = CircuitBreaker(name="soundness", fault_threshold=1,
+                         reset_s=60.0)
+backend = FailoverSigBackend(
+    SpotCheckSigBackend(ChaosSigBackend(PythonSigBackend(), schedule),
+                        rate=1.0),
+    PythonSigBackend(), breaker=breaker)
+digests, sigs = [b"\x11" * 32] * 4, [b"\x22" * 65] * 4
+want = PythonSigBackend().ecrecover_addresses(digests, sigs)
+got = backend.ecrecover_addresses(digests, sigs)
+assert got == want, got  # detected -> served correct from the fallback
+assert breaker.state == OPEN, breaker.state_name  # tripped on SILENT
+assert DEFAULT_REGISTRY.counter(
+    "resilience/soundness/ecrecover_addresses/mismatches").value >= 1
+assert schedule.injected.get("backend.ecrecover_addresses") == 1
+# ... and the counters reach the scrape surface
+prom = prometheus_text()
+for needle in ("gethsharding_resilience_soundness_ecrecover_addresses_"
+               "checks_total",
+               "gethsharding_resilience_soundness_ecrecover_addresses_"
+               "mismatches_total",
+               "gethsharding_resilience_breaker_soundness_trips_total"):
+    assert needle in prom, needle
+print("soundness smoke OK: silent corruption tripped the breaker,"
+      " answers stayed correct")
+PYEOF
+
 for f in tests/test_*.py; do
     echo "== $f"
     python -m pytest "$f" -q --no-header || fail=1

@@ -1,0 +1,13 @@
+#!/bin/bash
+# Continuous soundness audit on the REAL device path: the overhead of
+# the spot-check audit (always-on invariant sweep + rate-amortized
+# scalar re-verification) measured against a real jax ecrecover
+# dispatch — asserted <2% inside bench.py — plus the closed-loop
+# proof that an every-dispatch silent corruptor (chaos mode=corrupt,
+# no exception ever raised) trips the failover breaker within the
+# dispatch budget detection_probability predicts.
+cd /root/repo || exit 1
+env GETHSHARDING_BENCH_SOUNDNESS_BACKEND=jax \
+  timeout 1800 python bench.py --soundness >"$1.out" 2>"$1.err"
+grep -q soundness_overhead_pct "$1.out" \
+    && grep -q '"dispatches_to_trip"' "$1.out"

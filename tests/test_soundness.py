@@ -1,0 +1,674 @@
+"""The continuous soundness audit (gethsharding_tpu/resilience/
+soundness.py): randomized spot-checks against the scalar reference,
+the always-on verdict-plane invariant check, the chaos silent-
+corruption mode that feeds it, and the breaker composition that turns
+a detected silent corruption into a trip — sync, async, and serving.
+"""
+
+import pytest
+
+from gethsharding_tpu import metrics
+from gethsharding_tpu.crypto import bn256 as bls
+from gethsharding_tpu.resilience.breaker import (
+    CLOSED, OPEN, CircuitBreaker, FailoverSigBackend)
+from gethsharding_tpu.resilience.chaos import (
+    ChaosSchedule, ChaosSigBackend, parse_spec, unwired_seams)
+from gethsharding_tpu.resilience.errors import SoundnessViolation
+from gethsharding_tpu.resilience.soundness import (
+    DEFAULT_ROWS, SpotCheckSigBackend, detection_probability,
+    dispatches_to_detect, soundness_table)
+from gethsharding_tpu.serving import ServingConfig, ServingSigBackend
+from gethsharding_tpu.sigbackend import PythonSigBackend, VerdictFuture
+
+
+def _garbage_rows(n):
+    """n invalid ecrecover rows (both backends answer None per row)."""
+    return ([b"\x11" * 32] * n, [b"\x22" * 65] * n)
+
+
+def _committees(n_rows=2, members=2, tamper_row=None):
+    """Real BLS committee rows; `tamper_row` signs a wrong message so
+    the scalar verdict plane has both True and False rows."""
+    msgs, sig_rows, pk_rows = [], [], []
+    for i in range(n_rows):
+        tag = b"soundness-%d" % i
+        keys = [bls.bls_keygen(tag + bytes([j])) for j in range(members)]
+        sigs = [bls.bls_sign(tag, sk) for sk, _ in keys]
+        if i == tamper_row:
+            sigs[0] = bls.bls_sign(b"tampered", keys[0][0])
+        msgs.append(tag)
+        sig_rows.append(sigs)
+        pk_rows.append([pk for _, pk in keys])
+    return msgs, sig_rows, pk_rows
+
+
+def _spot(inner, rate=1.0, rows=DEFAULT_ROWS, seed=0):
+    registry = metrics.Registry()
+    backend = SpotCheckSigBackend(inner, rate=rate, rows=rows, seed=seed,
+                                  registry=registry)
+    return backend, registry
+
+
+def _count(registry, op, which):
+    return registry.counter(f"resilience/soundness/{op}/{which}").value
+
+
+# -- the soundness accounting ------------------------------------------------
+
+
+def test_detection_probability_math():
+    # full-coverage check of a fully corrupted dispatch is certain
+    assert detection_probability(1.0, 8, 8, corrupt_rows=8) == 1.0
+    # sampling every row catches any corruption at rate 1
+    assert detection_probability(1.0, 64, 64, corrupt_rows=1) == 1.0
+    # rate scales the per-dispatch probability linearly
+    p1 = detection_probability(1.0, 4, 64)
+    assert detection_probability(0.5, 4, 64) == pytest.approx(p1 / 2)
+    # more checked rows / more dispatches never hurt
+    assert detection_probability(0.5, 8, 64) > detection_probability(
+        0.5, 4, 64)
+    assert detection_probability(0.5, 4, 64, dispatches=100) > \
+        detection_probability(0.5, 4, 64, dispatches=10)
+    # the closed form matches the 1-row hypergeometric: s/n
+    assert detection_probability(1.0, 4, 64) == pytest.approx(4 / 64)
+    with pytest.raises(ValueError):
+        detection_probability(1.5, 4, 64)
+    with pytest.raises(ValueError):
+        detection_probability(0.5, 4, 0)
+
+
+def test_dispatches_to_detect_budget():
+    assert dispatches_to_detect(1.0, 8, 8) == 1  # p=1: first dispatch
+    budget = dispatches_to_detect(0.25, 4, 8, confidence=0.999)
+    p = detection_probability(0.25, 4, 8)
+    # the budget is the smallest D with 1-(1-p)^D >= confidence
+    assert 1.0 - (1.0 - p) ** budget >= 0.999
+    assert 1.0 - (1.0 - p) ** (budget - 1) < 0.999
+    with pytest.raises(ValueError):
+        dispatches_to_detect(0.0, 4, 8)  # undetectable: no budget exists
+    with pytest.raises(ValueError):
+        dispatches_to_detect(0.5, 4, 8, confidence=1.0)
+
+
+def test_soundness_table_shape():
+    table = soundness_table(64, 4, rates=(0.05, 1.0))
+    assert [row["rate"] for row in table] == [0.05, 1.0]
+    assert all(0.0 < row["p_detect_per_dispatch"] <= 1.0 for row in table)
+    assert all(row["dispatches_p99"] >= 1 for row in table)
+
+
+# -- clean-path behavior -----------------------------------------------------
+
+
+def test_spot_check_clean_backend_all_ops_byte_identical():
+    backend, registry = _spot(PythonSigBackend(), rate=1.0)
+    py = PythonSigBackend()
+
+    digests, sigs = _garbage_rows(5)
+    assert backend.ecrecover_addresses(digests, sigs) == \
+        py.ecrecover_addresses(digests, sigs)
+
+    msgs, sig_rows, pk_rows = _committees(2, members=1, tamper_row=1)
+    want = py.bls_verify_committees(msgs, sig_rows, pk_rows)
+    assert want == [True, False]  # the plane has both verdicts
+    assert backend.bls_verify_committees(msgs, sig_rows, pk_rows) == want
+
+    agg_sigs = [bls.bls_aggregate_sigs(row) for row in sig_rows]
+    agg_pks = [bls.bls_aggregate_pks(row) for row in pk_rows]
+    assert backend.bls_verify_aggregates(msgs, agg_sigs, agg_pks) == \
+        py.bls_verify_aggregates(msgs, agg_sigs, agg_pks)
+
+    # malformed das rows are False on both sides, never an exception
+    assert backend.das_verify_samples(
+        [b"\x00" * 16], [0], [[]], [b"\x01" * 32]) == [False]
+
+    for op in ("ecrecover_addresses", "bls_verify_committees",
+               "bls_verify_aggregates", "das_verify_samples"):
+        assert _count(registry, op, "checks") == 1
+        assert _count(registry, op, "mismatches") == 0
+        assert _count(registry, op, "invariant_violations") == 0
+    assert _count(registry, "ecrecover_addresses", "rows") == 4
+
+
+def test_spot_check_sampling_is_seeded_and_deterministic():
+    runs = []
+    for _ in range(2):
+        backend, registry = _spot(PythonSigBackend(), rate=0.5, seed=7)
+        for _ in range(40):
+            backend.ecrecover_addresses(*_garbage_rows(6))
+        runs.append(_count(registry, "ecrecover_addresses", "checks"))
+    assert runs[0] == runs[1]  # same seed, same decisions
+    assert 0 < runs[0] < 40    # and it IS sampling, not all-or-nothing
+
+
+def test_spot_check_rate_zero_never_checks_but_invariants_stay_on():
+    class _ShortBackend(PythonSigBackend):
+        name = "short"
+
+        def ecrecover_addresses(self, digests, sigs65):
+            return super().ecrecover_addresses(digests, sigs65)[:-1]
+
+    backend, registry = _spot(_ShortBackend(), rate=0.0)
+    with pytest.raises(SoundnessViolation, match="result rows"):
+        backend.ecrecover_addresses(*_garbage_rows(3))
+    assert _count(registry, "ecrecover_addresses", "checks") == 0
+    assert _count(registry, "ecrecover_addresses",
+                  "invariant_violations") == 1
+
+
+# -- detection: spot-check mismatches ---------------------------------------
+
+
+def test_spot_check_detects_corrupted_ecrecover():
+    schedule = ChaosSchedule(
+        seed=1, rules={"backend.ecrecover_addresses": True},
+        modes={"backend.ecrecover_addresses": "corrupt"})
+    backend, registry = _spot(
+        ChaosSigBackend(PythonSigBackend(), schedule), rate=1.0, rows=8)
+    with pytest.raises(SoundnessViolation, match="mismatch"):
+        backend.ecrecover_addresses(*_garbage_rows(4))
+    assert _count(registry, "ecrecover_addresses", "mismatches") == 1
+
+
+def test_spot_check_detects_flipped_committee_verdict():
+    schedule = ChaosSchedule(
+        seed=2, rules={"backend.bls_verify_committees": True},
+        modes={"backend.bls_verify_committees": "corrupt"})
+    backend, registry = _spot(
+        ChaosSigBackend(PythonSigBackend(), schedule), rate=1.0)
+    msgs, sig_rows, pk_rows = _committees(2, members=1)
+    with pytest.raises(SoundnessViolation, match="mismatch"):
+        backend.bls_verify_committees(msgs, sig_rows, pk_rows)
+    assert _count(registry, "bls_verify_committees", "mismatches") == 1
+
+
+def test_spot_check_detects_corrupted_das_verdict():
+    schedule = ChaosSchedule(
+        seed=3, rules={"backend.das_verify_samples": True},
+        modes={"backend.das_verify_samples": "corrupt"})
+    backend, registry = _spot(
+        ChaosSigBackend(PythonSigBackend(), schedule), rate=1.0)
+    # a malformed row is False by contract; the corruptor flips it True
+    with pytest.raises(SoundnessViolation, match="mismatch"):
+        backend.das_verify_samples([b"\x00" * 16], [0], [[]],
+                                   [b"\x01" * 32])
+    assert _count(registry, "das_verify_samples", "mismatches") == 1
+
+
+def test_spot_check_violation_emits_trace_event(tracer):
+    schedule = ChaosSchedule(
+        seed=1, rules={"backend.ecrecover_addresses": True},
+        modes={"backend.ecrecover_addresses": "corrupt"})
+    backend, _ = _spot(
+        ChaosSigBackend(PythonSigBackend(), schedule), rate=1.0, rows=8)
+    with pytest.raises(SoundnessViolation):
+        backend.ecrecover_addresses(*_garbage_rows(4))
+    names = {span["name"] for span in tracer.recent_spans()}
+    assert "resilience/soundness/violation" in names
+
+
+# -- detection: the always-on invariant plane --------------------------------
+
+
+def test_invariant_rejects_out_of_domain_verdicts():
+    class _WeirdBackend(PythonSigBackend):
+        name = "weird"
+
+        def das_verify_samples(self, chunks, indices, proofs, roots):
+            return [2] * len(chunks)  # not a 0/1 verdict
+
+    backend, registry = _spot(_WeirdBackend(), rate=0.0)
+    with pytest.raises(SoundnessViolation, match="0/1 domain"):
+        backend.das_verify_samples([b"\x00" * 16], [0], [[]],
+                                   [b"\x01" * 32])
+    assert _count(registry, "das_verify_samples",
+                  "invariant_violations") == 1
+
+
+def test_invariant_rejects_malformed_recovered_address():
+    class _StubbyBackend(PythonSigBackend):
+        name = "stubby"
+
+        def ecrecover_addresses(self, digests, sigs65):
+            return [b"\x01\x02"] * len(digests)  # not 20 bytes
+
+    backend, _ = _spot(_StubbyBackend(), rate=0.0)
+    with pytest.raises(SoundnessViolation, match="20 bytes"):
+        backend.ecrecover_addresses(*_garbage_rows(2))
+
+
+def test_invariant_rejects_empty_committee_row_verifying_true():
+    class _GullibleBackend(PythonSigBackend):
+        name = "gullible"
+
+        def bls_verify_committees(self, messages, sig_rows, pk_rows,
+                                  pk_row_keys=None):
+            return [True] * len(messages)  # even for empty committees
+
+    backend, registry = _spot(_GullibleBackend(), rate=0.0)
+    with pytest.raises(SoundnessViolation, match="empty committee"):
+        backend.bls_verify_committees([b"m"], [[]], [[]])
+    assert _count(registry, "bls_verify_committees",
+                  "invariant_violations") == 1
+
+
+# -- async + serving faces ---------------------------------------------------
+
+
+def test_async_spot_check_runs_at_pull_time_and_counts_once():
+    schedule = ChaosSchedule(
+        seed=4, rules={"backend.bls_verify_committees": True},
+        modes={"backend.bls_verify_committees": "corrupt"})
+    backend, registry = _spot(
+        ChaosSigBackend(PythonSigBackend(), schedule), rate=1.0)
+    msgs, sig_rows, pk_rows = _committees(2, members=1)
+    future = backend.bls_verify_committees_async(msgs, sig_rows, pk_rows)
+    with pytest.raises(SoundnessViolation):
+        future.result()
+    with pytest.raises(SoundnessViolation):
+        future.result()  # memoized: re-raised, not re-derived
+    assert _count(registry, "bls_verify_committees", "mismatches") == 1
+
+
+def test_serving_submit_face_spot_checks_at_pull_time():
+    schedule = ChaosSchedule(
+        seed=5, rules={"backend.ecrecover_addresses": True},
+        modes={"backend.ecrecover_addresses": "corrupt"})
+    serving = ServingSigBackend(
+        ChaosSigBackend(PythonSigBackend(), schedule),
+        ServingConfig(flush_us=100.0))
+    backend, registry = _spot(serving, rate=1.0, rows=8)
+    try:
+        future = backend.submit("ecrecover_addresses", *_garbage_rows(3))
+        with pytest.raises(SoundnessViolation):
+            future.result()
+        with pytest.raises(SoundnessViolation):
+            future.result()  # memoized
+        assert _count(registry, "ecrecover_addresses", "mismatches") == 1
+        # the clean tail still serves byte-identical answers
+        schedule.rules["backend.ecrecover_addresses"] = False
+        want = PythonSigBackend().ecrecover_addresses(*_garbage_rows(3))
+        assert backend.submit("ecrecover_addresses",
+                              *_garbage_rows(3)).result() == want
+    finally:
+        serving.close()
+
+
+def test_serving_nesting_guard_sees_through_the_spot_checker():
+    serving = ServingSigBackend(PythonSigBackend(),
+                                ServingConfig(flush_us=100.0))
+    try:
+        wrapped, _ = _spot(serving, rate=1.0)
+        with pytest.raises(ValueError, match="nest serving"):
+            ServingSigBackend(wrapped, ServingConfig(flush_us=100.0))
+    finally:
+        serving.close()
+
+
+# -- the breaker composition: silent corruption trips ------------------------
+
+
+def _corrupt_failover(rate=1.0, rule=True, fault_threshold=1,
+                      reset_s=60.0, seed=0, rows=DEFAULT_ROWS,
+                      op="ecrecover_addresses"):
+    schedule = ChaosSchedule(seed=seed, rules={f"backend.{op}": rule},
+                             modes={f"backend.{op}": "corrupt"})
+    registry = metrics.Registry()
+    breaker = CircuitBreaker(name="snd", fault_threshold=fault_threshold,
+                             reset_s=reset_s, registry=registry)
+    spot = SpotCheckSigBackend(
+        ChaosSigBackend(PythonSigBackend(), schedule), rate=rate,
+        rows=rows, seed=seed, registry=registry)
+    backend = FailoverSigBackend(spot, PythonSigBackend(),
+                                 breaker=breaker, registry=registry)
+    return backend, breaker, registry, schedule
+
+
+def test_breaker_trips_on_silent_corruption_sync():
+    """ISSUE 7 acceptance, sync: the corrupting primary raises NOTHING,
+    yet the spot-check trips the breaker and the caller still gets the
+    right answer (served from the fallback)."""
+    backend, breaker, registry, _ = _corrupt_failover(rate=1.0, rows=8)
+    want = PythonSigBackend().ecrecover_addresses(*_garbage_rows(4))
+    assert backend.ecrecover_addresses(*_garbage_rows(4)) == want
+    assert breaker.state == OPEN
+    assert registry.counter("resilience/breaker/snd/trips").value == 1
+    # while open, the corrupting primary is not consulted at all
+    assert backend.ecrecover_addresses(*_garbage_rows(4)) == want
+
+
+def test_breaker_trips_within_predicted_dispatch_budget():
+    """The statistical contract: at rate r and s checked rows, an
+    every-dispatch corruptor must be caught within the
+    `dispatches_to_detect(confidence=0.999)` budget."""
+    rate, batch = 0.5, 8
+    budget = dispatches_to_detect(rate, DEFAULT_ROWS, batch,
+                                  confidence=0.999)
+    backend, breaker, _, _ = _corrupt_failover(rate=rate, seed=11)
+    tripped_at = None
+    for i in range(budget):
+        backend.ecrecover_addresses(*_garbage_rows(batch))
+        if breaker.state == OPEN:
+            tripped_at = i + 1
+            break
+    assert tripped_at is not None and tripped_at <= budget
+
+
+def test_breaker_trips_on_silent_corruption_async():
+    """The async face: corruption lands at pull time, the violation
+    surfaces through the failover finalize, the breaker trips, and the
+    caller's future resolves to the fallback's correct answer."""
+    backend, breaker, registry, _ = _corrupt_failover(
+        op="bls_verify_committees")
+    msgs, sig_rows, pk_rows = _committees(2, members=1, tamper_row=1)
+    want = PythonSigBackend().bls_verify_committees(msgs, sig_rows,
+                                                    pk_rows)
+    future = backend.bls_verify_committees_async(msgs, sig_rows, pk_rows)
+    assert future.result() == want  # recovered on the fallback
+    assert breaker.state == OPEN
+    assert future.result() == want  # idempotent
+    assert registry.counter(
+        "resilience/breaker/snd/primary_faults").value == 1
+
+
+def test_breaker_trips_on_silent_corruption_through_serving():
+    """The full production composition: chaos-corrupted device under
+    the coalescing serving tier, spot-checker over it, failover over
+    everything — a silently wrong serving future trips the breaker at
+    pull time and the caller still gets the right rows."""
+    schedule = ChaosSchedule(
+        seed=6, rules={"backend.ecrecover_addresses": True},
+        modes={"backend.ecrecover_addresses": "corrupt"})
+    registry = metrics.Registry()
+    breaker = CircuitBreaker(name="snd", fault_threshold=1, reset_s=60,
+                             registry=registry)
+    serving = ServingSigBackend(
+        ChaosSigBackend(PythonSigBackend(), schedule),
+        ServingConfig(flush_us=100.0))
+    spot = SpotCheckSigBackend(serving, rate=1.0, rows=8,
+                               registry=registry)
+    backend = FailoverSigBackend(spot, PythonSigBackend(),
+                                 breaker=breaker, registry=registry)
+    try:
+        want = PythonSigBackend().ecrecover_addresses(*_garbage_rows(3))
+        future = backend.submit("ecrecover_addresses", *_garbage_rows(3))
+        assert future.result() == want
+        assert breaker.state == OPEN
+        assert registry.counter(
+            "resilience/soundness/ecrecover_addresses/mismatches"
+        ).value == 1
+        assert future.result() == want  # memoized end to end
+        assert registry.counter(
+            "resilience/breaker/snd/primary_faults").value == 1
+    finally:
+        serving.close()
+
+
+def test_zero_false_trips_on_a_clean_primary():
+    """With corruption off, spot-checking at full rate must never trip:
+    every check agrees, the breaker stays closed."""
+    registry = metrics.Registry()
+    breaker = CircuitBreaker(name="snd", fault_threshold=1, reset_s=60,
+                             registry=registry)
+    spot = SpotCheckSigBackend(PythonSigBackend(), rate=1.0,
+                               registry=registry)
+    backend = FailoverSigBackend(spot, PythonSigBackend(),
+                                 breaker=breaker, registry=registry)
+    msgs, sig_rows, pk_rows = _committees(2, members=1, tamper_row=0)
+    for _ in range(10):
+        backend.ecrecover_addresses(*_garbage_rows(5))
+        backend.bls_verify_committees(msgs, sig_rows, pk_rows)
+    assert breaker.state == CLOSED
+    assert registry.counter("resilience/breaker/snd/trips").value == 0
+    assert registry.counter(
+        "resilience/soundness/ecrecover_addresses/mismatches").value == 0
+
+
+# -- half-open + epoch interplay ---------------------------------------------
+
+
+def test_probe_soundness_violation_counts_as_probe_mismatch_once():
+    """A spot-check violation DURING the half-open differential probe
+    is the probe's verdict: exactly one probe_mismatches count, no
+    extra primary fault (no double-accounting), breaker back to open,
+    fallback answer served."""
+    backend, breaker, registry, _ = _corrupt_failover(reset_s=0.0)
+    breaker.record_fault(RuntimeError("seed fault"))
+    assert breaker.state == OPEN
+    want = PythonSigBackend().ecrecover_addresses(*_garbage_rows(4))
+    assert backend.ecrecover_addresses(*_garbage_rows(4)) == want  # probe
+    assert breaker.state == OPEN
+    assert registry.counter(
+        "resilience/breaker/snd/probe_mismatches").value == 1
+    # only the seed fault is on the counter: the violation was counted
+    # as the probe's mismatch verdict, not ALSO as a primary fault
+    assert registry.counter(
+        "resilience/breaker/snd/primary_faults").value == 1
+
+
+def test_probe_match_after_corruption_heals_recloses():
+    """first-n corrupt rule: the corruption window ends, the next probe
+    agrees byte-for-byte, and the breaker re-promotes the primary —
+    the corrupt mode composes with the standard recovery cycle."""
+    backend, breaker, _, schedule = _corrupt_failover(
+        rate=1.0, rule=1, reset_s=0.0)
+    want = PythonSigBackend().ecrecover_addresses(*_garbage_rows(4))
+    assert backend.ecrecover_addresses(*_garbage_rows(4)) == want  # trips
+    assert breaker.state == OPEN
+    assert backend.ecrecover_addresses(*_garbage_rows(4)) == want  # probe
+    assert breaker.state == CLOSED
+    assert schedule.injected.get("backend.ecrecover_addresses") == 1
+
+
+def test_stale_pre_trip_future_violation_does_not_retrip():
+    """Epoch guard: a corrupted async dispatch submitted BEFORE a trip
+    + recovery must not re-trip the recovered primary when its future
+    is finally pulled — the violation is a stale outcome (PR 4's
+    rule), counted on the fault metric but not toward tripping."""
+    backend, breaker, registry, schedule = _corrupt_failover(
+        rate=1.0, rule=1, reset_s=0.0, op="bls_verify_committees")
+    msgs, sig_rows, pk_rows = _committees(2, members=1)
+    want = PythonSigBackend().bls_verify_committees(msgs, sig_rows,
+                                                    pk_rows)
+    # submit while closed: this dispatch IS the one corrupted call
+    stale = backend.bls_verify_committees_async(msgs, sig_rows, pk_rows)
+    # an unrelated loud fault trips the breaker...
+    breaker.record_fault(RuntimeError("loud fault"), epoch=breaker.epoch)
+    assert breaker.state == OPEN
+    # ...and a matching probe recovers it (the corrupt rule has healed)
+    assert backend.bls_verify_committees(msgs, sig_rows, pk_rows) == want
+    assert breaker.state == CLOSED
+    epoch_after_recovery = breaker.epoch
+    # NOW the stale future drains: the violation fires, is recovered on
+    # the fallback, and must not re-trip the recovered primary
+    assert stale.result() == want
+    assert breaker.state == CLOSED
+    assert breaker.epoch == epoch_after_recovery
+    assert registry.counter(
+        "resilience/soundness/bls_verify_committees/mismatches").value == 1
+
+
+# -- chaos corrupt mode ------------------------------------------------------
+
+
+def test_chaos_corrupt_mode_is_silent_and_seeded():
+    digests, sigs = _garbage_rows(4)
+    outs = []
+    for _ in range(2):
+        schedule = ChaosSchedule(
+            seed=9, rules={"backend.ecrecover_addresses": True},
+            modes={"backend.ecrecover_addresses": "corrupt"})
+        chaotic = ChaosSigBackend(PythonSigBackend(), schedule)
+        outs.append(chaotic.ecrecover_addresses(digests, sigs))
+        assert schedule.injected["backend.ecrecover_addresses"] == 1
+    assert outs[0] == outs[1]  # same seed corrupts the same row the same
+    clean = PythonSigBackend().ecrecover_addresses(digests, sigs)
+    assert outs[0] != clean
+    # exactly one row perturbed, same row count (silent, not loud)
+    assert len(outs[0]) == len(clean)
+    assert sum(a != b for a, b in zip(outs[0], clean)) == 1
+
+
+def test_chaos_corrupt_first_n_heals():
+    schedule = ChaosSchedule(
+        seed=9, rules={"backend.das_verify_samples": 2},
+        modes={"backend.das_verify_samples": "corrupt"})
+    chaotic = ChaosSigBackend(PythonSigBackend(), schedule)
+    row = ([b"\x00" * 16], [0], [[]], [b"\x01" * 32])
+    assert chaotic.das_verify_samples(*row) == [True]   # flipped
+    assert chaotic.das_verify_samples(*row) == [True]   # flipped
+    assert chaotic.das_verify_samples(*row) == [False]  # healed
+
+
+def test_chaos_corrupt_empty_batch_passes_through_off_the_books():
+    """An empty batch has nothing to corrupt: it must pass through
+    WITHOUT consuming a schedule slot or counting as injected, so
+    `schedule.injected` equals results actually corrupted (the number
+    bench --chaos reports detected counts against) — sync and async."""
+    schedule = ChaosSchedule(
+        seed=9, rules={"backend": True}, modes={"backend": "corrupt"})
+    chaotic = ChaosSigBackend(PythonSigBackend(), schedule)
+    assert chaotic.ecrecover_addresses([], []) == []
+    assert chaotic.bls_verify_committees_async([], [], []).result() == []
+    assert schedule.injected == {}
+    assert schedule.calls("backend.ecrecover_addresses") == 0
+    assert schedule.calls("backend.bls_verify_committees") == 0
+
+
+def test_chaos_corrupt_async_lands_at_pull_time():
+    schedule = ChaosSchedule(
+        seed=9, rules={"backend.bls_verify_committees": True},
+        modes={"backend.bls_verify_committees": "corrupt"})
+    chaotic = ChaosSigBackend(PythonSigBackend(), schedule)
+    msgs, sig_rows, pk_rows = _committees(2, members=1)
+    future = chaotic.bls_verify_committees_async(msgs, sig_rows, pk_rows)
+    clean = PythonSigBackend().bls_verify_committees(msgs, sig_rows,
+                                                     pk_rows)
+    got = future.result()
+    assert got != clean and len(got) == len(clean)
+
+
+def test_chaos_schedule_rejects_unknown_mode():
+    with pytest.raises(ValueError, match="frobnicate"):
+        ChaosSchedule(rules={"backend.x": True},
+                      modes={"backend.x": "frobnicate"})
+
+
+def test_corrupt_mode_restricted_to_backend_seams():
+    """mode=corrupt on a seam with no result plane (mainchain.*,
+    dispatch.*) would silently degrade to every-call LOUD faults — the
+    opposite of the requested experiment. It must fail fast instead,
+    through both the spec parser and the programmatic constructor."""
+    for spec in ("mainchain.block_number:mode=corrupt",
+                 "dispatch.bls_verify_committees:mode=corrupt",
+                 "mainchain.*:mode=corrupt"):
+        with pytest.raises(ValueError, match="backend"):
+            parse_spec(spec)
+    with pytest.raises(ValueError, match="backend"):
+        ChaosSchedule(rules={"mainchain.sign": True},
+                      modes={"mainchain.sign": "corrupt"})
+    # the bare backend prefix and backend.<op> stay legal
+    assert parse_spec("backend.*:mode=corrupt").modes == \
+        {"backend": "corrupt"}
+    assert parse_spec("backend.das_verify_samples:mode=corrupt").modes \
+        == {"backend.das_verify_samples": "corrupt"}
+
+
+# -- parse_spec + unwired seams ----------------------------------------------
+
+
+def test_parse_spec_corrupt_mode_entries():
+    schedule = parse_spec("seed=3,backend.*:mode=corrupt")
+    assert schedule.seed == 3
+    assert schedule.rules == {"backend": True}
+    assert schedule.modes == {"backend": "corrupt"}
+    assert schedule.mode_for("backend.ecrecover_addresses") == "corrupt"
+    # a mode entry composes with an explicit rule for the same seam
+    schedule = parse_spec(
+        "backend.ecrecover_addresses=2,"
+        "backend.ecrecover_addresses:mode=corrupt")
+    assert schedule.rules == {"backend.ecrecover_addresses": 2}
+    assert schedule.modes == {"backend.ecrecover_addresses": "corrupt"}
+    # un-tagged seams stay in fault mode
+    assert schedule.mode_for("backend.das_verify_samples") == "fault"
+
+
+def test_parse_spec_malformed_mode_fails_fast_naming_the_token():
+    with pytest.raises(ValueError, match="explode"):
+        parse_spec("backend.x:mode=explode")
+    with pytest.raises(ValueError, match="frob"):
+        parse_spec("backend.x:frob=corrupt")
+
+
+def test_unwired_seams_covers_corrupt_rules():
+    """A mode-only corrupt entry materializes a rule, so a caller that
+    never routes the backend seam through an injector (a bench or test
+    harness without a ChaosSigBackend) sees it flagged like any other
+    unwired rule."""
+    schedule = parse_spec("seed=1,backend.ecrecover_addresses:mode=corrupt,"
+                          "mainchain.sign=2")
+    assert unwired_seams(schedule, ("mainchain",)) == \
+        ["backend.ecrecover_addresses"]
+    assert unwired_seams(schedule, ("mainchain", "backend")) == []
+
+
+# -- exports + surfaces ------------------------------------------------------
+
+
+def test_every_public_errors_class_is_exported():
+    """PR 4 shipped `FetchAborted` missing from the package `__all__`;
+    the lint-style contract: every public exception class defined in
+    resilience/errors.py is importable from the package and listed in
+    its `__all__`, so the next error type can't regress it."""
+    import gethsharding_tpu.resilience as resilience
+    from gethsharding_tpu.resilience import errors
+
+    public = [name for name in dir(errors)
+              if not name.startswith("_")
+              and isinstance(getattr(errors, name), type)
+              and issubclass(getattr(errors, name), BaseException)
+              and getattr(errors, name).__module__ == errors.__name__]
+    assert public  # the contract is vacuous if discovery breaks
+    for name in public:
+        assert name in resilience.__all__, (
+            f"{name} defined in resilience/errors.py but missing from "
+            f"resilience.__all__")
+        assert getattr(resilience, name) is getattr(errors, name)
+
+
+def test_describe_reports_knobs_and_detection():
+    backend, _ = _spot(PythonSigBackend(), rate=0.25, rows=4)
+    info = backend.describe()
+    assert info["rate"] == 0.25
+    assert info["rows_per_check"] == 4
+    assert info["reference"] == "python"
+    assert info["p_detect_per_dispatch_64"] == pytest.approx(
+        detection_probability(0.25, 4, 64), abs=1e-6)
+    assert info["dispatches_p99_64"] == dispatches_to_detect(0.25, 4, 64)
+
+
+def test_soundness_counters_reach_prometheus_exposition():
+    from gethsharding_tpu.metrics import prometheus_text
+
+    metrics.counter(
+        "resilience/soundness/ecrecover_addresses/checks").inc(2)
+    metrics.counter(
+        "resilience/soundness/ecrecover_addresses/mismatches").inc(0)
+    text = prometheus_text()
+    for needle in (
+            "gethsharding_resilience_soundness_ecrecover_addresses_"
+            "checks_total",
+            "gethsharding_resilience_soundness_ecrecover_addresses_"
+            "mismatches_total"):
+        assert needle in text, needle
+
+
+@pytest.fixture
+def tracer():
+    from gethsharding_tpu import tracing
+
+    tracing.enable(ring_spans=65536)
+    tracing.TRACER.clear()
+    yield tracing.TRACER
+    tracing.disable()
+    tracing.TRACER.clear()
