@@ -791,6 +791,83 @@ def measure_serving() -> dict:
     }
 
 
+def measure_fleet() -> dict:
+    """The fleet-serving acceptance run: 3 breaker-guarded serving
+    replicas behind the shard router, driven by the traffic-model soak
+    (diurnal curve, hot-shard skew, thundering-herd burst, mixed
+    admission classes) while a seeded chaos schedule trips replica
+    r0's breaker mid-soak. Asserts the ISSUE 8 closed-loop bar:
+
+    - zero divergences (every result verified against the known
+      signer) and zero hung clients — nothing lost or mis-answered;
+    - r0 drained at least once and RE-ENTERED through half-open
+      re-promotion;
+    - interactive saw ZERO sheds and held its p99 SLO, while the
+      catchup_replay flood was shed first (replica-level counters).
+
+    Hermetic by default (python replicas — the SLO default is
+    calibrated for scalar host crypto; tighten
+    GETHSHARDING_FLEET_SLO_INTERACTIVE_MS on an accelerator)."""
+    duration = float(os.environ.get("GETHSHARDING_BENCH_FLEET_S", "12"))
+    slo_ms = float(os.environ.get(
+        "GETHSHARDING_FLEET_SLO_INTERACTIVE_MS", "8000"))
+    backend = os.environ.get("GETHSHARDING_BENCH_FLEET_BACKEND", "python")
+    clients = int(os.environ.get("GETHSHARDING_BENCH_FLEET_CLIENTS", "16"))
+    cmd = [sys.executable,
+           os.path.join(REPO, "scripts", "serving_stress.py"),
+           "--replicas", "3", "--clients", str(clients),
+           "--duration", str(duration), "--backend", backend,
+           "--max-batch", "16", "--queue-cap", "16", "--policy", "shed",
+           "--classes", "interactive=8,bulk_audit=4,catchup_replay=4",
+           "--chaos-trip", "10", "--hot-shard", "0.9",
+           "--diurnal-s", str(max(4.0, duration / 2)),
+           "--herd-at", str(duration / 3),
+           "--slo-interactive-ms", str(slo_ms)]
+    env = {**os.environ}
+    if backend == "python":
+        env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=duration * 20 + 120, cwd=REPO, env=env)
+    lines = [line for line in proc.stdout.strip().splitlines()
+             if line.startswith("{")]
+    assert lines, f"no soak output (rc {proc.returncode}): {proc.stderr}"
+    summary = json.loads(lines[-1])
+    assert summary.get("summary") and summary.get("fleet"), summary
+    # the closed-loop acceptance assertions (the soak gates these too —
+    # rc != 0 means one of them failed inside the run)
+    assert proc.returncode == 0, (summary, proc.stderr[-2000:])
+    assert summary["divergences"] == 0, summary
+    assert summary["hung_clients"] == 0, summary
+    assert summary["interactive_shed"] == 0, summary
+    assert summary["drain_events"] >= 1, summary
+    assert summary["reentered"], summary
+    assert summary["chaos_injected"] >= 3, summary
+    sheds = summary["replica_shed_by_class"]
+    caller = summary["caller_shed"]
+    assert sheds["interactive"] == 0, summary
+    assert sheds["catchup_replay"] + caller["catchup_replay"] > 0, (
+        "the catchup flood never shed — the overload phase tested "
+        "nothing", summary)
+    assert summary["p99_ms"]["interactive"] <= slo_ms, summary
+    return {
+        "replicas": 3,
+        "clients": clients,
+        "backend": backend,
+        "platform": "cpu" if backend == "python"
+        else (_probe_backend() or "cpu"),
+        "duration_s": duration,
+        "p99_ms": summary["p99_ms"],
+        "slo_ms": summary["slo_ms"],
+        "done": summary["done"],
+        "replica_shed_by_class": sheds,
+        "caller_shed": caller,
+        "drain_events": summary["drain_events"],
+        "reentries": summary["reentries"],
+        "chaos_injected": summary["chaos_injected"],
+        "states": summary["states"],
+    }
+
+
 def measure_chaos() -> dict:
     """Failover availability under a seeded chaos schedule: N ecrecover
     calls through `FailoverSigBackend` while the primary backend is hit
@@ -1566,6 +1643,32 @@ def main() -> None:
                 stats["serving_rate"] / max(stats["direct_rate"], 1e-9), 4),
             "extra": {k: v for k, v in stats.items()
                       if k != "serving_rate"},
+        }))
+        return
+
+    if "--fleet" in sys.argv:
+        # the fleet-serving acceptance gate: the traffic-model soak
+        # (scripts/serving_stress.py --replicas) under a seeded chaos
+        # schedule that trips one replica's breaker mid-soak. The run
+        # IS the check: zero lost/mis-answered requests, the router
+        # drains and re-enters the tripped replica through half-open
+        # re-promotion, catchup_replay sheds first while interactive
+        # sees zero sheds and holds its p99 SLO.
+        stats = measure_fleet()
+        print(json.dumps({
+            "metric": "fleet_interactive_p99_ms",
+            "value": stats["p99_ms"]["interactive"],
+            "unit": (f"interactive p99 ms over a {stats['replicas']}"
+                     f"-replica routed fleet (SLO "
+                     f"{stats['slo_ms']['interactive']} ms; mid-soak "
+                     f"breaker trip + drain + re-entry; "
+                     f"{stats['clients']} mixed-class clients, "
+                     f"{stats['platform']})"),
+            "vs_baseline": round(
+                stats["p99_ms"]["interactive"]
+                / max(stats["slo_ms"]["interactive"], 1e-9), 4),
+            "extra": {k: v for k, v in stats.items() if k != "p99_ms"}
+            | {"p99_ms": stats["p99_ms"]},
         }))
         return
 

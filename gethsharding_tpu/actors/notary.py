@@ -26,6 +26,7 @@ from gethsharding_tpu import metrics, tracing
 from gethsharding_tpu.actors.base import Service
 from gethsharding_tpu.core.shard import Shard, ShardError
 from gethsharding_tpu.core.types import CollationHeader
+from gethsharding_tpu.serving.classes import CLASS_BULK_AUDIT, admission_class
 from gethsharding_tpu.mainchain.client import SMCClient
 from gethsharding_tpu.p2p.messages import CollationBodyRequest
 from gethsharding_tpu.p2p.service import P2PServer
@@ -581,8 +582,14 @@ class Notary(Service):
         with tracing.span("notary/audit", periods=len(spans),
                           rows=len(msgs)):
             with self.m_audit_latency.time():
-                ok = self.sig_backend.bls_verify_committees(
-                    msgs, sig_rows, pk_rows, pk_row_keys=pk_keys)
+                # the period audit is bulk traffic: behind a serving
+                # tier it must coalesce under the bulk_audit admission
+                # class (weighted share, shed before interactive), and
+                # the thread-local tag survives the failover/soundness
+                # wrapper composition in between
+                with admission_class(CLASS_BULK_AUDIT):
+                    ok = self.sig_backend.bls_verify_committees(
+                        msgs, sig_rows, pk_rows, pk_row_keys=pk_keys)
         self.audits_run += len(spans)
         for period, (start, end) in spans.items():
             results[period] = self._judge_period(
@@ -611,9 +618,13 @@ class Notary(Service):
                     rows = collected[period]
                     if rows is None:
                         continue
-                    future = self.sig_backend.bls_verify_committees_async(
-                        rows["msgs"], rows["sig_rows"], rows["pk_rows"],
-                        pk_row_keys=rows["pk_keys"])
+                    # bulk_audit admission class (see audit_periods)
+                    with admission_class(CLASS_BULK_AUDIT):
+                        future = (self.sig_backend
+                                  .bls_verify_committees_async(
+                                      rows["msgs"], rows["sig_rows"],
+                                      rows["pk_rows"],
+                                      pk_row_keys=rows["pk_keys"]))
                     pending.append((period, rows, future))
                 for period, rows, future in pending:
                     verdicts.append((period, rows, future.result()))
@@ -638,9 +649,11 @@ class Notary(Service):
             # judging (incl. the replay dispatch) after, so the metric
             # keeps one meaning across GETHSHARDING_NOTARY_OVERLAP
             t0 = time.monotonic()
-            future = self.sig_backend.bls_verify_committees_async(
-                collected["msgs"], collected["sig_rows"],
-                collected["pk_rows"], pk_row_keys=collected["pk_keys"])
+            # bulk_audit admission class (see audit_periods)
+            with admission_class(CLASS_BULK_AUDIT):
+                future = self.sig_backend.bls_verify_committees_async(
+                    collected["msgs"], collected["sig_rows"],
+                    collected["pk_rows"], pk_row_keys=collected["pk_keys"])
             submit_s = time.monotonic() - t0
 
         def finish() -> None:

@@ -1,0 +1,64 @@
+"""Fleet-scale serving: the shard-aware router over admission classes.
+
+The serving tier (gethsharding_tpu/serving/) coalesces ONE process's
+callers onto one device; the north star is millions of users hitting
+many frontends that share few devices. This package is the horizontal
+story on top of it:
+
+- ``router.py`` — a lightweight shard-aware router/balancer in front
+  of N ``chain_server`` replicas: consistent shard→replica affinity
+  (rendezvous hashing, so the device-resident pk-plane LRU stays warm),
+  per-replica health read from the breaker/soundness state, retry-on-
+  next-replica through the existing ``resilience/policy`` executors,
+  and breaker-aware draining (a tripped or corrupt-flagged replica
+  stops taking new work, finishes in-flight, and re-enters only after
+  its half-open differential probe re-promotes the primary).
+
+The admission-class vocabulary (``interactive`` / ``bulk_audit`` /
+``catchup_replay``: priorities, weighted batch shares, per-class
+deadlines, the thread-local ``admission_class`` tagging context) lives
+in ``serving/classes.py`` — it is policy the admission queue itself
+enforces, so the dependency runs one way (fleet → serving, never
+back). It is re-exported here because the fleet is where the classes
+earn their keep.
+"""
+
+from gethsharding_tpu.fleet.router import (
+    AllReplicasDraining,
+    FleetRouter,
+    Replica,
+    ReplicaState,
+    RouterSigBackend,
+    RpcReplicaBackend,
+)
+from gethsharding_tpu.serving.classes import (
+    ADMISSION_CLASSES,
+    CLASS_BULK_AUDIT,
+    CLASS_CATCHUP,
+    CLASS_INTERACTIVE,
+    ClassPolicy,
+    SHED_ORDER,
+    admission_class,
+    class_for,
+    current_admission,
+    default_policies,
+)
+
+__all__ = [
+    "ADMISSION_CLASSES",
+    "AllReplicasDraining",
+    "CLASS_BULK_AUDIT",
+    "CLASS_CATCHUP",
+    "CLASS_INTERACTIVE",
+    "ClassPolicy",
+    "FleetRouter",
+    "Replica",
+    "ReplicaState",
+    "RouterSigBackend",
+    "RpcReplicaBackend",
+    "SHED_ORDER",
+    "admission_class",
+    "class_for",
+    "current_admission",
+    "default_policies",
+]

@@ -1,0 +1,593 @@
+"""Shard-aware router/balancer in front of N chain_server replicas.
+
+Millions of users means many frontends sharing few devices: a frontend
+does not own a replica, it ROUTES to one. This module is that routing
+layer, kept deliberately lightweight — policy over existing pieces, no
+new protocol:
+
+- **shard affinity** — rendezvous (highest-random-weight) hashing maps
+  an affinity key (a shard id, a pk-row key, a DAS root) to a stable
+  replica preference order, so a shard's committee planes keep landing
+  on the replica whose device-resident pk-plane LRU already holds them.
+  Affinity survives replica set changes with minimal reshuffling: when
+  a replica drains, only ITS shards move; when it re-enters, exactly
+  those shards rebalance back. Keyless traffic (plain ecrecover) routes
+  least-in-flight.
+- **retry-on-next-replica** — one `resilience.policy.RetryExecutor`
+  (seam ``fleet.route``) drives the failover ladder: a transient
+  replica failure (connection loss, a watchdog `DeadlineExceeded`, a
+  `SoundnessViolation`, an admission shed) advances to the next replica
+  in the preference order; deterministic caller errors propagate on the
+  first throw. When no replica is accepting, callers get the typed
+  `AllReplicasDraining` — a fast, non-retryable overload signal.
+- **breaker-aware draining** — each replica exports health (its
+  failover breaker's state, plus an explicit drain flag); the router
+  marks a tripped or corrupt-flagged replica DRAINING: it takes no new
+  work, its in-flight calls finish, and while draining the router sends
+  a tiny probe call each health refresh so the replica's own half-open
+  differential probe can run — the replica re-enters the rotation only
+  after that probe re-promotes the primary (breaker closed). Transport-
+  dead replicas (consecutive connection failures) are TRIPPED and
+  re-enter after a cooldown plus a successful health read.
+
+Observability (``fleet/`` namespace, surfaced on /status and the
+Prometheus exposition): per-replica state gauge (0 healthy, 1 draining,
+2 tripped) and routed/failure counters (EWMA rates ride the counter
+snapshots), router-level failover / all-draining / rebalance counters,
+and the ``resilience/retry/fleet.route/*`` retry counters from the
+shared executor.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+import time
+from contextlib import contextmanager
+from typing import Callable, Dict, List, Optional
+
+from gethsharding_tpu import metrics
+from gethsharding_tpu.serving.classes import admission_class, class_for
+from gethsharding_tpu.resilience.errors import (
+    DeadlineExceeded,
+    DispatcherClosed,
+    SoundnessViolation,
+    TransientError,
+)
+from gethsharding_tpu.resilience.policy import RetryExecutor, RetryPolicy
+from gethsharding_tpu.serving.queue import ServingOverloadError
+
+log = logging.getLogger("fleet.router")
+
+
+class ReplicaState:
+    HEALTHY = "healthy"
+    DRAINING = "draining"
+    TRIPPED = "tripped"
+
+
+_STATE_GAUGE = {ReplicaState.HEALTHY: 0, ReplicaState.DRAINING: 1,
+                ReplicaState.TRIPPED: 2}
+
+
+class AllReplicasDraining(RuntimeError):
+    """No replica is accepting work (every one draining or tripped, or
+    every accepting one already refused this call). Deliberately NOT a
+    transient/retryable class: the fleet is saturated or down, and
+    hammering it from the router would be the thundering herd itself.
+    Callers queue upstream or surface the overload."""
+
+
+# failures worth trying the NEXT replica for: transport loss, a hung
+# dispatch the watchdog reaped, a shutdown race, detected corruption,
+# and admission sheds (an overloaded replica is routing information).
+# Everything else — ValueError, a revert, a logic bug — propagates.
+ROUTER_RETRYABLE = (ConnectionError, TimeoutError, OSError, TransientError,
+                    DeadlineExceeded, DispatcherClosed, SoundnessViolation,
+                    ServingOverloadError)
+
+# the subset that speaks to the TRANSPORT being dead (feeds the
+# consecutive-failure trip, unlike sheds/soundness which are the
+# replica's interior weather)
+_TRANSPORT_FAILURES = (ConnectionError, TimeoutError, OSError,
+                       DeadlineExceeded, DispatcherClosed)
+
+
+def breaker_of(backend):
+    """The failover breaker governing `backend`, found by walking the
+    wrapper chain (`.breaker` on the failover face; `.inner`/`.primary`
+    hops through serving/soundness/chaos wrappers). None when the
+    composition has no breaker."""
+    probe, hops = backend, 0
+    while probe is not None and hops < 8:
+        breaker = getattr(probe, "breaker", None)
+        if breaker is not None:
+            return breaker
+        probe = getattr(probe, "inner", None)
+        hops += 1
+    return None
+
+
+def default_health(backend) -> Callable[[], dict]:
+    """Health from the composition itself (in-process replicas): the
+    breaker's state name plus any explicit drain flag the backend
+    carries. Cross-process replicas replace this with the
+    ``shard_health`` RPC (`RpcReplicaBackend.health`)."""
+    def read() -> dict:
+        breaker = breaker_of(backend)
+        return {
+            "breaker": None if breaker is None else breaker.state_name,
+            "draining": bool(getattr(backend, "draining", False)),
+        }
+
+    return read
+
+
+def _default_probe(backend) -> Callable[[], None]:
+    """A minimal 1-row call: enough for the replica's half-open breaker
+    to run its differential probe (any input works — the probe compares
+    primary and fallback on the SAME rows, an unrecoverable signature
+    included)."""
+    def probe() -> None:
+        backend.ecrecover_addresses([b"\x00" * 32], [b"\x00" * 65])
+
+    return probe
+
+
+class Replica:
+    """One routed replica: its backend face, health source, and state.
+
+    `backend` is anything with the `SigBackend` batch ops (typically
+    ``FailoverSigBackend(ServingSigBackend(...))`` in-process, or an
+    `RpcReplicaBackend` dialing a chain_server). `health` overrides the
+    in-process default; `probe` overrides the draining-side probe call
+    (None disables probing — re-entry then relies on the replica's own
+    traffic running the half-open differential)."""
+
+    def __init__(self, name: str, backend,
+                 health: Optional[Callable[[], dict]] = None,
+                 probe: Optional[Callable[[], None]] = "default",
+                 trip_threshold: int = 3,
+                 trip_cooldown_s: float = 2.0,
+                 registry: metrics.Registry = metrics.DEFAULT_REGISTRY):
+        self.name = name
+        self.backend = backend
+        self.health = health or default_health(backend)
+        self.probe = _default_probe(backend) if probe == "default" else probe
+        self.trip_threshold = trip_threshold
+        self.trip_cooldown_s = trip_cooldown_s
+        self.state = ReplicaState.HEALTHY
+        self.in_flight = 0
+        self.drain_requested = False
+        self.drain_events = 0
+        self.reentries = 0
+        self._consecutive = 0
+        self._tripped_until = 0.0
+        self._lock = threading.Lock()
+        base = f"fleet/replica/{name}"
+        self._g_state = registry.gauge(f"{base}/state")
+        self._m_routed = registry.counter(f"{base}/routed")
+        self._m_failures = registry.counter(f"{base}/failures")
+
+    # -- flight accounting -------------------------------------------------
+
+    @contextmanager
+    def flight(self):
+        with self._lock:
+            self.in_flight += 1
+        self._m_routed.inc()
+        try:
+            yield
+        finally:
+            with self._lock:
+                self.in_flight -= 1
+
+    def note_success(self) -> None:
+        with self._lock:
+            self._consecutive = 0
+
+    def note_failure(self, exc: BaseException) -> None:
+        self._m_failures.inc()
+        if not isinstance(exc, _TRANSPORT_FAILURES):
+            return  # interior weather (shed, soundness): health decides
+        with self._lock:
+            self._consecutive += 1
+            if self._consecutive >= self.trip_threshold \
+                    and self.state != ReplicaState.TRIPPED:
+                self._set_state_locked(ReplicaState.TRIPPED)
+                self._tripped_until = (time.monotonic()
+                                       + self.trip_cooldown_s)
+                log.warning("replica %s tripped: %d consecutive transport "
+                            "failures (last: %r); cooling down %.1fs",
+                            self.name, self._consecutive, exc,
+                            self.trip_cooldown_s)
+
+    # -- health-driven state machine ---------------------------------------
+
+    def observe_health(self, health: Optional[dict],
+                       now: Optional[float] = None) -> None:
+        """Apply one health reading. None = the health read itself
+        failed (transport dead)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if health is None:
+                self._set_state_locked(ReplicaState.TRIPPED)
+                self._tripped_until = now + self.trip_cooldown_s
+                return
+            if self.state == ReplicaState.TRIPPED \
+                    and now < self._tripped_until:
+                return  # cooling down; a good health read can't shortcut
+            breaker = health.get("breaker")
+            should_drain = (self.drain_requested
+                            or bool(health.get("draining"))
+                            or breaker not in (None, "closed"))
+            if should_drain:
+                if self.state != ReplicaState.DRAINING:
+                    self.drain_events += 1
+                    log.warning(
+                        "replica %s draining (breaker=%s drain_flag=%s): "
+                        "no new work; in-flight %d finishing", self.name,
+                        breaker, health.get("draining"), self.in_flight)
+                self._set_state_locked(ReplicaState.DRAINING)
+            else:
+                if self.state != ReplicaState.HEALTHY:
+                    self.reentries += 1
+                    self._consecutive = 0
+                    log.warning("replica %s re-entering the rotation "
+                                "(breaker=%s)", self.name, breaker)
+                self._set_state_locked(ReplicaState.HEALTHY)
+
+    def _set_state_locked(self, state: str) -> None:
+        self.state = state
+        self._g_state.set(_STATE_GAUGE[state])
+
+    @property
+    def accepting(self) -> bool:
+        return self.state == ReplicaState.HEALTHY
+
+    @property
+    def drained(self) -> bool:
+        """True while draining with zero in-flight work left."""
+        return self.state == ReplicaState.DRAINING and self.in_flight == 0
+
+    def describe(self) -> dict:
+        return {"state": self.state, "in_flight": self.in_flight,
+                "routed": self._m_routed.value,
+                "failures": self._m_failures.value,
+                "drain_events": self.drain_events,
+                "reentries": self.reentries}
+
+
+class FleetRouter:
+    """The balancer: route, retry-on-next, drain, re-enter."""
+
+    def __init__(self, replicas: List[Replica],
+                 health_interval_s: float = 0.25,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 registry: metrics.Registry = metrics.DEFAULT_REGISTRY):
+        if not replicas:
+            raise ValueError("a router needs at least one replica")
+        names = [r.name for r in replicas]
+        if len(set(names)) != len(names):
+            raise ValueError(f"replica names must be unique: {names}")
+        self.replicas = list(replicas)
+        self.health_interval_s = health_interval_s
+        self._last_refresh = 0.0
+        self._refresh_lock = threading.Lock()
+        policy = retry_policy or RetryPolicy(
+            attempts=max(2, len(replicas)), base_s=0.0, jitter=0.0,
+            retryable=ROUTER_RETRYABLE)
+        self._executor = RetryExecutor("fleet.route", policy,
+                                       registry=registry)
+        self._m_failovers = registry.counter("fleet/router/failovers")
+        self._m_all_draining = registry.counter("fleet/router/all_draining")
+        self._m_calls = registry.counter("fleet/router/calls")
+        # health sweeps run on a BACKGROUND thread when an interval is
+        # set: a slow or dead replica's health read (a full RPC timeout
+        # against a silently-gone host) must stall the sweeper, never a
+        # caller's request path. interval <= 0 keeps the sweep inline
+        # per call — the deterministic mode tests drive with
+        # refresh(force=True).
+        self._stop_sweeper = threading.Event()
+        self._sweeper: Optional[threading.Thread] = None
+        if health_interval_s > 0:
+            self._sweeper = threading.Thread(
+                target=self._sweep_loop, name="fleet-health", daemon=True)
+            self._sweeper.start()
+
+    # -- health ------------------------------------------------------------
+
+    def _sweep_loop(self) -> None:
+        while not self._stop_sweeper.wait(self.health_interval_s):
+            try:
+                self.refresh(force=True)
+            except Exception:  # noqa: BLE001 - the sweeper must survive
+                log.exception("fleet health sweep failed")
+
+    def refresh(self, force: bool = False) -> None:
+        """Rate-limited health sweep: read every replica's health, run
+        the state machine, and probe draining replicas (one tiny call
+        each, so their half-open differential can re-promote them)."""
+        now = time.monotonic()
+        with self._refresh_lock:
+            if not force and now - self._last_refresh < self.health_interval_s:
+                return
+            self._last_refresh = now
+        for replica in self.replicas:
+            try:
+                health = replica.health()
+            except Exception as exc:  # noqa: BLE001 - dead health = dead node
+                log.warning("replica %s health read failed: %r",
+                            replica.name, exc)
+                health = None
+            replica.observe_health(health, now)
+            if replica.state == ReplicaState.DRAINING \
+                    and replica.probe is not None \
+                    and health is not None \
+                    and health.get("breaker") == "open":
+                # the nudge that lets an idle drained replica recover:
+                # once its cooldown elapses this call becomes the
+                # half-open differential probe; before that it is a
+                # cheap fallback-served request
+                try:
+                    replica.probe()
+                except Exception:  # noqa: BLE001 - probe outcome is the
+                    pass  # breaker's business, not ours
+
+    # -- routing -----------------------------------------------------------
+
+    def route(self, affinity: Optional[str] = None) -> List[Replica]:
+        """The preference-ordered accepting replicas for one call: a
+        stable rendezvous order for keyed traffic, least-in-flight for
+        keyless."""
+        accepting = [r for r in self.replicas if r.accepting]
+        if affinity is None:
+            return sorted(accepting, key=lambda r: (r.in_flight, r.name))
+        key = str(affinity)
+
+        def weight(replica: Replica) -> int:
+            digest = hashlib.blake2b(
+                f"{key}|{replica.name}".encode(), digest_size=8).digest()
+            return int.from_bytes(digest, "big")
+
+        return sorted(accepting, key=weight, reverse=True)
+
+    def call(self, op: str, *args, affinity: Optional[str] = None,
+             klass: Optional[str] = None, tenant: Optional[str] = None,
+             **kwargs):
+        """Route one batch call with retry-on-next-replica. `affinity`
+        pins the preference order (shard/pk-row/DAS-root keyed traffic
+        stays cache-warm); `klass`/`tenant` tag admission downstream
+        (the in-process serving tier reads the thread context, the RPC
+        adapter ships them on the wire)."""
+        self._m_calls.inc()
+        if self._sweeper is None:
+            self.refresh()  # inline mode only; see __init__
+        candidates = self.route(affinity)
+        if not candidates:
+            self.refresh(force=True)
+            candidates = self.route(affinity)
+            if not candidates:
+                self._m_all_draining.inc()
+                raise AllReplicasDraining(
+                    f"{op}: all {len(self.replicas)} replicas are "
+                    f"draining or tripped")
+        ladder = iter(candidates)
+        tried: List[str] = []
+
+        def attempt():
+            replica = next(ladder, None)
+            if replica is None:
+                self._m_all_draining.inc()
+                raise AllReplicasDraining(
+                    f"{op}: every accepting replica refused "
+                    f"(tried {tried}; "
+                    f"{len(self.replicas) - len(tried)} not accepting)")
+            if tried:
+                self._m_failovers.inc()
+            tried.append(replica.name)
+            try:
+                with replica.flight():
+                    if klass is not None or tenant is not None:
+                        # a tenant tag alone still charges the quota —
+                        # class_for resolves this op's default class
+                        with admission_class(class_for(op, klass), tenant):
+                            out = getattr(replica.backend, op)(*args,
+                                                               **kwargs)
+                    else:
+                        out = getattr(replica.backend, op)(*args, **kwargs)
+            except Exception as exc:  # noqa: BLE001 - classify + re-raise
+                replica.note_failure(exc)
+                raise
+            replica.note_success()
+            return out
+
+        return self._executor.call(attempt)
+
+    # -- drain lifecycle ---------------------------------------------------
+
+    def drain(self, name: str) -> None:
+        """Operator-initiated drain: the replica stops taking new work
+        on the next refresh and re-enters only after `undrain`."""
+        self._replica(name).drain_requested = True
+        self.refresh(force=True)
+
+    def undrain(self, name: str) -> None:
+        self._replica(name).drain_requested = False
+        self.refresh(force=True)
+
+    def _replica(self, name: str) -> Replica:
+        for replica in self.replicas:
+            if replica.name == name:
+                return replica
+        raise KeyError(f"unknown replica {name!r}")
+
+    # -- observability / lifecycle -----------------------------------------
+
+    def states(self) -> Dict[str, dict]:
+        return {replica.name: replica.describe()
+                for replica in self.replicas}
+
+    def close(self) -> None:
+        self._stop_sweeper.set()
+        if self._sweeper is not None:
+            self._sweeper.join(timeout=2.0)
+        for replica in self.replicas:
+            close = getattr(replica.backend, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:  # noqa: BLE001 - best-effort shutdown
+                    log.exception("closing replica %s failed", replica.name)
+
+
+class RouterSigBackend:
+    """The drop-in `SigBackend` face over a `FleetRouter`: actors and
+    the RPC server speak to the FLEET exactly as they would to one
+    backend. Affinity derives from the call's own cache key — the
+    committee op's first pk-row key, the DAS op's first root — so the
+    routing layer is invisible except in the fleet counters."""
+
+    def __init__(self, router: FleetRouter):
+        self.router = router
+        self.name = f"router[{len(router.replicas)}]"
+
+    def ecrecover_addresses(self, digests, sigs65):
+        return self.router.call("ecrecover_addresses", digests, sigs65)
+
+    def bls_verify_aggregates(self, messages, agg_sigs, agg_pks):
+        return self.router.call("bls_verify_aggregates", messages,
+                                agg_sigs, agg_pks)
+
+    def bls_verify_committees(self, messages, sig_rows, pk_rows,
+                              pk_row_keys=None):
+        affinity = None
+        if pk_row_keys:
+            affinity = next((str(k) for k in pk_row_keys if k is not None),
+                            None)
+        return self.router.call("bls_verify_committees", messages,
+                                sig_rows, pk_rows, pk_row_keys=pk_row_keys,
+                                affinity=affinity)
+
+    def das_verify_samples(self, chunks, indices, proofs, roots):
+        affinity = None
+        if roots:
+            root = roots[0]
+            affinity = root.hex() if hasattr(root, "hex") else str(root)
+        return self.router.call("das_verify_samples", chunks, indices,
+                                proofs, roots, affinity=affinity)
+
+    def bls_verify_committees_async(self, messages, sig_rows, pk_rows,
+                                    pk_row_keys=None):
+        from gethsharding_tpu.sigbackend import VerdictFuture
+
+        out = self.bls_verify_committees(messages, sig_rows, pk_rows,
+                                         pk_row_keys=pk_row_keys)
+        future = VerdictFuture(lambda: out)
+        future.result()
+        return future
+
+    def submit(self, op: str, *args, pk_row_keys=None,
+               klass: Optional[str] = None, tenant: Optional[str] = None):
+        """The serving-compatible async face: routed synchronously on
+        the calling thread (RPC handler threads are already per-
+        connection), returned as a resolved future."""
+        from concurrent.futures import Future
+
+        future: Future = Future()
+        kwargs = {}
+        if op == "bls_verify_committees":
+            kwargs["pk_row_keys"] = pk_row_keys
+        try:
+            future.set_result(self.router.call(op, *args, klass=klass,
+                                               tenant=tenant, **kwargs))
+        except BaseException as exc:  # noqa: BLE001 - future carries it
+            future.set_exception(exc)
+        return future
+
+    def close(self) -> None:
+        self.router.close()
+
+
+class RpcReplicaBackend:
+    """A chain_server replica's verification surface over JSON-RPC —
+    the cross-process face a frontend router balances. Covers the ops
+    the RPC serving tier exposes (``shard_ecrecover`` /
+    ``shard_verifyAggregates``) plus the ``shard_health`` /
+    ``shard_drain`` control plane; committee/DAS planes are in-process
+    ops today (the actors own them), so they raise here."""
+
+    def __init__(self, client, name: str = ""):
+        self.client = client
+        self.name = name or "rpc-replica"
+
+    @classmethod
+    def dial(cls, host: str, port: int,
+             timeout: float = 10.0) -> "RpcReplicaBackend":
+        from gethsharding_tpu.rpc.client import RPCClient
+
+        return cls(RPCClient(host, port, timeout=timeout),
+                   name=f"{host}:{port}")
+
+    def _call(self, method: str, *params):
+        from gethsharding_tpu.rpc.client import RPCError
+
+        try:
+            return self.client.call(method, *params)
+        except RPCError as exc:
+            if "draining" in exc.message:
+                # the replica refused because it is shutting down: a
+                # transient routing fact, not a caller bug — surface it
+                # retryable so the router advances to the next replica
+                raise ConnectionError(
+                    f"{self.name} draining: {exc.message}") from exc
+            raise
+
+    def ecrecover_addresses(self, digests, sigs65):
+        from gethsharding_tpu.rpc import codec
+        from gethsharding_tpu.utils.hexbytes import Address20
+
+        from gethsharding_tpu.serving.classes import current_admission
+
+        klass, tenant = current_admission()
+        out = self._call("shard_ecrecover",
+                         [codec.enc_bytes(d) for d in digests],
+                         [codec.enc_bytes(s) for s in sigs65],
+                         klass, tenant)
+        return [None if a is None else Address20(codec.dec_bytes(a))
+                for a in out]
+
+    def bls_verify_aggregates(self, messages, agg_sigs, agg_pks):
+        from gethsharding_tpu.rpc import codec
+
+        from gethsharding_tpu.serving.classes import current_admission
+
+        klass, tenant = current_admission()
+        out = self._call("shard_verifyAggregates",
+                         [codec.enc_bytes(m) for m in messages],
+                         [codec.enc_g1(s) for s in agg_sigs],
+                         [codec.enc_g2(p) for p in agg_pks],
+                         klass, tenant)
+        return [bool(b) for b in out]
+
+    def bls_verify_committees(self, *args, **kwargs):
+        raise NotImplementedError(
+            "the committee plane is an in-process op; route it with an "
+            "in-process Replica backend")
+
+    def das_verify_samples(self, *args, **kwargs):
+        raise NotImplementedError(
+            "the DAS sample plane is an in-process op; route it with an "
+            "in-process Replica backend")
+
+    # -- control plane -----------------------------------------------------
+
+    def health(self) -> dict:
+        return self.client.call("shard_health")
+
+    def drain(self) -> dict:
+        return self.client.call("shard_drain")
+
+    def close(self) -> None:
+        self.client.close()

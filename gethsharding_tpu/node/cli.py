@@ -85,6 +85,11 @@ def build_parser() -> argparse.ArgumentParser:
                           choices=("block", "shed"),
                           help="backpressure at the queue cap: block the "
                                "caller or shed with a fast error")
+    sharding.add_argument("--serving-quota-rows", type=int, default=None,
+                          help="per-tenant queued-row quota in the "
+                               "serving admission queues (fleet tenant "
+                               "isolation; default "
+                               "GETHSHARDING_TENANT_QUOTA_ROWS, 0 = off)")
     sharding.add_argument("--serving-watchdog-s", type=float, default=0.0,
                           help="dispatch watchdog deadline in seconds: a "
                                "device call wedging the serving dispatch "
@@ -402,6 +407,7 @@ def run_sharding_node(args) -> int:
             queue_cap=args.serving_queue_cap,
             policy=args.serving_policy,
             watchdog_s=args.serving_watchdog_s,
+            tenant_quota_rows=args.serving_quota_rows,
         )
     soundness_rate = args.soundness_rate
     if soundness_rate is None:

@@ -105,6 +105,14 @@ class StatusServer(Service):
                if name.startswith("das/")}
         if das:
             payload["das"] = das
+        # the fleet router at a glance: per-replica state gauges
+        # (0 healthy / 1 draining / 2 tripped), routed/failure counters
+        # with their EWMA rates, and the router's failover /
+        # all-draining totals — present only on a process that routes
+        fleet = {name: snap for name, snap in snapshot.items()
+                 if name.startswith("fleet/")}
+        if fleet:
+            payload["fleet"] = fleet
         return payload
 
     def metrics_payload(self) -> dict:

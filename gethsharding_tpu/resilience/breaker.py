@@ -498,6 +498,10 @@ class FailoverSigBackend(SigBackend):
         raise AttributeError(name)
 
     def _fallback_rows(self, op: str, args, kwargs):
+        # admission tags (klass/tenant) are serving-tier vocabulary the
+        # scalar fallback's plain SigBackend surface does not speak
+        kwargs = {k: v for k, v in kwargs.items()
+                  if k not in ("klass", "tenant")}
         return getattr(self.fallback, op)(*args, **kwargs)
 
     def _submit(self, op: str, *args, **kwargs) -> Future:

@@ -489,12 +489,15 @@ class SpotCheckSigBackend(SigBackend):
             return self._submit
         raise AttributeError(name)
 
-    def _submit(self, op: str, *args, pk_row_keys=None):
+    def _submit(self, op: str, *args, pk_row_keys=None, **kwargs):
+        # admission tags (klass/tenant) pass through untouched — the
+        # audit has no opinion on queueing policy
         cols = tuple(list(col) for col in args)
         if op == "bls_verify_committees":
-            inner = self.inner.submit(op, *cols, pk_row_keys=pk_row_keys)
+            inner = self.inner.submit(op, *cols, pk_row_keys=pk_row_keys,
+                                      **kwargs)
         else:
-            inner = self.inner.submit(op, *cols)
+            inner = self.inner.submit(op, *cols, **kwargs)
         if op not in AUDITED_OPS:  # pragma: no cover - SERVING_OPS today
             return inner
         return _SpotCheckFuture(inner,

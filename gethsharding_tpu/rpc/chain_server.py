@@ -43,11 +43,34 @@ def main(argv=None) -> int:
                              "engine-verified, state via checkpoint "
                              "pull — smc/sync.py)")
     parser.add_argument("--sigbackend", default="python",
-                        choices=("python", "jax"),
+                        choices=("python", "jax", "failover-python",
+                                 "failover-jax"),
                         help="backend behind the shard_ecrecover / "
                              "shard_verifyAggregates serving tier: handler "
                              "threads coalesce concurrent requests into "
-                             "shared dispatches (jax = batched TPU kernels)")
+                             "shared dispatches (jax = batched TPU "
+                             "kernels); failover-* composes the serving "
+                             "tier behind a circuit breaker over the "
+                             "scalar fallback, and exports the breaker "
+                             "state on shard_health so a fleet router "
+                             "(gethsharding_tpu/fleet/) drains a tripped "
+                             "replica")
+    parser.add_argument("--serving-watchdog-s", type=float, default=0.0,
+                        help="dispatch watchdog deadline for the serving "
+                             "tier (0 = off): a wedged device call fails "
+                             "its batch with DeadlineExceeded — under "
+                             "failover-* that is a breaker fault, and a "
+                             "router retries the caller on the next "
+                             "replica")
+    parser.add_argument("--serving-quota-rows", type=int, default=None,
+                        help="per-tenant queued-row quota in the serving "
+                             "admission queues (default: "
+                             "GETHSHARDING_TENANT_QUOTA_ROWS, 0 = off)")
+    parser.add_argument("--chaos", default="", metavar="SPEC",
+                        help="seeded chaos schedule at the backend/"
+                             "dispatch seams (resilience/chaos.py) — the "
+                             "router smoke trips one replica's breaker "
+                             "with this")
     parser.add_argument("--trace", action="store_true",
                         help="collect RPC-handler + serving-tier spans "
                              "(per-request queue/assembly/dispatch "
@@ -75,12 +98,33 @@ def main(argv=None) -> int:
     config = Config(**overrides)
     backend = SimulatedMainchain(config=config)
     # the serving seam: verification RPCs coalesce across handler
-    # threads onto the chosen backend (built lazily by RPCServer when a
-    # plain SigBackend is handed in)
+    # threads onto the chosen backend. A replica composes explicitly —
+    # device → (chaos) → serving → (failover) — so shard_health exports
+    # the breaker state and a fleet router can drain a tripped replica;
+    # the plain names keep the old lazy-wrap behavior.
+    from gethsharding_tpu.serving import ServingConfig, ServingSigBackend
     from gethsharding_tpu.sigbackend import get_backend
 
+    failover = args.sigbackend.startswith("failover-")
+    inner_name = (args.sigbackend[len("failover-"):] if failover
+                  else args.sigbackend)
+    sig_backend = get_backend(inner_name)
+    if args.chaos:
+        from gethsharding_tpu.resilience.chaos import (ChaosSigBackend,
+                                                       parse_spec)
+
+        sig_backend = ChaosSigBackend(sig_backend, parse_spec(args.chaos))
+    sig_backend = ServingSigBackend(sig_backend, ServingConfig(
+        watchdog_s=args.serving_watchdog_s,
+        tenant_quota_rows=args.serving_quota_rows))
+    composed = sig_backend
+    if failover:
+        from gethsharding_tpu.resilience.breaker import FailoverSigBackend
+
+        sig_backend = FailoverSigBackend(sig_backend,
+                                         get_backend("python"))
     server = RPCServer(backend, host=args.host, port=args.port,
-                       sig_backend=get_backend(args.sigbackend))
+                       sig_backend=sig_backend)
     server.start()
     follower = None
     if args.follow:
@@ -106,6 +150,9 @@ def main(argv=None) -> int:
         if follower is not None:
             follower.stop()
         server.stop()
+        # the server never owned the injected composition: drain-and-
+        # fail its queued serving futures here so no caller is stranded
+        composed.close()
         if args.trace_out:
             from gethsharding_tpu import tracing
 

@@ -18,6 +18,7 @@ import logging
 import socket
 import socketserver
 import threading
+import time
 from typing import Optional
 
 from gethsharding_tpu import tracing
@@ -61,6 +62,13 @@ class RPCServer:
         self._sig_backend = sig_backend
         self._sig_serving = None
         self._sig_serving_owned = False
+        # fleet drain lifecycle: a DRAINING server refuses NEW
+        # verification work with a typed "replica draining" error (the
+        # router retries on the next replica) while in-flight requests
+        # finish; `shard_health` exports the flag plus the breaker /
+        # serving state the router's health sweep reads
+        self.draining = False
+        self._inflight = 0
         server = self
 
         class Handler(socketserver.StreamRequestHandler):
@@ -94,7 +102,17 @@ class RPCServer:
         self._thread.start()
         log.info("RPC listening on %s:%d", *self.address)
 
-    def stop(self) -> None:
+    def stop(self, grace_s: float = 5.0) -> None:
+        """Graceful shutdown: stop admitting NEW verification work,
+        give in-flight RPC requests a bounded grace to finish, then
+        close the serving tier — whose `PipelinedDispatcher.close(
+        wait=True)` semantics drain what it can and FAIL the rest with
+        `DispatcherClosed`, so a router-initiated drain never strands a
+        caller on a future nothing will resolve."""
+        self.draining = True
+        deadline = time.monotonic() + grace_s
+        while self._inflight > 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
         if self._unsubscribe is not None:
             self._unsubscribe()
         self._tcp.shutdown()
@@ -104,6 +122,20 @@ class RPCServer:
         if self._sig_serving is not None and self._sig_serving_owned:
             self._sig_serving.close()
             self._sig_serving = None
+
+    def drain(self) -> dict:
+        """Router/operator-initiated drain: refuse new verification
+        work, drain-and-fail the serving queues (in-flight batches get
+        their grace, queued futures fail with `DispatcherClosed` /
+        `QueueClosed` instead of hanging). The RPC control surface
+        (`shard_drain`) calls this; `stop()` completes the shutdown."""
+        self.draining = True
+        with self._sub_lock:
+            serving = self._sig_serving
+        if serving is not None and hasattr(serving, "close") \
+                and self._sig_serving_owned:
+            serving.close()
+        return {"draining": True, "inflight": self._inflight}
 
     # -- head push (eth_subscribe newHeads parity) -------------------------
 
@@ -140,7 +172,13 @@ class RPCServer:
                 raw = raw.strip()
                 if not raw:
                     continue
-                response = self._dispatch(raw, handler, write_lock)
+                with self._sub_lock:
+                    self._inflight += 1
+                try:
+                    response = self._dispatch(raw, handler, write_lock)
+                finally:
+                    with self._sub_lock:
+                        self._inflight -= 1
                 if response is not None:
                     with write_lock:
                         handler.wfile.write(
@@ -341,7 +379,13 @@ class RPCServer:
                     self._sig_serving_owned = True
             return self._sig_serving
 
-    def rpc_ecrecover(self, digests, sigs):
+    def _check_accepting(self, method: str) -> None:
+        if self.draining:
+            # the router's retry ladder keys on this phrase: a draining
+            # replica is a routing fact, not a caller error
+            raise RuntimeError(f"replica draining: {method} refused")
+
+    def rpc_ecrecover(self, digests, sigs, klass=None, tenant=None):
         """Batch address recovery for external clients (txpool feeders,
         light verifiers). The serving backend's sync face enqueues and
         parks the handler thread on the request's future — while this
@@ -349,21 +393,86 @@ class RPCServer:
         enqueue into the SAME dispatch, so N concurrent small requests
         cost one device batch instead of N. (The sync face also records
         the future_wake trace phase — one await-then-wake sequence for
-        every entry point, serving/backend.py.)"""
-        out = self._serving().ecrecover_addresses(
-            [codec.dec_bytes(d) for d in digests],
-            [codec.dec_bytes(s) for s in sigs])
+        every entry point, serving/backend.py.) The optional trailing
+        `klass`/`tenant` params tag the request's admission class and
+        quota bucket (serving/classes.py) — a catch-up replayer passes
+        ``"catchup_replay"`` and is shed first under overload."""
+        self._check_accepting("shard_ecrecover")
+        from gethsharding_tpu.serving.classes import admission_class
+
+        serving = self._serving()
+        digests = [codec.dec_bytes(d) for d in digests]
+        sigs = [codec.dec_bytes(s) for s in sigs]
+        if klass is not None or tenant is not None:
+            # tenant without class still enters the context: the quota
+            # must charge the tenant even when the caller says nothing
+            # about class (default interactive, this op's default)
+            with admission_class(klass or "interactive", tenant):
+                out = serving.ecrecover_addresses(digests, sigs)
+        else:
+            out = serving.ecrecover_addresses(digests, sigs)
         return [None if addr is None else codec.enc_bytes(bytes(addr))
                 for addr in out]
 
-    def rpc_verifyAggregates(self, messages, agg_sigs, agg_pks):
+    def rpc_verifyAggregates(self, messages, agg_sigs, agg_pks,
+                             klass=None, tenant=None):
         """Batch aggregate-vote verification over the serving tier (the
-        coalescing analog of the notary's bls_verify_aggregates)."""
-        out = self._serving().bls_verify_aggregates(
-            [codec.dec_bytes(m) for m in messages],
-            [codec.dec_g1(s) for s in agg_sigs],
-            [codec.dec_g2(p) for p in agg_pks])
+        coalescing analog of the notary's bls_verify_aggregates); the
+        optional trailing params tag the admission class like
+        shard_ecrecover's."""
+        self._check_accepting("shard_verifyAggregates")
+        from gethsharding_tpu.serving.classes import admission_class
+
+        serving = self._serving()
+        args = ([codec.dec_bytes(m) for m in messages],
+                [codec.dec_g1(s) for s in agg_sigs],
+                [codec.dec_g2(p) for p in agg_pks])
+        if klass is not None or tenant is not None:
+            # see shard_ecrecover: a tenant tag alone still charges the
+            # quota under this op's default class
+            with admission_class(klass or "interactive", tenant):
+                out = serving.bls_verify_aggregates(*args)
+        else:
+            out = serving.bls_verify_aggregates(*args)
         return [bool(b) for b in out]
+
+    def rpc_health(self):
+        """The replica-health surface a fleet router sweeps: the drain
+        flag, the failover breaker's state (if the injected backend
+        composes one), and the serving tier's per-class queue depths.
+        One round trip, cheap enough for sub-second polling."""
+        from gethsharding_tpu.fleet.router import breaker_of
+
+        payload = {"draining": self.draining,
+                   # minus one: this health request is itself in flight
+                   "inflight": max(0, self._inflight - 1),
+                   "breaker": None, "serving": None}
+        backend = self._sig_backend
+        if backend is not None:
+            breaker = breaker_of(backend)
+            if breaker is not None:
+                payload["breaker"] = breaker.state_name
+        with self._sub_lock:
+            serving = self._sig_serving
+        batcher = getattr(serving, "batcher", None)
+        if batcher is None:
+            # the serving tier may hide under a failover/soundness face
+            probe, hops = serving, 0
+            while probe is not None and hops < 8 and batcher is None:
+                batcher = getattr(probe, "batcher", None)
+                probe, hops = getattr(probe, "inner", None), hops + 1
+        if batcher is not None:
+            payload["serving"] = {
+                "shed": batcher.shed_by_class(),
+                "quota_rejections": batcher.quota_rejections(),
+                "depth": {op: batcher.class_depths(op)
+                          for op in batcher.dispatch_counts},
+            }
+        return payload
+
+    def rpc_drain(self):
+        """Router/operator-initiated drain (see `drain()`)."""
+        return self.drain()
 
     def rpc_servingStats(self):
         """Dispatch/coalescing counters of the serving tier (None until

@@ -20,22 +20,45 @@ device batches (the zkSpeed / MSM-outsourcing scheduler shape):
   plus the async ``submit()`` future API for RPC handler threads.
 """
 
-from gethsharding_tpu.serving.backend import ServingConfig, ServingSigBackend
+from gethsharding_tpu.serving.classes import (
+    ADMISSION_CLASSES,
+    CLASS_BULK_AUDIT,
+    CLASS_CATCHUP,
+    CLASS_INTERACTIVE,
+    admission_class,
+)
+from gethsharding_tpu.serving.backend import (
+    ClassedSigBackend,
+    ServingConfig,
+    ServingSigBackend,
+)
 from gethsharding_tpu.serving.batcher import MicroBatcher, SERVING_OPS
 from gethsharding_tpu.serving.pipeline import PipelinedDispatcher
 from gethsharding_tpu.serving.queue import (
     AdmissionQueue,
+    ClassDeadlineExceeded,
+    QueueClosed,
     Request,
     ServingOverloadError,
+    TenantQuotaExceeded,
 )
 
 __all__ = [
+    "ADMISSION_CLASSES",
     "AdmissionQueue",
+    "CLASS_BULK_AUDIT",
+    "CLASS_CATCHUP",
+    "CLASS_INTERACTIVE",
+    "ClassDeadlineExceeded",
+    "ClassedSigBackend",
     "MicroBatcher",
     "PipelinedDispatcher",
+    "QueueClosed",
     "Request",
     "SERVING_OPS",
     "ServingConfig",
     "ServingOverloadError",
     "ServingSigBackend",
+    "TenantQuotaExceeded",
+    "admission_class",
 ]

@@ -53,6 +53,9 @@ class ServingConfig:
       wedges the dispatch thread longer than this fails its batch's
       futures with `resilience.DeadlineExceeded` and the dispatcher
       restarts on a fresh thread (0 = watchdog off).
+    - ``tenant_quota_rows``: per-tenant queued-row quota in the
+      admission queue (`TenantQuotaExceeded` beyond it; None = the
+      ``GETHSHARDING_TENANT_QUOTA_ROWS`` env default, 0 = off).
     """
 
     max_batch: int = 128
@@ -60,6 +63,7 @@ class ServingConfig:
     queue_cap: int = 4096
     policy: str = "block"
     watchdog_s: float = 0.0
+    tenant_quota_rows: Optional[int] = None
 
 
 class ServingSigBackend(SigBackend):
@@ -90,15 +94,21 @@ class ServingSigBackend(SigBackend):
             queue_cap=self.config.queue_cap,
             policy=self.config.policy,
             watchdog_s=self.config.watchdog_s,
+            tenant_quota_rows=self.config.tenant_quota_rows,
             registry=registry,
         )
 
     # -- async face --------------------------------------------------------
 
     def submit(self, op: str, *args: Sequence,
-               pk_row_keys: Optional[Sequence] = None) -> Future:
+               pk_row_keys: Optional[Sequence] = None,
+               klass: Optional[str] = None,
+               tenant: Optional[str] = None) -> Future:
         """Enqueue one request; the future resolves to the per-row
-        results in the caller's own order."""
+        results in the caller's own order. `klass`/`tenant` tag the
+        request's admission class and quota bucket (defaults: the
+        thread's `admission_class` context, then the per-op map —
+        serving/classes.py)."""
         if op not in SERVING_OPS:
             raise ValueError(f"unknown serving op {op!r}; "
                              f"choose from {SERVING_OPS}")
@@ -123,7 +133,8 @@ class ServingSigBackend(SigBackend):
             cols.append(keys)
         elif pk_row_keys is not None:
             raise ValueError(f"{op} takes no pk_row_keys")
-        return self.batcher.submit(op, tuple(cols), rows)
+        return self.batcher.submit(op, tuple(cols), rows,
+                                   klass=klass, tenant=tenant)
 
     # -- the synchronous SigBackend contract -------------------------------
 
@@ -164,6 +175,16 @@ class ServingSigBackend(SigBackend):
         return self.submit("bls_verify_committees", messages, sig_rows,
                            pk_rows, pk_row_keys=pk_row_keys)
 
+    # -- class tagging -----------------------------------------------------
+
+    def classed(self, klass: str, tenant: str = "") -> "ClassedSigBackend":
+        """A fixed-class view over this serving backend: the same
+        `SigBackend` surface with every call admitted under `klass`
+        (and `tenant`'s quota bucket). For call trees that pass through
+        wrapper compositions the caller does not control, prefer the
+        `serving.classes.admission_class` context — it rides the thread."""
+        return ClassedSigBackend(self, klass, tenant)
+
     # -- lifecycle / observability -----------------------------------------
 
     def close(self) -> None:
@@ -175,3 +196,59 @@ class ServingSigBackend(SigBackend):
         """Total device dispatches issued (all ops) — the denominator of
         the coalescing ratio tests and bench assert on."""
         return sum(self.batcher.dispatch_counts.values())
+
+
+class ClassedSigBackend(SigBackend):
+    """A thin fixed-(class, tenant) facade over a `ServingSigBackend`:
+    drop-in `SigBackend` whose every call coalesces under one admission
+    class — hand one to a service whose whole traffic is one class
+    (a catch-up replayer, a bulk re-verifier)."""
+
+    def __init__(self, serving: ServingSigBackend, klass: str,
+                 tenant: str = ""):
+        from gethsharding_tpu.serving.classes import check_class
+
+        self.inner = serving
+        self.klass = check_class(klass)
+        self.tenant = tenant
+        self.name = f"{serving.name}[{klass}]"
+
+    def submit(self, op: str, *args, pk_row_keys=None,
+               klass: Optional[str] = None,
+               tenant: Optional[str] = None) -> Future:
+        return self.inner.submit(op, *args, pk_row_keys=pk_row_keys,
+                                 klass=klass or self.klass,
+                                 tenant=self.tenant if tenant is None
+                                 else tenant)
+
+    def _await(self, future):
+        out = future.result()
+        observe_future_wake(future)
+        return out
+
+    def ecrecover_addresses(self, digests, sigs65):
+        return self._await(self.submit("ecrecover_addresses", digests,
+                                       sigs65))
+
+    def bls_verify_aggregates(self, messages, agg_sigs, agg_pks):
+        return self._await(self.submit("bls_verify_aggregates", messages,
+                                       agg_sigs, agg_pks))
+
+    def bls_verify_committees(self, messages, sig_rows, pk_rows,
+                              pk_row_keys=None):
+        return self._await(self.submit("bls_verify_committees", messages,
+                                       sig_rows, pk_rows,
+                                       pk_row_keys=pk_row_keys))
+
+    def das_verify_samples(self, chunks, indices, proofs, roots):
+        return self._await(self.submit("das_verify_samples", chunks,
+                                       indices, proofs, roots))
+
+    def bls_verify_committees_async(self, messages, sig_rows, pk_rows,
+                                    pk_row_keys=None):
+        return self.submit("bls_verify_committees", messages, sig_rows,
+                           pk_rows, pk_row_keys=pk_row_keys)
+
+    def close(self) -> None:
+        """Classed views never own the serving tier; closing one is a
+        no-op so a per-service shutdown can't kill shared serving."""

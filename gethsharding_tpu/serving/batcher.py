@@ -43,14 +43,21 @@ from __future__ import annotations
 import threading
 import time
 from concurrent.futures import Future
-from typing import Dict, List, Sequence
+from typing import Dict, List, Optional, Sequence
 
 from gethsharding_tpu import metrics, tracing
+from gethsharding_tpu.serving.classes import (
+    ADMISSION_CLASSES,
+    class_for,
+    current_admission,
+)
 from gethsharding_tpu.serving.pipeline import PipelinedDispatcher
 from gethsharding_tpu.serving.queue import (
     AdmissionQueue,
+    QueueClosed,
     Request,
     ServingOverloadError,
+    TenantQuotaExceeded,
 )
 
 # the SigBackend batch API surface the serving tier coalesces
@@ -87,6 +94,20 @@ class _OpMetrics:
         self.queue_depth = registry.gauge(f"{base}/queue_depth")
         self.wait_time = registry.timer(f"{base}/wait_time")
         self.dispatch_latency = registry.timer(f"{base}/dispatch_latency")
+        # the per-admission-class split (serving/classes.py): request and
+        # depth attribution per class, plus per-class queue-wait timers
+        # (the per-class p99 the fleet SLO gate reads). The shed/expiry
+        # counters under the same prefix are owned by the AdmissionQueue
+        # — displacement happens inside it, invisible from here.
+        self.class_requests = {
+            c: registry.counter(f"{base}/class/{c}/requests")
+            for c in ADMISSION_CLASSES}
+        self.class_depth = {
+            c: registry.gauge(f"{base}/class/{c}/queue_depth")
+            for c in ADMISSION_CLASSES}
+        self.class_wait = {
+            c: registry.timer(f"{base}/class/{c}/wait_time")
+            for c in ADMISSION_CLASSES}
 
 
 class MicroBatcher:
@@ -102,6 +123,7 @@ class MicroBatcher:
                  flush_us: float = 500.0, queue_cap: int = 4096,
                  policy: str = "block",
                  watchdog_s: float = 0.0,
+                 tenant_quota_rows: Optional[int] = None,
                  registry: metrics.Registry = metrics.DEFAULT_REGISTRY):
         from gethsharding_tpu.sigbackend import bucket_size
 
@@ -118,7 +140,9 @@ class MicroBatcher:
                          for op in SERVING_OPS}
         self._queues = {
             op: AdmissionQueue(cap_rows=queue_cap, policy=policy,
-                               max_batch=self.max_batch, flush_us=flush_us)
+                               max_batch=self.max_batch, flush_us=flush_us,
+                               tenant_quota_rows=tenant_quota_rows,
+                               registry=registry, label=_OP_LABELS[op])
             for op in SERVING_OPS
         }
         self._dispatcher = PipelinedDispatcher(registry=registry)
@@ -145,13 +169,17 @@ class MicroBatcher:
 
     # -- producer ----------------------------------------------------------
 
-    def submit(self, op: str, args: Sequence[Sequence], rows: int) -> Future:
-        """Enqueue one request; returns the future of its per-row results."""
+    def submit(self, op: str, args: Sequence[Sequence], rows: int,
+               klass: Optional[str] = None,
+               tenant: Optional[str] = None) -> Future:
+        """Enqueue one request; returns the future of its per-row results.
+        `klass`/`tenant` override the thread's `admission_class` context
+        and the per-op default (serving/classes.py)."""
         if op not in SERVING_OPS:
             raise ValueError(f"unknown serving op {op!r}; "
                              f"choose from {SERVING_OPS}")
         if self._closed:
-            raise RuntimeError("serving batcher is closed")
+            raise QueueClosed("serving batcher is closed")
         for column in args:
             if len(column) != rows:
                 # reject HERE: a short column concatenated into a
@@ -159,16 +187,20 @@ class MicroBatcher:
                 raise ValueError(
                     f"{op}: column of {len(column)} rows in a "
                     f"{rows}-row request")
+        klass = class_for(op, klass)
+        if tenant is None:
+            tenant = current_admission()[1] or ""
         met = self._metrics[op]
         met.requests.inc()
         met.request_rows.inc(rows)
+        met.class_requests[klass].inc()
         if rows == 0:
             # nothing to coalesce; resolve without touching the queue so
             # empty probes can't occupy flush windows
             future: Future = Future()
             future.set_result([])
             return future
-        request = Request(op, tuple(args), rows)
+        request = Request(op, tuple(args), rows, klass=klass, tenant=tenant)
         # trace stitching: the caller's active span (an RPC handler, a
         # notary phase) becomes the parent of this request's lifecycle
         # spans, recorded later from the flusher/dispatch threads. ONE
@@ -180,10 +212,16 @@ class MicroBatcher:
         queue = self._queues[op]
         try:
             queue.put(request)
+        except (QueueClosed, TenantQuotaExceeded):
+            # counted by the queue's own quota/lifecycle accounting —
+            # folding them into the shed rate would read as capacity
+            # overload that never happened
+            raise
         except ServingOverloadError:
             met.shed.inc()
             raise
         met.queue_depth.set(queue.depth_rows)
+        met.class_depth[klass].set(queue.class_depth_rows(klass))
         return request.future
 
     # -- consumer ----------------------------------------------------------
@@ -196,6 +234,8 @@ class MicroBatcher:
             if batch is None:
                 return
             met.queue_depth.set(queue.depth_rows)
+            for klass in ADMISSION_CLASSES:
+                met.class_depth[klass].set(queue.class_depth_rows(klass))
             if reason == AdmissionQueue.FLUSH_FULL:
                 met.flush_full.inc()
             elif reason == AdmissionQueue.FLUSH_DEADLINE:
@@ -205,7 +245,9 @@ class MicroBatcher:
                 rows = 0
                 traced = tracing.TRACER.enabled
                 for request in batch:
-                    met.wait_time.observe(request.wait_s(now))
+                    wait_s = request.wait_s(now)
+                    met.wait_time.observe(wait_s)
+                    met.class_wait[request.klass].observe(wait_s)
                     rows += request.rows
                     if traced:
                         request.t_taken = now  # queue_wait ends here
@@ -358,6 +400,24 @@ class MicroBatcher:
     def shed_counts(self) -> Dict[str, int]:
         return {op: queue.shed_requests
                 for op, queue in self._queues.items()}
+
+    def class_depths(self, op: str) -> Dict[str, int]:
+        queue = self._queues[op]
+        return {klass: queue.class_depth_rows(klass)
+                for klass in ADMISSION_CLASSES}
+
+    def shed_by_class(self) -> Dict[str, int]:
+        """Total shed requests per admission class, summed across ops
+        (arrival sheds + displacement by a higher class)."""
+        totals = {klass: 0 for klass in ADMISSION_CLASSES}
+        for queue in self._queues.values():
+            for klass, count in queue.shed_by_class.items():
+                totals[klass] += count
+        return totals
+
+    def quota_rejections(self) -> int:
+        return sum(queue.quota_rejections
+                   for queue in self._queues.values())
 
 
 def observe_future_wake(future) -> None:

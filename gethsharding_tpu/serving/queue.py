@@ -1,4 +1,4 @@
-"""Bounded admission queue: futures, deadline flush, backpressure.
+"""Bounded admission queue: futures, classes, quotas, deadline flush.
 
 The front door of the serving tier. Producers (actor threads, RPC
 handler threads) `put()` requests; ONE consumer per operation drains
@@ -6,32 +6,80 @@ with `take_batch()`, which blocks until a flush condition holds:
 
 - **full**: at least `max_batch` rows are queued — a full device bucket
   is ready, dispatch now;
-- **deadline**: `flush_us` microseconds elapsed since the OLDEST queued
-  request — a lone small request never waits longer than the latency
-  budget for company that isn't coming;
+- **deadline**: a class's flush deadline elapsed since ITS oldest
+  queued request (base ``flush_us`` scaled by the class's
+  ``flush_mult`` — an interactive request never waits longer than the
+  latency budget for company that isn't coming, while bulk waits
+  longer for a fuller bucket);
 - **close**: shutdown drains whatever is left.
 
+Since the fleet PR the queue is CLASS-AWARE (gethsharding_tpu/fleet/
+classes.py): one FIFO per admission class inside each queue, so a
+catch-up replay burst and an interactive RPC are never the same kind
+of occupancy:
+
+- `take_batch` assembles a batch with a WEIGHTED drain: each nonempty
+  class is guaranteed its weight share of `max_batch` (priority order
+  fills first and takes any leftover), so bulk can never starve
+  interactive and interactive can never fully starve bulk;
+- overload sheds BY CLASS: a higher-priority arrival displaces queued
+  lower-priority work (catchup first, interactive last — the victims'
+  futures fail with `ServingOverloadError`) before the arrival itself
+  is shed or blocked;
+- per-TENANT row quotas bound any one tenant's queue occupancy
+  (`TenantQuotaExceeded`, a `ServingOverloadError`), so a single noisy
+  frontend cannot crowd out the fleet;
+- a class may carry an EXPIRY deadline: requests queued longer are
+  failed with `ClassDeadlineExceeded` instead of occupying capacity
+  forever.
+
 Backpressure is explicit, not accidental: when queued rows reach
-`cap_rows`, `put()` either blocks until the drain frees space
-(policy ``block`` — callers absorb the device's pace) or raises
-`ServingOverloadError` immediately (policy ``shed`` — callers get a
-fast failure they can retry/queue upstream, and the shed is counted).
-The reference behavior this replaces — every caller dispatching
-privately — has neither: overload just piles threads onto the device
-lock. Capacity is accounted in ROWS (verification items), not request
+`cap_rows` (and nothing lower-priority is left to displace), `put()`
+either blocks until the drain frees space (policy ``block`` — callers
+absorb the device's pace) or raises `ServingOverloadError` immediately
+(policy ``shed``). A closed queue fails fast with `QueueClosed` — work
+must never be silently enqueued into (or left blocked against) a dead
+queue. Capacity is accounted in ROWS (verification items), not request
 objects, since rows are what size the device batch.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from concurrent.futures import Future
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
+
+from gethsharding_tpu.serving.classes import (
+    ADMISSION_CLASSES,
+    CLASS_INTERACTIVE,
+    SHED_ORDER,
+    check_class,
+    default_policies,
+)
 
 
 class ServingOverloadError(RuntimeError):
-    """The admission queue is at capacity and the policy is ``shed``."""
+    """The admission queue is at capacity and the policy is ``shed``
+    (or this request was displaced by a higher-priority class)."""
+
+
+class QueueClosed(ServingOverloadError):
+    """`put()` on a closed queue — fail fast, never enqueue into (or
+    stay blocked against) a queue nothing will ever drain."""
+
+
+class TenantQuotaExceeded(ServingOverloadError):
+    """One tenant's queued rows reached its quota; the request is
+    refused without consuming shared capacity."""
+
+
+class ClassDeadlineExceeded(ServingOverloadError):
+    """The request overran its admission class's queue-wait deadline
+    and was expired. A `ServingOverloadError` subclass on purpose: the
+    failover face treats it as the caller's weather (late work shed
+    under load), never a device fault."""
 
 
 class Request:
@@ -39,7 +87,9 @@ class Request:
 
     `args` holds the operation's per-row parallel sequences (e.g.
     ``(digests, sigs65)``); `rows` is their common length. The future
-    resolves to the per-row results in the caller's own order.
+    resolves to the per-row results in the caller's own order. `klass`
+    is the admission class (serving/classes.py) and `tenant` the quota
+    bucket ("" = untenanted).
 
     Trace fields: `trace_ctx` is the submitting caller's
     (trace_id, span_id) captured at enqueue (None when tracing is off),
@@ -51,13 +101,17 @@ class Request:
     """
 
     __slots__ = ("op", "args", "rows", "future", "enqueued_at",
+                 "klass", "tenant",
                  "trace_ctx", "t_taken", "t_dispatch", "t_done",
                  "trace_ids")
 
-    def __init__(self, op: str, args: tuple, rows: int):
+    def __init__(self, op: str, args: tuple, rows: int,
+                 klass: str = CLASS_INTERACTIVE, tenant: str = ""):
         self.op = op
         self.args = args
         self.rows = rows
+        self.klass = check_class(klass)
+        self.tenant = tenant or ""
         self.future: Future = Future()
         self.enqueued_at = time.monotonic()
         self.trace_ctx = None
@@ -72,12 +126,15 @@ class Request:
 
 
 class AdmissionQueue:
-    """Bounded FIFO of `Request`s with deadline-based flush.
+    """Bounded, class-aware FIFO of `Request`s with deadline flush.
 
     One queue per operation; `take_batch()` drains WHOLE requests (a
     request's rows are never split across dispatches) up to `max_batch`
     rows, always taking at least one request so an oversized caller
-    batch still flows through as its own dispatch.
+    batch still flows through as its own dispatch. With ``registry``
+    and ``label`` the queue emits its own shed/expiry/quota counters
+    (``serving/<label>/class/<class>/...``) — the events happen here,
+    where the batcher cannot see them.
     """
 
     FLUSH_FULL = "full"
@@ -85,7 +142,10 @@ class AdmissionQueue:
     FLUSH_CLOSE = "close"
 
     def __init__(self, cap_rows: int = 4096, policy: str = "block",
-                 max_batch: int = 128, flush_us: float = 500.0):
+                 max_batch: int = 128, flush_us: float = 500.0,
+                 policies: Optional[Dict] = None,
+                 tenant_quota_rows: Optional[int] = None,
+                 registry=None, label: str = ""):
         if policy not in ("block", "shed"):
             raise ValueError(f"unknown backpressure policy {policy!r}; "
                              f"choose 'block' or 'shed'")
@@ -97,38 +157,135 @@ class AdmissionQueue:
         self.policy = policy
         self.max_batch = max_batch
         self.flush_s = flush_us / 1e6
+        self.policies = policies or default_policies()
+        if tenant_quota_rows is None:
+            tenant_quota_rows = int(os.environ.get(
+                "GETHSHARDING_TENANT_QUOTA_ROWS", "0") or 0)
+        self.tenant_quota_rows = tenant_quota_rows
         self.shed_requests = 0
         self.shed_rows = 0
-        self._items: List[Request] = []
+        self.shed_by_class: Dict[str, int] = {c: 0 for c in ADMISSION_CLASSES}
+        self.expired_by_class: Dict[str, int] = {
+            c: 0 for c in ADMISSION_CLASSES}
+        self.quota_rejections = 0
+        self._by_class: Dict[str, List[Request]] = {
+            c: [] for c in ADMISSION_CLASSES}
+        self._class_rows: Dict[str, int] = {c: 0 for c in ADMISSION_CLASSES}
+        self._tenant_rows: Dict[str, int] = {}
         self._rows = 0
+        self._count = 0
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
         self._not_full = threading.Condition(self._lock)
         self._closed = False
+        self._metrics = None
+        if registry is not None and label:
+            base = f"serving/{label}"
+            self._metrics = {
+                "shed": {c: registry.counter(f"{base}/class/{c}/shed")
+                         for c in ADMISSION_CLASSES},
+                "expired": {c: registry.counter(f"{base}/class/{c}/expired")
+                            for c in ADMISSION_CLASSES},
+                "quota": registry.counter(f"{base}/quota_rejections"),
+            }
 
     # -- producer side -----------------------------------------------------
 
     def put(self, request: Request) -> None:
-        """Admit `request`, applying the backpressure policy at the cap.
+        """Admit `request`, applying quota, shed-by-class and the
+        backpressure policy at the cap.
 
         A request is admitted whenever current depth is below the cap
         (even if its own rows push past it) — an always-oversized request
-        must not deadlock against a cap it can never fit under.
+        must not deadlock against a cap it can never fit under. The same
+        high-water semantics apply to the tenant quota.
         """
         with self._lock:
-            while self._rows >= self.cap_rows and not self._closed:
+            if self._closed:
+                raise QueueClosed(
+                    f"serving queue for {request.op} is closed")
+            if self.tenant_quota_rows > 0 and request.tenant:
+                held = self._tenant_rows.get(request.tenant, 0)
+                if held >= self.tenant_quota_rows:
+                    self.quota_rejections += 1
+                    if self._metrics is not None:
+                        self._metrics["quota"].inc()
+                    raise TenantQuotaExceeded(
+                        f"tenant {request.tenant!r} holds {held} queued "
+                        f"rows (quota {self.tenant_quota_rows}); "
+                        f"request refused")
+            while self._rows >= self.cap_rows:
+                if self._shed_lower_locked(request):
+                    continue  # displaced lower-priority work; re-check
                 if self.policy == "shed":
                     self.shed_requests += 1
                     self.shed_rows += request.rows
+                    self.shed_by_class[request.klass] += 1
+                    if self._metrics is not None:
+                        self._metrics["shed"][request.klass].inc()
                     raise ServingOverloadError(
                         f"serving queue for {request.op} at capacity "
-                        f"({self._rows}/{self.cap_rows} rows); request shed")
+                        f"({self._rows}/{self.cap_rows} rows); "
+                        f"{request.klass} request shed")
                 self._not_full.wait()
-            if self._closed:
-                raise RuntimeError("serving queue is closed")
-            self._items.append(request)
+                if self._closed:
+                    raise QueueClosed(
+                        f"serving queue for {request.op} closed while "
+                        f"this request was blocked on admission")
+            self._by_class[request.klass].append(request)
+            self._class_rows[request.klass] += request.rows
+            if request.tenant:
+                self._tenant_rows[request.tenant] = (
+                    self._tenant_rows.get(request.tenant, 0)
+                    + request.rows)
             self._rows += request.rows
+            self._count += 1
             self._not_empty.notify()
+
+    def _shed_lower_locked(self, request: Request) -> bool:
+        """Displace queued work of strictly LOWER priority than the
+        arriving request — catchup first, interactive last — until the
+        queue is below the cap or nothing lower remains. Newest victims
+        first: the oldest queued work is closest to flushing and has
+        absorbed the most wait already. Victim futures fail HERE, under
+        the lock — nothing in this tier registers done-callbacks on
+        request futures (callers block in ``result()``, whose wake
+        rides the future's own condition), and deferring the failure
+        would strand victims behind a subsequently-blocked putter.
+        Returns True when anything was displaced."""
+        arriving = self.policies[request.klass].priority
+        displaced = False
+        for klass in SHED_ORDER:
+            if self.policies[klass].priority <= arriving:
+                continue  # never displace same-or-higher priority
+            items = self._by_class[klass]
+            while items and self._rows >= self.cap_rows:
+                victim = items.pop()
+                self._unaccount_locked(victim)
+                self.shed_requests += 1
+                self.shed_rows += victim.rows
+                self.shed_by_class[klass] += 1
+                if self._metrics is not None:
+                    self._metrics["shed"][klass].inc()
+                if not victim.future.done():
+                    victim.future.set_exception(ServingOverloadError(
+                        f"{klass} request shed by class: displaced by "
+                        f"{request.klass} under overload"))
+                displaced = True
+            if self._rows < self.cap_rows:
+                break
+        return displaced
+
+    def _unaccount_locked(self, request: Request) -> None:
+        self._rows -= request.rows
+        self._count -= 1
+        self._class_rows[request.klass] -= request.rows
+        if request.tenant:
+            left = self._tenant_rows.get(request.tenant, 0) - request.rows
+            if left > 0:
+                self._tenant_rows[request.tenant] = left
+            else:
+                self._tenant_rows.pop(request.tenant, None)
 
     # -- consumer side -----------------------------------------------------
 
@@ -140,36 +297,116 @@ class AdmissionQueue:
         """
         with self._lock:
             while True:
-                if self._items:
+                now = time.monotonic()
+                self._expire_locked(now)
+                if self._count:
                     if self._rows >= self.max_batch:
                         reason = self.FLUSH_FULL
                         break
                     if self._closed:
                         reason = self.FLUSH_CLOSE
                         break
-                    deadline = self._items[0].enqueued_at + self.flush_s
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
+                    flush_at, expire_at = self._deadlines_locked()
+                    if flush_at is not None and flush_at <= now:
                         reason = self.FLUSH_DEADLINE
                         break
-                    self._not_empty.wait(timeout=remaining)
+                    wake_at = flush_at
+                    if expire_at is not None and (
+                            wake_at is None or expire_at < wake_at):
+                        wake_at = expire_at
+                    self._not_empty.wait(
+                        timeout=None if wake_at is None
+                        else max(0.0, wake_at - now))
                 else:
                     if self._closed:
                         return None, self.FLUSH_CLOSE
                     self._not_empty.wait()
-            batch: List[Request] = []
-            rows = 0
-            while self._items and (
-                    not batch or rows + self._items[0].rows <= self.max_batch):
-                request = self._items.pop(0)
-                batch.append(request)
-                rows += request.rows
-            self._rows -= rows
+            batch = self._assemble_locked()
             self._not_full.notify_all()
             return batch, reason
 
+    def _deadlines_locked(self):
+        """(earliest per-class flush deadline, earliest per-class expiry
+        deadline) over the nonempty classes (None = no such deadline)."""
+        flush_at = expire_at = None
+        for klass, items in self._by_class.items():
+            if not items:
+                continue
+            policy = self.policies[klass]
+            head = items[0].enqueued_at
+            deadline = head + self.flush_s * policy.flush_mult
+            if flush_at is None or deadline < flush_at:
+                flush_at = deadline
+            if policy.deadline_s is not None:
+                expiry = head + policy.deadline_s
+                if expire_at is None or expiry < expire_at:
+                    expire_at = expiry
+        return flush_at, expire_at
+
+    def _expire_locked(self, now: float) -> None:
+        """Fail requests whose queue wait overran their class deadline
+        (`ClassDeadlineExceeded`, failed here for the same reasons as
+        `_shed_lower_locked` — an empty-again queue would otherwise
+        strand the victims behind the consumer's next indefinite
+        wait)."""
+        freed = False
+        for klass, items in self._by_class.items():
+            deadline_s = self.policies[klass].deadline_s
+            if deadline_s is None:
+                continue
+            while items and now - items[0].enqueued_at > deadline_s:
+                victim = items.pop(0)
+                self._unaccount_locked(victim)
+                self.expired_by_class[klass] += 1
+                if self._metrics is not None:
+                    self._metrics["expired"][klass].inc()
+                if not victim.future.done():
+                    victim.future.set_exception(ClassDeadlineExceeded(
+                        f"{klass} request expired after "
+                        f"{victim.wait_s(now):.3f}s in the {victim.op} "
+                        f"queue (class deadline {deadline_s}s)"))
+                freed = True
+        if freed:
+            # expiry freed capacity: blocked putters must see it
+            self._not_full.notify_all()
+
+    def _assemble_locked(self) -> List[Request]:
+        """The weighted drain: pass 1 grants every nonempty class its
+        weight share of `max_batch` in priority order; pass 2 hands any
+        leftover capacity out in priority order. Whole requests only; a
+        batch always takes at least one request (an oversized caller
+        batch flows through as its own dispatch)."""
+        ordered = sorted(
+            (klass for klass in ADMISSION_CLASSES if self._by_class[klass]),
+            key=lambda klass: self.policies[klass].priority)
+        total_weight = sum(self.policies[k].weight for k in ordered) or 1
+        batch: List[Request] = []
+        rows = 0
+        for klass in ordered:
+            budget = max(1, (self.max_batch
+                             * self.policies[klass].weight) // total_weight)
+            taken = 0
+            items = self._by_class[klass]
+            while items and (not batch
+                             or (taken < budget
+                                 and rows + items[0].rows <= self.max_batch)):
+                request = items.pop(0)
+                self._unaccount_locked(request)
+                batch.append(request)
+                rows += request.rows
+                taken += request.rows
+        for klass in ordered:  # pass 2: leftovers, priority first
+            items = self._by_class[klass]
+            while items and rows + items[0].rows <= self.max_batch:
+                request = items.pop(0)
+                self._unaccount_locked(request)
+                batch.append(request)
+                rows += request.rows
+        return batch
+
     def close(self) -> None:
-        """Stop admitting; wake the consumer to drain the remainder."""
+        """Stop admitting; wake the consumer to drain the remainder and
+        any blocked putters to fail fast with `QueueClosed`."""
         with self._lock:
             self._closed = True
             self._not_empty.notify_all()
@@ -183,4 +420,11 @@ class AdmissionQueue:
 
     @property
     def depth_requests(self) -> int:
-        return len(self._items)
+        return self._count
+
+    def class_depth_rows(self, klass: str) -> int:
+        return self._class_rows[klass]
+
+    def tenant_rows(self, tenant: str) -> int:
+        with self._lock:
+            return self._tenant_rows.get(tenant, 0)

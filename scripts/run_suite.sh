@@ -205,6 +205,73 @@ print("soundness smoke OK: silent corruption tripped the breaker,"
       " answers stayed correct")
 PYEOF
 
+# -- fleet router smoke: two breaker-guarded serving replicas behind the
+# shard-aware router — seeded chaos trips r0's breaker, every answer
+# stays correct, the router drains r0 and its refresh-side probe
+# re-promotes it through the half-open differential, and the fleet
+# counters reach the Prometheus exposition
+echo "== fleet router smoke"
+JAX_PLATFORMS=cpu python - <<'PYEOF' || fail=1
+import time
+
+from gethsharding_tpu.crypto import secp256k1 as ecdsa
+from gethsharding_tpu.crypto.keccak import keccak256
+from gethsharding_tpu.fleet import FleetRouter, Replica, RouterSigBackend
+from gethsharding_tpu.metrics import prometheus_text
+from gethsharding_tpu.resilience.breaker import (CircuitBreaker,
+                                                 FailoverSigBackend)
+from gethsharding_tpu.resilience.chaos import ChaosSchedule, ChaosSigBackend
+from gethsharding_tpu.serving import ServingConfig, ServingSigBackend
+from gethsharding_tpu.sigbackend import PythonSigBackend
+
+schedule = ChaosSchedule(seed=7, rules={"backend.ecrecover_addresses": 3})
+servings = [
+    ServingSigBackend(ChaosSigBackend(PythonSigBackend(), schedule),
+                      ServingConfig(flush_us=200)),
+    ServingSigBackend(PythonSigBackend(), ServingConfig(flush_us=200)),
+]
+breaker0 = CircuitBreaker(name="smoke-r0", fault_threshold=3, reset_s=0.2)
+router = FleetRouter([
+    Replica("r0", FailoverSigBackend(servings[0], PythonSigBackend(),
+                                     breaker=breaker0)),
+    Replica("r1", FailoverSigBackend(servings[1], PythonSigBackend(),
+                                     breaker=CircuitBreaker(
+                                         name="smoke-r1"))),
+], health_interval_s=0.0)
+back = RouterSigBackend(router)
+cases = []
+for i in range(6):
+    priv = int.from_bytes(keccak256(b"smoke-%d" % i), "big") % ecdsa.N
+    digest = keccak256(b"smoke-msg-%d" % i)
+    cases.append((digest, ecdsa.sign(digest, priv).to_bytes65(),
+                  ecdsa.priv_to_address(priv)))
+for digest, sig, want in cases[:4]:
+    assert back.ecrecover_addresses([digest], [sig]) == [want]
+router.refresh(force=True)
+r0 = router.replicas[0]
+assert r0.state == "draining", r0.state  # breaker tripped -> drained
+assert schedule.injected.get("backend.ecrecover_addresses") == 3
+time.sleep(0.25)
+deadline = time.monotonic() + 5
+while r0.state != "healthy" and time.monotonic() < deadline:
+    router.refresh(force=True)
+    time.sleep(0.02)
+assert r0.state == "healthy", r0.state  # probe re-promoted -> re-entered
+assert r0.reentries == 1
+for digest, sig, want in cases[4:]:
+    assert back.ecrecover_addresses([digest], [sig]) == [want]
+prom = prometheus_text()
+for needle in ("gethsharding_fleet_replica_r0_state",
+               "gethsharding_fleet_replica_r0_routed_total",
+               "gethsharding_fleet_router_calls_total",
+               "gethsharding_resilience_retry_fleet_route_retries_total"):
+    assert needle in prom, needle
+for serving in servings:
+    serving.close()
+print("fleet router smoke OK: drain ->", r0.drain_events,
+      "reentry ->", r0.reentries)
+PYEOF
+
 for f in tests/test_*.py; do
     echo "== $f"
     python -m pytest "$f" -q --no-header || fail=1

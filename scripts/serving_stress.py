@@ -1,30 +1,44 @@
 #!/usr/bin/env python
-"""Soak driver for the verification serving tier.
+"""Soak driver for the serving tier — single-backend or fleet.
 
-M client threads hammer the serving backend with small ecrecover
-requests for a fixed duration, verifying EVERY result against the
-known signer (zero-divergence soak, not just throughput), while a
-reporter prints one JSON stats line per interval:
+Default mode (unchanged since PR 1): M client threads hammer ONE
+serving backend with small ecrecover requests for a fixed duration,
+verifying EVERY result against the known signer (zero-divergence soak,
+not just throughput), while a reporter prints one JSON stats line per
+interval:
 
     python scripts/serving_stress.py --clients 32 --duration 30 \
         --policy shed --queue-cap 256 --flush-us 500
 
-What to look for:
-- `rate`: served verifications/sec (coalesced) — should sit well above
-  the direct-backend rate for the same client count (bench.py --serving
-  reports that baseline next to it);
-- `coalesce_ratio`: requests per device dispatch — the amortization;
-- `shed`: with --policy shed, how much traffic the admission cap
-  refused (should be zero until the offered load exceeds the device);
-- `queue_depth` / `wait_p50_ms`: the backpressure state.
+Fleet traffic-model mode (`--replicas N`): an in-process fleet of N
+breaker-guarded serving replicas behind the shard-aware router
+(gethsharding_tpu/fleet/), driven by a production-shaped load model:
 
-Exit code 1 on any result divergence or hung client.
+- **admission-class mix** (`--classes interactive=8,bulk_audit=3,...`):
+  each client thread carries a class; bulk/catchup issue multi-row
+  requests, interactive issues 1-row requests and must never be shed;
+- **diurnal curve** (`--diurnal-s`): the active-client fraction swings
+  sinusoidally between 30% and 100% over one period — load is a wave,
+  not a constant;
+- **hot-shard skew** (`--hot-shard`): that fraction of catchup/bulk
+  requests carries ONE affinity key, overloading a single replica the
+  way a popular shard does;
+- **thundering herd** (`--herd-at`): at that second every client
+  pauses, then re-bursts simultaneously — the reconnect stampede;
+- optional seeded chaos (`--chaos-trip`) trips replica r0's breaker
+  mid-soak so the drain→probe→re-enter cycle runs under load.
+
+Per-class p99 latencies are reported and (when `--slo-interactive-ms`
+etc. are nonzero) GATED: `bench.py --fleet` runs this model with SLOs
+on. Exit code 1 on any divergence, hung client, interactive shed, or
+SLO breach.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import sys
 import threading
@@ -39,6 +53,9 @@ from gethsharding_tpu.serving import (ServingConfig, ServingOverloadError,
                                       ServingSigBackend)
 from gethsharding_tpu.sigbackend import get_backend
 
+CLASS_MIX_DEFAULT = "interactive=8,bulk_audit=3,catchup_replay=1"
+CLASS_ROWS = {"interactive": 1, "bulk_audit": 4, "catchup_replay": 8}
+
 
 def build_cases(n: int):
     """n distinct (digest, sig65, expected address) rows."""
@@ -51,25 +68,23 @@ def build_cases(n: int):
     return cases
 
 
-def main() -> int:
-    parser = argparse.ArgumentParser(
-        description="soak the verification serving tier")
-    parser.add_argument("--clients", type=int, default=16)
-    parser.add_argument("--duration", type=float, default=10.0,
-                        help="seconds of offered load")
-    parser.add_argument("--backend", default="python",
-                        choices=("python", "jax"),
-                        help="wrapped backend (jax needs an accelerator)")
-    parser.add_argument("--max-batch", type=int, default=128)
-    parser.add_argument("--flush-us", type=float, default=500.0)
-    parser.add_argument("--queue-cap", type=int, default=4096)
-    parser.add_argument("--policy", default="block",
-                        choices=("block", "shed"))
-    parser.add_argument("--report-interval", type=float, default=2.0)
-    parser.add_argument("--cases", type=int, default=256,
-                        help="distinct signed rows cycled by the clients")
-    args = parser.parse_args()
+def parse_class_mix(spec: str):
+    mix = []
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        name, _, weight = part.partition("=")
+        mix.extend([name] * int(weight or 1))
+    return mix
 
+
+def percentile(samples, q: float) -> float:
+    if not samples:
+        return 0.0
+    ordered = sorted(samples)
+    return ordered[min(int(q * len(ordered)), len(ordered) - 1)]
+
+
+def run_single(args) -> int:
+    """The original single-backend soak (PR 1 behavior, unchanged)."""
     cases = build_cases(args.cases)
     serving = ServingSigBackend(
         get_backend(args.backend),
@@ -145,6 +160,250 @@ def main() -> int:
         "hung_clients": len(hung),
     }), flush=True)
     return 1 if divergences or hung else 0
+
+
+def build_fleet(args):
+    """N breaker-guarded serving replicas behind the shard router; r0
+    optionally carries a seeded chaos schedule that trips its breaker
+    mid-soak."""
+    from gethsharding_tpu.fleet import FleetRouter, Replica, RouterSigBackend
+    from gethsharding_tpu.resilience.breaker import (CircuitBreaker,
+                                                     FailoverSigBackend)
+    from gethsharding_tpu.resilience.chaos import (ChaosSchedule,
+                                                   ChaosSigBackend)
+
+    servings, replicas, schedule = [], [], None
+    for i in range(args.replicas):
+        inner = get_backend(args.backend)
+        if i == 0 and args.chaos_trip > 0:
+            start = args.chaos_trip
+            schedule = ChaosSchedule(
+                seed=args.chaos_seed,
+                rules={"backend.ecrecover_addresses":
+                       lambda idx, start=start: start <= idx < start + 8})
+            inner = ChaosSigBackend(inner, schedule)
+        serving = ServingSigBackend(
+            inner,
+            ServingConfig(max_batch=args.max_batch, flush_us=args.flush_us,
+                          queue_cap=args.queue_cap, policy=args.policy))
+        servings.append(serving)
+        replicas.append(Replica(
+            f"r{i}",
+            FailoverSigBackend(
+                serving, get_backend("python"),
+                breaker=CircuitBreaker(name=f"soak-r{i}",
+                                       fault_threshold=3,
+                                       reset_s=args.breaker_reset_s))))
+    router = FleetRouter(replicas, health_interval_s=0.05)
+    return router, RouterSigBackend(router), servings, replicas, schedule
+
+
+def run_fleet(args) -> int:
+    from gethsharding_tpu.fleet import AllReplicasDraining
+    from gethsharding_tpu.serving.classes import CLASS_INTERACTIVE
+
+    router, back, servings, replicas, schedule = build_fleet(args)
+    cases = build_cases(args.cases)
+    mix = parse_class_mix(args.classes)
+    lat = {name: [] for name in CLASS_ROWS}
+    done = {name: 0 for name in CLASS_ROWS}
+    shed = {name: 0 for name in CLASS_ROWS}
+    divergences: list = []
+    stop = threading.Event()
+    t0 = time.monotonic()
+    deadline = t0 + args.duration
+    herd_gate = threading.Event()
+    herd_gate.set()
+
+    def active_fraction(now: float) -> float:
+        if args.diurnal_s <= 0:
+            return 1.0
+        phase = 2 * math.pi * ((now - t0) % args.diurnal_s) / args.diurnal_s
+        return 0.65 + 0.35 * math.sin(phase)  # 30%..100%
+
+    def client(c: int) -> None:
+        klass = mix[c % len(mix)]
+        rows = CLASS_ROWS[klass]
+        rng_i = c
+        while time.monotonic() < deadline and not stop.is_set():
+            herd_gate.wait()
+            # diurnal gating: clients beyond the active fraction sleep
+            if (c / max(1, args.clients)) > active_fraction(
+                    time.monotonic()):
+                time.sleep(0.01)
+                continue
+            batch = [cases[(rng_i + j) % len(cases)] for j in range(rows)]
+            rng_i += rows * args.clients
+            # hot-shard skew applies to the bulk planes
+            affinity = None
+            if klass != CLASS_INTERACTIVE \
+                    and (rng_i % 100) < args.hot_shard * 100:
+                affinity = "hot-shard"
+            t_req = time.monotonic()
+            try:
+                got = router.call("ecrecover_addresses",
+                                  [b[0] for b in batch],
+                                  [b[1] for b in batch],
+                                  affinity=affinity, klass=klass)
+            except (ServingOverloadError, AllReplicasDraining):
+                shed[klass] += 1
+                continue
+            lat[klass].append(time.monotonic() - t_req)
+            if got != [b[2] for b in batch]:
+                divergences.append((c, rng_i))
+                stop.set()
+                return
+            done[klass] += 1
+            if klass == CLASS_INTERACTIVE:
+                time.sleep(0.001)
+
+    threads = [threading.Thread(target=client, args=(c,), daemon=True)
+               for c in range(args.clients)]
+    for t in threads:
+        t.start()
+
+    herd_done = args.herd_at <= 0
+    last_report = t0
+    while time.monotonic() < deadline and not stop.is_set():
+        time.sleep(0.1)
+        now = time.monotonic()
+        if not herd_done and now - t0 >= args.herd_at:
+            # thundering herd: everyone disconnects, then re-bursts at
+            # the same instant
+            herd_gate.clear()
+            time.sleep(args.herd_pause_s)
+            herd_gate.set()
+            herd_done = True
+            print(json.dumps({"herd": True, "t_s": round(now - t0, 1)}),
+                  flush=True)
+        if now - last_report >= args.report_interval:
+            last_report = now
+            print(json.dumps({
+                "t_s": round(now - t0, 1),
+                "active_fraction": round(active_fraction(now), 2),
+                "done": dict(done),
+                "shed": dict(shed),
+                "states": {name: state["state"]
+                           for name, state in router.states().items()},
+            }), flush=True)
+
+    for t in threads:
+        t.join(timeout=60)
+    hung = [t for t in threads if t.is_alive()]
+    stop.set()
+
+    # let a tripped replica finish its probe-driven re-entry
+    reentered = True
+    if schedule is not None:
+        reentry_deadline = time.monotonic() + 10
+        while replicas[0].state != "healthy" \
+                and time.monotonic() < reentry_deadline:
+            router.refresh(force=True)
+            time.sleep(0.05)
+        reentered = replicas[0].state == "healthy"
+
+    shed_by_class = {name: 0 for name in CLASS_ROWS}
+    for serving in servings:
+        for klass, count in serving.batcher.shed_by_class().items():
+            shed_by_class[klass] += count
+    p99_ms = {name: round(percentile(samples, 0.99) * 1e3, 2)
+              for name, samples in lat.items()}
+    slo = {"interactive": args.slo_interactive_ms,
+           "bulk_audit": args.slo_bulk_ms,
+           "catchup_replay": args.slo_catchup_ms}
+    slo_breaches = [name for name, limit in slo.items()
+                    if limit > 0 and p99_ms[name] > limit]
+
+    summary = {
+        "summary": True,
+        "fleet": True,
+        "replicas": args.replicas,
+        "clients": args.clients,
+        "wall_s": round(time.monotonic() - t0, 2),
+        "done": dict(done),
+        "caller_shed": dict(shed),
+        "replica_shed_by_class": shed_by_class,
+        "p99_ms": p99_ms,
+        "slo_ms": slo,
+        "slo_breaches": slo_breaches,
+        "divergences": len(divergences),
+        "hung_clients": len(hung),
+        "interactive_shed": shed["interactive"]
+        + shed_by_class["interactive"],
+        "drain_events": replicas[0].drain_events,
+        "reentries": replicas[0].reentries,
+        "chaos_injected": (0 if schedule is None else
+                           schedule.injected.get(
+                               "backend.ecrecover_addresses", 0)),
+        "reentered": reentered,
+        "states": {name: state["state"]
+                   for name, state in router.states().items()},
+    }
+    print(json.dumps(summary), flush=True)
+    for serving in servings:
+        serving.close()
+
+    failed = bool(divergences or hung or slo_breaches
+                  or summary["interactive_shed"]
+                  or (schedule is not None
+                      and (summary["drain_events"] < 1 or not reentered)))
+    return 1 if failed else 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        description="soak the serving tier (single backend or fleet)")
+    parser.add_argument("--clients", type=int, default=16)
+    parser.add_argument("--duration", type=float, default=10.0,
+                        help="seconds of offered load")
+    parser.add_argument("--backend", default="python",
+                        choices=("python", "jax"),
+                        help="wrapped backend (jax needs an accelerator)")
+    parser.add_argument("--max-batch", type=int, default=128)
+    parser.add_argument("--flush-us", type=float, default=500.0)
+    parser.add_argument("--queue-cap", type=int, default=4096)
+    parser.add_argument("--policy", default="block",
+                        choices=("block", "shed"))
+    parser.add_argument("--report-interval", type=float, default=2.0)
+    parser.add_argument("--cases", type=int, default=256,
+                        help="distinct signed rows cycled by the clients")
+    # -- fleet traffic model ------------------------------------------------
+    parser.add_argument("--replicas", type=int, default=0,
+                        help="> 0: run the FLEET soak — this many "
+                             "breaker-guarded serving replicas behind "
+                             "the shard router (gethsharding_tpu/fleet/)")
+    parser.add_argument("--classes", default=CLASS_MIX_DEFAULT,
+                        help="admission-class client mix, e.g. "
+                             "'interactive=8,bulk_audit=3,"
+                             "catchup_replay=1'")
+    parser.add_argument("--diurnal-s", type=float, default=0.0,
+                        help="sinusoidal load period in seconds (0 = "
+                             "flat load): active clients swing 30%%-100%%")
+    parser.add_argument("--hot-shard", type=float, default=0.0,
+                        help="fraction of bulk/catchup requests keyed to "
+                             "ONE hot affinity (0..1)")
+    parser.add_argument("--herd-at", type=float, default=0.0,
+                        help="seconds into the soak to fire a thundering-"
+                             "herd reconnect burst (0 = off)")
+    parser.add_argument("--herd-pause-s", type=float, default=0.3,
+                        help="how long the herd holds its breath")
+    parser.add_argument("--chaos-trip", type=int, default=0,
+                        help="> 0: seed a chaos run of 8 consecutive "
+                             "device faults on replica r0 starting at "
+                             "this dispatch index — trips its breaker "
+                             "mid-soak")
+    parser.add_argument("--chaos-seed", type=int, default=11)
+    parser.add_argument("--breaker-reset-s", type=float, default=0.5)
+    parser.add_argument("--slo-interactive-ms", type=float, default=0.0,
+                        help="gate: interactive p99 must stay under this "
+                             "(0 = report only)")
+    parser.add_argument("--slo-bulk-ms", type=float, default=0.0)
+    parser.add_argument("--slo-catchup-ms", type=float, default=0.0)
+    args = parser.parse_args()
+
+    if args.replicas > 0:
+        return run_fleet(args)
+    return run_single(args)
 
 
 if __name__ == "__main__":
