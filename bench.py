@@ -849,6 +849,42 @@ def measure_fleet() -> dict:
         "the catchup flood never shed — the overload phase tested "
         "nothing", summary)
     assert summary["p99_ms"]["interactive"] <= slo_ms, summary
+
+    # -- the SLO-layer overhead gate (the PR 2 tracer-budget shape) --------
+    # the serving hot path now records one SLO event per request (and a
+    # routed request records a second at the router); both together must
+    # cost <2% of a serving request. Measured, not assumed: a real
+    # serving request's latency vs the amortized cost of
+    # SLOTracker.record on a warm tracker.
+    from gethsharding_tpu.metrics import Registry
+    from gethsharding_tpu.serving import ServingConfig, ServingSigBackend
+    from gethsharding_tpu.sigbackend import PythonSigBackend
+    from gethsharding_tpu.slo import SLOTracker
+
+    serving = ServingSigBackend(PythonSigBackend(),
+                                ServingConfig(flush_us=500.0),
+                                registry=Registry())
+    try:
+        serving.ecrecover_addresses([], [])  # warm the threads
+        n = 100
+        t0 = time.perf_counter()
+        for i in range(n):
+            serving.ecrecover_addresses(
+                [bytes([i % 251]) * 32], [b"\x00" * 65])
+        per_request_s = (time.perf_counter() - t0) / n
+    finally:
+        serving.close()
+    tracker = SLOTracker(registry=Registry())
+    m = 20_000
+    t0 = time.perf_counter()
+    for _ in range(m):
+        tracker.record("interactive", ok=True, latency_s=0.001)
+    record_s = (time.perf_counter() - t0) / m
+    slo_overhead_pct = 100.0 * 2 * record_s / per_request_s
+    assert slo_overhead_pct < 2.0, (
+        f"SLO layer overhead {slo_overhead_pct:.3f}% of a serving "
+        f"request ({record_s * 1e6:.3f}us x2 vs "
+        f"{per_request_s * 1e6:.1f}us) breaches the 2% budget")
     return {
         "replicas": 3,
         "clients": clients,
@@ -865,6 +901,8 @@ def measure_fleet() -> dict:
         "reentries": summary["reentries"],
         "chaos_injected": summary["chaos_injected"],
         "states": summary["states"],
+        "slo_record_us": round(record_s * 1e6, 3),
+        "slo_overhead_pct": round(slo_overhead_pct, 4),
     }
 
 

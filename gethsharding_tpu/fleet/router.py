@@ -47,8 +47,12 @@ import time
 from contextlib import contextmanager
 from typing import Callable, Dict, List, Optional
 
-from gethsharding_tpu import metrics
-from gethsharding_tpu.serving.classes import admission_class, class_for
+from gethsharding_tpu import metrics, slo, tracing
+from gethsharding_tpu.serving.classes import (
+    ADMISSION_CLASSES,
+    admission_class,
+    class_for,
+)
 from gethsharding_tpu.resilience.errors import (
     DeadlineExceeded,
     DispatcherClosed,
@@ -148,6 +152,7 @@ class Replica:
     def __init__(self, name: str, backend,
                  health: Optional[Callable[[], dict]] = None,
                  probe: Optional[Callable[[], None]] = "default",
+                 metrics_read: Optional[Callable[[], dict]] = "default",
                  trip_threshold: int = 3,
                  trip_cooldown_s: float = 2.0,
                  registry: metrics.Registry = metrics.DEFAULT_REGISTRY):
@@ -155,6 +160,15 @@ class Replica:
         self.backend = backend
         self.health = health or default_health(backend)
         self.probe = _default_probe(backend) if probe == "default" else probe
+        # metrics federation source: a callable returning the replica's
+        # registry snapshot (`RpcReplicaBackend.metrics` → the
+        # `shard_metrics` RPC). The default resolves it off the backend;
+        # in-process replicas (which share THIS process's registry)
+        # have none and are skipped by the sweep's fold. None disables.
+        if metrics_read == "default":
+            metrics_read = getattr(backend, "metrics", None)
+        self.metrics_read = metrics_read
+        self.last_metrics: Optional[dict] = None
         self.trip_threshold = trip_threshold
         self.trip_cooldown_s = trip_cooldown_s
         self.state = ReplicaState.HEALTHY
@@ -280,9 +294,19 @@ class FleetRouter:
             retryable=ROUTER_RETRYABLE)
         self._executor = RetryExecutor("fleet.route", policy,
                                        registry=registry)
+        self._registry = registry
         self._m_failovers = registry.counter("fleet/router/failovers")
         self._m_all_draining = registry.counter("fleet/router/all_draining")
         self._m_calls = registry.counter("fleet/router/calls")
+        # federation aggregates, refreshed each sweep from the scraped
+        # replica snapshots: the one-glance fleet answers — how much
+        # work is in flight anywhere, how deep each class is queued
+        # across replicas, and the worst replica's device-dispatch p99
+        self._g_inflight = registry.gauge("fleet/total_inflight")
+        self._g_class_depth = {
+            c: registry.gauge(f"fleet/class/{c}/queue_depth")
+            for c in ADMISSION_CLASSES}
+        self._g_worst_p99 = registry.gauge("fleet/worst_replica_p99_s")
         # health sweeps run on a BACKGROUND thread when an interval is
         # set: a slow or dead replica's health read (a full RPC timeout
         # against a silently-gone host) must stall the sweeper, never a
@@ -314,6 +338,9 @@ class FleetRouter:
             if not force and now - self._last_refresh < self.health_interval_s:
                 return
             self._last_refresh = now
+        total_inflight = 0
+        class_depth = {c: 0 for c in ADMISSION_CLASSES}
+        worst_p99 = 0.0
         for replica in self.replicas:
             try:
                 health = replica.health()
@@ -322,6 +349,27 @@ class FleetRouter:
                             replica.name, exc)
                 health = None
             replica.observe_health(health, now)
+            if health is not None:
+                total_inflight += int(health.get("inflight") or 0)
+                # metrics federation: scrape the replica's registry
+                # snapshot (the shard_metrics RPC) on the same sweep
+                # that read its health — one background thread pays
+                # both round trips, callers pay neither
+                if replica.metrics_read is not None:
+                    try:
+                        snapshot = replica.metrics_read()
+                    except Exception as exc:  # noqa: BLE001 - scrape is
+                        # best-effort: health already said it is alive
+                        log.warning("replica %s metrics scrape failed: %r",
+                                    replica.name, exc)
+                        snapshot = None
+                    if snapshot:
+                        replica.last_metrics = snapshot
+                        self._fold_metrics(replica.name, snapshot,
+                                           class_depth)
+            if replica.last_metrics:
+                worst_p99 = max(worst_p99,
+                                self._dispatch_p99(replica.last_metrics))
             if replica.state == ReplicaState.DRAINING \
                     and replica.probe is not None \
                     and health is not None \
@@ -334,6 +382,59 @@ class FleetRouter:
                     replica.probe()
                 except Exception:  # noqa: BLE001 - probe outcome is the
                     pass  # breaker's business, not ours
+        self._g_inflight.set(total_inflight)
+        for klass, depth in class_depth.items():
+            self._g_class_depth[klass].set(depth)
+        self._g_worst_p99.set(round(worst_p99, 6))
+        # the sweep doubles as the SLO gauge heartbeat: an idle class's
+        # burn rate decays on the exposition instead of freezing
+        slo.tracker().sweep(now)
+
+    # federation fold: which remote namespaces land under
+    # fleet/replica/<name>/..., and which snapshot fields per metric
+    # type (the full snapshots would be thousands of gauges; these are
+    # the dashboard-grade fields)
+    _FOLD_NAMESPACES = ("serving/", "resilience/", "slo/", "trace/",
+                        "sig/", "jax/", "das/")
+    _FOLD_FIELDS = {
+        "counter": ("count", "rate_1m"),
+        "gauge": ("value",),
+        "timer": ("count", "mean_s", "p50_s", "p95_s", "p99_s"),
+        "histogram": ("count", "mean", "p50", "p95", "p99"),
+    }
+
+    def _fold_metrics(self, name: str, snapshot: dict,
+                      class_depth: Dict[str, int]) -> None:
+        """Fold one replica's scraped snapshot into this process's
+        registry as ``fleet/replica/<name>/<metric>/<field>`` gauges
+        (re-set in place every sweep), accumulating the per-class
+        queue depths into the fleet aggregate on the way."""
+        base = f"fleet/replica/{name}"
+        for metric, snap in snapshot.items():
+            if not isinstance(snap, dict) \
+                    or not metric.startswith(self._FOLD_NAMESPACES):
+                continue
+            for field in self._FOLD_FIELDS.get(snap.get("type"), ()):
+                value = snap.get(field)
+                if isinstance(value, (int, float)):
+                    self._registry.gauge(
+                        f"{base}/{metric}/{field}").set(value)
+            if metric.endswith("/queue_depth"):
+                for klass in class_depth:
+                    if f"/class/{klass}/" in metric:
+                        class_depth[klass] += int(snap.get("value") or 0)
+
+    @staticmethod
+    def _dispatch_p99(snapshot: dict) -> float:
+        """The replica's worst per-op device-dispatch p99 from its
+        scraped snapshot — the 'slow chip' scalar."""
+        worst = 0.0
+        for metric, snap in snapshot.items():
+            if metric.startswith("serving/") \
+                    and metric.endswith("/dispatch_latency") \
+                    and isinstance(snap, dict):
+                worst = max(worst, float(snap.get("p99_s") or 0.0))
+        return worst
 
     # -- routing -----------------------------------------------------------
 
@@ -360,8 +461,18 @@ class FleetRouter:
         pins the preference order (shard/pk-row/DAS-root keyed traffic
         stays cache-warm); `klass`/`tenant` tag admission downstream
         (the in-process serving tier reads the thread context, the RPC
-        adapter ships them on the wire)."""
+        adapter ships them on the wire).
+
+        Observability per call: a ``fleet/route`` span (op, class,
+        shard affinity) parenting one ``fleet/attempt`` span per
+        replica tried (replica name + attempt ordinal — and, through
+        the RPC trace envelope, the replica's own handler/dispatch
+        spans). SLO events: each FAILED attempt charges the class's
+        error budget (a breaker trip burns budget even when failover
+        keeps the caller whole — that is the fleet-health signal), the
+        final success records one good event with end-to-end latency."""
         self._m_calls.inc()
+        slo_class = class_for(op, klass)
         if self._sweeper is None:
             self.refresh()  # inline mode only; see __init__
         candidates = self.route(affinity)
@@ -370,6 +481,7 @@ class FleetRouter:
             candidates = self.route(affinity)
             if not candidates:
                 self._m_all_draining.inc()
+                slo.record(slo_class, ok=False)
                 raise AllReplicasDraining(
                     f"{op}: all {len(self.replicas)} replicas are "
                     f"draining or tripped")
@@ -388,7 +500,9 @@ class FleetRouter:
                 self._m_failovers.inc()
             tried.append(replica.name)
             try:
-                with replica.flight():
+                with replica.flight(), \
+                        tracing.span("fleet/attempt", replica=replica.name,
+                                     attempt=len(tried)):
                     if klass is not None or tenant is not None:
                         # a tenant tag alone still charges the quota —
                         # class_for resolves this op's default class
@@ -399,11 +513,20 @@ class FleetRouter:
                         out = getattr(replica.backend, op)(*args, **kwargs)
             except Exception as exc:  # noqa: BLE001 - classify + re-raise
                 replica.note_failure(exc)
+                slo.record(slo_class, ok=False)
                 raise
             replica.note_success()
             return out
 
-        return self._executor.call(attempt)
+        t_start = time.monotonic()
+        route_tags = {"op": op, "klass": slo_class}
+        if affinity is not None:
+            route_tags["shard"] = str(affinity)
+        with tracing.span("fleet/route", **route_tags):
+            out = self._executor.call(attempt)
+        slo.record(slo_class, ok=True,
+                   latency_s=time.monotonic() - t_start)
+        return out
 
     # -- drain lifecycle ---------------------------------------------------
 
@@ -534,6 +657,11 @@ class RpcReplicaBackend:
         from gethsharding_tpu.rpc.client import RPCError
 
         try:
+            # tag the enclosing span (the router's fleet/attempt, or
+            # whatever the direct caller has open) with the endpoint
+            # this call actually dialed — the router's `replica` tag
+            # names the routing slot, this names the wire address
+            tracing.tag_current(endpoint=self.name)
             return self.client.call(method, *params)
         except RPCError as exc:
             if "draining" in exc.message:
@@ -585,6 +713,12 @@ class RpcReplicaBackend:
 
     def health(self) -> dict:
         return self.client.call("shard_health")
+
+    def metrics(self) -> dict:
+        """The replica's full registry snapshot (`shard_metrics`) —
+        the federation scrape the router's health sweep folds into
+        ``fleet/replica/<name>/...`` rollups."""
+        return self.client.call("shard_metrics")
 
     def drain(self) -> dict:
         return self.client.call("shard_drain")

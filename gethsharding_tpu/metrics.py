@@ -189,6 +189,31 @@ class Histogram:
         (what the name has always implied; ``le_inf`` == ``count``)."""
         return self._cumulative(self.read()[0])
 
+    def quantile(self, q: float) -> float:
+        """Estimate the q-quantile from the cumulative buckets, linear
+        interpolation WITHIN the bucket the target rank falls in (the
+        Prometheus `histogram_quantile` estimator): the first bucket
+        interpolates from 0, the overflow bucket clamps to the largest
+        finite bound — an estimator cannot invent an upper edge for
+        +Inf. 0.0 with no observations."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        counts, count, _ = self.read()
+        if count == 0:
+            return 0.0
+        target = q * count
+        running = 0
+        lower = 0.0
+        for i, bound in enumerate(self._bounds):
+            if running + counts[i] >= target:
+                if counts[i] == 0:
+                    return float(bound)
+                frac = (target - running) / counts[i]
+                return lower + (bound - lower) * frac
+            running += counts[i]
+            lower = float(bound)
+        return float(self._bounds[-1])
+
     def slot_counts(self) -> Dict[str, int]:
         """EXACT per-slot counts under ``bucket_*`` keys (each
         observation in exactly one slot; ``bucket_inf`` is overflow)."""
@@ -198,6 +223,12 @@ class Histogram:
         counts, count, total = self.read()
         return {"type": "histogram", "count": count,
                 "mean": round(total / count if count else 0.0, 3),
+                # bucket-interpolated percentiles next to the raw
+                # buckets: /status renders snapshots verbatim, so the
+                # serving/fleet sections show p50/p95/p99 directly
+                "p50": round(self.quantile(0.50), 4),
+                "p95": round(self.quantile(0.95), 4),
+                "p99": round(self.quantile(0.99), 4),
                 **self._cumulative(counts), **self._per_slot(counts)}
 
 

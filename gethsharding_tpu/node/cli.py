@@ -528,6 +528,14 @@ def run_sharding_node(args) -> int:
 
         tracing.enable(ring_spans=args.trace_ring)
         log.info("span tracing enabled (ring %d)", args.trace_ring)
+    # build the SLO tracker at boot (env-derived objectives) so the
+    # slo/<class>/... gauges exist on /metrics and the Prometheus
+    # exposition from the first scrape, not only after the first
+    # recorded event — scrapers treat an absent series as "no SLO
+    # plane", which a freshly-booted idle node is not
+    from gethsharding_tpu import slo
+
+    slo.tracker()
 
     node.start()
 

@@ -107,12 +107,31 @@ class StatusServer(Service):
             payload["das"] = das
         # the fleet router at a glance: per-replica state gauges
         # (0 healthy / 1 draining / 2 tripped), routed/failure counters
-        # with their EWMA rates, and the router's failover /
-        # all-draining totals — present only on a process that routes
+        # with their EWMA rates, the router's failover / all-draining
+        # totals — and, on a federating router, the scraped
+        # fleet/replica/<name>/ rollups + fleet aggregates (total
+        # in-flight, per-class depth, worst replica p99)
         fleet = {name: snap for name, snap in snapshot.items()
                  if name.startswith("fleet/")}
         if fleet:
             payload["fleet"] = fleet
+        # per-class SLOs at a glance: declared objectives, fast/slow
+        # burn rates, budget remaining, breach counts, latency ladder
+        # (slo/tracker.py) — only once something recorded an event
+        from gethsharding_tpu import slo as slo_mod
+
+        if slo_mod.active() is not None:
+            payload["slo"] = slo_mod.active().describe()
+        # span-ring health: a nonzero dropped count means the bounded
+        # finished-span ring overwrote spans nobody exported — raise
+        # --trace-ring or export more often
+        from gethsharding_tpu import tracing
+
+        payload["trace"] = {
+            "enabled": tracing.TRACER.enabled,
+            "spans_recorded": tracing.TRACER.spans_recorded,
+            "spans_dropped": tracing.TRACER.spans_dropped,
+        }
         return payload
 
     def metrics_payload(self) -> dict:
@@ -126,6 +145,7 @@ class StatusServer(Service):
 
         return {"enabled": tracing.TRACER.enabled,
                 "spans_recorded": tracing.TRACER.spans_recorded,
+                "spans_dropped": tracing.TRACER.spans_dropped,
                 "traces": tracing.TRACER.recent_traces(limit=100)}
 
     # -- lifecycle ---------------------------------------------------------

@@ -62,7 +62,7 @@ import time
 from concurrent import futures
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from gethsharding_tpu import metrics, tracing
+from gethsharding_tpu import metrics, slo, tracing
 from gethsharding_tpu.resilience.errors import SoundnessViolation
 from gethsharding_tpu.sigbackend import SigBackend, VerdictFuture
 
@@ -329,6 +329,10 @@ class SpotCheckSigBackend(SigBackend):
     def _violation(self, op: str, kind: str, detail: str) -> None:
         self._m[op][("mismatches" if kind == "mismatch"
                      else "invariant_violations")].inc()
+        # the integrity SLO: every violation burns the integrity
+        # objective's error budget, so the 2G2T detection budget reads
+        # as a burn rate, not just a counter (slo/tracker.py)
+        slo.record(slo.INTEGRITY, ok=False)
         tracer = tracing.TRACER
         if tracer.enabled:
             now = time.monotonic()
@@ -414,6 +418,10 @@ class SpotCheckSigBackend(SigBackend):
                             f"{[got[picked.index(i)] for i in bad]}, "
                             f"reference says "
                             f"{[want[picked.index(i)] for i in bad]}")
+        # a clean spot-check is one GOOD integrity event: the SLO's
+        # event stream runs at the sampled check rate, so its burn rate
+        # is the detected-corruption fraction of audited dispatches
+        slo.record(slo.INTEGRITY, ok=True)
 
     def _audit(self, op: str, cols: Tuple, out) -> None:
         self._check_invariants(op, cols, out)

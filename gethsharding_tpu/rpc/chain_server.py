@@ -123,6 +123,12 @@ def main(argv=None) -> int:
 
         sig_backend = FailoverSigBackend(sig_backend,
                                          get_backend("python"))
+    # boot the SLO tracker so this replica's shard_metrics snapshot
+    # carries the slo/<class>/... series from the first federation
+    # scrape (env-derived objectives; serving records the events)
+    from gethsharding_tpu import slo
+
+    slo.tracker()
     server = RPCServer(backend, host=args.host, port=args.port,
                        sig_backend=sig_backend)
     server.start()

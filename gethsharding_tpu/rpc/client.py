@@ -23,6 +23,7 @@ import threading
 from dataclasses import dataclass
 from typing import Callable, List, Optional
 
+from gethsharding_tpu import tracing
 from gethsharding_tpu.rpc import codec
 from gethsharding_tpu.smc.state_machine import SMCRevert
 from gethsharding_tpu.utils.hexbytes import Address20, Hash32
@@ -96,21 +97,36 @@ class RPCClient:
         slot: dict = {"event": event}
         with self._pending_lock:
             self._pending[rid] = slot
-        payload = (json.dumps({"jsonrpc": "2.0", "id": rid, "method": method,
-                               "params": list(params)}) + "\n").encode()
-        with self._write_lock:
-            self._file.write(payload)
-            self._file.flush()
-        if not event.wait(self._timeout):
-            with self._pending_lock:
-                self._pending.pop(rid, None)
-            raise TimeoutError(f"rpc call {method} timed out")
-        if "error" in slot:
-            err = slot["error"]
-            if err.get("data") == "SMCRevert":
-                raise SMCRevert(err.get("message", ""))
-            raise RPCError(err.get("code", -1), err.get("message", ""))
-        return slot.get("result")
+        # cross-process trace propagation: the caller's active span
+        # context rides the request as a `trace` envelope field, and the
+        # server adopts it as its handler span's trace/parent — one
+        # trace id from a router's route span down into the replica's
+        # dispatch spans. Extra envelope keys are legal JSON-RPC.
+        with tracing.span(f"rpc/client/{method}") as client_span:
+            request = {"jsonrpc": "2.0", "id": rid, "method": method,
+                       "params": list(params)}
+            ctx = tracing.current_context()
+            if ctx is not None:
+                request["trace"] = {"trace_id": ctx[0], "span_id": ctx[1]}
+            payload = (json.dumps(request) + "\n").encode()
+            with self._write_lock:
+                self._file.write(payload)
+                self._file.flush()
+            if not event.wait(self._timeout):
+                with self._pending_lock:
+                    self._pending.pop(rid, None)
+                raise TimeoutError(f"rpc call {method} timed out")
+            if "trace" in slot:
+                # the server's handler trace id: equal to ours once the
+                # server stitches, the REMOTE id against an older server
+                # — either way caller logs correlate to replica traces
+                client_span.tag(remote_trace=slot["trace"])
+            if "error" in slot:
+                err = slot["error"]
+                if err.get("data") == "SMCRevert":
+                    raise SMCRevert(err.get("message", ""))
+                raise RPCError(err.get("code", -1), err.get("message", ""))
+            return slot.get("result")
 
     def subscribe_heads(self, callback: Callable) -> Callable[[], None]:
         self._head_subscribers.append(callback)
@@ -146,6 +162,12 @@ class RPCClient:
                 with self._pending_lock:
                     slot = self._pending.pop(rid, None)
                 if slot is not None:
+                    if "trace" in msg:
+                        # the handler-span trace id the server returns
+                        # on the envelope — surfaced as the caller
+                        # span's `remote_trace` tag (it was received
+                        # and silently discarded before)
+                        slot["trace"] = msg["trace"]
                     if "error" in msg:
                         slot["error"] = msg["error"]
                     else:

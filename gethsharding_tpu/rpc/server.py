@@ -253,7 +253,16 @@ class RPCServer:
                 # attribution seam), and its trace id rides back to the
                 # client on the response envelope. Extra envelope keys
                 # are legal JSON-RPC: clients read `result`/`error` only.
-                with tracing.span(f"rpc/{method}") as handler_span:
+                # An inbound `trace` envelope (RPCClient.call attaches
+                # the caller's span context) is ADOPTED: the handler
+                # span joins the remote trace and parents under the
+                # remote span, stitching a router-traced request into
+                # this replica's spans.
+                inbound = req.get("trace")
+                ctx = None
+                if isinstance(inbound, dict):
+                    ctx = (inbound.get("trace_id"), inbound.get("span_id"))
+                with tracing.span(f"rpc/{method}", ctx=ctx) as handler_span:
                     result = fn(*params)
                 trace_id = handler_span.trace_id
         except SMCRevert as exc:
@@ -473,6 +482,18 @@ class RPCServer:
     def rpc_drain(self):
         """Router/operator-initiated drain (see `drain()`)."""
         return self.drain()
+
+    def rpc_metrics(self):
+        """Metrics federation: this replica's full registry snapshot in
+        ONE round trip — the scrape the fleet router's background
+        health sweep folds into its own registry under
+        ``fleet/replica/<name>/...`` (plus fleet-level aggregates), so
+        a router's /status answers "which replica's chip is slow"
+        without dialing N dashboards. Snapshots are plain JSON-safe
+        dicts (counters/gauges/timers/histograms)."""
+        from gethsharding_tpu.metrics import DEFAULT_REGISTRY
+
+        return DEFAULT_REGISTRY.snapshot()
 
     def rpc_servingStats(self):
         """Dispatch/coalescing counters of the serving tier (None until

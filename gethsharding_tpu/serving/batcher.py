@@ -34,8 +34,15 @@ With tracing enabled (``gethsharding_tpu.tracing``), every request also
 emits a span tree: ``serving/<op>/request`` decomposing into contiguous
 ``queue_wait`` / ``batch_assembly`` / ``device_dispatch`` children (the
 per-request latency attribution the aggregate timers cannot give), plus
-a ``future_wake`` phase recorded by the caller on resume. When tracing
-is off the hot path pays one attribute read per request.
+a ``future_wake`` phase recorded by the caller on resume; the dispatch
+child carries ``device_ms``/``marshal_ms``/``wire_bytes`` tags. When
+tracing is off the hot path pays one attribute read per request.
+
+Every completed request additionally records one per-class SLO event
+(``gethsharding_tpu/slo/``): good with its end-to-end latency on
+success, bad on a shed or a failed batch — the burn-rate feed, always
+on and budgeted inside the serving tier's 2% overhead bar (asserted in
+``bench.py --fleet``).
 """
 
 from __future__ import annotations
@@ -45,7 +52,7 @@ import time
 from concurrent.futures import Future
 from typing import Dict, List, Optional, Sequence
 
-from gethsharding_tpu import metrics, tracing
+from gethsharding_tpu import metrics, slo, tracing
 from gethsharding_tpu.serving.classes import (
     ADMISSION_CLASSES,
     class_for,
@@ -219,6 +226,9 @@ class MicroBatcher:
             raise
         except ServingOverloadError:
             met.shed.inc()
+            # a shed IS an availability event: the class's error budget
+            # pays for it even though no device dispatch ever ran
+            slo.record(klass, ok=False)
             raise
         met.queue_depth.set(queue.depth_rows)
         met.class_depth[klass].set(queue.class_depth_rows(klass))
@@ -276,9 +286,7 @@ class MicroBatcher:
             except Exception as exc:  # noqa: BLE001 - a malformed batch
                 # must fail ITS futures, not kill the op's only consumer
                 # (a dead flusher would hang every later caller forever)
-                for request in batch:
-                    if not request.future.done():
-                        request.future.set_exception(exc)
+                self._fail_batch(batch, exc)
 
     def _run_batch(self, op: str, batch: List[Request], cols: tuple,
                    rows: int, reason: str = "") -> None:
@@ -297,23 +305,27 @@ class MicroBatcher:
                 # errored requests are the ones most worth attributing:
                 # emit their spans (error-tagged) before failing them
                 t_done = time.monotonic()
+                wire = self._wire_bytes(op, cols)
                 for request in batch:
                     if request.t_taken and request.t_dispatch:
                         request.t_done = t_done
                         self._emit_request_trace(op, request, reason, rows,
+                                                 wire_bytes=wire,
                                                  error=repr(exc))
             self._fail_batch(batch, exc)
             return
         self.dispatch_counts[op] += 1
         met.dispatches.inc()
+        t_done = time.monotonic()
         if traced:
             # emit BEFORE resolving the futures so a waking caller reads
             # complete trace_ids for its future_wake span
-            t_done = time.monotonic()
+            wire = self._wire_bytes(op, cols)
             for request in batch:
                 if request.t_taken and request.t_dispatch:
                     request.t_done = t_done
-                    self._emit_request_trace(op, request, reason, rows)
+                    self._emit_request_trace(op, request, reason, rows,
+                                             wire_bytes=wire)
         offset = 0
         for request in batch:
             # done() guard: the watchdog (or shutdown) may have failed
@@ -321,19 +333,54 @@ class MicroBatcher:
             # must not raise InvalidStateError over them
             if not request.future.done():
                 request.future.set_result(out[offset:offset + request.rows])
+                # the per-class SLO event: one good/bad mark per request
+                # with its end-to-end serving latency (enqueue -> result
+                # set) — watchdog-failed requests were already marked
+                # bad by their _fail_batch
+                slo.record(request.klass, ok=True,
+                           latency_s=t_done - request.enqueued_at)
             offset += request.rows
 
-    @staticmethod
-    def _fail_batch(batch: List[Request], exc: BaseException) -> None:
+    def _fail_batch(self, batch: List[Request],
+                    exc: BaseException) -> None:
         """Fail every still-pending future in `batch` — the shared
         failure channel of the dispatch error path, the watchdog abort
-        and the drain-and-fail shutdown."""
+        and the drain-and-fail shutdown. Each newly-failed request
+        charges its class's SLO error budget exactly once."""
         for request in batch:
             if not request.future.done():
                 request.future.set_exception(exc)
+                slo.record(request.klass, ok=False)
+
+    # the ops whose dispatch refreshes the backend's last_wire ledger —
+    # for any other op the ledger is a STALE leftover from a previous
+    # dispatch and must not be trusted
+    _LEDGER_OPS = ("bls_verify_committees", "das_verify_samples")
+
+    def _wire_bytes(self, op: str, cols: tuple) -> int:
+        """This dispatch's host->device wire bytes for span tags: the
+        backend's own per-dispatch ledger when THIS op writes one (the
+        jax committee/DAS paths — we read it right after the dispatch
+        on the single dispatch thread, so it is this dispatch's entry),
+        else the payload bytes of the batch columns (bytes-like rows
+        one level deep) — computed only when tracing is on."""
+        if op in self._LEDGER_OPS:
+            wire = getattr(self.inner, "last_wire", None)
+            if wire:
+                return int(wire.get("wire_bytes", 0))
+        total = 0
+        for col in cols:
+            for item in col:
+                if isinstance(item, (bytes, bytearray, memoryview)):
+                    total += len(item)
+                elif isinstance(item, (list, tuple)):
+                    total += sum(len(leaf) for leaf in item
+                                 if isinstance(leaf, (bytes, bytearray,
+                                                      memoryview)))
+        return total
 
     def _emit_request_trace(self, op: str, request: Request, reason: str,
-                            batch_rows: int,
+                            batch_rows: int, wire_bytes: int = 0,
                             error: str = None) -> None:
         """One request's lifecycle as spans: the parent request span
         decomposes EXACTLY into contiguous queue_wait / batch_assembly /
@@ -350,8 +397,14 @@ class MicroBatcher:
         ctx = request.trace_ctx
         trace_id = ctx[0] if ctx else tracer.new_trace_id()
         parent = ctx[1] if ctx else None
+        # device-time attribution rides the spans: device_ms is the
+        # dispatch phase of THIS request, wire_bytes/batch_rows the
+        # whole coalesced dispatch it shared (the federation's
+        # "which replica's chip is slow" answer, per request)
+        device_ms = round((request.t_done - request.t_dispatch) * 1e3, 3)
         tags = {"rows": request.rows, "batch_rows": batch_rows,
-                "flush": reason}
+                "flush": reason, "klass": request.klass,
+                "device_ms": device_ms, "wire_bytes": wire_bytes}
         if error is not None:
             tags["error"] = error
         root = tracer.record(
@@ -361,8 +414,16 @@ class MicroBatcher:
                 ("queue_wait", request.enqueued_at, request.t_taken),
                 ("batch_assembly", request.t_taken, request.t_dispatch),
                 ("device_dispatch", request.t_dispatch, request.t_done)):
+            phase_tags = None
+            if name == "device_dispatch":
+                phase_tags = {"device_ms": device_ms,
+                              "wire_bytes": wire_bytes,
+                              "marshal_ms": round(
+                                  (request.t_dispatch - request.t_taken)
+                                  * 1e3, 3)}
             tracer.record(f"serving/{label}/{name}", start, end,
-                          trace_id=trace_id, parent_id=root, tid=trace_id)
+                          trace_id=trace_id, parent_id=root, tid=trace_id,
+                          tags=phase_tags)
         request.trace_ids = (trace_id, root, label)
 
     def _dispatch(self, op: str, cols: tuple):

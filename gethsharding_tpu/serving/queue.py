@@ -51,6 +51,7 @@ import time
 from concurrent.futures import Future
 from typing import Dict, List, Optional, Tuple
 
+from gethsharding_tpu import slo
 from gethsharding_tpu.serving.classes import (
     ADMISSION_CLASSES,
     CLASS_INTERACTIVE,
@@ -271,6 +272,10 @@ class AdmissionQueue:
                     victim.future.set_exception(ServingOverloadError(
                         f"{klass} request shed by class: displaced by "
                         f"{request.klass} under overload"))
+                    # displacement burns the victim class's SLO error
+                    # budget — shed-under-overload is exactly what the
+                    # burn-rate plane must see (slo/tracker.py)
+                    slo.record(klass, ok=False)
                 displaced = True
             if self._rows < self.cap_rows:
                 break
@@ -365,6 +370,9 @@ class AdmissionQueue:
                         f"{klass} request expired after "
                         f"{victim.wait_s(now):.3f}s in the {victim.op} "
                         f"queue (class deadline {deadline_s}s)"))
+                    # an expiry is a missed request: charge the class's
+                    # SLO error budget like any other failure
+                    slo.record(klass, ok=False)
                 freed = True
         if freed:
             # expiry freed capacity: blocked putters must see it

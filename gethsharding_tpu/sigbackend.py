@@ -279,6 +279,13 @@ class JaxSigBackend(SigBackend):
         self._g_dev_bytes = metrics.gauge("jax/pk_device_cache/bytes")
         self._m_wire_bytes = metrics.counter("jax/wire/bytes")
         self._m_pk_hit_bytes = metrics.counter("jax/wire/pk_device_hit_bytes")
+        # device-time attribution rollups (always on — two clock reads
+        # per dispatch): host marshal seconds vs device dispatch seconds
+        # per call, the SIG_TIMING split as registry timers so the fleet
+        # federation can answer "which replica's chip is slow" from a
+        # scrape (p99 under sig/device_time) without a profiler attach
+        self._t_marshal = metrics.timer("sig/marshal_time")
+        self._t_device = metrics.timer("sig/device_time")
         # compile-cache visibility: jax.jit compiles once per argument
         # SHAPE, and every padded bucket this process has not dispatched
         # before is a fresh XLA compile (seconds to minutes). Tracking
@@ -312,6 +319,7 @@ class JaxSigBackend(SigBackend):
         n = len(digests)
         if n == 0:
             return []
+        t_start = time.monotonic()
         sigs, valid, host_rows = [], [], []
         for i, sig in enumerate(sigs65):
             sig = bytes(sig)
@@ -334,7 +342,7 @@ class JaxSigBackend(SigBackend):
             [bytes(d) for d in digests] + [b"\x00" * 32] * pad)
         r, s, v = self._sec.sigs_to_limbs(sigs)
         tracer = tracing.TRACER
-        t0 = time.monotonic() if tracer.enabled else 0.0
+        t0 = time.monotonic()
         qx, qy, ok = self._recover(
             jnp.asarray(e), jnp.asarray(r), jnp.asarray(s), jnp.asarray(v),
             jnp.asarray(np.asarray(valid)))
@@ -343,10 +351,15 @@ class JaxSigBackend(SigBackend):
         # an async backend recording before materialization would show a
         # near-zero dispatch span with the device time hidden elsewhere
         pubs = self._sec.limbs_to_pubkeys(qx, qy, ok)[:n]
+        t1 = time.monotonic()
+        self._t_marshal.observe(t0 - t_start)
+        self._t_device.observe(t1 - t0)
         if tracer.enabled:
-            tracer.record("jax/ecrecover_dispatch", t0, time.monotonic(),
+            tracer.record("jax/ecrecover_dispatch", t0, t1,
                           tags={"rows": n, "bucket": bucket,
-                                "compile": "miss" if fresh else "hit"})
+                                "compile": "miss" if fresh else "hit",
+                                "marshal_ms": round((t0 - t_start) * 1e3, 3),
+                                "device_ms": round((t1 - t0) * 1e3, 3)})
         out = [ecdsa.pubkey_to_address(p) if p is not None else None
                for p in pubs]
         for i in host_rows:
@@ -365,6 +378,7 @@ class JaxSigBackend(SigBackend):
         n = len(messages)
         if n == 0:
             return []
+        t_start = time.monotonic()
         bucket = self._bucket(n)
         fresh = self._note_shape("bls_aggregate", bucket)
         pad = bucket - n
@@ -375,16 +389,21 @@ class JaxSigBackend(SigBackend):
         # infinity signature/key is an outright rejection (scalar parity)
         valid = hok & sok & pok
         tracer = tracing.TRACER
-        t0 = time.monotonic() if tracer.enabled else 0.0
+        t0 = time.monotonic()
         out = self._bls(
             jnp.asarray(hx), jnp.asarray(hy), jnp.asarray(sx),
             jnp.asarray(sy), jnp.asarray(pkx), jnp.asarray(pky),
             jnp.asarray(valid))
         res = [bool(b) for b in np.asarray(out)[:n]]
+        t1 = time.monotonic()
+        self._t_marshal.observe(t0 - t_start)
+        self._t_device.observe(t1 - t0)
         if tracer.enabled:
-            tracer.record("jax/bls_aggregate_dispatch", t0, time.monotonic(),
+            tracer.record("jax/bls_aggregate_dispatch", t0, t1,
                           tags={"rows": n, "bucket": bucket,
-                                "compile": "miss" if fresh else "hit"})
+                                "compile": "miss" if fresh else "hit",
+                                "marshal_ms": round((t0 - t_start) * 1e3, 3),
+                                "device_ms": round((t1 - t0) * 1e3, 3)})
         return res
 
     def bls_verify_committees(self, messages, sig_rows, pk_rows,
@@ -416,6 +435,7 @@ class JaxSigBackend(SigBackend):
         if n == 0:
             self.last_wire = None
             return []
+        t_start = time.monotonic()
         bucket = self._bucket(n)
         fresh = self._note_shape("das_verify", bucket)
         st = das_proofs.marshal_samples(chunks, indices, proofs, roots,
@@ -434,14 +454,19 @@ class JaxSigBackend(SigBackend):
         tracing.tag_current_add(wire_bytes=sample_bytes,
                                 sample_wire_bytes=sample_bytes)
         tracer = tracing.TRACER
-        t0 = time.monotonic() if tracer.enabled else 0.0
+        t0 = time.monotonic()
         out = das_proofs.batch_verifier()(*(jnp.asarray(p) for p in planes))
         res = [bool(b) for b in np.asarray(out)[:n]]
+        t1 = time.monotonic()
+        self._t_marshal.observe(t0 - t_start)
+        self._t_device.observe(t1 - t0)
         if tracer.enabled:
-            tracer.record("jax/das_verify_dispatch", t0, time.monotonic(),
+            tracer.record("jax/das_verify_dispatch", t0, t1,
                           tags={"rows": n, "bucket": bucket,
                                 "compile": "miss" if fresh else "hit",
-                                "sample_wire_bytes": sample_bytes})
+                                "sample_wire_bytes": sample_bytes,
+                                "marshal_ms": round((t0 - t_start) * 1e3, 3),
+                                "device_ms": round((t1 - t0) * 1e3, 3)})
         return res
 
     # -- the staged committee path -----------------------------------------
@@ -501,7 +526,9 @@ class JaxSigBackend(SigBackend):
         fn = (self._bls_committee_u16 if self._wire_u16
               else self._bls_committee)
         tracer = tracing.TRACER
-        td = time.monotonic() if tracer.enabled else 0.0
+        marshal_s = t1 - t0  # host marshal: limb planes + cache resolve
+        self._t_marshal.observe(marshal_s)
+        td = time.monotonic()
         out = fn(*args)  # async dispatch: returns before execution ends
         # finalize must close over SCALARS, not the marshal dict: `st`
         # pins every host limb plane (MBs per dispatch) until result(),
@@ -510,17 +537,21 @@ class JaxSigBackend(SigBackend):
 
         def finalize():
             res = [bool(b) for b in np.asarray(out)[:n]]
+            t_done = time.monotonic()
+            self._t_device.observe(t_done - td)
             if tracer.enabled:
                 # the np.asarray pull above means the span closes only
                 # after the dispatch actually executed; on the async
                 # path it additionally covers the overlapped wait
                 tracer.record(
-                    "jax/bls_committee_dispatch", td, time.monotonic(),
+                    "jax/bls_committee_dispatch", td, t_done,
                     tags={"rows": n, "bucket": bucket,
                           "width": width, "wire": self._wire,
                           "compile": "miss" if fresh else "hit",
                           "wire_bytes": wire["wire_bytes"],
-                          "pk_hit_bytes": wire["pk_hit_bytes"]})
+                          "pk_hit_bytes": wire["pk_hit_bytes"],
+                          "marshal_ms": round(marshal_s * 1e3, 3),
+                          "device_ms": round((t_done - td) * 1e3, 3)})
             if timing:
                 t3 = time.perf_counter()
                 # per-instance: two backends in one process must not

@@ -1,0 +1,398 @@
+"""Rolling multi-window SLO burn-rate tracking (the SRE workbook shape).
+
+An objective owns an ERROR BUDGET: ``1 - availability`` of events may
+be bad (failed, or slower than the latency target) before the SLO is
+broken. The burn rate is how fast that budget is being spent:
+
+    burn = (bad / events over a window) / (1 - availability)
+
+1.0 means the budget exactly lasts the window's period; 14.4 over the
+fast window is the classic "2% of a 30-day budget in one hour" page
+threshold. Two windows make the signal robust — the FAST window (5 m)
+reacts to an outage in seconds, the SLOW window (1 h) stops a brief
+blip from paging — and a breach fires only when both burn (the
+multi-window, multi-burn-rate alert).
+
+Mechanics: per objective, good/bad counts land in 5-second buckets on
+a ring sized to the slow window; both windows read the same ring
+(lazy-advanced on record/read like `metrics.Counter.rate_1m`, so an
+idle class costs nothing). Latency distribution rides a
+`metrics.Histogram` whose bucket-interpolated `quantile()` gives the
+p50/p95/p99 shown on /status. Everything is O(ring) only on reads
+that are throttled to ~1/s; the hot-path `record()` is two dict hops,
+two int adds and a histogram observe under a per-objective lock —
+budgeted (with tracing off) under 2% of the serving hot path,
+asserted in ``bench.py --fleet``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from gethsharding_tpu import metrics
+
+log = logging.getLogger("slo")
+
+# ring resolution: 5-second buckets (the go-metrics meter tick); the
+# windows must be multiples of this
+BUCKET_S = 5.0
+DEFAULT_FAST_S = 300.0
+DEFAULT_SLOW_S = 3600.0
+
+# breach thresholds: fast-window burn 14.4 (2% of a 30-day budget per
+# hour) AND slow-window burn 6 (5% per 6 h) — the SRE workbook's page
+# pair, scaled to our 5m/1h windows
+DEFAULT_BREACH_FAST = 14.4
+DEFAULT_BREACH_SLOW = 6.0
+
+# latency histogram bounds in seconds: sub-ms host calls up through
+# multi-second bulk audits
+LATENCY_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                     0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+INTEGRITY = "integrity"
+
+
+@dataclass(frozen=True)
+class Objective:
+    """One declarative objective: availability target + optional
+    latency target at a quantile. ``latency_target_s`` None means
+    availability-only (the integrity objective's shape)."""
+
+    name: str
+    availability: float
+    latency_target_s: Optional[float] = None
+    latency_q: float = 0.99
+
+    def __post_init__(self):
+        if not 0.0 < self.availability < 1.0:
+            raise ValueError(
+                f"availability must be in (0, 1), got {self.availability}")
+
+    @property
+    def error_budget(self) -> float:
+        return 1.0 - self.availability
+
+    def bad(self, ok: bool, latency_s: Optional[float]) -> bool:
+        """Is one event bad under this objective? A failure always is;
+        a success is bad when it blew the latency target."""
+        if not ok:
+            return True
+        return (self.latency_target_s is not None
+                and latency_s is not None
+                and latency_s > self.latency_target_s)
+
+    def describe(self) -> dict:
+        return {
+            "availability": self.availability,
+            "error_budget": round(self.error_budget, 6),
+            "latency_target_ms": (
+                None if self.latency_target_s is None
+                else round(self.latency_target_s * 1e3, 3)),
+            "latency_q": self.latency_q,
+        }
+
+
+def _env_float(name: str, default: Optional[float]) -> Optional[float]:
+    raw = os.environ.get(name, "")
+    return float(raw) if raw else default
+
+
+# (availability, p99 latency ms or None) per objective; the latency
+# defaults mirror the bench --fleet gates (interactive 8000 ms is
+# GETHSHARDING_FLEET_SLO_INTERACTIVE_MS's hermetic-CPU default)
+_DEFAULTS = {
+    "interactive": (0.999, 8000.0),
+    "bulk_audit": (0.99, 30000.0),
+    "catchup_replay": (0.95, None),
+    INTEGRITY: (0.9999, None),
+}
+
+
+def default_objectives() -> Dict[str, Objective]:
+    """The default objective table: one per admission class plus the
+    soundness-fed ``integrity`` objective. Env-overridable per
+    objective: ``GETHSHARDING_SLO_<NAME>_AVAILABILITY`` and
+    ``GETHSHARDING_SLO_<NAME>_P99_MS`` (0 disables the latency
+    target). Fresh per call so env changes in tests take effect per
+    instance."""
+    out = {}
+    for name, (availability, p99_ms) in _DEFAULTS.items():
+        key = name.upper()
+        availability = _env_float(
+            f"GETHSHARDING_SLO_{key}_AVAILABILITY", availability)
+        p99_ms = _env_float(f"GETHSHARDING_SLO_{key}_P99_MS", p99_ms)
+        target_s = None if not p99_ms else p99_ms / 1e3
+        out[name] = Objective(name, availability,
+                              latency_target_s=target_s)
+    return out
+
+
+DEFAULT_OBJECTIVES = tuple(_DEFAULTS)
+
+
+class _Series:
+    """One objective's live state: the good/bad bucket ring (sized to
+    the slow window), its metric handles, and breach hysteresis."""
+
+    __slots__ = ("objective", "good", "bad", "head", "lock", "latency",
+                 "m_good", "m_bad", "m_breaches", "g_fast", "g_slow",
+                 "g_budget", "breached", "last_gauge")
+
+    def __init__(self, objective: Objective, n_buckets: int,
+                 registry: metrics.Registry):
+        base = f"slo/{objective.name}"
+        self.objective = objective
+        self.good = [0] * n_buckets
+        self.bad = [0] * n_buckets
+        self.head = 0  # absolute bucket tick of the newest bucket
+        self.lock = threading.Lock()
+        self.latency = registry.histogram(f"{base}/latency_s",
+                                          buckets=LATENCY_BUCKETS_S)
+        self.m_good = registry.counter(f"{base}/good")
+        self.m_bad = registry.counter(f"{base}/bad")
+        self.m_breaches = registry.counter(f"{base}/breaches")
+        self.g_fast = registry.gauge(f"{base}/burn_rate")
+        self.g_slow = registry.gauge(f"{base}/burn_rate_slow")
+        self.g_budget = registry.gauge(f"{base}/budget_remaining")
+        self.g_budget.set(1.0)
+        self.breached = False
+        self.last_gauge = 0.0
+
+    # callers hold self.lock for the ring operations below
+
+    def _advance(self, tick: int) -> None:
+        n = len(self.good)
+        if tick <= self.head:
+            return
+        steps = min(tick - self.head, n)
+        for i in range(1, steps + 1):
+            idx = (self.head + i) % n
+            self.good[idx] = 0
+            self.bad[idx] = 0
+        self.head = tick
+
+    def _window(self, buckets: int) -> tuple:
+        n = len(self.good)
+        buckets = min(buckets, n)
+        good = bad = 0
+        for i in range(buckets):
+            idx = (self.head - i) % n
+            good += self.good[idx]
+            bad += self.bad[idx]
+        return good, bad
+
+
+class SLOTracker:
+    """Burn-rate tracker over a set of objectives (see module doc).
+
+    `now` parameters take a monotonic-clock reading and exist for
+    deterministic tests; production callers omit them."""
+
+    def __init__(self, objectives: Optional[Dict[str, Objective]] = None,
+                 registry: metrics.Registry = metrics.DEFAULT_REGISTRY,
+                 fast_window_s: Optional[float] = None,
+                 slow_window_s: Optional[float] = None,
+                 breach_fast: Optional[float] = None,
+                 breach_slow: Optional[float] = None,
+                 min_events: int = 10):
+        self.fast_window_s = fast_window_s or _env_float(
+            "GETHSHARDING_SLO_FAST_S", DEFAULT_FAST_S)
+        self.slow_window_s = slow_window_s or _env_float(
+            "GETHSHARDING_SLO_SLOW_S", DEFAULT_SLOW_S)
+        if self.fast_window_s > self.slow_window_s:
+            raise ValueError("fast window must not exceed the slow window")
+        self.breach_fast = breach_fast if breach_fast is not None \
+            else _env_float("GETHSHARDING_SLO_BREACH_FAST",
+                            DEFAULT_BREACH_FAST)
+        self.breach_slow = breach_slow if breach_slow is not None \
+            else _env_float("GETHSHARDING_SLO_BREACH_SLOW",
+                            DEFAULT_BREACH_SLOW)
+        self.min_events = min_events
+        self._fast_buckets = max(1, int(self.fast_window_s / BUCKET_S))
+        n = max(1, int(self.slow_window_s / BUCKET_S))
+        self.objectives = dict(objectives or default_objectives())
+        self._series = {name: _Series(obj, n, registry)
+                        for name, obj in self.objectives.items()}
+        self._hooks: List[Callable] = []
+
+    # -- event intake (the hot path) ---------------------------------------
+
+    def record(self, name: str, ok: bool = True,
+               latency_s: Optional[float] = None,
+               now: Optional[float] = None) -> None:
+        """One event against objective `name` (an admission class, or
+        ``integrity``). Unknown names are DROPPED, not raised — the
+        serving hot path must never fail a request over SLO
+        bookkeeping."""
+        series = self._series.get(name)
+        if series is None:
+            return
+        now = time.monotonic() if now is None else now
+        bad = series.objective.bad(ok, latency_s)
+        tick = int(now / BUCKET_S)
+        with series.lock:
+            series._advance(tick)
+            idx = tick % len(series.good)
+            if bad:
+                series.bad[idx] += 1
+            else:
+                series.good[idx] += 1
+        (series.m_bad if bad else series.m_good).inc()
+        if latency_s is not None:
+            series.latency.observe(latency_s)
+        # gauge refresh is throttled to ~1/s per objective: O(ring)
+        # work stays off the per-request path at high rates while the
+        # exposition never lags a live incident by more than a second
+        if now - series.last_gauge >= 1.0:
+            series.last_gauge = now
+            self._refresh(series, now)
+
+    # -- window math --------------------------------------------------------
+
+    def _burns(self, series: _Series, now: float) -> tuple:
+        """(fast_burn, slow_burn, fast_events, slow_events) at `now`."""
+        tick = int(now / BUCKET_S)
+        with series.lock:
+            series._advance(tick)
+            fg, fb = series._window(self._fast_buckets)
+            sg, sb = series._window(len(series.good))
+        budget = series.objective.error_budget
+        fast = (fb / (fg + fb)) / budget if fg + fb else 0.0
+        slow = (sb / (sg + sb)) / budget if sg + sb else 0.0
+        return fast, slow, fg + fb, sg + sb
+
+    def burn_rate(self, name: str, window: str = "fast",
+                  now: Optional[float] = None) -> float:
+        now = time.monotonic() if now is None else now
+        fast, slow, _, _ = self._burns(self._series[name], now)
+        return fast if window == "fast" else slow
+
+    def budget_remaining(self, name: str,
+                         now: Optional[float] = None) -> float:
+        """Fraction of the slow-window error budget left at the
+        current slow burn: 1.0 = untouched, 0.0 = a full slow window
+        at burn >= 1 (the SLO is being missed outright)."""
+        now = time.monotonic() if now is None else now
+        _, slow, _, _ = self._burns(self._series[name], now)
+        return max(0.0, 1.0 - slow)
+
+    # -- gauges + breach ----------------------------------------------------
+
+    def _refresh(self, series: _Series, now: float) -> None:
+        fast, slow, fast_n, slow_n = self._burns(series, now)
+        series.g_fast.set(round(fast, 4))
+        series.g_slow.set(round(slow, 4))
+        series.g_budget.set(round(max(0.0, 1.0 - slow), 4))
+        name = series.objective.name
+        if (fast >= self.breach_fast and slow >= self.breach_slow
+                and fast_n >= self.min_events):
+            if not series.breached:
+                series.breached = True
+                series.m_breaches.inc()
+                log.warning(
+                    "SLO breach on %s: fast burn %.1fx budget "
+                    "(threshold %.1fx), slow burn %.1fx (threshold "
+                    "%.1fx) over %d/%d events", name, fast,
+                    self.breach_fast, slow, self.breach_slow,
+                    fast_n, slow_n)
+                for hook in list(self._hooks):
+                    try:
+                        hook(name, fast, slow)
+                    except Exception:  # noqa: BLE001 - hook owns it
+                        log.exception("SLO breach hook failed")
+        elif fast < self.breach_fast / 2:
+            # hysteresis: re-arm only once the fast burn halves, so a
+            # burn hovering at the threshold logs one breach, not one
+            # per gauge refresh
+            series.breached = False
+
+    def sweep(self, now: Optional[float] = None) -> None:
+        """Recompute every objective's gauges now (the router's health
+        sweep and /status call this so an idle class's burn DECAYS on
+        the exposition instead of freezing at its last recorded
+        value)."""
+        now = time.monotonic() if now is None else now
+        for series in self._series.values():
+            series.last_gauge = now
+            self._refresh(series, now)
+
+    def on_breach(self, hook: Callable[[str, float, float], None]) -> None:
+        """Register ``hook(objective_name, fast_burn, slow_burn)`` —
+        fired once per breach onset (hysteresis-gated)."""
+        self._hooks.append(hook)
+
+    # -- introspection ------------------------------------------------------
+
+    def describe(self, now: Optional[float] = None) -> dict:
+        """The /status ``slo`` section: per objective, the declared
+        target, both burn rates, budget remaining, event/breach counts
+        and the latency percentile ladder."""
+        now = time.monotonic() if now is None else now
+        out = {}
+        for name, series in self._series.items():
+            fast, slow, fast_n, slow_n = self._burns(series, now)
+            entry = {
+                "objective": series.objective.describe(),
+                "burn_rate": round(fast, 4),
+                "burn_rate_slow": round(slow, 4),
+                "budget_remaining": round(max(0.0, 1.0 - slow), 4),
+                "events_fast_window": fast_n,
+                "events_slow_window": slow_n,
+                "good": series.m_good.value,
+                "bad": series.m_bad.value,
+                "breaches": series.m_breaches.value,
+            }
+            if series.latency.count:
+                entry["latency_ms"] = {
+                    "p50": round(series.latency.quantile(0.50) * 1e3, 3),
+                    "p95": round(series.latency.quantile(0.95) * 1e3, 3),
+                    "p99": round(series.latency.quantile(0.99) * 1e3, 3),
+                }
+            out[name] = entry
+        return out
+
+
+# THE process tracker (the metrics.DEFAULT_REGISTRY analog): serving,
+# router and soundness record here; objectives come from the env at
+# first use. Lazy so importing the package never pins env readings
+# taken before a test/CLI could set its overrides.
+TRACKER: Optional[SLOTracker] = None
+_TRACKER_LOCK = threading.Lock()
+
+
+def tracker() -> SLOTracker:
+    global TRACKER
+    if TRACKER is None:
+        with _TRACKER_LOCK:
+            if TRACKER is None:
+                TRACKER = SLOTracker()
+    return TRACKER
+
+
+def active() -> Optional[SLOTracker]:
+    """The process tracker if anything built it yet, else None — the
+    /status probe that must not conjure objectives on an idle node."""
+    return TRACKER
+
+
+def configure(**kwargs) -> SLOTracker:
+    """Replace the process tracker (node boot applies env/CLI knobs
+    here; tests hand in a fresh registry so burn state can't leak
+    between them)."""
+    global TRACKER
+    with _TRACKER_LOCK:
+        TRACKER = SLOTracker(**kwargs)
+    return TRACKER
+
+
+def record(name: str, ok: bool = True,
+           latency_s: Optional[float] = None) -> None:
+    """Record one event on the process tracker (see
+    `SLOTracker.record`)."""
+    tracker().record(name, ok=ok, latency_s=latency_s)

@@ -19,6 +19,7 @@ and ``trace/<span-name>`` timers on ``/metrics`` + the influx exporter.
 
 from gethsharding_tpu.tracing.export import (
     chrome_trace_events,
+    clock_offset_us,
     write_chrome_trace,
 )
 from gethsharding_tpu.tracing.tracer import (
@@ -26,10 +27,12 @@ from gethsharding_tpu.tracing.tracer import (
     Span,
     TRACER,
     Tracer,
+    current_context,
     disable,
     enable,
     request_context,
     span,
+    tag_current,
     tag_current_add,
 )
 
@@ -39,10 +42,13 @@ __all__ = [
     "TRACER",
     "Tracer",
     "chrome_trace_events",
+    "clock_offset_us",
+    "current_context",
     "disable",
     "enable",
     "request_context",
     "span",
+    "tag_current",
     "tag_current_add",
     "write_chrome_trace",
 ]

@@ -13,12 +13,23 @@ Timestamps are the tracer's raw monotonic clock scaled to microseconds
 cross-thread serving request is recorded under its trace id as the
 ``tid`` so every request renders as its own track; context spans keep
 their OS thread id.
+
+Cross-process merging: every exported file carries its process id, a
+process-name metadata event (its own Perfetto lane), and a
+``clock_offset_us`` anchor — the wall-clock value of this process's
+monotonic zero — in ``otherData``. Two processes' monotonic clocks
+share no origin, so ``scripts/trace_merge.py`` rebases each file onto
+the common wall clock via that anchor; span/trace ids are already
+process-unique (tracer.py seeds the id counter with the pid), so a
+router's file and a replica's file merge into ONE Perfetto view where
+a stitched request's spans share a trace id across pid lanes.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import time
 from typing import List, Optional
 
 from gethsharding_tpu.tracing.tracer import TRACER, Tracer
@@ -46,10 +57,33 @@ def chrome_trace_events(spans: List[dict],
     return events
 
 
-def write_chrome_trace(path: str, tracer: Tracer = TRACER) -> int:
+def clock_offset_us() -> float:
+    """THIS process's monotonic→wall anchor in microseconds:
+    ``wall_us = mono_us + clock_offset_us()``. Sampled at call time —
+    good to well under a millisecond, plenty for lane alignment."""
+    return (time.time() - time.monotonic()) * 1e6
+
+
+def write_chrome_trace(path: str, tracer: Tracer = TRACER,
+                       pid: Optional[int] = None,
+                       label: Optional[str] = None) -> int:
     """Write the tracer's finished-span ring as Chrome trace JSON.
-    Returns the number of events written."""
-    events = chrome_trace_events(tracer.recent_spans())
+    `label` names this process's lane in the merged view (defaults to
+    ``pid <n>``). Returns the number of events written."""
+    pid = os.getpid() if pid is None else pid
+    events = chrome_trace_events(tracer.recent_spans(), pid=pid)
+    meta = [{
+        "name": "process_name", "ph": "M", "pid": pid, "ts": 0,
+        "args": {"name": label or f"pid {pid}"},
+    }]
     with open(path, "w") as fh:
-        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, fh)
+        json.dump({
+            "traceEvents": meta + events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "pid": pid,
+                "label": label or f"pid {pid}",
+                "clock_offset_us": clock_offset_us(),
+            },
+        }, fh)
     return len(events)

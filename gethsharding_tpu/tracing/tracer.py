@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import contextvars
 import itertools
+import os
 import threading
 import time
 from collections import deque
@@ -41,6 +42,20 @@ from gethsharding_tpu import metrics
 # per-thread for plain threads, per-task under asyncio — either way the
 # parent of a new span is whatever THIS control flow opened last)
 _SPAN_STACK = contextvars.ContextVar("gethsharding_span_stack", default=())
+
+
+def _id_base() -> int:
+    """Per-process id-space offset: trace/span ids now CROSS process
+    boundaries (the RPC trace envelope, the merged Chrome export), so
+    two replicas both counting from 1 would stitch unrelated requests
+    together. The pid in the high bits keeps ids unique across a
+    router + N replicas on one host without any coordination.
+
+    Capped below 2^53: the exported JSON is consumed by JavaScript
+    (Perfetto), where ids above Number.MAX_SAFE_INTEGER would round
+    together and merge unrelated spans. 20 pid bits << 32 tops out at
+    ~2^52 and leaves 2^32 ids per process before neighbors overlap."""
+    return (os.getpid() & 0xFFFFF) << 32
 
 
 class Span:
@@ -110,10 +125,12 @@ class Tracer:
         self.enabled = False
         self.registry = registry
         self._ring: deque = deque(maxlen=ring_spans)
-        self._ids = itertools.count(1)
+        self._ids = itertools.count(_id_base() + 1)
         self._lock = threading.Lock()
         self._timers: Dict[str, metrics.Timer] = {}
+        self._dropped: Optional[metrics.Counter] = None
         self.spans_recorded = 0
+        self.spans_dropped = 0
 
     # -- configuration ------------------------------------------------------
 
@@ -125,6 +142,7 @@ class Tracer:
             if registry is not None:
                 self.registry = registry
                 self._timers = {}
+                self._dropped = None
 
     def clear(self) -> None:
         with self._lock:
@@ -135,20 +153,30 @@ class Tracer:
     def new_trace_id(self) -> int:
         return next(self._ids)
 
-    def start(self, name: str, tags: Optional[dict] = None):
+    def start(self, name: str, tags: Optional[dict] = None,
+              ctx: Optional[Tuple[int, int]] = None):
         """Open a span under the context's current span (a new trace when
         there is none). Returns NOOP_SPAN when disabled — callers use the
-        result as a context manager either way."""
+        result as a context manager either way.
+
+        An explicit `ctx` — a ``(trace_id, span_id)`` pair from ANOTHER
+        process's tracer, carried on the RPC trace envelope — wins over
+        the local stack: the new span adopts the remote trace id and
+        parents under the remote span, which is how a request traced in
+        the router stitches into the replica's handler/dispatch spans."""
         if not self.enabled:
             return NOOP_SPAN
         stack = _SPAN_STACK.get()
-        parent = stack[-1] if stack else None
-        span = Span(
-            self, name,
-            trace_id=parent.trace_id if parent else self.new_trace_id(),
-            span_id=self.new_trace_id(),
-            parent_id=parent.span_id if parent else None,
-            tags=tags)
+        if ctx is not None and ctx[0] is not None:
+            trace_id, parent_id = int(ctx[0]), ctx[1]
+            parent_id = None if parent_id is None else int(parent_id)
+        else:
+            parent = stack[-1] if stack else None
+            trace_id = parent.trace_id if parent else self.new_trace_id()
+            parent_id = parent.span_id if parent else None
+        span = Span(self, name, trace_id=trace_id,
+                    span_id=self.new_trace_id(),
+                    parent_id=parent_id, tags=tags)
         span._token = _SPAN_STACK.set(stack + (span,))
         return span
 
@@ -208,8 +236,18 @@ class Tracer:
         # it, and an unlocked concurrent append would raise "deque
         # mutated during iteration" mid-scrape
         with self._lock:
+            dropped = len(self._ring) == self._ring.maxlen
             self._ring.append(record)
             self.spans_recorded += 1
+            if dropped:
+                # the ring just overwrote a finished span nobody
+                # exported: ring overflow used to be invisible —
+                # `trace/dropped` makes an undersized --trace-ring an
+                # alert instead of a silently truncated export
+                self.spans_dropped += 1
+                if self._dropped is None:
+                    self._dropped = self.registry.counter("trace/dropped")
+                self._dropped.inc()
 
     # -- consumer API -------------------------------------------------------
 
@@ -251,12 +289,25 @@ def disable() -> None:
     TRACER.enabled = False
 
 
-def span(name: str, **tags):
+def span(name: str, ctx: Optional[Tuple[int, int]] = None, **tags):
     """Open a context-stacked span on the process tracer (no-op when
-    disabled). Use as ``with tracing.span("notary/fetch"):``."""
+    disabled). Use as ``with tracing.span("notary/fetch"):``. `ctx`
+    adopts a remote (trace_id, span_id) — see `Tracer.start`."""
     if not TRACER.enabled:
         return NOOP_SPAN
-    return TRACER.start(name, tags or None)
+    return TRACER.start(name, tags or None, ctx=ctx)
+
+
+def tag_current(**tags) -> None:
+    """SET tags on the context's innermost active span, last writer
+    wins (the non-numeric sibling of `tag_current_add`: ids, names,
+    labels). No-op when tracing is off or no span is open."""
+    if not TRACER.enabled:
+        return
+    stack = _SPAN_STACK.get()
+    if not stack:
+        return
+    stack[-1].tags.update(tags)
 
 
 def tag_current_add(**tags) -> None:
@@ -285,3 +336,8 @@ def request_context() -> Optional[Tuple[int, int]]:
     if not TRACER.enabled:
         return None
     return TRACER.current()
+
+
+# the wire-propagation name: what `RPCClient.call` ships on the JSON-RPC
+# trace envelope is exactly the serving tier's stitching context
+current_context = request_context

@@ -38,6 +38,14 @@ if [ "$up" = 1 ]; then
             fail=1
         fi
     done
+    # the SLO plane boots with the node: its burn-rate gauges must be
+    # present on the Prometheus exposition from the first scrape
+    prom=$(curl -sf "http://127.0.0.1:$obs_port/metrics?format=prom") || prom=""
+    if ! echo "$prom" | grep -q "gethsharding_slo_interactive_burn_rate"; then
+        echo "observability smoke FAILED: slo/interactive/burn_rate missing" \
+             "from /metrics?format=prom"
+        fail=1
+    fi
 else
     echo "observability smoke FAILED: node never answered /healthz"
     fail=1
@@ -271,6 +279,92 @@ for serving in servings:
 print("fleet router smoke OK: drain ->", r0.drain_events,
       "reentry ->", r0.reentries)
 PYEOF
+
+# -- fleet observability smoke: a chain_server replica + a router-side
+# client in separate processes — the router's trace ships over the RPC
+# trace envelope, both sides export Chrome traces, trace_merge.py folds
+# them into ONE file where the stitched request's spans share a trace
+# id across pid lanes; the router side's Prometheus payload carries the
+# slo/<class> burn gauges and the fleet/replica federation rollups
+echo "== fleet observability smoke"
+obsfleet_dir=$(mktemp -d)
+JAX_PLATFORMS=cpu python -m gethsharding_tpu.rpc.chain_server \
+    --sigbackend python --trace \
+    --trace-out "$obsfleet_dir/replica.json" --runtime 60 \
+    --verbosity error > "$obsfleet_dir/server.json" &
+obsfleet_pid=$!
+for _ in $(seq 1 100); do
+    [ -s "$obsfleet_dir/server.json" ] && break
+    sleep 0.2
+done
+JAX_PLATFORMS=cpu OBSFLEET_DIR="$obsfleet_dir" python - <<'PYEOF' || fail=1
+import json, os
+
+from gethsharding_tpu import tracing
+from gethsharding_tpu.crypto import secp256k1 as ecdsa
+from gethsharding_tpu.crypto.keccak import keccak256
+from gethsharding_tpu.fleet import FleetRouter, Replica, RouterSigBackend
+from gethsharding_tpu.fleet.router import RpcReplicaBackend
+from gethsharding_tpu.metrics import prometheus_text
+
+out = os.environ["OBSFLEET_DIR"]
+addr = json.load(open(os.path.join(out, "server.json")))
+tracing.enable(ring_spans=16384)
+backend = RpcReplicaBackend.dial(addr["host"], addr["port"])
+router = FleetRouter([Replica("r0", backend, health=backend.health,
+                              probe=None)], health_interval_s=0.0)
+back = RouterSigBackend(router)
+for i in range(4):
+    priv = int.from_bytes(keccak256(b"obsf-%d" % i), "big") % ecdsa.N
+    digest = keccak256(b"obsf-msg-%d" % i)
+    got = back.ecrecover_addresses([digest],
+                                   [ecdsa.sign(digest, priv).to_bytes65()])
+    assert got == [ecdsa.priv_to_address(priv)], "wrong answer via router"
+router.refresh(force=True)  # health + shard_metrics federation scrape
+prom = prometheus_text()
+for needle in ("gethsharding_slo_interactive_burn_rate",
+               "gethsharding_fleet_replica_r0_serving_ecrecover_"
+               "requests_count",
+               "gethsharding_fleet_total_inflight"):
+    assert needle in prom, needle
+tracing.write_chrome_trace(os.path.join(out, "router.json"),
+                           label="router")
+backend.close()
+print("fleet observability client OK")
+PYEOF
+kill -INT "$obsfleet_pid" 2>/dev/null
+wait "$obsfleet_pid" 2>/dev/null
+if [ -s "$obsfleet_dir/replica.json" ] && [ -s "$obsfleet_dir/router.json" ]
+then
+    JAX_PLATFORMS=cpu python scripts/trace_merge.py \
+        "$obsfleet_dir/router.json" "$obsfleet_dir/replica.json" \
+        -o "$obsfleet_dir/merged.json" >/dev/null || fail=1
+    JAX_PLATFORMS=cpu OBSFLEET_DIR="$obsfleet_dir" python - <<'PYEOF' || fail=1
+import json, os
+from collections import defaultdict
+
+merged = json.load(open(os.path.join(os.environ["OBSFLEET_DIR"],
+                                     "merged.json")))
+events = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+by_trace = defaultdict(lambda: defaultdict(set))
+for e in events:
+    by_trace[e["args"].get("trace_id")][e["pid"]].add(e["name"])
+stitched = [t for t, pids in by_trace.items() if len(pids) >= 2]
+assert stitched, "no trace id spans both processes in the merged export"
+names = set()
+for t in stitched:
+    for pid_names in by_trace[t].values():
+        names |= pid_names
+assert "fleet/route" in names and "rpc/shard_ecrecover" in names, names
+print("fleet observability smoke OK:", len(stitched),
+      "stitched trace(s) across", len({e['pid'] for e in events}),
+      "process lanes")
+PYEOF
+else
+    echo "fleet observability smoke FAILED: missing trace exports"
+    fail=1
+fi
+rm -rf "$obsfleet_dir"
 
 for f in tests/test_*.py; do
     echo "== $f"

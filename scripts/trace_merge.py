@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Merge per-process Chrome trace exports into ONE Perfetto file.
+
+Each process in a fleet topology (router frontend, N chain_server
+replicas) exports its own Chrome trace JSON via
+``tracing.write_chrome_trace`` / ``chain_server --trace-out``. Those
+files share span/trace ids for stitched requests (the RPC trace
+envelope carries the caller's context; tracer ids are process-unique),
+but their timestamps are raw per-process monotonic clocks with
+unrelated origins — loaded together as-is they would not line up.
+
+This tool rebases every file onto the common wall clock using the
+``clock_offset_us`` anchor the export writes into ``otherData``
+(``wall_us = mono_us + offset``), keeps each file's ``pid`` lane
+(reassigning on collision so two replicas on different hosts with the
+same pid still get separate lanes), and emits one merged
+``{"traceEvents": [...]}`` file: open it in https://ui.perfetto.dev
+and a routed request reads router route → replica handler → serving
+dispatch end to end, one trace id across process lanes.
+
+Usage::
+
+    python scripts/trace_merge.py router.json replica0.json \
+        replica1.json -o merged.json
+
+Files written by older exports (no ``otherData`` anchor) merge with a
+zero offset and a warning — lanes appear, alignment is best-effort.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List
+
+
+def merge_traces(payloads: List[dict]) -> dict:
+    """Merge loaded Chrome-trace payloads (the testable core).
+
+    Timestamps are rebased to wall microseconds via each payload's
+    ``otherData.clock_offset_us``, then shifted so the merged origin is
+    the earliest event (Perfetto renders small positive timestamps
+    better than epoch-sized ones)."""
+    merged: List[dict] = []
+    used_pids: dict = {}
+    rebased: List[tuple] = []
+    for i, payload in enumerate(payloads):
+        other = payload.get("otherData", {}) or {}
+        offset = float(other.get("clock_offset_us", 0.0))
+        if "clock_offset_us" not in other:
+            print(f"warning: input {i} has no clock anchor; merging "
+                  f"with zero offset (lanes align only within it)",
+                  file=sys.stderr)
+        pid = other.get("pid", i)
+        # lane collision (same pid from two hosts, or anchorless files
+        # defaulting): reassign a fresh lane, keep the label
+        lane = pid
+        while lane in used_pids and used_pids[lane] != i:
+            lane = max(used_pids) + 1
+        used_pids[lane] = i
+        for event in payload.get("traceEvents", []):
+            event = dict(event)
+            if event.get("pid") == pid or "pid" not in event:
+                event["pid"] = lane
+            if event.get("ph") != "M":
+                event["ts"] = event.get("ts", 0) + offset
+            rebased.append((lane, event))
+    spans = [e for _, e in rebased if e.get("ph") != "M"]
+    if not spans:
+        # metadata-only inputs (idle processes exported before any
+        # span finished): emit the lanes, nothing to rebase
+        return {"traceEvents": [e for _, e in rebased],
+                "displayTimeUnit": "ms",
+                "otherData": {"merged_from": len(payloads),
+                              "origin_wall_us": 0.0}}
+    origin = min(e["ts"] for e in spans)
+    for _, event in rebased:
+        if event.get("ph") != "M":
+            event["ts"] = round(event["ts"] - origin, 1)
+        merged.append(event)
+    return {"traceEvents": merged, "displayTimeUnit": "ms",
+            "otherData": {"merged_from": len(payloads),
+                          "origin_wall_us": origin}}
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="trace-merge", description=__doc__.splitlines()[0])
+    parser.add_argument("inputs", nargs="+",
+                        help="per-process Chrome trace JSON files")
+    parser.add_argument("-o", "--out", default="merged_trace.json")
+    args = parser.parse_args(argv)
+    payloads = []
+    for path in args.inputs:
+        with open(path) as fh:
+            payloads.append(json.load(fh))
+    merged = merge_traces(payloads)
+    with open(args.out, "w") as fh:
+        json.dump(merged, fh)
+    spans = sum(1 for e in merged["traceEvents"] if e.get("ph") == "X")
+    traces = len({e["args"]["trace_id"]
+                  for e in merged["traceEvents"]
+                  if e.get("ph") == "X" and "trace_id" in e.get("args", {})})
+    print(json.dumps({"out": args.out, "inputs": len(payloads),
+                      "events": spans, "traces": traces}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,6 +3,8 @@ scoped to the native counters/gauges/timers the framework instruments)."""
 
 import time
 
+import pytest
+
 from gethsharding_tpu.metrics import (
     Counter,
     Gauge,
@@ -69,6 +71,54 @@ def test_timer_ring_buffer_recent_window():
     # old 1.0s samples were overwritten by the recent window
     assert t.percentile(0.99) == 0.001
     assert t.count == 8
+
+
+def test_histogram_quantile_known_distributions():
+    """`Histogram.quantile(q)` interpolates linearly within the
+    cumulative bucket the target rank falls in — checked against
+    distributions whose quantiles are known exactly."""
+    from gethsharding_tpu.metrics import Histogram
+
+    # uniform over (0, 10]: 100 observations, one per 0.1 step, in a
+    # single-bucket histogram (bounds 10) — the q-quantile of uniform
+    # data interpolates to ~10q
+    h = Histogram(buckets=(10,))
+    for i in range(1, 101):
+        h.observe(i / 10)
+    assert h.quantile(0.5) == pytest.approx(5.0)
+    assert h.quantile(0.9) == pytest.approx(9.0)
+    assert h.quantile(1.0) == pytest.approx(10.0)
+
+    # two buckets, skewed mass: 90 in (0,1], 10 in (1,2] — p50 sits at
+    # 5/9 through the first bucket, p95 midway through the second
+    h = Histogram(buckets=(1, 2))
+    for _ in range(90):
+        h.observe(0.5)
+    for _ in range(10):
+        h.observe(1.5)
+    assert h.quantile(0.5) == pytest.approx(50 / 90)
+    assert h.quantile(0.95) == pytest.approx(1.5)
+
+    # overflow clamps to the largest finite bound (no +Inf edge to
+    # interpolate toward), empty histogram reads 0
+    h = Histogram(buckets=(1, 2))
+    h.observe(100.0)
+    assert h.quantile(0.99) == 2.0
+    assert Histogram(buckets=(1,)).quantile(0.5) == 0.0
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+
+
+def test_histogram_snapshot_carries_percentiles():
+    from gethsharding_tpu.metrics import Histogram
+
+    h = Histogram(buckets=(1, 2, 4))
+    for v in (0.5, 1.5, 3.0, 3.5):
+        h.observe(v)
+    snap = h.snapshot()
+    for key in ("p50", "p95", "p99"):
+        assert key in snap and snap[key] > 0
+    assert snap["p50"] <= snap["p95"] <= snap["p99"]
 
 
 def test_registry_get_or_register_and_snapshot():

@@ -387,7 +387,10 @@ def test_serving_request_spans_decompose_to_parent(tracer, tmp_path):
     # the same contract must hold in the exported Chrome trace
     path = str(tmp_path / "trace.json")
     assert tracing.write_chrome_trace(path) == len(spans)
-    events = json.load(open(path))["traceEvents"]
+    payload = json.load(open(path))
+    # the merge anchor rides every export (scripts/trace_merge.py)
+    assert "clock_offset_us" in payload["otherData"]
+    events = [e for e in payload["traceEvents"] if e["ph"] != "M"]
     assert all(e["ph"] == "X" for e in events)
     for req in (e for e in events
                 if e["name"] == "serving/ecrecover/request"):
@@ -584,7 +587,8 @@ def test_bench_trace_mode_emits_perfetto_profile(tmp_path):
     assert line["extra"]["trace_out"] == trace_path
     assert line["extra"]["traced_requests"] == 8
 
-    events = json.load(open(trace_path))["traceEvents"]
+    events = [e for e in json.load(open(trace_path))["traceEvents"]
+              if e["ph"] != "M"]  # skip the process_name merge metadata
     assert line["extra"]["trace_events"] == len(events)
     requests = [e for e in events
                 if e["name"] == "serving/ecrecover/request"]
