@@ -1,0 +1,29 @@
+"""shardlint: repo-wide static analysis for the TPU sharding node.
+
+The build-time half of the integrity story (the soundness spot-checker
+is the runtime half): AST rules enforcing the invariants the threaded
+subsystems and the jitted-kernel surface depend on. Run with
+``python -m gethsharding_tpu.analysis``; gate is zero findings outside
+the committed baseline (`analysis/baseline.json`).
+
+Rules: jit-purity, host-sync, lock-order, backend-contract,
+thread-lifecycle, flag-doc, export-completeness. The static lock graph
+is cross-validated at runtime by `analysis/lockcheck.py`
+(``GETHSHARDING_LOCKCHECK=1``).
+"""
+
+from gethsharding_tpu.analysis.core import (
+    BASELINE_REL, Baseline, Corpus, Finding, RULE_DOCS, RULES, RunReport,
+    run, run_rules)
+
+__all__ = [
+    "BASELINE_REL",
+    "Baseline",
+    "Corpus",
+    "Finding",
+    "RULES",
+    "RULE_DOCS",
+    "RunReport",
+    "run",
+    "run_rules",
+]

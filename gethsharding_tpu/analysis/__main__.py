@@ -1,0 +1,117 @@
+"""CLI: ``python -m gethsharding_tpu.analysis [--root DIR] [...]``.
+
+Exit codes: 0 clean (modulo baseline), 1 new findings, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from gethsharding_tpu.analysis.core import (
+    BASELINE_REL, Baseline, RULE_DOCS, RULES, run)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m gethsharding_tpu.analysis",
+        description="shardlint: repo-wide static analysis "
+                    "(jit-purity, host-sync, lock-order, backend-contract, "
+                    "thread-lifecycle, flag-doc, export-completeness)")
+    parser.add_argument("--root", default=None,
+                        help="repo root to scan (default: the checkout "
+                             "this package was imported from)")
+    parser.add_argument("--rule", action="append", default=None,
+                        metavar="NAME",
+                        help="run only this rule (repeatable)")
+    parser.add_argument("--baseline", default=None,
+                        help=f"baseline file (default: <root>/"
+                             f"{BASELINE_REL})")
+    parser.add_argument("--list", action="store_true",
+                        help="list registered rules and exit")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit findings as JSON")
+    parser.add_argument("--all", action="store_true",
+                        help="print baselined findings too, not just new")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="accept the current findings: write them to "
+                             "the baseline (existing justifications are "
+                             "kept; new entries get a TODO placeholder)")
+    args = parser.parse_args(argv)
+
+    if args.list:
+        # rule modules self-register on import
+        from gethsharding_tpu.analysis import (  # noqa: F401
+            contract, exports, flags, hostsync, lifecycle, locks, purity)
+        for name in sorted(RULES):
+            print(f"{name:22s} {RULE_DOCS[name]}")
+        return 0
+
+    if args.root is None:
+        # the repo root is two levels above this package
+        root = Path(__file__).resolve().parents[2]
+    else:
+        root = Path(args.root).resolve()
+    if not root.is_dir():
+        print(f"error: root {root} is not a directory", file=sys.stderr)
+        return 2
+
+    baseline_path = Path(args.baseline) if args.baseline else \
+        root / BASELINE_REL
+    try:
+        report = run(root, names=args.rule, baseline_path=baseline_path)
+    except KeyError as exc:
+        print(f"error: {exc.args[0]}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        baseline = Baseline.load(baseline_path)
+        entries = {}
+        if args.rule:
+            # partial run: keep every entry belonging to a rule that did
+            # NOT run — only the selected rules' findings are rewritten
+            # (a `--rule X --write-baseline` must never wipe the other
+            # rules' justified entries)
+            ran = set(args.rule)
+            entries = {k: v for k, v in baseline.entries.items()
+                       if k.split("::", 1)[0] not in ran}
+        for f in report.findings:
+            entries[f.key] = baseline.entries.get(
+                f.key, f"TODO: justify — {f.message[:80]}")
+        Baseline(entries).save(baseline_path)
+        print(f"wrote {len(entries)} finding(s) to {baseline_path}")
+        return 0
+
+    if args.as_json:
+        payload = {
+            "elapsed_s": round(report.elapsed_s, 3),
+            "new": [vars(f) | {"key": f.key} for f in report.new],
+            "accepted": [vars(f) | {"key": f.key} for f in report.accepted],
+            "stale_baseline_keys": report.stale,
+        }
+        print(json.dumps(payload, indent=2))
+        return 1 if report.new else 0
+
+    shown = report.findings if args.all else report.new
+    for f in shown:
+        mark = "" if f in report.new else " [baselined]"
+        print(f.render() + mark)
+    per_rule = {}
+    for f in report.findings:
+        per_rule[f.rule] = per_rule.get(f.rule, 0) + 1
+    summary = ", ".join(f"{k}={v}" for k, v in sorted(per_rule.items())) \
+        or "none"
+    print(f"shardlint: {len(report.new)} new, {len(report.accepted)} "
+          f"baselined, {len(report.stale)} stale baseline entr"
+          f"{'y' if len(report.stale) == 1 else 'ies'} "
+          f"({summary}) in {report.elapsed_s:.2f}s")
+    if report.stale:
+        for key in report.stale:
+            print(f"  stale baseline entry (finding no longer fires): {key}")
+    return 1 if report.new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,223 @@
+"""backend-contract: every SigBackend wrapper proxies the full surface.
+
+The composition story (device → chaos → serving → soundness → failover →
+router, any prefix of it) only works because every wrapper is a drop-in
+`SigBackend`: a wrapper missing one public method works until the first
+caller of that method lands on it through a composed stack, then dies
+with AttributeError at 2am. PR 7 shipped a one-off lint for the errors
+surface; this rule generalizes the idea to the backend contract itself.
+
+Mechanics:
+
+- The REQUIRED surface is computed from `sigbackend.py`: the public
+  methods `PythonSigBackend` exposes — its own defs plus the concrete
+  defaults it inherits from `SigBackend` (whose NotImplementedError
+  stubs mark the abstract set every backend must fill).
+- A WRAPPER is any class outside `sigbackend.py` that subclasses
+  `SigBackend` (resolved through imports) or duck-types it (defines at
+  least half of the required surface — catches `RouterSigBackend` /
+  `RpcReplicaBackend`, which wrap without inheriting).
+- Each wrapper must define every required method ITSELF (or via a
+  corpus base that is itself a wrapper) with a real body. Inheriting
+  `SigBackend`'s sync-fallback default silently bypasses the wrap (a
+  chaos/soundness/serving wrapper that fell back to the base
+  `bls_verify_committees_async` would skip its own seam), so it does
+  not count. A method whose whole body is `raise NotImplementedError`
+  is flagged as a stub — deliberately unsupported planes belong in the
+  baseline with a justification, not silently absent.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from gethsharding_tpu.analysis.core import Corpus, Finding, dotted_name, rule
+
+RULE = "backend-contract"
+BASE_MODULE = "gethsharding_tpu.sigbackend"
+BASE_CLASS = "SigBackend"
+REFERENCE_CLASS = "PythonSigBackend"
+
+
+def _method_defs(cls: ast.ClassDef) -> Dict[str, ast.FunctionDef]:
+    return {n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def _is_stub(fn: ast.FunctionDef) -> bool:
+    """Body is (docstring +) a single `raise NotImplementedError...`."""
+    body = list(fn.body)
+    if body and isinstance(body[0], ast.Expr) and \
+            isinstance(body[0].value, ast.Constant) and \
+            isinstance(body[0].value.value, str):
+        body = body[1:]
+    if len(body) != 1 or not isinstance(body[0], ast.Raise):
+        return False
+    exc = body[0].exc
+    name = dotted_name(exc.func if isinstance(exc, ast.Call) else exc)
+    return name == "NotImplementedError"
+
+
+def _find_base_file(corpus: Corpus):
+    sf = corpus.find_module(BASE_MODULE)
+    if sf is not None:
+        return sf
+    # fixture trees: any file defining both the base and the reference
+    for cand in corpus.files:
+        if cand.tree is None:
+            continue
+        names = {n.name for n in cand.tree.body
+                 if isinstance(n, ast.ClassDef)}
+        if BASE_CLASS in names and REFERENCE_CLASS in names:
+            return cand
+    return None
+
+
+def required_surface(corpus: Corpus) -> Tuple[Optional[str], Set[str]]:
+    """(base file rel, public method names every backend must serve)."""
+    sf = _find_base_file(corpus)
+    if sf is None or sf.tree is None:
+        return None, set()
+    base_cls = ref_cls = None
+    for node in sf.tree.body:
+        if isinstance(node, ast.ClassDef):
+            if node.name == BASE_CLASS:
+                base_cls = node
+            elif node.name == REFERENCE_CLASS:
+                ref_cls = node
+    required: Set[str] = set()
+    if base_cls is not None:
+        for name, fn in _method_defs(base_cls).items():
+            if not name.startswith("_"):
+                required.add(name)
+    if ref_cls is not None:
+        for name in _method_defs(ref_cls):
+            if not name.startswith("_"):
+                required.add(name)
+    return sf.rel, required
+
+
+def wrapper_report(corpus: Corpus) -> Dict[str, Dict[str, str]]:
+    """class qualname -> {method: 'missing'|'stub'} (empty = complete)."""
+    base_rel, required = required_surface(corpus)
+    if not required:
+        return {}
+
+    # collect every class + resolved base names
+    infos: Dict[Tuple[str, str], ast.ClassDef] = {}
+    bases: Dict[Tuple[str, str], List[Tuple[str, str]]] = {}
+    subclasses_sig: Set[Tuple[str, str]] = set()
+    for sf in corpus.files:
+        if sf.tree is None:
+            continue
+        for node in sf.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            key = (sf.rel, node.name)
+            infos[key] = node
+            resolved: List[Tuple[str, str]] = []
+            for b in node.bases:
+                name = dotted_name(b)
+                if not name:
+                    continue
+                if "." in name:
+                    mod_alias, cls = name.rsplit(".", 1)
+                    module = sf.imports.get(mod_alias.split(".", 1)[0])
+                    other = corpus.find_module(module) if module else None
+                    if other is not None:
+                        resolved.append((other.rel, cls))
+                else:
+                    target = sf.imports.get(name)
+                    if target and "." in target:
+                        mod, cls = target.rsplit(".", 1)
+                        other = corpus.find_module(mod)
+                        if other is not None:
+                            resolved.append((other.rel, cls))
+                        elif cls == BASE_CLASS and base_rel:
+                            resolved.append((base_rel, cls))
+                    else:
+                        resolved.append((sf.rel, name))
+            bases[key] = resolved
+
+    # transitive "subclasses SigBackend"
+    def is_sig_subclass(key, seen=None) -> bool:
+        if seen is None:
+            seen = set()
+        if key in seen:
+            return False
+        seen.add(key)
+        for b in bases.get(key, ()):
+            if b == (base_rel, BASE_CLASS):
+                return True
+            if b in infos and is_sig_subclass(b, seen):
+                return True
+        return False
+
+    report: Dict[str, Dict[str, str]] = {}
+    threshold = max(1, len(required) // 2)
+    for key, node in sorted(infos.items()):
+        rel, cls_name = key
+        if rel == base_rel:
+            continue  # the backends themselves, not wrappers
+        own = _method_defs(node)
+        defined_required = [m for m in required if m in own]
+        subclasses = is_sig_subclass(key)
+        if not subclasses and len(defined_required) < threshold:
+            continue  # not a backend wrapper
+        # methods available through corpus bases that are NOT SigBackend
+        avail: Dict[str, ast.FunctionDef] = {}
+
+        def collect(k, seen=None):
+            if seen is None:
+                seen = set()
+            if k in seen or k == (base_rel, BASE_CLASS):
+                return
+            seen.add(k)
+            n = infos.get(k)
+            if n is None:
+                return
+            for name, fn in _method_defs(n).items():
+                avail.setdefault(name, fn)
+            for b in bases.get(k, ()):
+                collect(b, seen)
+
+        collect(key)
+        problems: Dict[str, str] = {}
+        for m in sorted(required):
+            fn = avail.get(m)
+            if fn is None:
+                problems[m] = "missing"
+            elif _is_stub(fn):
+                problems[m] = "stub"
+        report[f"{rel}::{cls_name}"] = problems
+    return report
+
+
+@rule(RULE, "every SigBackend wrapper proxies the full "
+            "PythonSigBackend public surface")
+def check(corpus: Corpus) -> List[Finding]:
+    findings: List[Finding] = []
+    for qual, problems in sorted(wrapper_report(corpus).items()):
+        rel, cls_name = qual.split("::", 1)
+        sf = corpus.get(rel)
+        line = 0
+        if sf is not None and sf.tree is not None:
+            for node in sf.tree.body:
+                if isinstance(node, ast.ClassDef) and node.name == cls_name:
+                    line = node.lineno
+                    break
+        for method, kind in sorted(problems.items()):
+            if kind == "missing":
+                msg = (f"backend wrapper `{cls_name}` does not define "
+                       f"`{method}` — a composed stack calling it dies "
+                       f"with AttributeError (the SigBackend default, if "
+                       f"any, bypasses the wrapper's seam)")
+            else:
+                msg = (f"backend wrapper `{cls_name}.{method}` is a "
+                       f"NotImplementedError stub — if the plane is "
+                       f"deliberately unsupported, baseline this with the "
+                       f"justification")
+            findings.append(Finding(RULE, rel, line, msg,
+                                    f"{cls_name}.{method}:{kind}"))
+    return findings

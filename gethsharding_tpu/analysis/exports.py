@@ -1,0 +1,143 @@
+"""export-completeness: package `__all__`s are complete and truthful.
+
+Generalizes the PR 7 one-off (`FetchAborted` shipped missing from
+`resilience.__all__`) into a corpus rule over EVERY package:
+
+- every name in a package's `__init__.__all__` must actually be bound
+  in that `__init__.py` (import or assignment) — a dangling export is
+  an ImportError waiting for the first `from pkg import *` or
+  re-export consumer;
+- every public exception class defined in a package's `errors.py`
+  must be listed in the package `__all__` — the error surface is API,
+  and a new error type that can't be caught by name from the package
+  is how PR 4's regression happened.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from gethsharding_tpu.analysis.core import (
+    Corpus, Finding, SourceFile, dotted_name, rule)
+
+RULE = "export-completeness"
+
+_EXC_BASES = {"Exception", "BaseException", "RuntimeError", "ValueError",
+              "TypeError", "KeyError", "OSError", "IOError",
+              "ConnectionError", "TimeoutError", "ArithmeticError",
+              "LookupError", "AssertionError", "StopIteration"}
+
+
+def _all_names(tree: ast.Module) -> Optional[List[ast.Constant]]:
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == "__all__" and \
+                isinstance(node.value, (ast.List, ast.Tuple)):
+            return [el for el in node.value.elts
+                    if isinstance(el, ast.Constant) and
+                    isinstance(el.value, str)]
+    return None
+
+
+def _bound_names(tree: ast.Module) -> Set[str]:
+    bound: Set[str] = set()
+    for node in tree.body:
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                bound.add(alias.asname or alias.name.split(".")[0])
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    bound.add(t.id)
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name):
+            bound.add(node.target.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            bound.add(node.name)
+        elif isinstance(node, (ast.If, ast.Try)):
+            for sub in ast.walk(node):
+                if isinstance(sub, (ast.Import, ast.ImportFrom)):
+                    for alias in sub.names:
+                        if alias.name != "*":
+                            bound.add(alias.asname or
+                                      alias.name.split(".")[0])
+                elif isinstance(sub, ast.Assign):
+                    for t in sub.targets:
+                        if isinstance(t, ast.Name):
+                            bound.add(t.id)
+    return bound
+
+
+def _public_exceptions(sf: SourceFile) -> List[ast.ClassDef]:
+    """Classes in errors.py that are (transitively) exception types."""
+    if sf.tree is None:
+        return []
+    local = {n.name: n for n in sf.tree.body if isinstance(n, ast.ClassDef)}
+    memo = {}
+
+    def is_exc(cls: ast.ClassDef) -> bool:
+        if cls.name in memo:
+            return memo[cls.name]
+        memo[cls.name] = False  # cycle guard
+        for b in cls.bases:
+            name = dotted_name(b)
+            if not name:
+                continue
+            last = name.rsplit(".", 1)[-1]
+            if last in _EXC_BASES or last.endswith("Error") and \
+                    last not in local:
+                memo[cls.name] = True
+                break
+            if last in local and is_exc(local[last]):
+                memo[cls.name] = True
+                break
+        return memo[cls.name]
+
+    return [cls for cls in local.values()
+            if not cls.name.startswith("_") and is_exc(cls)]
+
+
+@rule(RULE, "package __all__ entries are bound, and every public "
+            "errors.py exception is exported")
+def check(corpus: Corpus) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in corpus.files:
+        if sf.tree is None or not sf.rel.endswith("/__init__.py"):
+            continue
+        exported = _all_names(sf.tree)
+        if exported is None:
+            continue
+        package = sf.rel.rsplit("/", 1)[0]
+        bound = _bound_names(sf.tree)
+        seen: Set[str] = set()
+        for el in exported:
+            name = el.value
+            if name in seen:
+                findings.append(Finding(
+                    RULE, sf.rel, el.lineno,
+                    f"`{name}` listed twice in `__all__`",
+                    f"duplicate-export:{package}:{name}"))
+            seen.add(name)
+            if name not in bound:
+                findings.append(Finding(
+                    RULE, sf.rel, el.lineno,
+                    f"`__all__` exports `{name}` but `__init__.py` never "
+                    f"binds it — dangling export",
+                    f"dangling-export:{package}:{name}"))
+        errors_sf = corpus.get(f"{package}/errors.py")
+        if errors_sf is not None:
+            for cls in _public_exceptions(errors_sf):
+                if cls.name not in seen:
+                    findings.append(Finding(
+                        RULE, errors_sf.rel, cls.lineno,
+                        f"public exception `{cls.name}` in "
+                        f"{errors_sf.rel} is missing from "
+                        f"`{package}.__all__` — uncatchable by name from "
+                        f"the package",
+                        f"unexported-error:{package}:{cls.name}"))
+    return findings

@@ -1,0 +1,119 @@
+"""host-sync: device→host pulls belong in the marshal layer.
+
+A `.item()`, `np.asarray(device_array)`, `jax.device_get` or
+`.block_until_ready()` is a synchronous device round trip: the calling
+thread stalls until the device drains. The architecture confines those
+pulls to the designated marshal/finalize stages (`sigbackend.py`, the
+kernel modules under `ops/`, the mesh code under `parallel/`, and the
+DAS proof marshaller) — everywhere else a pull on the hot path silently
+serializes dispatch against device execution (the exact failure mode
+PR 3's staging split was built to remove).
+
+This rule flags pull-shaped calls OUTSIDE the allowed zones, in files
+that import jax (a pure-NumPy module's `np.asarray` is host→host and
+exempt). `jnp.asarray(...)` is host→device marshalling, not a sync, and
+is never flagged. Deliberate pulls (the observer's replay mirror, the
+SMC state machine's host-resident step boundary) are recorded in the
+baseline with justifications rather than exempted here — new ones
+should have to argue their case in review.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from gethsharding_tpu.analysis.core import (
+    Corpus, Finding, SourceFile, dotted_name, rule)
+
+RULE = "host-sync"
+
+# rel-path prefixes (or exact files) where pulls are the job
+ALLOWED_ZONES = (
+    "gethsharding_tpu/sigbackend.py",
+    "gethsharding_tpu/ops/",
+    "gethsharding_tpu/parallel/",
+    "gethsharding_tpu/das/proofs.py",
+    "gethsharding_tpu/analysis/",  # the linter itself names the patterns
+)
+
+_PULL_METHODS = {"item", "block_until_ready"}
+
+
+def _imports_jax(sf: SourceFile) -> bool:
+    """Files that can plausibly hold device arrays: direct jax imports,
+    or imports of the kernel/mesh modules whose return values are
+    device-resident (the observer pulls `replay_jax` outputs without
+    ever importing jax itself)."""
+    for target in sf.imports.values():
+        if target == "jax" or target.startswith("jax."):
+            return True
+        if target.startswith("gethsharding_tpu.ops") or \
+                target.startswith("gethsharding_tpu.parallel"):
+            return True
+    return False
+
+
+def _pull_tag(node: ast.Call, sf: SourceFile) -> str:
+    """Non-empty tag when this call is a device→host pull shape."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        if func.attr in _PULL_METHODS and not node.args:
+            return f".{func.attr}()"
+        name = dotted_name(func)
+        if name:
+            root, _, tail = name.partition(".")
+            resolved = sf.imports.get(root, root)
+            base = resolved.split(".", 1)[0]
+            if tail == "device_get" and base == "jax":
+                return "jax.device_get"
+            # np.asarray / numpy.asarray — but NOT jnp.asarray
+            if tail in ("asarray", "array") and base == "numpy":
+                return f"{root}.{tail}"
+    return ""
+
+
+@rule(RULE, "device→host pulls (.item()/np.asarray/device_get/"
+            "block_until_ready) outside the marshal stages")
+def check(corpus: Corpus) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in corpus.files:
+        if sf.tree is None or any(
+                sf.rel == z or sf.rel.startswith(z) for z in ALLOWED_ZONES):
+            continue
+        if not _imports_jax(sf):
+            continue
+        per_fn_seen = set()
+        # attribute enclosing function names for stable idents
+        parents = {}
+        for parent in ast.walk(sf.tree):
+            for child in ast.iter_child_nodes(parent):
+                parents[child] = parent
+
+        def qual(node: ast.AST) -> str:
+            cur = parents.get(node)
+            names = []
+            while cur is not None:
+                if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.ClassDef)):
+                    names.append(cur.name)
+                cur = parents.get(cur)
+            return ".".join(reversed(names)) or "<module>"
+
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            tag = _pull_tag(node, sf)
+            if not tag:
+                continue
+            where = qual(node)
+            ident = f"{where}:{tag}"
+            if ident in per_fn_seen:  # one finding per (function, shape)
+                continue
+            per_fn_seen.add(ident)
+            findings.append(Finding(
+                RULE, sf.rel, node.lineno,
+                f"`{where}` pulls device state to host via `{tag}` outside "
+                f"the marshal layer — hot-path host sync",
+                ident))
+    return findings

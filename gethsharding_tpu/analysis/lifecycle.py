@@ -1,0 +1,182 @@
+"""thread-lifecycle: every started thread has a reachable join path.
+
+A `threading.Thread` with no `.join()` anywhere is either a daemon the
+process abandons at exit (fine for a REPL, lethal for a server that
+must drain in-flight verification futures before its datadir unmounts)
+or an accidental leak that keeps state alive across test cases. The
+repo's convention is: store the thread, stop the loop, join in
+`stop()`/`close()` with a bounded timeout. This rule makes the
+convention checkable:
+
+- a Thread assigned (directly, or through a local temp — the
+  `thread = threading.Thread(...); …; self._t = thread` idiom) to
+  `self.<attr>` must have a `<attr>.join(...)` call somewhere in the
+  SAME MODULE (reads through locals are followed one step:
+  `t = self._t; t.join()` counts);
+- a Thread kept only in a local must be joined in the same function;
+- a Thread never stored (`threading.Thread(...).start()`) is always a
+  finding — nothing can ever join it.
+
+Deliberately unjoined daemons (e.g. a best-effort stats flusher whose
+loop sleeps long) belong in the baseline with their one-line reason.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from gethsharding_tpu.analysis.core import (
+    Corpus, Finding, SourceFile, dotted_name, rule)
+
+RULE = "thread-lifecycle"
+
+
+def _is_thread_ctor(node: ast.AST, sf: SourceFile) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted_name(node.func)
+    if not name:
+        return False
+    root, _, tail = name.rpartition(".")
+    if tail != "Thread":
+        return False
+    if not root:
+        return sf.imports.get("Thread", "") == "threading.Thread"
+    return sf.imports.get(root.split(".", 1)[0],
+                          root).split(".", 1)[0] == "threading"
+
+
+def _join_roots(sf: SourceFile) -> Set[str]:
+    """Names X with a `<something X>.join()` call in the module:
+    `self.X.join()` and `local.join()` where `local = self.X` both
+    yield X; a bare `local.join()` yields the local's name too (for
+    function-local threads)."""
+    roots: Set[str] = set()
+    if sf.tree is None:
+        return roots
+    # map locals assigned from self.<attr> (one step, module-wide),
+    # including iteration over a tuple/list of self attrs
+    # (`for t in (self._a, self._b): t.join()`)
+    alias_of: Dict[str, Set[str]] = {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            src = dotted_name(node.value)
+            if src and src.startswith("self."):
+                alias_of.setdefault(node.targets[0].id, set()).add(src[5:])
+        elif isinstance(node, ast.For) and \
+                isinstance(node.target, ast.Name) and \
+                isinstance(node.iter, (ast.Tuple, ast.List)):
+            for el in node.iter.elts:
+                src = dotted_name(el)
+                if src and src.startswith("self."):
+                    alias_of.setdefault(node.target.id, set()).add(src[5:])
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "join":
+            base = dotted_name(node.func.value)
+            if not base:
+                continue
+            if base.startswith("self."):
+                roots.add(base[5:])
+            else:
+                root = base.split(".", 1)[0]
+                roots.add(root)
+                roots.update(alias_of.get(root, ()))
+    return roots
+
+
+def _scope_nodes(root: ast.AST):
+    """Walk `root` WITHOUT descending into nested function scopes —
+    each function (and the module itself) is analyzed exactly once, so
+    a thread created in a nested def is reported by its own scope only
+    and module-level spawns are covered too."""
+    yield root
+    for child in ast.iter_child_nodes(root):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue  # nested scope: analyzed on its own
+        yield from _scope_nodes(child)
+
+
+@rule(RULE, "every started threading.Thread has a reachable join path")
+def check(corpus: Corpus) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in corpus.files:
+        if sf.tree is None:
+            continue
+        joined = _join_roots(sf)
+        scopes = [sf.tree] + [n for n in ast.walk(sf.tree)
+                              if isinstance(n, (ast.FunctionDef,
+                                                ast.AsyncFunctionDef))]
+        for scope in scopes:
+            scope_name = getattr(scope, "name", "<module>")
+            # locals holding a thread in this scope -> ctor line
+            local_threads: Dict[str, int] = {}
+            stored: Set[str] = set()
+            for node in _scope_nodes(scope):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    tgt = node.targets[0]
+                    if _is_thread_ctor(node.value, sf):
+                        if isinstance(tgt, ast.Name):
+                            local_threads[tgt.id] = node.value.lineno
+                        elif isinstance(tgt, ast.Attribute):
+                            attr = dotted_name(tgt)
+                            if attr and attr.startswith("self."):
+                                stored.add(attr[5:])
+                                if attr[5:] not in joined:
+                                    findings.append(Finding(
+                                        RULE, sf.rel, node.lineno,
+                                        f"thread stored in `self.{attr[5:]}` "
+                                        f"(in `{scope_name}`) is never "
+                                        f"joined in this module — no "
+                                        f"shutdown path drains it",
+                                        f"{scope_name}:self.{attr[5:]}"))
+                    elif isinstance(tgt, ast.Attribute) and \
+                            isinstance(node.value, ast.Name) and \
+                            node.value.id in local_threads:
+                        attr = dotted_name(tgt)
+                        if attr and attr.startswith("self."):
+                            stored.add(node.value.id)
+                            if attr[5:] not in joined:
+                                findings.append(Finding(
+                                    RULE, sf.rel, node.lineno,
+                                    f"thread stored in `self.{attr[5:]}` "
+                                    f"(in `{scope_name}`) is never joined "
+                                    f"in this module — no shutdown path "
+                                    f"drains it",
+                                    f"{scope_name}:self.{attr[5:]}"))
+                elif isinstance(node, ast.Expr) and \
+                        isinstance(node.value, ast.Call) and \
+                        isinstance(node.value.func, ast.Attribute) and \
+                        node.value.func.attr == "start" and \
+                        _is_thread_ctor(node.value.func.value, sf):
+                    findings.append(Finding(
+                        RULE, sf.rel, node.lineno,
+                        f"`threading.Thread(...).start()` in "
+                        f"`{scope_name}` keeps no reference — this thread "
+                        f"can never be joined",
+                        f"{scope_name}:anonymous"))
+            for name, line in sorted(local_threads.items()):
+                if name in stored or name in joined:
+                    continue
+                # a thread that escapes — returned, or passed to a call
+                # (`self._threads.append(t)` hands it to the actor base's
+                # joining stop()) — becomes the receiver's responsibility
+                escapes = any(
+                    (isinstance(n, ast.Return) and n.value is not None and
+                     name in {x.id for x in ast.walk(n.value)
+                              if isinstance(x, ast.Name)}) or
+                    (isinstance(n, ast.Call) and
+                     any(isinstance(a, ast.Name) and a.id == name
+                         for a in n.args))
+                    for n in _scope_nodes(scope))
+                if escapes:
+                    continue
+                findings.append(Finding(
+                    RULE, sf.rel, line,
+                    f"local thread `{name}` in `{scope_name}` is neither "
+                    f"stored nor joined — leaked on return",
+                    f"{scope_name}:{name}"))
+    return findings

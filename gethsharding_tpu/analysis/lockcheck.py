@@ -1,0 +1,313 @@
+"""Runtime lock-order checker — the race-detector-lite that keeps the
+static lock graph honest.
+
+``GETHSHARDING_LOCKCHECK=1`` (tests/conftest.py installs it, or call
+:func:`install` directly) replaces `threading.Lock`/`RLock` with thin
+recording wrappers. Locks created from repo source files are labeled by
+their creation site; every acquisition records, per thread, the set of
+labels already held, building the OBSERVED lock-order graph:
+
+- an **inversion** is recorded the moment some thread acquires A while
+  holding B after any thread ever acquired B while holding A — the
+  classic deadlock witness, caught even when the schedule happens not
+  to deadlock this run;
+- :func:`verify_against_static` additionally cross-checks every
+  observed edge against the static model from `analysis/locks.py`: an
+  observed order whose REVERSE is derivable in the static graph means
+  one of the two is wrong — either the code deadlocks or the model
+  does not describe the code. Observed edges the static graph missed
+  entirely are reported as (non-fatal) coverage gaps.
+
+The wrappers add two dict operations per uncontended acquire; they are
+test-harness overhead, never production overhead (install is explicit).
+`threading.Condition` needs no patching: it duck-types over whatever
+lock it is given — over a plain wrapped Lock its wait() falls back to
+our release()/acquire(), and the RLock wrapper forwards the
+_release_save/_acquire_restore/_is_owned protocol at full recursion
+depth — so a condition sleep correctly drops the held-set entry while
+parked in both cases.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_REAL_LOCK = None  # originals, captured at install
+_REAL_RLOCK = None
+_installed = False
+
+# paths (substrings of the creation frame's filename) that get recorded;
+# everything else is wrapped but invisible
+_DEFAULT_RECORD_PATHS = ("gethsharding_tpu",)
+
+
+@dataclass
+class Inversion:
+    first: Tuple[str, str]  # (held, acquired) seen earlier
+    second: Tuple[str, str]  # the reversed pair that fired now
+    first_site: str
+    second_stack: List[str] = field(default_factory=list)
+
+
+class _Recorder:
+    def __init__(self, record_paths: Sequence[str]):
+        self.record_paths = tuple(record_paths)
+        self._mutex = (_REAL_LOCK or threading.Lock)()
+        # (held_label, acquired_label) -> short stack summary at first sight
+        self.edges: Dict[Tuple[str, str], str] = {}
+        self.inversions: List[Inversion] = []
+        self._tls = threading.local()
+
+    def _stack(self) -> List[str]:
+        frames = traceback.extract_stack()[:-3]
+        return [f"{f.filename}:{f.lineno} in {f.name}" for f in frames
+                if "lockcheck.py" not in f.filename][-6:]
+
+    def held(self) -> List["_TracedLock"]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def on_acquire(self, lock: "_TracedLock"):
+        stack = self.held()
+        if any(h is lock for h in stack):
+            return  # RLock re-entry: no new order fact
+        new_edges = []
+        for h in stack:
+            if h.label != lock.label:
+                new_edges.append((h.label, lock.label))
+        stack.append(lock)
+        if not new_edges:
+            return
+        frames = self._stack()
+        site = frames[-1] if frames else "?"
+        with self._mutex:
+            for edge in new_edges:
+                rev = (edge[1], edge[0])
+                if rev in self.edges and edge not in self.edges:
+                    self.inversions.append(Inversion(
+                        first=rev, second=edge,
+                        first_site=self.edges[rev],
+                        second_stack=self._stack()))
+                self.edges.setdefault(edge, site)
+
+    def on_release(self, lock: "_TracedLock"):
+        stack = self.held()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is lock:
+                del stack[i]
+                return
+
+
+_recorder: Optional[_Recorder] = None
+
+
+class _TracedLock:
+    """Wrapper over a real lock; records order facts when labeled."""
+
+    _reentrant = False
+
+    def __init__(self, label: Optional[str]):
+        self._real = (_REAL_RLOCK if self._reentrant else _REAL_LOCK)()
+        self.label = label  # None = wrapped but unrecorded
+        self._count = 0  # RLock depth (owner thread only mutates it)
+
+    def acquire(self, blocking=True, timeout=-1):
+        got = self._real.acquire(blocking, timeout)
+        if got and self.label is not None and _recorder is not None:
+            if self._reentrant:
+                self._count += 1
+                if self._count == 1:
+                    _recorder.on_acquire(self)
+            else:
+                _recorder.on_acquire(self)
+        return got
+
+    def release(self):
+        if self.label is not None and _recorder is not None:
+            if self._reentrant:
+                self._count -= 1
+                if self._count == 0:
+                    _recorder.on_release(self)
+            else:
+                _recorder.on_release(self)
+        self._real.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._real.locked()
+
+    def __repr__(self):
+        return f"<TracedLock {self.label or 'unlabeled'}>"
+
+
+class _TracedRLock(_TracedLock):
+    _reentrant = True
+
+    def locked(self):  # RLock has no locked() pre-3.12; emulate
+        try:
+            return self._real.locked()
+        except AttributeError:  # pragma: no cover - old interpreters
+            if self._real.acquire(False):
+                self._real.release()
+                return False
+            return True
+
+    # Condition support: CPython's Condition delegates to these when the
+    # lock defines them, else falls back to a SINGLE release()/acquire()
+    # pair — which would release only one recursion level of an RLock
+    # held recursively across a wait() and deadlock the waiter. Forward
+    # the full-depth protocol to the real RLock, keeping the recorder's
+    # held-set and our recursion count in sync.
+    def _is_owned(self):
+        return self._real._is_owned()
+
+    def _release_save(self):
+        state = self._real._release_save()  # drops ALL recursion levels
+        depth, self._count = self._count, 0
+        if depth > 0 and self.label is not None and _recorder is not None:
+            _recorder.on_release(self)
+        return (state, depth)
+
+    def _acquire_restore(self, saved):
+        state, depth = saved
+        self._real._acquire_restore(state)
+        self._count = depth
+        if depth > 0 and self.label is not None and _recorder is not None:
+            _recorder.on_acquire(self)
+
+
+def _creation_label(record_paths: Sequence[str]) -> Optional[str]:
+    """Label from the first non-lockcheck, non-threading caller frame —
+    the `threading.Lock()` call site, matching the static site map's
+    (file, line) keys."""
+    for frame in reversed(traceback.extract_stack()[:-2]):
+        fn = frame.filename.replace(os.sep, "/")
+        if fn.endswith("threading.py") or "lockcheck.py" in fn:
+            continue
+        if any(p in fn for p in record_paths):
+            # repo-relative tail, matching the corpus rel convention
+            for p in record_paths:
+                idx = fn.find(p)
+                if idx >= 0:
+                    return f"{fn[idx:]}:{frame.lineno}"
+        return None
+    return None
+
+
+def _make_factory(cls):
+    def factory(*args, **kwargs):
+        # threading.Lock takes no args; tolerate and pass nothing
+        label = _creation_label(_recorder.record_paths) \
+            if _recorder is not None else None
+        return cls(label)
+    return factory
+
+
+def install(record_paths: Sequence[str] = _DEFAULT_RECORD_PATHS) -> None:
+    """Patch threading.Lock/RLock with recording wrappers (idempotent)."""
+    global _REAL_LOCK, _REAL_RLOCK, _installed, _recorder
+    if _installed:
+        return
+    _REAL_LOCK = threading.Lock
+    _REAL_RLOCK = threading.RLock
+    _recorder = _Recorder(record_paths)
+    threading.Lock = _make_factory(_TracedLock)
+    threading.RLock = _make_factory(_TracedRLock)
+    _installed = True
+
+
+def uninstall() -> None:
+    """Restore the real lock constructors; existing wrappers keep working."""
+    global _installed, _recorder
+    if not _installed:
+        return
+    threading.Lock = _REAL_LOCK
+    threading.RLock = _REAL_RLOCK
+    _installed = False
+    _recorder = None
+
+
+def active() -> bool:
+    return _installed
+
+
+def report() -> dict:
+    """Observed edges + inversions so far."""
+    if _recorder is None:
+        return {"edges": {}, "inversions": []}
+    return {
+        "edges": dict(_recorder.edges),
+        "inversions": list(_recorder.inversions),
+    }
+
+
+def reset() -> None:
+    if _recorder is not None:
+        _recorder.edges.clear()
+        _recorder.inversions.clear()
+
+
+@dataclass
+class Verdict:
+    inversions: List[Inversion]
+    static_violations: List[str]  # observed edge whose reverse is static
+    coverage_gaps: List[str]  # observed edges the static graph missed
+
+    @property
+    def ok(self) -> bool:
+        return not self.inversions and not self.static_violations
+
+
+def verify_against_static(model=None, root=None) -> Verdict:
+    """Cross-check the observed order graph against the static model.
+
+    `model` is an `analysis.locks.LockModel`; built from `root` (default:
+    this checkout) when not given. Observed labels are (rel:line) of the
+    lock creation call, which is exactly the static site map's key.
+    """
+    if model is None:
+        from pathlib import Path
+
+        from gethsharding_tpu.analysis.core import Corpus
+        from gethsharding_tpu.analysis.locks import build_lock_model
+
+        if root is None:
+            root = Path(__file__).resolve().parents[2]
+        model = build_lock_model(Corpus.load(root))
+
+    data = report()
+    violations: List[str] = []
+    gaps: List[str] = []
+
+    def node_of(label: str) -> Optional[str]:
+        rel, _, line = label.rpartition(":")
+        try:
+            return model.site_map.get((rel, int(line)))
+        except ValueError:
+            return None
+
+    for (a, b), site in sorted(data["edges"].items()):
+        na, nb = node_of(a), node_of(b)
+        if na is None or nb is None or na == nb:
+            continue
+        if model.reachable(nb, na):
+            violations.append(
+                f"observed {na} -> {nb} (at {site}) but the static graph "
+                f"orders {nb} -> {na} — real code and model disagree")
+        elif not model.reachable(na, nb) and (na, nb) not in model.edges:
+            gaps.append(f"observed {na} -> {nb} (at {site}) is not in the "
+                        f"static graph — static model coverage gap")
+    return Verdict(list(data["inversions"]), violations, gaps)

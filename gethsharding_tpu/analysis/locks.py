@@ -1,0 +1,518 @@
+"""lock-order: extract the cross-module lock graph, fail on cycles.
+
+Nine subsystems hold `threading.Lock`s (serving dispatcher, watchdog,
+router health sweep, breaker, journal, DAS service, SLO tracker, tracer,
+metrics); a deadlock needs only two of them to nest in opposite orders
+on two threads. This rule builds the static analogue of a lock-order
+witness:
+
+- **nodes**: every lock creation site, named `rel::Class.attr` (or
+  `rel::NAME` for module-level locks). `threading.Condition(self._lock)`
+  aliases to the underlying lock's node; a bare `Condition()` is its own
+  node (its hidden RLock is created at that line).
+- **edges** `A -> B`: somewhere, B is acquired while A is held — either
+  a literally nested `with`, or a call made under A into a method whose
+  transitive acquire-set (a fixpoint over the resolved call graph)
+  contains B. Calls are resolved through `self.m()`, typed components
+  (`self.attr = ClassName(...)`), locally constructed objects, imported
+  corpus modules, and annotated factory returns
+  (`def counter(...) -> Counter` makes `metrics.counter(...).inc()`
+  land on `Counter.inc`).
+- **findings**: any strongly-connected component with more than one
+  node (a potential AB/BA deadlock), and any self-loop on a
+  NON-reentrant lock (a guaranteed self-deadlock if the path executes).
+
+Unresolvable calls (callbacks, getattr indirection) are ignored — the
+graph under-approximates, so a clean result is "no deadlock the static
+model can see". The runtime validator (`analysis/lockcheck.py`,
+`GETHSHARDING_LOCKCHECK=1`) records ACTUAL acquisition orders during
+the concurrency tests and cross-checks them against this graph, which
+keeps the static model honest from the other side.
+
+The edge extraction is scoped to the threaded subsystems named in the
+module list below; the site map covers the whole tree so the runtime
+checker can name any lock it sees.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from gethsharding_tpu.analysis.core import (
+    Corpus, Finding, SourceFile, dotted_name, rule)
+
+RULE = "lock-order"
+
+# subtrees whose lock nestings form the graph (the threaded subsystems);
+# metrics.py is the shared leaf nearly everything calls into under a lock
+DEFAULT_SCOPES = (
+    "gethsharding_tpu/serving/",
+    "gethsharding_tpu/fleet/",
+    "gethsharding_tpu/resilience/",
+    "gethsharding_tpu/slo/",
+    "gethsharding_tpu/tracing/",
+    "gethsharding_tpu/metrics.py",
+)
+
+_LOCK_CTORS = {"Lock": False, "RLock": True, "Condition": True}
+
+
+def _lock_ctor(node: ast.AST, sf: SourceFile) -> Optional[str]:
+    """'Lock'/'RLock'/'Condition' when node is threading.<ctor>(...)."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = dotted_name(node.func)
+    if not name:
+        return None
+    root, _, tail = name.rpartition(".")
+    if tail not in _LOCK_CTORS:
+        return None
+    if root:
+        base = sf.imports.get(root.split(".", 1)[0], root)
+        return tail if base.split(".", 1)[0] == "threading" else None
+    return tail if sf.imports.get(tail, "").startswith("threading.") else None
+
+
+@dataclass
+class _ClassInfo:
+    rel: str
+    name: str  # "<module>" for top-level scope
+    node: object
+    lock_attrs: Dict[str, str] = field(default_factory=dict)  # attr -> node id
+    reentrant: Set[str] = field(default_factory=set)  # node ids
+    attr_types: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+    methods: Dict[str, ast.FunctionDef] = field(default_factory=dict)
+
+
+@dataclass
+class LockModel:
+    nodes: Set[str] = field(default_factory=set)
+    reentrant: Set[str] = field(default_factory=set)
+    # (a, b) -> human-readable example site
+    edges: Dict[Tuple[str, str], str] = field(default_factory=dict)
+    # (rel, lineno of creation call) -> node id, whole tree
+    site_map: Dict[Tuple[str, int], str] = field(default_factory=dict)
+
+    def successors(self, node: str) -> List[str]:
+        return [b for (a, b) in self.edges if a == node]
+
+    def reachable(self, src: str, dst: str) -> bool:
+        seen, stack = set(), [src]
+        while stack:
+            cur = stack.pop()
+            if cur == dst:
+                return True
+            if cur in seen:
+                continue
+            seen.add(cur)
+            stack.extend(self.successors(cur))
+        return False
+
+    def cycles(self) -> List[List[str]]:
+        """SCCs with >1 node, plus single-node self-loops."""
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on: Set[str] = set()
+        order: List[str] = []
+        out: List[List[str]] = []
+        counter = [0]
+        succ = {n: [] for n in self.nodes}
+        for (a, b) in self.edges:
+            succ.setdefault(a, []).append(b)
+            succ.setdefault(b, [])
+
+        def strongconnect(v: str):
+            work = [(v, iter(succ[v]))]
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            order.append(v)
+            on.add(v)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        order.append(w)
+                        on.add(w)
+                        work.append((w, iter(succ[w])))
+                        advanced = True
+                        break
+                    elif w in on:
+                        low[node] = min(low[node], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    low[work[-1][0]] = min(low[work[-1][0]], low[node])
+                if low[node] == index[node]:
+                    comp = []
+                    while True:
+                        w = order.pop()
+                        on.discard(w)
+                        comp.append(w)
+                        if w == node:
+                            break
+                    if len(comp) > 1:
+                        out.append(sorted(comp))
+        for v in sorted(succ):
+            if v not in index:
+                strongconnect(v)
+        for (a, b) in self.edges:
+            if a == b:
+                out.append([a])
+        return out
+
+
+def _class_name_of(call: ast.Call, sf: SourceFile,
+                   local_classes: Set[str]) -> Optional[Tuple[str, str]]:
+    """(module_rel_dotted, ClassName) when `call` constructs a corpus class."""
+    name = dotted_name(call.func)
+    if not name:
+        return None
+    if "." not in name:
+        if name in local_classes and name[:1].isupper():
+            return ("", name)  # same file
+        target = sf.imports.get(name)
+        if target and "." in target:
+            mod, cls = target.rsplit(".", 1)
+            if cls[:1].isupper():
+                return (mod, cls)
+        return None
+    mod_alias, cls = name.rsplit(".", 1)
+    if not cls[:1].isupper():
+        return None
+    module = sf.imports.get(mod_alias.split(".", 1)[0])
+    return (module, cls) if module else None
+
+
+def build_lock_model(corpus: Corpus,
+                     scopes: Sequence[str] = DEFAULT_SCOPES) -> LockModel:
+    model = LockModel()
+    classes: Dict[Tuple[str, str], _ClassInfo] = {}
+    # (module_rel, fn_name) -> ClassName, from `def f(...) -> Cls:` in file
+    factory_returns: Dict[Tuple[str, str], str] = {}
+
+    def in_scope(rel: str) -> bool:
+        return any(rel == s or rel.startswith(s) for s in scopes)
+
+    def note_factory(rel: str, fn: ast.FunctionDef):
+        """`def counter(...) -> Counter:` makes call-chain resolution
+        (`metrics.counter("x").inc()`) land on Counter.inc."""
+        ret = fn.returns
+        ret_name = dotted_name(ret) if ret is not None else None
+        if isinstance(ret, ast.Constant) and isinstance(ret.value, str):
+            ret_name = ret.value.strip('"')
+        if ret_name and "." not in ret_name and ret_name[:1].isupper():
+            factory_returns[(rel, fn.name)] = ret_name
+
+    # ---- pass 1: locks, component types, factories, site map (whole tree)
+    for sf in corpus.files:
+        if sf.tree is None:
+            continue
+        local_classes = {n.name for n in sf.tree.body
+                         if isinstance(n, ast.ClassDef)}
+        mod_info = _ClassInfo(sf.rel, "<module>", sf.tree)
+        classes[(sf.rel, "<module>")] = mod_info
+
+        def record_lock(owner: _ClassInfo, attr: str, call: ast.Call,
+                        ctor: str):
+            node_id = f"{owner.rel}::{attr}" if owner.name == "<module>" \
+                else f"{owner.rel}::{owner.name}.{attr}"
+            if ctor == "Condition" and call.args:
+                # Condition over an existing lock: alias to its node
+                target = dotted_name(call.args[0])
+                if target and target.startswith("self."):
+                    alias = owner.lock_attrs.get(target[5:])
+                    if alias:
+                        owner.lock_attrs[attr] = alias
+                        return
+                elif target and target in mod_info.lock_attrs:
+                    owner.lock_attrs[attr] = mod_info.lock_attrs[target]
+                    return
+            owner.lock_attrs[attr] = node_id
+            model.nodes.add(node_id)
+            if _LOCK_CTORS[ctor]:
+                model.reentrant.add(node_id)
+                owner.reentrant.add(node_id)
+            model.site_map[(sf.rel, call.lineno)] = node_id
+
+        for top in sf.tree.body:
+            if isinstance(top, ast.Assign) and len(top.targets) == 1 and \
+                    isinstance(top.targets[0], ast.Name):
+                ctor = _lock_ctor(top.value, sf)
+                if ctor:
+                    record_lock(mod_info, top.targets[0].id, top.value, ctor)
+            elif isinstance(top, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                mod_info.methods[top.name] = top
+                note_factory(sf.rel, top)
+            elif isinstance(top, ast.ClassDef):
+                info = _ClassInfo(sf.rel, top.name, top)
+                classes[(sf.rel, top.name)] = info
+                for node in ast.walk(top):
+                    if isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)) and \
+                            node in top.body:
+                        info.methods.setdefault(node.name, node)
+                        note_factory(sf.rel, node)
+                    if isinstance(node, ast.Assign) and \
+                            len(node.targets) == 1:
+                        tgt = node.targets[0]
+                        if isinstance(tgt, ast.Attribute) and \
+                                isinstance(tgt.value, ast.Name) and \
+                                tgt.value.id == "self":
+                            ctor = _lock_ctor(node.value, sf)
+                            if ctor:
+                                record_lock(info, tgt.attr, node.value, ctor)
+                            elif isinstance(node.value, ast.Call):
+                                hit = _class_name_of(node.value, sf,
+                                                     local_classes)
+                                if hit is not None:
+                                    mod, cls = hit
+                                    rel = sf.rel if not mod else (
+                                        corpus.find_module(mod).rel
+                                        if corpus.find_module(mod) else None)
+                                    if rel:
+                                        info.attr_types[tgt.attr] = (rel, cls)
+                    elif isinstance(node, ast.AnnAssign) and \
+                            isinstance(node.target, ast.Attribute) and \
+                            isinstance(node.target.value, ast.Name) and \
+                            node.target.value.id == "self":
+                        # `self.peer: "Other" = other` — the annotation
+                        # types the component when the value can't
+                        ann = node.annotation
+                        ann_name = dotted_name(ann)
+                        if isinstance(ann, ast.Constant) and \
+                                isinstance(ann.value, str):
+                            ann_name = ann.value
+                        if ann_name:
+                            cls = ann_name.rsplit(".", 1)[-1]
+                            if cls in local_classes:
+                                info.attr_types[node.target.attr] = \
+                                    (sf.rel, cls)
+                            else:
+                                target = sf.imports.get(cls)
+                                if target and "." in target:
+                                    mod = target.rsplit(".", 1)[0]
+                                    other = corpus.find_module(mod)
+                                    if other is not None:
+                                        info.attr_types[node.target.attr] \
+                                            = (other.rel, cls)
+
+    # ---- pass 2: per-method acquire/call traces (scoped files only)
+    # summaries: key -> (direct_acquires, callee_keys, trace records)
+    direct: Dict[str, Set[str]] = {}
+    callees: Dict[str, Set[str]] = {}
+    # (held_node, callee_key, site) across all methods
+    calls_under: List[Tuple[str, str, str]] = []
+
+    def method_key(rel: str, cls: str, m: str) -> str:
+        return f"{rel}::{cls}.{m}"
+
+    # duck-typed metric sinks: `<anything>.inc()` / `.observe()` on an
+    # unresolvable receiver (counters live in dicts and tuples all over
+    # the serving tier) conservatively lands on every lock-owning
+    # metrics class defining that method — metrics is a strict leaf, so
+    # the over-approximation can add edges INTO it but never a cycle
+    # through it
+    duck_sinks: Dict[str, List[str]] = {}
+    metrics_sf = corpus.get("gethsharding_tpu/metrics.py")
+    if metrics_sf is not None and metrics_sf.tree is not None:
+        for top in metrics_sf.tree.body:
+            if not isinstance(top, ast.ClassDef):
+                continue
+            cinfo = classes.get((metrics_sf.rel, top.name))
+            if cinfo is None or not cinfo.lock_attrs:
+                continue
+            for m in ("inc", "observe", "set"):
+                if m in cinfo.methods:
+                    duck_sinks.setdefault(m, []).append(
+                        method_key(metrics_sf.rel, top.name, m))
+
+    for (rel, cls_name), info in sorted(classes.items()):
+        if not in_scope(rel):
+            continue
+        sf = corpus.get(rel)
+        local_classes = {n.name for n in sf.tree.body
+                         if isinstance(n, ast.ClassDef)}
+        mod_info = classes[(rel, "<module>")]
+
+        for m_name, fn in sorted(info.methods.items()):
+            key = method_key(rel, cls_name, m_name)
+            direct.setdefault(key, set())
+            callees.setdefault(key, set())
+            # local var -> (rel, ClassName)
+            local_types: Dict[str, Tuple[str, str]] = {}
+
+            def lock_of(expr: ast.AST) -> Optional[str]:
+                name = dotted_name(expr)
+                if not name:
+                    return None
+                if name.startswith("self."):
+                    return info.lock_attrs.get(name[5:])
+                return mod_info.lock_attrs.get(name)
+
+            def resolve_callees(call: ast.Call) -> List[str]:
+                func = call.func
+                if isinstance(func, ast.Attribute):
+                    m = func.attr
+                    base = func.value
+                    # self.m()
+                    if isinstance(base, ast.Name) and base.id == "self":
+                        return [method_key(rel, cls_name, m)] \
+                            if m in info.methods else []
+                    # self.attr.m()
+                    bname = dotted_name(base)
+                    if bname and bname.startswith("self."):
+                        attr = bname[5:]
+                        typ = info.attr_types.get(attr)
+                        if typ:
+                            return [method_key(typ[0], typ[1], m)]
+                        return duck_sinks.get(m, [])
+                    # local_var.m() / alias.m()
+                    if isinstance(base, ast.Name):
+                        typ = local_types.get(base.id)
+                        if typ:
+                            return [method_key(typ[0], typ[1], m)]
+                        module = sf.imports.get(base.id)
+                        if module:
+                            other = corpus.find_module(module)
+                            if other is not None:
+                                return [method_key(other.rel,
+                                                   "<module>", m)]
+                        return duck_sinks.get(m, [])
+                    # factory(...).m()  e.g. metrics.counter("x").inc()
+                    if isinstance(base, ast.Call):
+                        for inner in resolve_callees(base):
+                            irel, iname = inner.split("::", 1)
+                            fn_name = iname.rsplit(".", 1)[-1]
+                            cls = factory_returns.get((irel, fn_name))
+                            if cls:
+                                return [method_key(irel, cls, m)]
+                    return duck_sinks.get(m, [])
+                if isinstance(func, ast.Name):
+                    if func.id in mod_info.methods:
+                        return [method_key(rel, "<module>", func.id)]
+                    target = sf.imports.get(func.id)
+                    if target and "." in target:
+                        mod, f_name = target.rsplit(".", 1)
+                        other = corpus.find_module(mod)
+                        if other is not None:
+                            return [method_key(other.rel, "<module>",
+                                               f_name)]
+                return []
+
+            def visit(node: ast.AST, held: Tuple[str, ...]):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and node is not fn:
+                    # nested def: body runs later, not under these locks
+                    for child in ast.iter_child_nodes(node):
+                        visit(child, ())
+                    return
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Call) and \
+                        len(node.targets) == 1 and \
+                        isinstance(node.targets[0], ast.Name):
+                    hit = _class_name_of(node.value, sf, local_classes)
+                    if hit is not None:
+                        mod, cls = hit
+                        trel = rel if not mod else (
+                            corpus.find_module(mod).rel
+                            if corpus.find_module(mod) else None)
+                        if trel:
+                            local_types[node.targets[0].id] = (trel, cls)
+                if isinstance(node, ast.With):
+                    acquired = []
+                    for item in node.items:
+                        ln = lock_of(item.context_expr)
+                        if ln is not None:
+                            site = f"{rel}:{item.context_expr.lineno}"
+                            direct[key].add(ln)
+                            # earlier items of this same `with a, b:` are
+                            # already held when this one acquires — they
+                            # order-constrain it exactly like an outer with
+                            held_here = held + tuple(
+                                a for a in acquired if a not in held)
+                            for h in held_here:
+                                if h != ln:
+                                    model.edges.setdefault((h, ln), site)
+                                elif ln not in model.reentrant:
+                                    model.edges.setdefault((h, ln), site)
+                            acquired.append(ln)
+                    inner = held + tuple(a for a in acquired
+                                         if a not in held)
+                    for child in node.body:
+                        visit(child, inner)
+                    return
+                if isinstance(node, ast.Call):
+                    for callee in resolve_callees(node):
+                        callees[key].add(callee)
+                        if held:
+                            site = f"{rel}:{node.lineno}"
+                            for h in held:
+                                calls_under.append((h, callee, site))
+                for child in ast.iter_child_nodes(node):
+                    visit(child, held)
+
+            for stmt in fn.body:
+                visit(stmt, ())
+
+    # ---- pass 3: fixpoint transitive acquire-sets over the call graph
+    may: Dict[str, Set[str]] = {k: set(v) for k, v in direct.items()}
+    changed = True
+    while changed:
+        changed = False
+        for key, cs in callees.items():
+            cur = may.setdefault(key, set())
+            for c in cs:
+                extra = may.get(c)
+                if extra and not extra.issubset(cur):
+                    cur |= extra
+                    changed = True
+
+    # ---- pass 4: lift calls-under-lock into lock→lock edges
+    for held, callee, site in calls_under:
+        for acquired in may.get(callee, ()):
+            if acquired != held:
+                model.edges.setdefault((held, acquired), site)
+            elif acquired not in model.reentrant:
+                model.edges.setdefault((held, acquired), site + " (re-entry)")
+    return model
+
+
+@rule(RULE, "cross-module lock acquisition graph must be cycle-free")
+def check(corpus: Corpus) -> List[Finding]:
+    model = build_lock_model(corpus)
+    findings: List[Finding] = []
+    for comp in model.cycles():
+        if len(comp) == 1:
+            node = comp[0]
+            site = model.edges.get((node, node), "?")
+            rel = node.split("::", 1)[0]
+            m = re.search(r":(\d+)", site)
+            line = int(m.group(1)) if m else 0
+            findings.append(Finding(
+                RULE, rel, line,
+                f"non-reentrant lock `{node}` re-acquired while held "
+                f"(at {site}) — guaranteed self-deadlock if this path runs",
+                f"self-deadlock:{node}"))
+            continue
+        # name the cycle by its sorted members (stable under edge churn)
+        sig = "<->".join(comp)
+        sites = []
+        for a in comp:
+            for b in comp:
+                if (a, b) in model.edges:
+                    sites.append(f"{a}->{b}@{model.edges[(a, b)]}")
+        rel = comp[0].split("::", 1)[0]
+        findings.append(Finding(
+            RULE, rel, 0,
+            f"lock-order cycle between {', '.join(comp)} "
+            f"(edges: {'; '.join(sites)}) — opposite nesting orders can "
+            f"deadlock",
+            f"cycle:{sig}"))
+    return findings

@@ -1,0 +1,279 @@
+"""jit-purity: no host impurity inside jitted / Pallas kernel functions.
+
+A `jax.jit`/`pjit`/`pallas_call` function body runs at TRACE time; a
+`time.time()` read, `random` draw, threading call, or mutation of
+enclosing-scope state inside one is at best a silent constant burned
+into the compiled program and at worst a correctness bug that only
+shows up on the second call. The repo's kernels are pure by
+convention; this rule makes the convention mechanical.
+
+Detection is two-phase:
+
+1. collect every jit-wrapped function: ``@jax.jit`` / ``@pjit`` /
+   ``@partial(jax.jit, ...)`` decorators, ``jax.jit(fn)`` /
+   ``pjit(fn)`` call sites (first positional arg a Name or dotted
+   attribute, resolved through the file's imports to defs in other
+   corpus modules), and kernels passed to ``pl.pallas_call(kernel,…)``.
+2. walk each collected body for impure constructs:
+   - calls rooted at the ``time`` / ``random`` / ``threading`` /
+     ``secrets`` modules, or ``numpy.random`` chains;
+   - ``global`` declarations;
+   - stores through an attribute/subscript whose ROOT name is not
+     local to the function (params, local assigns, comprehension/for
+     targets all count as local) — mutation of captured state.
+
+The walk is shallow on purpose (no interprocedural closure): helpers
+called FROM a kernel are usually themselves jitted or trivially pure,
+and a deep points-to pass would drown the signal. Nested defs inside a
+jitted function are included — they trace with it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from gethsharding_tpu.analysis.core import (
+    Corpus, Finding, SourceFile, dotted_name, rule)
+
+RULE = "jit-purity"
+
+_JIT_TAILS = ("jit", "pjit")
+_IMPURE_MODULES = {"time", "random", "threading", "secrets"}
+
+
+def _is_jit_callable(func: ast.AST, sf: SourceFile) -> bool:
+    """Is this Call.func a jit/pjit transform?"""
+    name = dotted_name(func)
+    if name is None:
+        return False
+    last = name.rsplit(".", 1)[-1]
+    if last not in _JIT_TAILS:
+        return False
+    if "." not in name:
+        # bare `jit(...)`: require it to be imported from jax-land
+        target = sf.imports.get(name, "")
+        return target.startswith("jax") or target.endswith(".jit") or \
+            target.endswith(".pjit") or name == "pjit"
+    return True  # jax.jit / self._jax.jit / pjit-ish attribute chains
+
+
+def _is_pallas_call(func: ast.AST) -> bool:
+    name = dotted_name(func)
+    return name is not None and name.rsplit(".", 1)[-1] == "pallas_call"
+
+
+def _decorator_marks_jit(dec: ast.AST, sf: SourceFile) -> bool:
+    if isinstance(dec, ast.Call):
+        if _is_jit_callable(dec.func, sf) or _is_pallas_call(dec.func):
+            return True
+        # @partial(jax.jit, ...) / @functools.partial(jax.jit, ...)
+        name = dotted_name(dec.func)
+        if name and name.rsplit(".", 1)[-1] == "partial" and dec.args:
+            return _is_jit_callable(dec.args[0], sf) or \
+                _is_pallas_call(dec.args[0])
+        return False
+    return _is_jit_callable(dec, sf)
+
+
+class _DefIndex:
+    """name -> FunctionDef nodes, per file (all nesting levels), plus
+    `x = functools.partial(fn, ...)` aliases (the pallas kernel idiom:
+    ``kernel = partial(_kernel, …); pl.pallas_call(kernel, …)``)."""
+
+    def __init__(self, sf: SourceFile):
+        self.by_name: Dict[str, List[ast.FunctionDef]] = {}
+        self.partial_of: Dict[str, str] = {}
+        if sf.tree is not None:
+            for node in ast.walk(sf.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self.by_name.setdefault(node.name, []).append(node)
+                elif isinstance(node, ast.Assign) and \
+                        len(node.targets) == 1 and \
+                        isinstance(node.targets[0], ast.Name) and \
+                        isinstance(node.value, ast.Call):
+                    fname = dotted_name(node.value.func)
+                    if fname and fname.rsplit(".", 1)[-1] == "partial" and \
+                            node.value.args:
+                        target = dotted_name(node.value.args[0])
+                        if target:
+                            self.partial_of[node.targets[0].id] = target
+
+
+def _collect_jitted(corpus: Corpus):
+    """-> list of (SourceFile, FunctionDef, how) to purity-check."""
+    indexes: Dict[str, _DefIndex] = {}
+
+    def index(sf: SourceFile) -> _DefIndex:
+        if sf.rel not in indexes:
+            indexes[sf.rel] = _DefIndex(sf)
+        return indexes[sf.rel]
+
+    seen: Set[Tuple[str, int]] = set()
+    out = []
+
+    def add(sf: SourceFile, fn: ast.FunctionDef, how: str):
+        key = (sf.rel, fn.lineno)
+        if key not in seen:
+            seen.add(key)
+            out.append((sf, fn, how))
+
+    def resolve(sf: SourceFile, target: ast.AST) -> Optional[
+            Tuple[SourceFile, ast.FunctionDef]]:
+        name = dotted_name(target)
+        if name is None:
+            return None
+        idx = index(sf)
+        name = idx.partial_of.get(name, name)
+        if "." not in name:
+            defs = idx.by_name.get(name)
+            return (sf, defs[0]) if defs else None
+        mod_alias, func = name.rsplit(".", 1)
+        if "." in mod_alias:  # self._sec.fn etc.: not statically resolvable
+            mod_alias = mod_alias.rsplit(".", 1)[-1]
+        module = sf.imports.get(mod_alias)
+        if not module:
+            return None
+        other = corpus.find_module(module)
+        if other is None or other.tree is None:
+            return None
+        defs = index(other).by_name.get(func)
+        return (other, defs[0]) if defs else None
+
+    for sf in corpus.files:
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if _decorator_marks_jit(dec, sf):
+                        add(sf, node, "decorator")
+            elif isinstance(node, ast.Call):
+                is_jit = _is_jit_callable(node.func, sf)
+                is_pallas = _is_pallas_call(node.func)
+                if (is_jit or is_pallas) and node.args:
+                    hit = resolve(sf, node.args[0])
+                    if hit is not None:
+                        add(hit[0], hit[1],
+                            "pallas_call" if is_pallas else "jit()")
+    return out
+
+
+def _local_names(fn: ast.FunctionDef) -> Set[str]:
+    locals_: Set[str] = set()
+    args = fn.args
+    for a in (list(args.posonlyargs) + list(args.args) +
+              list(args.kwonlyargs) +
+              ([args.vararg] if args.vararg else []) +
+              ([args.kwarg] if args.kwarg else [])):
+        locals_.add(a.arg)
+
+    def bind(target: ast.AST):
+        if isinstance(target, ast.Name):
+            locals_.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                bind(el)
+        elif isinstance(target, ast.Starred):
+            bind(target.value)
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                bind(t)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            bind(node.target)
+        elif isinstance(node, ast.For):
+            bind(node.target)
+        elif isinstance(node, ast.withitem) and node.optional_vars:
+            bind(node.optional_vars)
+        elif isinstance(node, ast.comprehension):
+            bind(node.target)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            locals_.add(node.name)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                locals_.add(alias.asname or alias.name.split(".")[0])
+        elif isinstance(node, ast.NamedExpr):
+            bind(node.target)
+    return locals_
+
+
+def _store_root(target: ast.AST) -> Optional[Tuple[str, ast.AST]]:
+    """For a store through Attribute/Subscript, the root Name."""
+    node = target
+    dotted = isinstance(node, (ast.Attribute, ast.Subscript))
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    if dotted and isinstance(node, ast.Name):
+        return node.id, node
+    return None
+
+
+def _impure_call(name: str, sf: SourceFile) -> Optional[str]:
+    """Non-None = human tag, when dotted call `name` is host-impure."""
+    root = name.split(".", 1)[0]
+    resolved = sf.imports.get(root, root)
+    base = resolved.split(".", 1)[0]
+    if base in _IMPURE_MODULES:
+        if "." in name:
+            return name
+        # bare call through a from-import: `from time import time`
+        # resolves "time" -> "time.time" (a module member, not the
+        # module object itself — calling the module would TypeError
+        # anyway)
+        if "." in resolved:
+            return f"{name} ({resolved})"
+    # numpy.random / np.random chains (jax.random is fine: functional)
+    if base == "numpy" and ".random." in ("." + name.split(".", 1)[-1] + "."):
+        return name
+    if "." not in name and resolved.startswith("numpy.random."):
+        return f"{name} ({resolved})"
+    if name == "print":
+        return "print (use jax.debug.print inside kernels)"
+    return None
+
+
+def check_function(sf: SourceFile, fn: ast.FunctionDef,
+                   how: str) -> List[Finding]:
+    findings: List[Finding] = []
+    locals_ = _local_names(fn)
+    qual = fn.name
+
+    def emit(node: ast.AST, kind: str, what: str):
+        findings.append(Finding(
+            RULE, sf.rel, getattr(node, "lineno", fn.lineno),
+            f"`{qual}` is jitted ({how}) but {what}",
+            f"{qual}:{kind}"))
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Global):
+            emit(node, "global:" + ",".join(node.names),
+                 f"declares `global {', '.join(node.names)}`")
+        elif isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name:
+                tag = _impure_call(name, sf)
+                if tag:
+                    emit(node, f"call:{name}", f"calls `{tag}` at trace time")
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                hit = _store_root(t)
+                if hit and hit[0] not in locals_:
+                    emit(t, f"mutate:{hit[0]}",
+                         f"mutates enclosing-scope state through "
+                         f"`{hit[0]}[...]`/`.attr` — captured objects are "
+                         f"trace-time constants")
+    return findings
+
+
+@rule(RULE, "no time/random/threading/global mutation inside "
+            "jax.jit / pjit / pallas_call functions")
+def check(corpus: Corpus) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf, fn, how in _collect_jitted(corpus):
+        findings.extend(check_function(sf, fn, how))
+    return findings

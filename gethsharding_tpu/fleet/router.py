@@ -704,6 +704,16 @@ class RpcReplicaBackend:
             "the committee plane is an in-process op; route it with an "
             "in-process Replica backend")
 
+    def bls_verify_committees_async(self, *args, **kwargs):
+        # explicit so a composed stack fails with the routing hint above
+        # instead of falling into SigBackend's sync-delegating default
+        # (which would raise the same error two frames deeper) — and so
+        # the backend-contract lint sees the plane is deliberate, not
+        # forgotten
+        raise NotImplementedError(
+            "the committee plane is an in-process op; route it with an "
+            "in-process Replica backend")
+
     def das_verify_samples(self, *args, **kwargs):
         raise NotImplementedError(
             "the DAS sample plane is an in-process op; route it with an "

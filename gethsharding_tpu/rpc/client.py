@@ -88,6 +88,14 @@ class RPCClient:
         except OSError:
             pass
         self._sock.close()
+        # drain both service threads (bounded: the socket is dead and the
+        # dispatch queue got its sentinel, so neither can block long).
+        # A subscriber callback may close() from the dispatcher thread
+        # itself — never join the current thread.
+        me = threading.current_thread()
+        for thread in (self._dispatcher, self._reader):
+            if thread is not me:
+                thread.join(timeout=5.0)
 
     # -- request/response --------------------------------------------------
 

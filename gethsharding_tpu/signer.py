@@ -92,6 +92,7 @@ class SignerServer:
         self._lock = threading.Lock()
         self._host, self._port = host, port
         self._server: Optional[socketserver.ThreadingTCPServer] = None
+        self._thread: Optional[threading.Thread] = None
 
     # -- rules + audit -----------------------------------------------------
 
@@ -226,6 +227,9 @@ class SignerServer:
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
 
 
 class RemoteAccount:

@@ -366,6 +366,23 @@ else
 fi
 rm -rf "$obsfleet_dir"
 
+# -- shardlint: the repo-wide static analysis gate (jit-purity,
+# host-sync, lock-order, backend-contract, thread-lifecycle, flag-doc,
+# export-completeness) — fails on any finding outside the committed
+# baseline (gethsharding_tpu/analysis/baseline.json)
+echo "== shardlint (static analysis gate)"
+JAX_PLATFORMS=cpu python -m gethsharding_tpu.analysis || fail=1
+
+# -- lockcheck smoke: the concurrency-heavy suites run ONCE with the
+# runtime lock-order recorder patched in (GETHSHARDING_LOCKCHECK=1);
+# conftest's session gate fails the run on any observed AB/BA
+# inversion or an order that contradicts the static lock graph —
+# the runtime validation of the lock-order rule's model
+echo "== lockcheck smoke (fleet/serving/concurrency under the recorder)"
+GETHSHARDING_LOCKCHECK=1 JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_concurrency.py tests/test_serving.py tests/test_fleet.py \
+    -q --no-header -m 'not slow' || fail=1
+
 for f in tests/test_*.py; do
     echo "== $f"
     python -m pytest "$f" -q --no-header || fail=1

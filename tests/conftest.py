@@ -40,6 +40,45 @@ from gethsharding_tpu.parallel.virtual import configure_compile_cache
 if _os.environ.get("GETHSHARDING_CACHE_OFF") == "1":
     configure_compile_cache(enabled=False)
 
+# GETHSHARDING_LOCKCHECK=1: wrap threading.Lock/RLock with the runtime
+# lock-order recorder (analysis/lockcheck.py) for the whole session and
+# assert, at session end, that the OBSERVED acquisition orders are
+# inversion-free and consistent with the static lock graph the
+# lock-order lint derives — the race-detector-lite that keeps the
+# static model honest. Install happens at conftest import so every
+# lock a test creates is wrapped.
+if _os.environ.get("GETHSHARDING_LOCKCHECK") == "1":
+    from gethsharding_tpu.analysis import lockcheck as _lockcheck
+
+    _lockcheck.install()
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _lockcheck_gate():
+    yield
+    from gethsharding_tpu.analysis import lockcheck
+
+    if not lockcheck.active():
+        return
+    verdict = lockcheck.verify_against_static()
+    observed = len(lockcheck.report()["edges"])
+    print(f"\nlockcheck: {observed} lock-order edge(s) observed, "
+          f"{len(verdict.inversions)} inversion(s), "
+          f"{len(verdict.static_violations)} static violation(s), "
+          f"{len(verdict.coverage_gaps)} coverage gap(s)")
+    assert not verdict.inversions, (
+        "lockcheck: AB/BA lock-order inversion observed:\n" + "\n".join(
+            f"  {inv.second[0]} -> {inv.second[1]} reverses "
+            f"{inv.first[0]} -> {inv.first[1]} (first seen at "
+            f"{inv.first_site})" for inv in verdict.inversions))
+    assert not verdict.static_violations, (
+        "lockcheck: observed order contradicts the static lock graph:\n"
+        + "\n".join(f"  {v}" for v in verdict.static_violations))
+    if verdict.coverage_gaps:  # informational: model under-approximates
+        print("\nlockcheck coverage gaps (observed, not in static graph):")
+        for gap in verdict.coverage_gaps:
+            print(f"  {gap}")
+
 
 @pytest.fixture(autouse=True, scope="module")
 def _bound_xla_executable_pressure():

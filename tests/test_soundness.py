@@ -612,28 +612,11 @@ def test_unwired_seams_covers_corrupt_rules():
     assert unwired_seams(schedule, ("mainchain", "backend")) == []
 
 
-# -- exports + surfaces ------------------------------------------------------
-
-
-def test_every_public_errors_class_is_exported():
-    """PR 4 shipped `FetchAborted` missing from the package `__all__`;
-    the lint-style contract: every public exception class defined in
-    resilience/errors.py is importable from the package and listed in
-    its `__all__`, so the next error type can't regress it."""
-    import gethsharding_tpu.resilience as resilience
-    from gethsharding_tpu.resilience import errors
-
-    public = [name for name in dir(errors)
-              if not name.startswith("_")
-              and isinstance(getattr(errors, name), type)
-              and issubclass(getattr(errors, name), BaseException)
-              and getattr(errors, name).__module__ == errors.__name__]
-    assert public  # the contract is vacuous if discovery breaks
-    for name in public:
-        assert name in resilience.__all__, (
-            f"{name} defined in resilience/errors.py but missing from "
-            f"resilience.__all__")
-        assert getattr(resilience, name) is getattr(errors, name)
+# the PR 4 `FetchAborted`-missing-from-__all__ lint that used to live
+# here is now the corpus-wide `export-completeness` analysis rule
+# (gethsharding_tpu/analysis/exports.py), gated over every package by
+# tests/test_analysis.py — which also keeps a live-import twin of the
+# original assertion (test_export_completeness_live_resilience_contract).
 
 
 def test_describe_reports_knobs_and_detection():
