@@ -6,10 +6,12 @@ subsystems and the jitted-kernel surface depend on. Run with
 ``python -m gethsharding_tpu.analysis``; gate is zero findings outside
 the committed baseline (`analysis/baseline.json`).
 
-Rules: jit-purity, host-sync, lock-order, backend-contract,
-thread-lifecycle, flag-doc, export-completeness. The static lock graph
-is cross-validated at runtime by `analysis/lockcheck.py`
-(``GETHSHARDING_LOCKCHECK=1``).
+Rules: jit-purity, host-sync, lock-order, race-guard, layering,
+backend-contract, thread-lifecycle, flag-doc, export-completeness.
+Two rules are cross-validated at runtime: the static lock graph by
+`analysis/lockcheck.py` (``GETHSHARDING_LOCKCHECK=1``) and the
+race-guard lockset model by the access sanitizer
+`analysis/racecheck.py` (``GETHSHARDING_RACECHECK=1``).
 """
 
 from gethsharding_tpu.analysis.core import (

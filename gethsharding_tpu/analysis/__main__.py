@@ -18,8 +18,9 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m gethsharding_tpu.analysis",
         description="shardlint: repo-wide static analysis "
-                    "(jit-purity, host-sync, lock-order, backend-contract, "
-                    "thread-lifecycle, flag-doc, export-completeness)")
+                    "(jit-purity, host-sync, lock-order, race-guard, "
+                    "layering, backend-contract, thread-lifecycle, "
+                    "flag-doc, export-completeness)")
     parser.add_argument("--root", default=None,
                         help="repo root to scan (default: the checkout "
                              "this package was imported from)")
@@ -39,12 +40,17 @@ def main(argv=None) -> int:
                         help="accept the current findings: write them to "
                              "the baseline (existing justifications are "
                              "kept; new entries get a TODO placeholder)")
+    parser.add_argument("--prune-baseline", action="store_true",
+                        help="report and DROP baseline entries whose "
+                             "fingerprint no longer matches any finding, "
+                             "so dead justifications can't accumulate")
     args = parser.parse_args(argv)
 
     if args.list:
         # rule modules self-register on import
         from gethsharding_tpu.analysis import (  # noqa: F401
-            contract, exports, flags, hostsync, lifecycle, locks, purity)
+            contract, exports, flags, hostsync, layering, lifecycle,
+            locks, purity, races)
         for name in sorted(RULES):
             print(f"{name:22s} {RULE_DOCS[name]}")
         return 0
@@ -65,6 +71,36 @@ def main(argv=None) -> int:
     except KeyError as exc:
         print(f"error: {exc.args[0]}", file=sys.stderr)
         return 2
+
+    if args.prune_baseline:
+        # `--rule` partial runs must not prune: a rule that did not run
+        # reports no findings, so every one of its entries would look
+        # stale and be wrongly dropped
+        if args.rule:
+            print("error: --prune-baseline requires a full run "
+                  "(no --rule)", file=sys.stderr)
+            return 2
+        baseline = Baseline.load(baseline_path)
+        if not report.stale:
+            print("prune-baseline: nothing stale; "
+                  f"{len(baseline.entries)} entr"
+                  f"{'y' if len(baseline.entries) == 1 else 'ies'} kept")
+        else:
+            for key in report.stale:
+                print(f"pruning stale baseline entry: {key}\n"
+                      f"  (was: {baseline.entries.get(key, '?')})")
+                baseline.entries.pop(key, None)
+            baseline.save(baseline_path)
+            print(f"prune-baseline: dropped {len(report.stale)}, kept "
+                  f"{len(baseline.entries)} in {baseline_path}")
+        # pruning must not green-wash a dirty tree: new findings still
+        # gate exactly like a plain run
+        for f in report.new:
+            print(f.render())
+        if report.new:
+            print(f"prune-baseline: {len(report.new)} NEW finding(s) "
+                  f"remain — fix or baseline them")
+        return 1 if report.new else 0
 
     if args.write_baseline:
         baseline = Baseline.load(baseline_path)

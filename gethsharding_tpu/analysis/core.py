@@ -2,9 +2,10 @@
 
 The geth lineage wires `go vet` + the race detector into its build; this
 package is the TPU rewrite's analogue — an AST-level pass with
-repo-specific rules (jit-purity, host-sync, lock-order, backend-contract,
-thread-lifecycle, flag-doc, export-completeness) run by
-``python -m gethsharding_tpu.analysis`` and gated in CI.
+repo-specific rules (jit-purity, host-sync, lock-order, race-guard,
+layering, backend-contract, thread-lifecycle, flag-doc,
+export-completeness) run by ``python -m gethsharding_tpu.analysis`` and
+gated in CI.
 
 Design rules of the framework:
 
@@ -207,7 +208,8 @@ def run_rules(corpus: Corpus,
     # rule modules self-register on import; pull them in here so callers
     # (tests, __main__) need only the package
     from gethsharding_tpu.analysis import (  # noqa: F401
-        contract, exports, flags, hostsync, lifecycle, locks, purity)
+        contract, exports, flags, hostsync, layering, lifecycle, locks,
+        purity, races)
 
     selected = list(names) if names is not None else sorted(RULES)
     unknown = [n for n in selected if n not in RULES]

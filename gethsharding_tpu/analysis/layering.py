@@ -1,0 +1,217 @@
+"""layering: the package DAG is declared in layers.json and enforced.
+
+ROADMAP item 1 will split the ~2.5k-line ``sigbackend.py`` into
+marshal / device-layout / dispatch / cache modules; without a declared
+dependency structure that refactor (and every PR after it) can quietly
+re-tangle the tree — a serving module importing ``node``, the analysis
+package growing a runtime dependency, ``sigbackend`` importing the
+serving tier at module scope and recreating the import cycle the lazy
+registry factory exists to avoid.
+
+``analysis/layers.json`` is the committed contract: for every
+top-level unit of ``gethsharding_tpu`` (a subpackage, or a single
+module like ``metrics``/``sigbackend``), the cross-unit imports it may
+make — split into ``imports`` (module scope: these define the import
+DAG and must stay acyclic where declared) and ``lazy`` (function
+scope: the repo's sanctioned cycle-breaking idiom, still declared so
+a new back-edge is a decision, not an accident).
+
+Checks, both directions (the flag-doc shape):
+
+- a module-scope cross-unit import absent from the unit's ``imports``
+  list -> ``undeclared-import``;
+- a function-scope import absent from BOTH lists -> ``undeclared-lazy``
+  (anything allowed eagerly is allowed lazily);
+- a unit with cross-unit imports but no layers.json entry ->
+  ``undeclared-unit`` (new packages must declare their place);
+- a declared edge no code exercises -> ``stale-layer`` (the DAG file
+  must not accumulate dead permissions);
+- hard bans are structural, not just declarative: ``analysis`` may
+  import NO runtime unit in either list, and no unit but the
+  composition roots (``node``, ``cli``) may import ``node``.
+
+Import facts come from the corpus's parsed ASTs (the same import-alias
+machinery every other rule uses), so string-built importlib calls are
+invisible — which is exactly right: the racecheck class registry uses
+importlib BECAUSE analysis must not import the runtime packages.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+from typing import Dict, List, Set, Tuple
+
+from gethsharding_tpu.analysis.core import Corpus, Finding, rule
+
+RULE = "layering"
+LAYERS_REL = "gethsharding_tpu/analysis/layers.json"
+PACKAGE = "gethsharding_tpu"
+
+# units that may import the composition root; everything else importing
+# `node` is an inverted dependency by construction
+NODE_IMPORTERS = {"node", "cli"}
+
+
+def _unit_of(rel: str) -> str:
+    parts = rel.split("/")
+    if len(parts) < 2 or parts[0] != PACKAGE:
+        return ""
+    if len(parts) == 2:
+        return parts[1][:-3] if parts[1].endswith(".py") else parts[1]
+    return parts[1]
+
+
+def collect_import_edges(corpus: Corpus):
+    """((unit, target) -> first (rel, line)) for module-scope and
+    function-scope cross-unit imports, from the parsed ASTs."""
+    top: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    lazy: Dict[Tuple[str, str], Tuple[str, int]] = {}
+
+    for sf in corpus.files:
+        if sf.tree is None:
+            continue
+        unit = _unit_of(sf.rel)
+        if not unit:
+            continue
+        toplevel = {id(n) for n in sf.tree.body}
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, (ast.Import, ast.ImportFrom)):
+                continue
+            targets: List[str] = []
+            if isinstance(node, ast.Import):
+                targets = [alias.name.split(".")[1]
+                           for alias in node.names
+                           if alias.name.startswith(PACKAGE + ".")]
+            elif node.level:
+                # relative import: resolve against this file's package
+                # (same walk as SourceFile.imports) — `from ..fleet
+                # import router` inside serving/ IS a cross-unit edge
+                # and must not slip the DAG
+                base = sf.rel.rsplit("/", 1)[0].replace("/", ".")
+                for _ in range(max(node.level - 1, 0)):
+                    base = base.rsplit(".", 1)[0]
+                module = f"{base}.{node.module}" if node.module else base
+                if module == PACKAGE:
+                    targets = [alias.name for alias in node.names]
+                elif module.startswith(PACKAGE + "."):
+                    targets = [module.split(".")[1]]
+            elif node.module:
+                if node.module == PACKAGE:
+                    targets = [alias.name for alias in node.names]
+                elif node.module.startswith(PACKAGE + "."):
+                    targets = [node.module.split(".")[1]]
+            for target in targets:
+                if target == unit:
+                    continue
+                dest = top if id(node) in toplevel else lazy
+                dest.setdefault((unit, target), (sf.rel, node.lineno))
+    return top, lazy
+
+
+@rule(RULE, "cross-package imports match the DAG declared in "
+            "analysis/layers.json (module-scope vs lazy, both "
+            "directions)")
+def check(corpus: Corpus) -> List[Finding]:
+    findings: List[Finding] = []
+    raw = corpus.read_doc(LAYERS_REL)
+    if raw is None:
+        return [Finding(RULE, LAYERS_REL, 0,
+                        "layers.json is missing — the package DAG must "
+                        "be declared and committed",
+                        "missing-layers-json")]
+    try:
+        declared = json.loads(raw).get("units", {})
+    except json.JSONDecodeError as exc:
+        return [Finding(RULE, LAYERS_REL, 0,
+                        f"layers.json is not valid JSON: {exc}",
+                        "bad-layers-json")]
+
+    top, lazy = collect_import_edges(corpus)
+    units_with_edges: Set[str] = {u for (u, _) in top} | \
+        {u for (u, _) in lazy}
+
+    def allowed(unit: str, kind: str) -> Set[str]:
+        entry = declared.get(unit)
+        if entry is None:
+            return set()
+        if kind == "imports":
+            return set(entry.get("imports", ()))
+        # anything allowed eagerly is allowed lazily too
+        return set(entry.get("imports", ())) | set(entry.get("lazy", ()))
+
+    for (unit, target), (rel, line) in sorted(top.items()):
+        if unit not in declared:
+            continue  # reported once as undeclared-unit below
+        if target not in allowed(unit, "imports"):
+            hint = " (declared lazy-only: move the import into the " \
+                   "function that needs it)" \
+                if target in allowed(unit, "lazy") else ""
+            findings.append(Finding(
+                RULE, rel, line,
+                f"module-scope import `{unit} -> {target}` is not in "
+                f"layers.json's `{unit}.imports`{hint}",
+                f"undeclared-import:{unit}->{target}"))
+    for (unit, target), (rel, line) in sorted(lazy.items()):
+        if unit not in declared:
+            continue
+        if target not in allowed(unit, "lazy"):
+            findings.append(Finding(
+                RULE, rel, line,
+                f"function-scope import `{unit} -> {target}` is in "
+                f"neither `{unit}.imports` nor `{unit}.lazy` in "
+                f"layers.json",
+                f"undeclared-lazy:{unit}->{target}"))
+
+    for unit in sorted(units_with_edges):
+        if unit not in declared:
+            rel, line = min(
+                [loc for (u, _), loc in list(top.items())
+                 + list(lazy.items()) if u == unit])
+            findings.append(Finding(
+                RULE, rel, line,
+                f"unit `{unit}` makes cross-unit imports but has no "
+                f"layers.json entry — new packages must declare their "
+                f"place in the DAG",
+                f"undeclared-unit:{unit}"))
+
+    # stale direction: declared permissions nothing exercises
+    for unit, entry in sorted(declared.items()):
+        for target in sorted(entry.get("imports", ())):
+            if (unit, target) not in top:
+                findings.append(Finding(
+                    RULE, LAYERS_REL, 0,
+                    f"layers.json allows `{unit} -> {target}` at module "
+                    f"scope but no such import exists — stale edge",
+                    f"stale-layer:{unit}->{target}"))
+        for target in sorted(entry.get("lazy", ())):
+            if (unit, target) not in lazy:
+                findings.append(Finding(
+                    RULE, LAYERS_REL, 0,
+                    f"layers.json allows lazy `{unit} -> {target}` but "
+                    f"no function-scope import exists — stale edge",
+                    f"stale-lazy:{unit}->{target}"))
+
+    # structural bans, enforced over the DECLARATION so weakening the
+    # file is itself a finding
+    analysis_entry = declared.get("analysis", {})
+    for kind in ("imports", "lazy"):
+        for target in analysis_entry.get(kind, ()):
+            findings.append(Finding(
+                RULE, LAYERS_REL, 0,
+                f"analysis must stay runtime-free but layers.json "
+                f"grants it `{target}` ({kind}) — the lint must be "
+                f"importable without the node",
+                f"analysis-not-leaf:{target}"))
+    for unit, entry in sorted(declared.items()):
+        if unit in NODE_IMPORTERS:
+            continue
+        for kind in ("imports", "lazy"):
+            if "node" in entry.get(kind, ()):
+                findings.append(Finding(
+                    RULE, LAYERS_REL, 0,
+                    f"`{unit}` is granted an import of the composition "
+                    f"root `node` ({kind}) — dependencies point INTO "
+                    f"the planes, never back out",
+                    f"node-inversion:{unit}"))
+    return findings

@@ -244,6 +244,24 @@ def active() -> bool:
     return _installed
 
 
+def current_held_labels() -> Tuple[str, ...]:
+    """Creation-site labels of the locks THIS thread holds right now
+    (empty when the recorder is off). The race sanitizer
+    (analysis/racecheck.py) reads this at every instrumented attribute
+    write to build runtime per-write locksets."""
+    if _recorder is None:
+        return ()
+    return tuple(lock.label for lock in _recorder.held()
+                 if lock.label is not None)
+
+
+def real_lock():
+    """An UNWRAPPED lock for checker-internal state: invisible to the
+    recorder, so instrumentation bookkeeping can never add edges (or
+    inversions) to the graph it is measuring."""
+    return (_REAL_LOCK or threading.Lock)()
+
+
 def report() -> dict:
     """Observed edges + inversions so far."""
     if _recorder is None:

@@ -190,15 +190,20 @@ def _class_name_of(call: ast.Call, sf: SourceFile,
     return (module, cls) if module else None
 
 
-def build_lock_model(corpus: Corpus,
-                     scopes: Sequence[str] = DEFAULT_SCOPES) -> LockModel:
+def collect_classes(corpus: Corpus):
+    """Pass 1 of the lock model, shared with the race-guard rule
+    (analysis/races.py): every class's lock attributes (Condition
+    aliasing applied), component attribute types, methods and the
+    module-level factory-return annotations, plus a `LockModel` whose
+    nodes / reentrancy / site map are filled in (edges still empty).
+
+    Returns ``(classes, factory_returns, model)`` where `classes` maps
+    ``(rel, ClassName)`` (and ``(rel, "<module>")``) to `_ClassInfo`.
+    """
     model = LockModel()
     classes: Dict[Tuple[str, str], _ClassInfo] = {}
     # (module_rel, fn_name) -> ClassName, from `def f(...) -> Cls:` in file
     factory_returns: Dict[Tuple[str, str], str] = {}
-
-    def in_scope(rel: str) -> bool:
-        return any(rel == s or rel.startswith(s) for s in scopes)
 
     def note_factory(rel: str, fn: ast.FunctionDef):
         """`def counter(...) -> Counter:` makes call-chain resolution
@@ -302,6 +307,15 @@ def build_lock_model(corpus: Corpus,
                                     if other is not None:
                                         info.attr_types[node.target.attr] \
                                             = (other.rel, cls)
+    return classes, factory_returns, model
+
+
+def build_lock_model(corpus: Corpus,
+                     scopes: Sequence[str] = DEFAULT_SCOPES) -> LockModel:
+    classes, factory_returns, model = collect_classes(corpus)
+
+    def in_scope(rel: str) -> bool:
+        return any(rel == s or rel.startswith(s) for s in scopes)
 
     # ---- pass 2: per-method acquire/call traces (scoped files only)
     # summaries: key -> (direct_acquires, callee_keys, trace records)

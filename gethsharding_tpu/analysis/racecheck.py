@@ -1,0 +1,395 @@
+"""Runtime access sanitizer — the dynamic half of the race-guard rule.
+
+``GETHSHARDING_RACECHECK=1`` (tests/conftest.py installs it, or call
+:func:`install` directly) patches ``__setattr__`` on the REGISTERED
+component classes of the threaded planes with recording wrappers, and
+piggybacks on the lock-order recorder (analysis/lockcheck.py) so every
+instrumented write knows which locks its thread holds:
+
+- per ``(instance, attribute)`` the recorder runs the Eraser state
+  machine: writes by the creating thread only are EXCLUSIVE (the
+  init-only idiom, free); the moment a second thread writes, the
+  attribute is SHARED and a running lockset intersection starts —
+  every subsequent write intersects in the labels of the locks held at
+  that write. An empty intersection on a shared attribute is a
+  runtime race witness, caught even on schedules that happen not to
+  corrupt anything this run;
+- records aggregate per ``rel::Class.attr`` — exactly the static race
+  model's keys — with the first shared-write site kept as evidence;
+- :func:`verify_against_static` cross-validates: a runtime-unguarded
+  shared write to an attribute the static model calls ``guarded`` (or
+  ``init-only``) is a VIOLATION — one of the two is wrong, either the
+  code races or the model's call-graph resolution over-promised; a
+  statically-``racy`` attribute never observed shared at runtime is an
+  honest COVERAGE GAP (the tests never drove that interleaving), and
+  one observed shared-and-unguarded is a runtime CONFIRMATION.
+
+The wrappers cost one dict hop and a held-lockset read per write on
+instrumented classes only; like lockcheck this is test-harness
+overhead, never production overhead (install is explicit). Instance
+state lives in a side table keyed by ``id(obj)``; ``__init__`` is
+wrapped too so a fresh allocation at a dead instance's address resets
+its record instead of inheriting a stale writer-thread history.
+
+Honest limitation: ``__setattr__`` sees attribute REBINDS and
+augmented assignments only — in-place container mutation
+(``self._x[k] = v``, ``self._x.append(...)``) never reaches the
+wrapper, so those sites are covered by the static rule alone. The
+coverage-gap report exists precisely to keep that asymmetry visible.
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import threading
+import traceback
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from gethsharding_tpu.analysis import lockcheck
+
+# the instrumented component classes of the threaded planes, as
+# "module:Class" specs resolved lazily at install (importlib, so the
+# layering rule's static no-runtime-imports contract for analysis/
+# holds). Underscore helpers included: they hold the per-thread state.
+DEFAULT_CLASSES = (
+    "gethsharding_tpu.serving.queue:AdmissionQueue",
+    "gethsharding_tpu.serving.batcher:MicroBatcher",
+    "gethsharding_tpu.serving.pipeline:PipelinedDispatcher",
+    "gethsharding_tpu.fleet.router:Replica",
+    "gethsharding_tpu.fleet.router:FleetRouter",
+    "gethsharding_tpu.resilience.breaker:CircuitBreaker",
+    "gethsharding_tpu.resilience.watchdog:DispatchWatchdog",
+    "gethsharding_tpu.slo.tracker:SLOTracker",
+    "gethsharding_tpu.slo.tracker:_Series",
+    "gethsharding_tpu.tracing.tracer:Tracer",
+    "gethsharding_tpu.metrics:Counter",
+    "gethsharding_tpu.metrics:Gauge",
+    "gethsharding_tpu.metrics:Histogram",
+    "gethsharding_tpu.metrics:Timer",
+    "gethsharding_tpu.metrics:Registry",
+    "gethsharding_tpu.metrics:InfluxLineExporter",
+    "gethsharding_tpu.rpc.server:RPCServer",
+    "gethsharding_tpu.rpc.client:RPCClient",
+)
+
+@dataclass
+class AttrRecord:
+    """Aggregated evidence for one ``rel::Class.attr``."""
+
+    key: str
+    writes: int = 0
+    writer_threads: Set[int] = field(default_factory=set)
+    shared: bool = False  # some INSTANCE saw a second writer thread
+    # running intersection of creation-site lock labels over all
+    # shared-phase writes (None until the first shared write)
+    lockset: Optional[FrozenSet[str]] = None
+    first_shared_site: str = ""
+
+    @property
+    def unguarded(self) -> bool:
+        return self.shared and not self.lockset
+
+
+class _InstState:
+    __slots__ = ("first_thread", "attr_threads")
+
+    def __init__(self, tid: int):
+        self.first_thread = tid
+        self.attr_threads: Dict[str, Set[int]] = {}
+
+
+class _Recorder:
+    def __init__(self):
+        self._mutex = lockcheck.real_lock()
+        self.records: Dict[str, AttrRecord] = {}
+        self._instances: Dict[int, _InstState] = {}
+        self.writes_seen = 0
+
+    def _site(self) -> str:
+        for frame in reversed(traceback.extract_stack()[:-2]):
+            fn = frame.filename.replace(os.sep, "/")
+            if "racecheck.py" in fn or "lockcheck.py" in fn:
+                continue
+            idx = fn.find("gethsharding_tpu")
+            if idx >= 0:
+                return f"{fn[idx:]}:{frame.lineno}"
+            return f"{fn}:{frame.lineno}"
+        return "?"
+
+    def on_init(self, obj) -> None:
+        """A registered class is constructing: (re)create the instance
+        record. Keyed by ``id(obj)``, so a fresh allocation at a dead
+        instance's address must RESET here — otherwise the stale
+        record's writer threads would make ordinary ``__init__`` writes
+        look cross-thread-shared (observed in long pytest sessions)."""
+        with self._mutex:
+            self._instances[id(obj)] = _InstState(threading.get_ident())
+
+    def on_write(self, obj, cls_key: str, attr: str) -> None:
+        tid = threading.get_ident()
+        held = lockcheck.current_held_labels()
+        key = f"{cls_key}.{attr}"
+        with self._mutex:
+            self.writes_seen += 1
+            inst = self._instances.get(id(obj))
+            if inst is None:
+                inst = self._instances[id(obj)] = _InstState(tid)
+            threads = inst.attr_threads.setdefault(attr, set())
+            threads.add(tid)
+            record = self.records.get(key)
+            if record is None:
+                record = self.records[key] = AttrRecord(key)
+            record.writes += 1
+            record.writer_threads.add(tid)
+            if len(threads) > 1:
+                # Eraser shared phase for THIS instance: intersect in
+                # the held locks (creation-site labels, the static site
+                # map's currency)
+                if not record.shared:
+                    record.shared = True
+                    record.first_shared_site = self._site()
+                if record.lockset is None:
+                    record.lockset = frozenset(held)
+                else:
+                    record.lockset &= frozenset(held)
+
+
+_recorder: Optional[_Recorder] = None
+# class -> (original __setattr__, original __init__); None entries mean
+# the class inherited the slot
+_patched: Dict[type, Tuple[Optional[object], Optional[object]]] = {}
+_installed = False
+_owns_lockcheck = False  # did OUR install patch threading?
+
+
+def _resolve(spec: str) -> Optional[type]:
+    module, _, cls = spec.partition(":")
+    try:
+        mod = importlib.import_module(module)
+    except Exception:  # pragma: no cover - optional plane not importable
+        return None
+    return getattr(mod, cls, None)
+
+
+def class_key(cls: type) -> str:
+    """``rel::Class`` matching the static model's keys."""
+    rel = cls.__module__.replace(".", "/") + ".py"
+    return f"{rel}::{cls.__qualname__}"
+
+
+_class_key = class_key
+
+
+def _make_setattr(cls_key: str, orig):
+    def recording_setattr(self, name, value):
+        recorder = _recorder
+        if recorder is not None:
+            recorder.on_write(self, cls_key, name)
+        orig(self, name, value)
+    recording_setattr._racecheck_wrapped = orig  # uninstall marker
+    return recording_setattr
+
+
+def install(classes: Sequence[str] = DEFAULT_CLASSES,
+            record_paths: Optional[Sequence[str]] = None) -> None:
+    """Patch the registered classes' ``__setattr__`` (idempotent) and
+    make sure the lock recorder is on — without it every write would
+    look unguarded. Extra classes can be registered later with
+    :func:`register`. `record_paths` forwards to the lock recorder
+    (tests add their own tree so fixture locks get labels); it has no
+    effect when a recorder is already installed."""
+    global _recorder, _installed, _owns_lockcheck
+    if _installed:
+        return
+    _owns_lockcheck = not lockcheck.active()
+    if record_paths is not None:
+        lockcheck.install(record_paths=record_paths)
+    else:
+        lockcheck.install()
+    _recorder = _Recorder()
+    _installed = True
+    for spec in classes:
+        cls = _resolve(spec)
+        if cls is not None:
+            register(cls)
+
+
+def _make_init(orig):
+    def recording_init(self, *args, **kwargs):
+        recorder = _recorder
+        if recorder is not None:
+            recorder.on_init(self)
+        orig(self, *args, **kwargs)
+    recording_init._racecheck_wrapped = orig  # uninstall marker
+    return recording_init
+
+
+def register(cls: type) -> None:
+    """Instrument one more class (tests register their fixtures)."""
+    if not _installed or cls in _patched:
+        return
+    orig_set = cls.__dict__.get("__setattr__")
+    base_set = orig_set if orig_set is not None else cls.__setattr__
+    cls.__setattr__ = _make_setattr(_class_key(cls), base_set)
+    orig_init = cls.__dict__.get("__init__")
+    base_init = orig_init if orig_init is not None else cls.__init__
+    cls.__init__ = _make_init(base_init)
+    _patched[cls] = (orig_set, orig_init)
+
+
+def uninstall() -> None:
+    """Restore every patched class. The lock recorder is uninstalled
+    only if OUR install patched it — a session lockcheck
+    (GETHSHARDING_LOCKCHECK=1) someone else installed stays; and a
+    fixture-scoped racecheck must not leak wrapped locks into the rest
+    of a plain test session."""
+    global _recorder, _installed, _owns_lockcheck
+    for cls, (orig_set, orig_init) in _patched.items():
+        for name, orig in (("__setattr__", orig_set),
+                           ("__init__", orig_init)):
+            if orig is not None:
+                setattr(cls, name, orig)
+            else:
+                try:
+                    delattr(cls, name)
+                except AttributeError:  # pragma: no cover - already gone
+                    pass
+    _patched.clear()
+    _recorder = None
+    _installed = False
+    if _owns_lockcheck:
+        lockcheck.uninstall()
+        _owns_lockcheck = False
+
+
+def active() -> bool:
+    return _installed
+
+
+def reset() -> None:
+    global _recorder
+    if _installed:
+        _recorder = _Recorder()
+
+
+def report() -> Dict[str, AttrRecord]:
+    """Aggregated per-attribute records so far."""
+    if _recorder is None:
+        return {}
+    with _recorder._mutex:
+        return dict(_recorder.records)
+
+
+def stats() -> dict:
+    rep = report()
+    return {
+        "classes_instrumented": len(_patched),
+        "attrs_written": len(rep),
+        "writes_seen": 0 if _recorder is None else _recorder.writes_seen,
+        "shared_attrs": sum(1 for r in rep.values() if r.shared),
+        "unguarded_shared": sum(1 for r in rep.values() if r.unguarded),
+    }
+
+
+@dataclass
+class Verdict:
+    """The cross-validation outcome (mirrors lockcheck.Verdict)."""
+
+    violations: List[str]  # runtime contradicts the static claim
+    confirmations: List[str]  # both sides agree the attr races
+    coverage_gaps: List[str]  # statically racy, never driven shared
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+def verify_against_static(model=None, root=None,
+                          baseline_keys: Optional[Set[str]] = None
+                          ) -> Verdict:
+    """Cross-check observed write locksets against the static race
+    model (built from `root` when not given). Observed lock labels are
+    ``rel:line`` creation sites, mapped onto static lock nodes through
+    the SAME site map the lock-order rule exports — the two checkers
+    literally share their vocabulary."""
+    if model is None:
+        from pathlib import Path
+
+        from gethsharding_tpu.analysis.core import Corpus
+        from gethsharding_tpu.analysis.races import build_race_model
+
+        if root is None:
+            root = Path(__file__).resolve().parents[2]
+        model = build_race_model(Corpus.load(root))
+
+    def nodes_of(labels: Optional[FrozenSet[str]]) -> FrozenSet[str]:
+        if not labels:
+            return frozenset()
+        out = set()
+        for label in labels:
+            rel, _, line = label.rpartition(":")
+            try:
+                node = model.site_map.get((rel, int(line)))
+            except ValueError:
+                node = None
+            out.add(node if node is not None else label)
+        return frozenset(out)
+
+    baseline_keys = baseline_keys or set()
+    violations: List[str] = []
+    confirmations: List[str] = []
+    gaps: List[str] = []
+    observed = report()
+
+    for key, record in sorted(observed.items()):
+        verdict = model.verdict(key)
+        if verdict is None:
+            continue  # attr the static model does not track (dunder &c)
+        if not record.shared:
+            continue
+        runtime_nodes = nodes_of(record.lockset)
+        if verdict.classification == "guarded":
+            if not runtime_nodes:
+                violations.append(
+                    f"{key}: static model says guarded by "
+                    f"{{{', '.join(sorted(verdict.guards))}}} but a "
+                    f"shared write ran with NO lock held (first at "
+                    f"{record.first_shared_site}) — the model's "
+                    f"call-graph resolution over-promised or the code "
+                    f"races")
+            elif not runtime_nodes & verdict.guards:
+                violations.append(
+                    f"{key}: static guard "
+                    f"{{{', '.join(sorted(verdict.guards))}}} never in "
+                    f"the runtime lockset "
+                    f"{{{', '.join(sorted(runtime_nodes))}}} (first "
+                    f"shared write at {record.first_shared_site}) — "
+                    f"guarded by a DIFFERENT lock than modeled")
+        elif verdict.classification == "init-only":
+            violations.append(
+                f"{key}: static model says init-only but "
+                f"{len(record.writer_threads)} threads wrote it (first "
+                f"shared write at {record.first_shared_site}) — a "
+                f"post-publication write the model missed")
+        elif verdict.classification == "racy" and record.unguarded:
+            confirmations.append(
+                f"{key}: statically flagged AND observed unguarded-"
+                f"shared at runtime (first at {record.first_shared_site})"
+                + (" (baselined: justified)" if key in baseline_keys
+                   else " — fix or baseline it"))
+        # publication / atomic-type: shared unguarded writes are the
+        # modeled idiom; nothing to say
+
+    for key, verdict in sorted(model.attrs.items()):
+        if verdict.classification != "racy":
+            continue
+        record = observed.get(key)
+        if record is None or not record.shared:
+            gaps.append(
+                f"{key}: statically racy but never observed written "
+                f"from two threads this run — coverage gap, not "
+                f"exoneration"
+                + (" (baselined)" if key in baseline_keys else ""))
+    return Verdict(violations, confirmations, gaps)

@@ -1,0 +1,975 @@
+"""race-guard: Eraser-style lockset inference over the threaded planes.
+
+The reference geth client runs its notary/proposer goroutines under
+Go's race detector; this rule is the static half of our analogue. The
+lock-order rule (analysis/locks.py) catches locks nested in conflicting
+orders — but it is blind to shared mutable state guarded by NO lock at
+all, which is the dominant failure mode in the thread-heavy serving /
+fleet / resilience / slo / tracing / rpc planes (dispatcher threads,
+router health sweeps, watchdogs, SLO rings, RPC handler threads).
+
+The model, per the classic Eraser algorithm adapted to the repo's real
+idioms:
+
+- **Threaded classes.** A class is thread-shared when it owns a started
+  `threading.Thread`, allocates a lock (a class that buys a lock
+  declares itself shared), or is reachable from one — constructed or
+  held (typed attributes, container annotations, `__init__` parameter
+  annotations) by a threaded class, or constructed inside a function a
+  threaded class's methods call (the lifecycle.py escape-to-call
+  spirit: `slo.record()` runs on the flusher thread, so the tracker it
+  lazily builds is thread-shared).
+- **Locksets.** For every write to a `self._x`-style attribute of a
+  threaded class the rule computes the set of locks statically held at
+  the site: literal `with` nesting (reusing the lock-node identities of
+  analysis/locks.py, so the runtime sanitizer can cross-check against
+  the same site map) PLUS the method's guaranteed ENTRY lockset — the
+  intersection, over every resolved call site, of the locks held there
+  (a private helper only ever called under `self._lock` inherits the
+  guard; a fixpoint handles helper chains and recursion).
+- **Verdicts.** An attribute whose write-site lockset intersection is
+  empty is a race candidate — UNLESS it is init-only (written in
+  `__init__` / init-only helpers before the object is published),
+  an atomic-by-convention type (`threading.Event`, locks, queues,
+  `deque`, `threading.local`), or a pure snapshot publication (every
+  write is a plain rebind of a fresh value — the GIL makes a single
+  reference store atomic, and the repo's snapshot-swap idiom depends
+  on exactly that). Read-modify-writes (`+=`, rebinds reading the old
+  value), container mutation (`self._x[k] = v`, `.append()`, aliased
+  element pops) and check-then-act lazy initialization
+  (`if self._x is None: self._x = ...` with no lock) stay findings,
+  with the conflicting sites listed.
+
+Like every shardlint rule the graph under-approximates: unresolvable
+receivers are ignored, so "guarded" claims are only as strong as the
+call-graph resolution — which is why the runtime access sanitizer
+(analysis/racecheck.py, ``GETHSHARDING_RACECHECK=1``) records REAL
+per-thread write locksets and `verify_against_static` makes each side
+vouch for the other: a runtime-unguarded write the static map calls
+guarded is a violation; a statically-flagged attribute never observed
+written off-thread is an honest coverage gap.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from gethsharding_tpu.analysis.core import (
+    Corpus, Finding, SourceFile, dotted_name, rule)
+from gethsharding_tpu.analysis.locks import (
+    _class_name_of, collect_classes)
+
+RULE = "race-guard"
+
+# the thread-heavy subtrees findings are reported for (the whole corpus
+# still feeds threadedness and call resolution)
+DEFAULT_SCOPES = (
+    "gethsharding_tpu/serving/",
+    "gethsharding_tpu/fleet/",
+    "gethsharding_tpu/resilience/",
+    "gethsharding_tpu/slo/",
+    "gethsharding_tpu/tracing/",
+    "gethsharding_tpu/metrics.py",
+    "gethsharding_tpu/rpc/",
+)
+
+# atomic-by-convention constructor names: attributes holding these are
+# synchronization primitives or internally-synchronized containers, not
+# racy state (threading.*, queue.*, collections.deque)
+ATOMIC_CTORS = {
+    "Lock", "RLock", "Condition", "Event", "Semaphore",
+    "BoundedSemaphore", "Barrier", "local", "Thread",
+    "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue", "deque",
+}
+
+# receiver methods that mutate the receiver in place — a call
+# `self._x.append(...)` is a WRITE to _x's value, not a read
+MUTATING_METHODS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "remove",
+    "pop", "popleft", "popitem", "clear", "update", "setdefault", "add",
+    "discard", "sort", "reverse", "__setitem__",
+}
+
+# write kinds, in increasing "needs a lock" order
+KIND_REBIND = "rebind"      # self._x = fresh_value (atomic publication)
+KIND_LAZY = "lazy-init"     # rebind guarded by a test on the same attr
+KIND_RMW = "rmw"            # self._x += 1 / self._x = f(self._x)
+KIND_MUTATE = "mutate"      # self._x[k] = v / self._x.append(...)
+
+RACY_KINDS = (KIND_LAZY, KIND_RMW, KIND_MUTATE)
+
+
+@dataclass
+class Access:
+    """One attribute access site with its static lockset."""
+
+    rel: str
+    cls: str
+    attr: str
+    line: int
+    kind: str  # KIND_* for writes, "read" for reads
+    method: str  # method key "rel::Cls.m" the access occurs in
+    held: FrozenSet[str]  # literal lock nodes held at the site
+    init_phase: bool = False  # inside __init__ / init-only helpers
+
+    def site(self) -> str:
+        return f"{self.rel}:{self.line}"
+
+
+@dataclass
+class AttrVerdict:
+    """The per-attribute classification the cross-validator reads."""
+
+    key: str  # "rel::Cls.attr" — matches the runtime recorder's keys
+    classification: str  # guarded | init-only | atomic-type |
+    #                      publication | racy | unwritten
+    guards: FrozenSet[str] = frozenset()  # lock nodes, when guarded
+    writes: List[Access] = field(default_factory=list)
+    init_writes: List[Access] = field(default_factory=list)
+    reads: List[Access] = field(default_factory=list)
+    atomic_type: Optional[str] = None
+
+
+@dataclass
+class RaceModel:
+    """Everything the rule derived: per-attribute verdicts plus the
+    threadedness set (for non-vacuity checks) and the lock site map
+    (shared with the runtime sanitizer)."""
+
+    attrs: Dict[str, AttrVerdict] = field(default_factory=dict)
+    threaded: Set[Tuple[str, str]] = field(default_factory=set)
+    scoped_threaded: Set[Tuple[str, str]] = field(default_factory=set)
+    site_map: Dict[Tuple[str, int], str] = field(default_factory=dict)
+
+    def verdict(self, key: str) -> Optional[AttrVerdict]:
+        return self.attrs.get(key)
+
+
+# ---------------------------------------------------------------------------
+# type lattice helpers: (rel, ClassName) scalar types and container
+# element types, resolved through annotations
+# ---------------------------------------------------------------------------
+
+_CONTAINER_ANNOTATIONS = {"List", "list", "Sequence", "Tuple", "tuple",
+                          "Set", "set", "FrozenSet", "frozenset",
+                          "Iterable", "Deque", "deque"}
+_DICT_ANNOTATIONS = {"Dict", "dict", "Mapping", "MutableMapping",
+                     "OrderedDict", "DefaultDict", "defaultdict"}
+_PASSTHROUGH_ANNOTATIONS = {"Optional"}
+
+
+def _ann_strings(node: ast.AST) -> Optional[ast.AST]:
+    """Unquote string annotations: `x: "Replica"` -> a Name-ish str."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        try:
+            return ast.parse(node.value, mode="eval").body
+        except SyntaxError:
+            return None
+    return node
+
+
+def _resolve_class_name(name: str, sf: SourceFile, corpus: Corpus,
+                        local_classes: Set[str]) -> Optional[Tuple[str, str]]:
+    """Class name (possibly dotted) -> (rel, ClassName) in the corpus.
+
+    Unlike the lock model's `_class_name_of`, underscore-prefixed
+    helper classes (`_Series`, `_OpMetrics`) resolve too — they hold
+    exactly the per-thread state this rule exists to check."""
+    cls = name.rsplit(".", 1)[-1]
+    if cls in local_classes and "." not in name:
+        return (sf.rel, cls)
+    if not cls.lstrip("_")[:1].isupper():
+        return None
+    target = sf.imports.get(name.split(".", 1)[0])
+    if "." in name and target:
+        other = corpus.find_module(target)
+        if other is not None:
+            return (other.rel, cls)
+        return None
+    target = sf.imports.get(cls)
+    if target and "." in target:
+        mod, cname = target.rsplit(".", 1)
+        other = corpus.find_module(mod)
+        if other is not None and cname == cls:
+            return (other.rel, cls)
+    return None
+
+
+def _annotation_type(ann: Optional[ast.AST], sf: SourceFile, corpus: Corpus,
+                     local_classes: Set[str]):
+    """Annotation AST -> ('scalar'|'elem', (rel, cls)) or None.
+
+    `Replica` -> scalar; `List[Replica]` / `Dict[str, Replica]` /
+    `Optional[Replica]` (scalar) -> the element class; strings unquoted.
+    """
+    ann = _ann_strings(ann) if ann is not None else None
+    if ann is None:
+        return None
+    if isinstance(ann, ast.Subscript):
+        base = dotted_name(ann.value)
+        if not base:
+            return None
+        head = base.rsplit(".", 1)[-1]
+        inner = ann.slice
+        if head in _PASSTHROUGH_ANNOTATIONS:
+            return _annotation_type(inner, sf, corpus, local_classes)
+        if head in _DICT_ANNOTATIONS and isinstance(inner, ast.Tuple) \
+                and len(inner.elts) == 2:
+            hit = _annotation_type(inner.elts[1], sf, corpus, local_classes)
+            if hit is not None:
+                return ("elem", hit[1])
+            return None
+        if head in _CONTAINER_ANNOTATIONS:
+            if isinstance(inner, ast.Tuple):
+                inner = inner.elts[0] if inner.elts else None
+            hit = _annotation_type(inner, sf, corpus, local_classes) \
+                if inner is not None else None
+            if hit is not None:
+                return ("elem", hit[1])
+            return None
+        return None
+    name = dotted_name(ann)
+    if not name:
+        return None
+    hit = _resolve_class_name(name, sf, corpus, local_classes)
+    return ("scalar", hit) if hit is not None else None
+
+
+def _ctor_class(call: ast.Call, sf: SourceFile, corpus: Corpus, rel: str,
+                local_classes: Set[str]) -> Optional[Tuple[str, str]]:
+    """(rel, ClassName) when `call` constructs a corpus class —
+    `_class_name_of` plus underscore-prefixed local helper classes."""
+    name = dotted_name(call.func)
+    if name and "." not in name and name in local_classes:
+        return (rel, name)
+    hit = _class_name_of(call, sf, local_classes)
+    if hit is None:
+        return None
+    mod, cls = hit
+    if not mod:
+        return (rel, cls)
+    other = corpus.find_module(mod)
+    return (other.rel, cls) if other is not None else None
+
+
+def _atomic_ctor(node: ast.AST, sf: SourceFile) -> Optional[str]:
+    """'Event'/'Queue'/... when node constructs an atomic-by-convention
+    type from threading / queue / collections."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = dotted_name(node.func)
+    if not name:
+        return None
+    root, _, tail = name.rpartition(".")
+    if tail not in ATOMIC_CTORS:
+        return None
+    if root:
+        base = sf.imports.get(root.split(".", 1)[0], root).split(".", 1)[0]
+        return tail if base in ("threading", "queue", "collections") else None
+    target = sf.imports.get(tail, "")
+    return tail if target.split(".", 1)[0] in ("threading", "queue",
+                                               "collections") else None
+
+
+# ---------------------------------------------------------------------------
+# the model builder
+# ---------------------------------------------------------------------------
+
+def build_race_model(corpus: Corpus,
+                     scopes: Sequence[str] = DEFAULT_SCOPES) -> RaceModel:
+    classes, factory_returns, lock_model = collect_classes(corpus)
+    model = RaceModel(site_map=dict(lock_model.site_map))
+
+    def in_scope(rel: str) -> bool:
+        return any(rel == s or rel.startswith(s) for s in scopes)
+
+    # ---- enriched per-class typing tables ---------------------------------
+    # (rel, cls) -> attr -> ('scalar'|'elem', (rel, cls))
+    attr_typing: Dict[Tuple[str, str], Dict[str, Tuple[str, Tuple[str, str]]]]
+    attr_typing = {}
+    # (rel, cls) -> attr -> atomic ctor name
+    attr_atomic: Dict[Tuple[str, str], Dict[str, str]] = {}
+    # (rel, cls) -> method name -> (rel, cls) return type (annotation)
+    method_returns: Dict[Tuple[str, str], Dict[str, Tuple[str, str]]] = {}
+    # (rel, cls) -> classes constructed anywhere in its methods
+    constructs: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+
+    local_classes_of: Dict[str, Set[str]] = {}
+    for sf in corpus.files:
+        if sf.tree is not None:
+            local_classes_of[sf.rel] = {
+                n.name for n in sf.tree.body if isinstance(n, ast.ClassDef)}
+
+    # pass A: method return annotations for EVERY class first, so the
+    # attribute-typing pass can resolve annotated factory calls across
+    # classes (`self.g = registry.gauge(...)` with `Registry.gauge()
+    # -> Gauge` types the attribute no matter the collection order)
+    for (rel, cls_name), info in classes.items():
+        sf = corpus.get(rel)
+        if sf is None or sf.tree is None:
+            method_returns[(rel, cls_name)] = {}
+            continue
+        local_classes = local_classes_of.get(rel, set())
+        returns: Dict[str, Tuple[str, str]] = {}
+        for m_name, fn in info.methods.items():
+            ret = fn.returns
+            hit = _annotation_type(ret, sf, corpus, local_classes) \
+                if ret is not None else None
+            if hit is not None and hit[0] == "scalar":
+                returns[m_name] = hit[1]
+        method_returns[(rel, cls_name)] = returns
+
+    for (rel, cls_name), info in classes.items():
+        sf = corpus.get(rel)
+        if sf is None or sf.tree is None:
+            continue
+        local_classes = local_classes_of.get(rel, set())
+        typing: Dict[str, Tuple[str, Tuple[str, str]]] = {}
+        atomics: Dict[str, str] = {}
+        built: Set[Tuple[str, str]] = set()
+        # direct component types from the shared collector
+        for attr, (trel, tcls) in info.attr_types.items():
+            typing.setdefault(attr, ("scalar", (trel, tcls)))
+        for m_name, fn in info.methods.items():
+            # __init__ param annotations type the matching self.<x> = x
+            # (and list(x)/dict(x)/tuple(x)) stores
+            param_types: Dict[str, Tuple[str, Tuple[str, str]]] = {}
+            for arg in list(fn.args.args) + list(fn.args.kwonlyargs):
+                hit = _annotation_type(arg.annotation, sf, corpus,
+                                       local_classes) \
+                    if arg.annotation is not None else None
+                if hit is not None:
+                    param_types[arg.arg] = hit
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    hit2 = _ctor_class(node, sf, corpus, rel, local_classes)
+                    if hit2 is not None:
+                        built.add(hit2)
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                tgt = targets[0] if len(targets) == 1 else None
+                if not (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    continue
+                value = node.value
+                if value is None:
+                    continue
+                kind = _atomic_ctor(value, sf)
+                if kind is not None:
+                    atomics[tgt.attr] = kind
+                    continue
+                # self.x = <param> / list(<param>) / dict(<param>)
+                src = None
+                if isinstance(value, ast.Name):
+                    src = value.id
+                elif isinstance(value, ast.Call) and \
+                        isinstance(value.func, ast.Name) and \
+                        value.func.id in ("list", "dict", "tuple", "set") \
+                        and len(value.args) == 1 and \
+                        isinstance(value.args[0], ast.Name):
+                    src = value.args[0].id
+                if src is not None and src in param_types:
+                    typing.setdefault(tgt.attr, param_types[src])
+                    continue
+                # self.g = registry.gauge(...) — an annotated factory
+                # method on a typed parameter/component types the attr
+                if isinstance(value, ast.Call) and \
+                        isinstance(value.func, ast.Attribute):
+                    rname = dotted_name(value.func.value)
+                    rtype = None
+                    if rname and rname in param_types and \
+                            param_types[rname][0] == "scalar":
+                        rtype = param_types[rname][1]
+                    elif rname and rname.startswith("self."):
+                        own = typing.get(rname[5:])
+                        if own is not None and own[0] == "scalar":
+                            rtype = own[1]
+                    if rtype is not None:
+                        hit = method_returns.get(rtype, {}).get(
+                            value.func.attr)
+                        if hit is not None:
+                            typing.setdefault(tgt.attr, ("scalar", hit))
+                            continue
+                # self.x = {k: Cls(...) for ...} / [Cls(...) for ...]
+                elt = None
+                if isinstance(value, ast.DictComp):
+                    elt = value.value
+                elif isinstance(value, (ast.ListComp, ast.SetComp)):
+                    elt = value.elt
+                if isinstance(elt, ast.Call):
+                    hit2 = _ctor_class(elt, sf, corpus, rel, local_classes)
+                    if hit2 is not None:
+                        typing.setdefault(tgt.attr, ("elem", hit2))
+                # AnnAssign annotations (scalar or container)
+                if isinstance(node, ast.AnnAssign):
+                    hit = _annotation_type(node.annotation, sf, corpus,
+                                           local_classes)
+                    if hit is not None:
+                        typing.setdefault(tgt.attr, hit)
+        attr_typing[(rel, cls_name)] = typing
+        attr_atomic[(rel, cls_name)] = atomics
+        constructs[(rel, cls_name)] = built
+
+    # ---- threadedness -----------------------------------------------------
+    def _owns_thread(info) -> bool:
+        for fn in info.methods.values():
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    name = dotted_name(node.func)
+                    if name and name.rsplit(".", 1)[-1] == "Thread":
+                        sf = corpus.get(info.rel)
+                        root = name.rpartition(".")[0]
+                        if root:
+                            base = sf.imports.get(root.split(".", 1)[0],
+                                                  root)
+                            if base.split(".", 1)[0] == "threading":
+                                return True
+                        elif sf.imports.get("Thread",
+                                            "") == "threading.Thread":
+                            return True
+        return False
+
+    threaded: Set[Tuple[str, str]] = set()
+    for key, info in classes.items():
+        if info.name == "<module>":
+            continue
+        if _owns_thread(info):
+            threaded.add(key)
+        elif in_scope(info.rel) and info.lock_attrs:
+            # a scoped class that allocates a lock declares itself
+            # thread-shared — the lock IS the evidence
+            threaded.add(key)
+
+    # closure over held/constructed components and reachable calls:
+    # a threaded class's components are thread-shared; functions its
+    # methods call run on its threads, so classes built there are too
+    changed = True
+    reachable_scopes: Set[Tuple[str, str]] = set(threaded)
+    while changed:
+        changed = False
+        for key in list(reachable_scopes):
+            for attr, (_, tkey) in attr_typing.get(key, {}).items():
+                for target in (tkey,):
+                    if target in classes and target not in threaded:
+                        threaded.add(target)
+                        reachable_scopes.add(target)
+                        changed = True
+            for built in constructs.get(key, ()):
+                if built in classes and built not in threaded:
+                    threaded.add(built)
+                    reachable_scopes.add(built)
+                    changed = True
+
+    # module scopes whose functions threaded code calls (one hop through
+    # the import-alias tables — `slo.record(...)`, `tracing.span(...)`)
+    # contribute the classes they construct
+    module_hops: Set[str] = set()
+    for key in threaded:
+        info = classes.get(key)
+        sf = corpus.get(info.rel) if info else None
+        if info is None or sf is None:
+            continue
+        for fn in info.methods.values():
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        isinstance(node.func.value, ast.Name):
+                    module = sf.imports.get(node.func.value.id)
+                    if module:
+                        other = corpus.find_module(module)
+                        if other is not None:
+                            module_hops.add(other.rel)
+    for rel in module_hops:
+        for built in constructs.get((rel, "<module>"), ()):
+            if built in classes:
+                threaded.add(built)
+        # one re-export hop: gethsharding_tpu/slo/__init__.py pulls
+        # record()/tracker() from slo/tracker.py
+        sf = corpus.get(rel)
+        if sf is None:
+            continue
+        for target in set(sf.imports.values()):
+            mod = target.rsplit(".", 1)[0] if "." in target else target
+            other = corpus.find_module(mod)
+            if other is not None:
+                for built in constructs.get((other.rel, "<module>"), ()):
+                    if built in classes:
+                        threaded.add(built)
+    # classes constructed by threaded <module> functions' constructions
+    changed = True
+    while changed:
+        changed = False
+        for key in list(threaded):
+            for built in constructs.get(key, ()):
+                if built in classes and built not in threaded:
+                    threaded.add(built)
+                    changed = True
+            for attr, (_, tkey) in attr_typing.get(key, {}).items():
+                if tkey in classes and tkey not in threaded:
+                    threaded.add(tkey)
+                    changed = True
+
+    model.threaded = threaded
+    model.scoped_threaded = {k for k in threaded if in_scope(k[0])
+                             and classes[k].name != "<module>"}
+
+    # ---- access + call extraction over the scoped classes -----------------
+    accesses: List[Access] = []
+    # method key -> [(caller key, frozen held at site)]
+    call_sites: Dict[str, List[Tuple[str, FrozenSet[str]]]] = {}
+    method_keys: Set[str] = set()
+
+    def mkey(rel: str, cls: str, m: str) -> str:
+        return f"{rel}::{cls}.{m}"
+
+    for (rel, cls_name), info in sorted(classes.items()):
+        if not in_scope(rel) or info.name == "<module>":
+            continue
+        sf = corpus.get(rel)
+        if sf is None or sf.tree is None:
+            continue
+        local_classes = local_classes_of.get(rel, set())
+        mod_info = classes.get((rel, "<module>"))
+        typing = attr_typing.get((rel, cls_name), {})
+        returns = method_returns.get((rel, cls_name), {})
+
+        for m_name, fn in sorted(info.methods.items()):
+            key = mkey(rel, cls_name, m_name)
+            method_keys.add(key)
+            # local name -> ('scalar'|'elem', (rel, cls)); parameter
+            # annotations seed it (`def _burns(self, series: _Series)`)
+            local_types: Dict[str, Tuple[str, Tuple[str, str]]] = {}
+            for arg in list(fn.args.args) + list(fn.args.kwonlyargs):
+                if arg.annotation is not None:
+                    hit = _annotation_type(arg.annotation, sf, corpus,
+                                           local_classes)
+                    if hit is not None:
+                        local_types[arg.arg] = hit
+            # local name -> (cls_key, attr) alias of a self container
+            local_alias: Dict[str, Tuple[Tuple[str, str], str]] = {}
+
+            def typed(expr) -> Optional[Tuple[str, str]]:
+                """Scalar class of an expression, best effort."""
+                name = dotted_name(expr)
+                if name:
+                    if name == "self":
+                        return (rel, cls_name)
+                    if name.startswith("self."):
+                        hit = typing.get(name[5:])
+                        if hit is not None and hit[0] == "scalar":
+                            return hit[1]
+                        return None
+                    root = name.split(".", 1)[0]
+                    hit = local_types.get(root)
+                    if hit is not None and "." not in name:
+                        return hit[1] if hit[0] == "scalar" else None
+                    return None
+                if isinstance(expr, ast.Subscript):
+                    base = dotted_name(expr.value)
+                    if base and base.startswith("self."):
+                        hit = typing.get(base[5:])
+                        if hit is not None and hit[0] == "elem":
+                            return hit[1]
+                    elif base and base in local_types:
+                        hit = local_types[base]
+                        if hit[0] == "elem":
+                            return hit[1]
+                    return None
+                if isinstance(expr, ast.Call):
+                    func = expr.func
+                    if isinstance(func, ast.Attribute):
+                        recv_base = dotted_name(func.value)
+                        # self._series.get(name) -> element type
+                        if func.attr == "get" and recv_base:
+                            if recv_base.startswith("self."):
+                                hit = typing.get(recv_base[5:])
+                                if hit is not None and hit[0] == "elem":
+                                    return hit[1]
+                            elif recv_base in local_types:
+                                hit = local_types[recv_base]
+                                if hit[0] == "elem":
+                                    return hit[1]
+                        # self._replica(name) -> Replica (annotation)
+                        if isinstance(func.value, ast.Name) and \
+                                func.value.id == "self":
+                            hit = returns.get(func.attr)
+                            if hit is not None:
+                                return hit
+                        # typed_receiver.m() -> m's return annotation
+                        owner = typed(func.value)
+                        if owner is not None:
+                            hit = method_returns.get(owner, {}) \
+                                .get(func.attr)
+                            if hit is not None:
+                                return hit
+                    elif isinstance(func, ast.Name):
+                        hit2 = _ctor_class(expr, sf, corpus, rel,
+                                           local_classes)
+                        if hit2 is not None:
+                            return hit2
+                        target = sf.imports.get(func.id)
+                        if target and "." in target:
+                            mod, f_name = target.rsplit(".", 1)
+                            other = corpus.find_module(mod)
+                            if other is not None:
+                                # module-level factory annotation
+                                fr = factory_returns.get(
+                                    (other.rel, f_name))
+                                if fr:
+                                    return (other.rel, fr)
+                return None
+
+            def lock_of(expr) -> Optional[str]:
+                name = dotted_name(expr)
+                if not name:
+                    return None
+                if name.startswith("self."):
+                    return info.lock_attrs.get(name[5:])
+                if "." in name:
+                    root, attr = name.split(".", 1)
+                    if "." in attr:
+                        return None
+                    hit = local_types.get(root)
+                    if hit is not None and hit[0] == "scalar" and \
+                            hit[1] in classes:
+                        return classes[hit[1]].lock_attrs.get(attr)
+                    return None
+                if mod_info is not None:
+                    return mod_info.lock_attrs.get(name)
+                return None
+
+            def attr_target(expr) -> Optional[Tuple[Tuple[str, str], str]]:
+                """((rel, cls), attr) written when `expr` is the
+                assignment target root: self.x, typed_local.x,
+                self._replica(n).x, alias[k]-style roots."""
+                if not isinstance(expr, ast.Attribute):
+                    return None
+                base = expr.value
+                bname = dotted_name(base)
+                if bname == "self":
+                    return ((rel, cls_name), expr.attr)
+                owner = typed(base)
+                if owner is not None and owner in classes:
+                    return (owner, expr.attr)
+                return None
+
+            def root_attr(expr) -> Optional[Tuple[Tuple[str, str], str]]:
+                """The (class, attr) whose VALUE a subscript/mutating
+                call touches: `self._x[k]`, `alias.pop()` where alias
+                came from `self._x[...]` or `self._x`."""
+                if isinstance(expr, ast.Subscript):
+                    return root_attr(expr.value)
+                if isinstance(expr, ast.Attribute):
+                    hit = attr_target(expr)
+                    return hit
+                if isinstance(expr, ast.Name):
+                    return local_alias.get(expr.id)
+                return None
+
+            def rhs_reads(value: ast.AST, target: Tuple) -> bool:
+                for node in ast.walk(value):
+                    if isinstance(node, ast.Attribute):
+                        if attr_target(node) == target:
+                            return True
+                return False
+
+            init_phase = m_name == "__init__"
+
+            def record_write(target, line, kind, held):
+                (trel, tcls), attr = target
+                accesses.append(Access(trel, tcls, attr, line, kind,
+                                       key, frozenset(held),
+                                       init_phase=init_phase))
+
+            def resolve_call(call: ast.Call) -> List[str]:
+                func = call.func
+                if isinstance(func, ast.Attribute):
+                    base = func.value
+                    if isinstance(base, ast.Name) and base.id == "self":
+                        if func.attr in info.methods:
+                            return [mkey(rel, cls_name, func.attr)]
+                        return []
+                    owner = typed(base)
+                    if owner is not None and owner in classes and \
+                            func.attr in classes[owner].methods:
+                        return [mkey(owner[0], owner[1], func.attr)]
+                    return []
+                if isinstance(func, ast.Name):
+                    if mod_info is not None and \
+                            func.id in mod_info.methods:
+                        return [mkey(rel, "<module>", func.id)]
+                return []
+
+            def visit(node: ast.AST, held: Tuple[str, ...],
+                      guards: FrozenSet[Tuple] = frozenset()):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                        and node is not fn:
+                    # nested def runs later, on an unknown thread with
+                    # no locks held
+                    for child in ast.iter_child_nodes(node):
+                        visit(child, (), frozenset())
+                    return
+                if isinstance(node, ast.With):
+                    acquired = []
+                    for item in node.items:
+                        ln = lock_of(item.context_expr)
+                        if ln is not None:
+                            acquired.append(ln)
+                    inner = held + tuple(a for a in acquired
+                                         if a not in held)
+                    for child in node.body:
+                        visit(child, inner, guards)
+                    return
+                if isinstance(node, ast.For):
+                    # `for replica in self.replicas:` /
+                    # `for s in self._series.values():` /
+                    # `for k, s in self._series.items():` type the loop
+                    # variable from the container's element type
+                    src = node.iter
+                    values = items = False
+                    if isinstance(src, ast.Call) and \
+                            isinstance(src.func, ast.Attribute) and \
+                            src.func.attr in ("values", "items"):
+                        values = src.func.attr == "values"
+                        items = src.func.attr == "items"
+                        src = src.func.value
+                    elem = None
+                    sname = dotted_name(src)
+                    if sname and sname.startswith("self."):
+                        hit = typing.get(sname[5:])
+                        if hit is not None and hit[0] == "elem":
+                            elem = hit[1]
+                    elif sname and sname in local_types:
+                        hit = local_types[sname]
+                        if hit is not None and hit[0] == "elem":
+                            elem = hit[1]
+                    if elem is not None:
+                        tgt = node.target
+                        if items and isinstance(tgt, ast.Tuple) and \
+                                len(tgt.elts) == 2 and \
+                                isinstance(tgt.elts[1], ast.Name):
+                            local_types[tgt.elts[1].id] = ("scalar", elem)
+                        elif (values or not items) and \
+                                isinstance(tgt, ast.Name):
+                            local_types[tgt.id] = ("scalar", elem)
+                if isinstance(node, ast.If):
+                    # track which attrs the test reads so a rebind in
+                    # the body can be classified check-then-act
+                    read_targets = set()
+                    for sub in ast.walk(node.test):
+                        if isinstance(sub, ast.Attribute):
+                            hit = attr_target(sub)
+                            if hit is not None:
+                                read_targets.add(hit)
+                    visit(node.test, held, guards)
+                    for child in node.body:
+                        visit(child, held, guards | read_targets)
+                    for child in node.orelse:
+                        visit(child, held, guards)
+                    return
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    tgt = node.targets[0]
+                    # local typing/aliasing
+                    if isinstance(tgt, ast.Name):
+                        src = dotted_name(node.value)
+                        hit = None
+                        if isinstance(node.value, (ast.Call,
+                                                   ast.Subscript)):
+                            t = typed(node.value)
+                            if t is not None:
+                                hit = ("scalar", t)
+                        if hit is None and src and src.startswith("self."):
+                            t = typing.get(src[5:])
+                            if t is not None:
+                                hit = t
+                            alias = ((rel, cls_name), src[5:])
+                            local_alias[tgt.id] = alias
+                        if hit is None and isinstance(node.value,
+                                                      ast.Subscript):
+                            base = dotted_name(node.value.value)
+                            if base and base.startswith("self."):
+                                local_alias[tgt.id] = ((rel, cls_name),
+                                                       base[5:])
+                        if hit is not None:
+                            local_types[tgt.id] = hit
+                if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for tgt in targets:
+                        hit = attr_target(tgt) \
+                            if isinstance(tgt, ast.Attribute) else None
+                        if hit is not None and node.value is not None:
+                            if hit in guards or (
+                                    rhs_reads(node.value, hit)):
+                                kind = KIND_LAZY if hit in guards \
+                                    else KIND_RMW
+                            else:
+                                kind = KIND_REBIND
+                            record_write(hit, tgt.lineno, kind, held)
+                        elif isinstance(tgt, ast.Subscript):
+                            hit = root_attr(tgt)
+                            if hit is not None:
+                                record_write(hit, tgt.lineno,
+                                             KIND_MUTATE, held)
+                elif isinstance(node, ast.AugAssign):
+                    tgt = node.target
+                    hit = attr_target(tgt) \
+                        if isinstance(tgt, ast.Attribute) else None
+                    if hit is not None:
+                        record_write(hit, tgt.lineno, KIND_RMW, held)
+                    elif isinstance(tgt, ast.Subscript):
+                        hit = root_attr(tgt)
+                        if hit is not None:
+                            record_write(hit, tgt.lineno, KIND_MUTATE,
+                                         held)
+                elif isinstance(node, ast.Delete):
+                    for tgt in node.targets:
+                        hit = root_attr(tgt)
+                        if hit is not None:
+                            record_write(hit, node.lineno, KIND_MUTATE,
+                                         held)
+                elif isinstance(node, ast.Call):
+                    if isinstance(node.func, ast.Attribute) and \
+                            node.func.attr in MUTATING_METHODS:
+                        hit = root_attr(node.func.value)
+                        if hit is not None:
+                            record_write(hit, node.lineno, KIND_MUTATE,
+                                         held)
+                    for callee in resolve_call(node):
+                        call_sites.setdefault(callee, []).append(
+                            (key, frozenset(held)))
+                elif isinstance(node, ast.Attribute) and \
+                        isinstance(node.ctx, ast.Load):
+                    hit = attr_target(node)
+                    if hit is not None:
+                        (trel, tcls), attr = hit
+                        accesses.append(Access(
+                            trel, tcls, attr, node.lineno, "read", key,
+                            frozenset(held), init_phase=init_phase))
+                for child in ast.iter_child_nodes(node):
+                    visit(child, held, guards)
+
+            for stmt in fn.body:
+                visit(stmt, ())
+
+    # ---- entry-lockset fixpoint ------------------------------------------
+    # entry[m] = ⋂ over resolved call sites of (held ∪ entry[caller]);
+    # no known callers (public surface, thread targets) -> ∅. Optimistic
+    # init (TOP = None), refined downward; a cycle that never gets
+    # outside information collapses to ∅ at the end (conservative: no
+    # guaranteed locks -> more findings, never a false "guarded").
+    entry: Dict[str, Optional[FrozenSet[str]]] = {}
+    for m in method_keys:
+        entry[m] = None if m in call_sites else frozenset()
+    changed = True
+    while changed:
+        changed = False
+        for callee, sites in call_sites.items():
+            if callee not in entry:
+                continue
+            new: Optional[FrozenSet[str]] = None
+            for caller, held in sites:
+                ce = entry.get(caller)
+                if ce is None:
+                    if caller in entry:
+                        continue  # TOP caller: no constraint yet
+                    ce = frozenset()
+                site_set = held | ce
+                new = site_set if new is None else (new & site_set)
+            if new is not None and new != entry[callee]:
+                if entry[callee] is None or not new >= entry[callee]:
+                    entry[callee] = new if entry[callee] is None \
+                        else (entry[callee] & new)
+                    changed = True
+    for m, e in entry.items():
+        if e is None:
+            entry[m] = frozenset()
+
+    # init-only helpers: methods whose every resolved call site is the
+    # class's own __init__ — their writes are init-phase
+    init_only_methods: Set[str] = set()
+    for m, sites in call_sites.items():
+        if m in method_keys and sites and all(
+                caller.endswith(".__init__") and
+                caller.rsplit("::", 1)[0] == m.rsplit("::", 1)[0] and
+                caller.rsplit(".", 1)[0] == m.rsplit(".", 1)[0]
+                for caller, _ in sites):
+            init_only_methods.add(m)
+
+    # ---- classify ---------------------------------------------------------
+    by_attr: Dict[str, List[Access]] = {}
+    for acc in accesses:
+        cls_key = (acc.rel, acc.cls)
+        if cls_key not in model.scoped_threaded:
+            continue
+        by_attr.setdefault(f"{acc.rel}::{acc.cls}.{acc.attr}",
+                           []).append(acc)
+
+    for key, accs in sorted(by_attr.items()):
+        rel, tail = key.split("::", 1)
+        cls_name, attr = tail.rsplit(".", 1)
+        atomic = attr_atomic.get((rel, cls_name), {}).get(attr)
+        writes = [a for a in accs if a.kind != "read"]
+        reads = [a for a in accs if a.kind == "read"]
+        init_writes = [a for a in writes
+                       if a.init_phase or a.method in init_only_methods]
+        live_writes = [a for a in writes if a not in init_writes]
+        verdict = AttrVerdict(key, "unwritten", writes=live_writes,
+                              init_writes=init_writes, reads=reads,
+                              atomic_type=atomic)
+        if atomic is not None:
+            verdict.classification = "atomic-type"
+        elif not live_writes:
+            verdict.classification = "init-only" if writes else "unwritten"
+        else:
+            locksets = [a.held | entry.get(a.method, frozenset())
+                        for a in live_writes]
+            inter = frozenset.intersection(*[frozenset(s)
+                                             for s in locksets])
+            if inter:
+                verdict.classification = "guarded"
+                verdict.guards = inter
+            elif all(a.kind == KIND_REBIND for a in live_writes):
+                verdict.classification = "publication"
+            else:
+                verdict.classification = "racy"
+        model.attrs[key] = verdict
+    return model
+
+
+@rule(RULE, "shared attributes of threaded classes have a consistent "
+            "non-empty write lockset (Eraser-style), modulo init-only / "
+            "snapshot-publication / atomic-type idioms")
+def check(corpus: Corpus) -> List[Finding]:
+    model = build_race_model(corpus)
+    findings: List[Finding] = []
+    for key, verdict in sorted(model.attrs.items()):
+        if verdict.classification != "racy":
+            continue
+        rel, tail = key.split("::", 1)
+        racy = [a for a in verdict.writes if a.kind in RACY_KINDS]
+        shown = racy or verdict.writes
+        sites = ", ".join(
+            f"{a.site()} ({a.kind}, locks={{{', '.join(sorted(a.held)) or ''}}})"
+            for a in shown[:4])
+        read_hint = ""
+        cross_reads = [a for a in verdict.reads
+                       if a.method not in {w.method
+                                           for w in verdict.writes}]
+        if cross_reads:
+            read_hint = (f"; also read at "
+                         f"{cross_reads[0].site()} in another method")
+        findings.append(Finding(
+            RULE, rel, shown[0].line,
+            f"`{tail}` is written with an EMPTY lockset intersection "
+            f"from a thread-shared class: {sites}{read_hint} — "
+            f"unsynchronized read-modify-write/mutation races under "
+            f"concurrent threads",
+            tail))
+    return findings

@@ -409,6 +409,15 @@ class InfluxLineExporter:
         self.namespace = namespace
         self.pushes = 0
         self._sock = None
+        # push() runs on the reporter thread AND on stop()'s final
+        # flush (whose join is bounded and may time out with the
+        # reporter mid-push): the socket lazy-init and the pushes
+        # counter need a real guard, not a single-writer convention.
+        # _closed (set under the same lock after the final flush)
+        # stops a timed-out straggler reporter from lazily RE-creating
+        # the socket stop() just closed and leaking it.
+        self._closed = False
+        self._push_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -434,16 +443,20 @@ class InfluxLineExporter:
         payload = self.encode_snapshot()
         if not payload:
             return
-        if self.path is not None:
-            with open(self.path, "ab") as fh:
-                fh.write(payload)
-        else:
-            import socket
+        with self._push_lock:
+            if self._closed:
+                return  # stop() already final-flushed and closed
+            if self.path is not None:
+                with open(self.path, "ab") as fh:
+                    fh.write(payload)
+            else:
+                import socket
 
-            if self._sock is None:
-                self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-            self._sock.sendto(payload, self.udp)
-        self.pushes += 1
+                if self._sock is None:
+                    self._sock = socket.socket(socket.AF_INET,
+                                               socket.SOCK_DGRAM)
+                self._sock.sendto(payload, self.udp)
+            self.pushes += 1
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._run, daemon=True,
@@ -458,9 +471,11 @@ class InfluxLineExporter:
             self.push()  # final flush
         except OSError:
             pass
-        if self._sock is not None:
-            self._sock.close()
-            self._sock = None
+        with self._push_lock:
+            self._closed = True
+            if self._sock is not None:
+                self._sock.close()
+                self._sock = None
 
     def _run(self) -> None:
         while not self._stop.wait(self.interval):

@@ -137,19 +137,25 @@ class RPCClient:
             return slot.get("result")
 
     def subscribe_heads(self, callback: Callable) -> Callable[[], None]:
-        self._head_subscribers.append(callback)
+        # registration is caller-thread territory while the dispatcher
+        # iterates a snapshot copy: the list mutations take the pending
+        # lock so concurrent subscribe/unsubscribe can't lose entries
+        with self._pending_lock:
+            self._head_subscribers.append(callback)
         self.call("shard_subscribe", "newHeads")
 
         def unsubscribe() -> None:
-            if callback in self._head_subscribers:
-                self._head_subscribers.remove(callback)
+            with self._pending_lock:
+                if callback in self._head_subscribers:
+                    self._head_subscribers.remove(callback)
 
         return unsubscribe
 
     def on_notification(self, method: str, callback: Callable) -> None:
         """Route push notifications with the given method (e.g. the
         shard_p2p relay) to `callback(params)` off the reader thread."""
-        self._notification_hooks[method] = callback
+        with self._pending_lock:
+            self._notification_hooks[method] = callback
 
     def _read_loop(self) -> None:
         try:

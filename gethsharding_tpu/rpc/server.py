@@ -119,9 +119,16 @@ class RPCServer:
         self._tcp.server_close()
         if self._thread is not None:
             self._thread.join(timeout=2.0)
-        if self._sig_serving is not None and self._sig_serving_owned:
-            self._sig_serving.close()
-            self._sig_serving = None
+        # detach under the same lock `_serving()` builds under — a
+        # handler still lazily building the tier must never race the
+        # teardown's write; close() runs outside the lock (it joins
+        # serving threads and must not hold the server's lock doing it)
+        with self._sub_lock:
+            serving = self._sig_serving if self._sig_serving_owned else None
+            if serving is not None:
+                self._sig_serving = None
+        if serving is not None:
+            serving.close()
 
     def drain(self) -> dict:
         """Router/operator-initiated drain: refuse new verification
@@ -715,7 +722,10 @@ class RPCServer:
             return dict(self.method_calls)
 
     def rpc_p2pSend(self, from_id, to_id, kind, payload):
-        self.p2p_relayed_sends += 1
+        # handler threads are concurrent: the relayed-sends count is a
+        # read-modify-write and takes the same lock as the peer tables
+        with self._sub_lock:
+            self.p2p_relayed_sends += 1
         return self._p2p_push(to_id,
                               self._p2p_note(to_id, from_id, kind, payload))
 

@@ -140,9 +140,12 @@ class MicroBatcher:
         self.flush_us = flush_us
         self.queue_cap = queue_cap
         self.policy = policy
-        # per-op dispatch counts mutated only on the dispatch thread;
-        # tests read them after joining traffic
+        # per-op dispatch counts; "only the dispatch thread writes"
+        # stopped being true the day the watchdog grew fail_current —
+        # a superseded dispatch thread finishing its device call can
+        # overlap the fresh thread's next batch, so the += takes a lock
         self.dispatch_counts: Dict[str, int] = {op: 0 for op in SERVING_OPS}
+        self._counts_lock = threading.Lock()
         self._metrics = {op: _OpMetrics(registry, _OP_LABELS[op])
                          for op in SERVING_OPS}
         self._queues = {
@@ -314,7 +317,8 @@ class MicroBatcher:
                                                  error=repr(exc))
             self._fail_batch(batch, exc)
             return
-        self.dispatch_counts[op] += 1
+        with self._counts_lock:
+            self.dispatch_counts[op] += 1
         met.dispatches.inc()
         t_done = time.monotonic()
         if traced:

@@ -219,7 +219,11 @@ class SLOTracker:
         self.objectives = dict(objectives or default_objectives())
         self._series = {name: _Series(obj, n, registry)
                         for name, obj in self.objectives.items()}
+        # breach hooks register from whatever thread boots a subsystem
+        # while recorder threads iterate a snapshot: the append needs a
+        # guard (list() copies on the read side stay lock-free)
         self._hooks: List[Callable] = []
+        self._hooks_lock = threading.Lock()
 
     # -- event intake (the hot path) ---------------------------------------
 
@@ -243,14 +247,19 @@ class SLOTracker:
                 series.bad[idx] += 1
             else:
                 series.good[idx] += 1
+            # gauge refresh is throttled to ~1/s per objective: O(ring)
+            # work stays off the per-request path at high rates while
+            # the exposition never lags a live incident by more than a
+            # second. Claiming the refresh slot is a check-then-act on
+            # last_gauge, so it happens under the ring lock — exactly
+            # one of N concurrent recorders wins the refresh.
+            refresh = now - series.last_gauge >= 1.0
+            if refresh:
+                series.last_gauge = now
         (series.m_bad if bad else series.m_good).inc()
         if latency_s is not None:
             series.latency.observe(latency_s)
-        # gauge refresh is throttled to ~1/s per objective: O(ring)
-        # work stays off the per-request path at high rates while the
-        # exposition never lags a live incident by more than a second
-        if now - series.last_gauge >= 1.0:
-            series.last_gauge = now
+        if refresh:
             self._refresh(series, now)
 
     # -- window math --------------------------------------------------------
@@ -290,27 +299,35 @@ class SLOTracker:
         series.g_slow.set(round(slow, 4))
         series.g_budget.set(round(max(0.0, 1.0 - slow), 4))
         name = series.objective.name
-        if (fast >= self.breach_fast and slow >= self.breach_slow
-                and fast_n >= self.min_events):
-            if not series.breached:
-                series.breached = True
-                series.m_breaches.inc()
-                log.warning(
-                    "SLO breach on %s: fast burn %.1fx budget "
-                    "(threshold %.1fx), slow burn %.1fx (threshold "
-                    "%.1fx) over %d/%d events", name, fast,
-                    self.breach_fast, slow, self.breach_slow,
-                    fast_n, slow_n)
-                for hook in list(self._hooks):
-                    try:
-                        hook(name, fast, slow)
-                    except Exception:  # noqa: BLE001 - hook owns it
-                        log.exception("SLO breach hook failed")
-        elif fast < self.breach_fast / 2:
-            # hysteresis: re-arm only once the fast burn halves, so a
-            # burn hovering at the threshold logs one breach, not one
-            # per gauge refresh
-            series.breached = False
+        # the breached flag is a check-then-act shared by every
+        # recorder thread that wins a refresh slot plus the sweep: the
+        # flip happens under the ring lock (taken AFTER _burns released
+        # it) so breach onset fires the counter and hooks exactly once
+        fire = False
+        with series.lock:
+            if (fast >= self.breach_fast and slow >= self.breach_slow
+                    and fast_n >= self.min_events):
+                if not series.breached:
+                    series.breached = True
+                    fire = True
+            elif fast < self.breach_fast / 2:
+                # hysteresis: re-arm only once the fast burn halves, so
+                # a burn hovering at the threshold logs one breach, not
+                # one per gauge refresh
+                series.breached = False
+        if fire:
+            series.m_breaches.inc()
+            log.warning(
+                "SLO breach on %s: fast burn %.1fx budget "
+                "(threshold %.1fx), slow burn %.1fx (threshold "
+                "%.1fx) over %d/%d events", name, fast,
+                self.breach_fast, slow, self.breach_slow,
+                fast_n, slow_n)
+            for hook in list(self._hooks):
+                try:
+                    hook(name, fast, slow)
+                except Exception:  # noqa: BLE001 - hook owns it
+                    log.exception("SLO breach hook failed")
 
     def sweep(self, now: Optional[float] = None) -> None:
         """Recompute every objective's gauges now (the router's health
@@ -319,13 +336,15 @@ class SLOTracker:
         value)."""
         now = time.monotonic() if now is None else now
         for series in self._series.values():
-            series.last_gauge = now
+            with series.lock:
+                series.last_gauge = now
             self._refresh(series, now)
 
     def on_breach(self, hook: Callable[[str, float, float], None]) -> None:
         """Register ``hook(objective_name, fast_burn, slow_burn)`` —
         fired once per breach onset (hysteresis-gated)."""
-        self._hooks.append(hook)
+        with self._hooks_lock:
+            self._hooks.append(hook)
 
     # -- introspection ------------------------------------------------------
 

@@ -367,19 +367,27 @@ fi
 rm -rf "$obsfleet_dir"
 
 # -- shardlint: the repo-wide static analysis gate (jit-purity,
-# host-sync, lock-order, backend-contract, thread-lifecycle, flag-doc,
-# export-completeness) — fails on any finding outside the committed
-# baseline (gethsharding_tpu/analysis/baseline.json)
+# host-sync, lock-order, race-guard, layering, backend-contract,
+# thread-lifecycle, flag-doc, export-completeness) — fails on any
+# finding outside the committed baseline
+# (gethsharding_tpu/analysis/baseline.json)
 echo "== shardlint (static analysis gate)"
 JAX_PLATFORMS=cpu python -m gethsharding_tpu.analysis || fail=1
 
-# -- lockcheck smoke: the concurrency-heavy suites run ONCE with the
-# runtime lock-order recorder patched in (GETHSHARDING_LOCKCHECK=1);
-# conftest's session gate fails the run on any observed AB/BA
-# inversion or an order that contradicts the static lock graph —
-# the runtime validation of the lock-order rule's model
-echo "== lockcheck smoke (fleet/serving/concurrency under the recorder)"
-GETHSHARDING_LOCKCHECK=1 JAX_PLATFORMS=cpu python -m pytest \
+# -- lockcheck + racecheck smoke: the concurrency-heavy suites run
+# ONCE with BOTH runtime recorders patched in (one run on purpose:
+# GETHSHARDING_RACECHECK requires the lock recorder anyway, so both
+# session gates fire — re-running the suites under LOCKCHECK alone
+# would duplicate ~26 s for no extra coverage). The lockcheck gate
+# fails the run on any observed AB/BA inversion or an order that
+# contradicts the static lock graph; the racecheck gate fails it on
+# any runtime write lockset that CONTRADICTS the static race-guard
+# model (a "guarded" attr written shared with no lock, an "init-only"
+# attr written from two threads) and prints the honest coverage gaps —
+# statically-flagged attrs this run never drove shared.
+echo "== lockcheck+racecheck smoke (fleet/serving/concurrency under both recorders)"
+GETHSHARDING_LOCKCHECK=1 GETHSHARDING_RACECHECK=1 JAX_PLATFORMS=cpu \
+    python -m pytest \
     tests/test_concurrency.py tests/test_serving.py tests/test_fleet.py \
     -q --no-header -m 'not slow' || fail=1
 

@@ -7,12 +7,27 @@ in `gethsharding_tpu.parallel.virtual` (shared with the dryrun entry) and
 must run before any backend init, hence at conftest import time.
 """
 
+import os as _os
 import sys
 from pathlib import Path
 
 import pytest
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+# The lock recorder must patch threading BEFORE any package module is
+# imported: module-level singletons (metrics.DEFAULT_REGISTRY, the
+# tracer) allocate their locks at import time, and a lock created
+# before the patch is real, unlabeled and invisible — every write it
+# guards would look lockless to the race sanitizer and the session
+# gate would report false violations against the static model.
+# analysis/lockcheck imports nothing from the runtime packages, so
+# this is safe ahead of the virtual-device forcing below.
+if _os.environ.get("GETHSHARDING_LOCKCHECK") == "1" or \
+        _os.environ.get("GETHSHARDING_RACECHECK") == "1":
+    from gethsharding_tpu.analysis import lockcheck as _lockcheck_early
+
+    _lockcheck_early.install()
 
 from gethsharding_tpu.parallel.virtual import force_virtual_cpu_devices
 
@@ -31,8 +46,6 @@ force_virtual_cpu_devices(8)
 # green AND takes cache hits. GETHSHARDING_CACHE_OFF=1 disables the
 # cache for debugging; `scripts/run_suite.sh` (one process per file)
 # remains an equivalent, maximally isolated entry.
-import os as _os
-
 import gc as _gc
 
 from gethsharding_tpu.parallel.virtual import configure_compile_cache
@@ -50,7 +63,21 @@ if _os.environ.get("GETHSHARDING_CACHE_OFF") == "1":
 if _os.environ.get("GETHSHARDING_LOCKCHECK") == "1":
     from gethsharding_tpu.analysis import lockcheck as _lockcheck
 
-    _lockcheck.install()
+    _lockcheck.install()  # idempotent: the early install above won
+
+# GETHSHARDING_RACECHECK=1: instrument attribute writes on the
+# registered component classes (analysis/racecheck.py) with the runtime
+# access sanitizer — per-(instance, attr) Eraser lockset tracking over
+# real threads. The session gate below cross-validates the observed
+# write locksets against the static race-guard model: a shared write
+# the static map calls guarded running with no lock is a violation;
+# statically-flagged attrs the tests never drove shared are printed as
+# honest coverage gaps. Installing implies the lock recorder (the
+# sanitizer reads per-thread held locks from it).
+if _os.environ.get("GETHSHARDING_RACECHECK") == "1":
+    from gethsharding_tpu.analysis import racecheck as _racecheck
+
+    _racecheck.install()
 
 
 @pytest.fixture(scope="session", autouse=True)
@@ -76,6 +103,49 @@ def _lockcheck_gate():
         + "\n".join(f"  {v}" for v in verdict.static_violations))
     if verdict.coverage_gaps:  # informational: model under-approximates
         print("\nlockcheck coverage gaps (observed, not in static graph):")
+        for gap in verdict.coverage_gaps:
+            print(f"  {gap}")
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _racecheck_gate():
+    yield
+    import json as _json
+
+    from gethsharding_tpu.analysis import racecheck
+
+    if not racecheck.active():
+        return
+    baseline_path = (Path(__file__).resolve().parents[1]
+                     / "gethsharding_tpu/analysis/baseline.json")
+    baselined = set()
+    if baseline_path.is_file():
+        data = _json.loads(baseline_path.read_text())
+        baselined = {key.split("::", 1)[1]
+                     for key in data.get("findings", {})
+                     if key.startswith("race-guard::")}
+    verdict = racecheck.verify_against_static(baseline_keys=baselined)
+    stats = racecheck.stats()
+    print(f"\nracecheck: {stats['writes_seen']} write(s) on "
+          f"{stats['attrs_written']} attr(s) across "
+          f"{stats['classes_instrumented']} instrumented class(es); "
+          f"{stats['shared_attrs']} shared, "
+          f"{stats['unguarded_shared']} unguarded-shared, "
+          f"{len(verdict.violations)} violation(s), "
+          f"{len(verdict.confirmations)} confirmation(s), "
+          f"{len(verdict.coverage_gaps)} coverage gap(s)")
+    assert not verdict.violations, (
+        "racecheck: runtime write locksets contradict the static "
+        "race-guard model:\n" + "\n".join(f"  {v}"
+                                          for v in verdict.violations))
+    if verdict.confirmations:
+        print("racecheck confirmations (statically flagged AND observed "
+              "racing — fix or baseline):")
+        for line in verdict.confirmations:
+            print(f"  {line}")
+    if verdict.coverage_gaps:  # informational: tests never drove these
+        print("racecheck coverage gaps (statically racy, never observed "
+              "shared this run):")
         for gap in verdict.coverage_gaps:
             print(f"  {gap}")
 

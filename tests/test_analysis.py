@@ -940,6 +940,7 @@ def test_lockcheck_uninstall_restores_real_locks():
 def test_rule_registry_is_complete():
     # keep the README rule catalog and the registry in sync by count
     assert set(RULES) == {
-        "jit-purity", "host-sync", "lock-order", "backend-contract",
-        "thread-lifecycle", "flag-doc", "export-completeness",
+        "jit-purity", "host-sync", "lock-order", "race-guard",
+        "layering", "backend-contract", "thread-lifecycle", "flag-doc",
+        "export-completeness",
     }
