@@ -508,6 +508,10 @@ def _measure_extras(dispatch_s: float) -> dict:
 
     from gethsharding_tpu.crypto import bn256 as ref
     from gethsharding_tpu.ops import bn256_jax as k
+    # checked_pull: the block-vs-pull self-checked device->host pull —
+    # a no-op block under the tunnel plugin lands on the timer_suspect
+    # counter and flags this run's ledger record invalid
+    from gethsharding_tpu.perfwatch import checked_pull
 
     out = {}
 
@@ -525,7 +529,7 @@ def _measure_extras(dispatch_s: float) -> dict:
     t0 = time.perf_counter()
     for _ in range(3):
         r = fn(*args)
-    np.asarray(r)  # device->host pull: block_until_ready can no-op
+    checked_pull(r, op="bench/config1")  # real pull, self-checked
     out["config1_pairing_check_s"] = round((time.perf_counter() - t0) / 3, 4)
 
     # config 2: ONE 135-vote aggregate (batch 1 of the BLS kernel)
@@ -544,7 +548,7 @@ def _measure_extras(dispatch_s: float) -> dict:
     t0 = time.perf_counter()
     for _ in range(3):
         r = fn2(*args2)
-    np.asarray(r)  # device->host pull: block_until_ready can no-op
+    checked_pull(r, op="bench/config2")  # real pull, self-checked
     out["config2_aggregate_verify_s"] = round((time.perf_counter() - t0) / 3,
                                               4)
 
@@ -568,7 +572,13 @@ def _measure_extras(dispatch_s: float) -> dict:
     t0 = time.perf_counter()
     for _ in range(3):
         out4 = replay_jax.replay_batch(inp)
-    jax.device_get(out4)  # real pull: block_until_ready can no-op
+    # the tiny statuses plane first as the self-checked barrier, then
+    # the full-output transfer the HISTORICAL records timed — the
+    # extra bool-plane RTT is noise next to the balances plane, while
+    # changing the transferred volume would make every new
+    # config4_replay_txs_per_s incomparable to the imported baseline
+    checked_pull(out4.statuses, op="bench/config4")
+    jax.device_get(out4)
     dt = (time.perf_counter() - t0) / 3
     out["config4_replay_txs_per_s"] = round(n_txs / dt, 1)
 
@@ -589,7 +599,7 @@ def _measure_extras(dispatch_s: float) -> dict:
         jax.device_get(res.roots)
         t0 = time.perf_counter()
         res = pipe.run(inputs, pool, bh, 1, sample_size)
-        jax.device_get(res.roots)  # real pull: block_until_ready can no-op
+        checked_pull(res.roots, op="bench/config5")  # self-checked pull
         dt = time.perf_counter() - t0
         out["config5_stress_shards_per_s"] = round(n_shards / dt, 1)
 
@@ -1309,6 +1319,217 @@ def measure_das() -> dict:
     }
 
 
+# == perfwatch closed-loop acceptance (bench.py --perfwatch) ===============
+
+
+def measure_perfwatch() -> dict:
+    """The measurement substrate's own acceptance run, closed-loop:
+
+    1. **Gate trips on a real slowdown.** Seed a fresh ledger with
+       clean CPU-quick micro-suite runs, assert the gate passes, inject
+       a 1.3x slowdown into one registered microbenchmark and assert
+       `--check` flags exactly that workload, then assert a clean rerun
+       passes again (the injected record does not poison the median).
+    2. **The timer cannot be lied to.** A simulated no-op
+       `block_until_ready` (the r4 tunnel-plugin hazard) must increment
+       `perfwatch/timer_suspect` and flag the enclosing ledger record
+       invalid.
+    3. **The black box is complete.** A chaos-injected dispatch hang
+       under the serving watchdog must produce a flight-recorder bundle
+       containing the event ring (with the watchdog_timeout and
+       chaos_decision events), the finished-span ring, a metrics
+       snapshot, and the ledger tail.
+    4. **It all stays cheap.** DeviceTimer + recorder ring appends per
+       dispatch are measured against a real serving request and
+       asserted <2% — the same budget bar as the tracing and SLO
+       layers."""
+    import tempfile
+    import threading
+
+    import numpy as _np
+
+    from gethsharding_tpu import metrics as _metrics
+    from gethsharding_tpu import perfwatch
+    from gethsharding_tpu.perfwatch import gate as pgate
+    from gethsharding_tpu.perfwatch import registry as pregistry
+    from gethsharding_tpu.perfwatch.ledger import Ledger
+    from gethsharding_tpu.perfwatch.recorder import RECORDER
+    from gethsharding_tpu.perfwatch.timer import DeviceTimer
+
+    out: dict = {}
+    tmp = tempfile.mkdtemp(prefix="bench_perfwatch_")
+    ledger = Ledger(os.path.join(tmp, "ledger.jsonl"))
+
+    # -- part 1: the regression gate, tripped by an honest 1.3x ------------
+    # the drill lane is the deterministic clock-spin reference bench:
+    # the REAL workload benches drift ~20% with host load on a shared
+    # box (their gating belongs to a quiet CI lane, with the band
+    # doing the noise absorption), but the acceptance contract here —
+    # "1.3x trips, clean reruns do not" — must hold on ANY machine,
+    # so it is asserted on the bench whose wall the clock controls
+    target = "clock_spin_5ms"
+    lane = [f"micro/{target}"]
+    clean_runs = 4
+    for _ in range(clean_runs):
+        pregistry.run_suite(ledger=ledger, quick=True, inject={})
+    full = pgate.check(ledger)  # the whole-suite face, reported below
+    clean = pgate.check(ledger, workloads=lane)
+    assert not clean.failed, [vars(v) for v in clean.regressions]
+    pregistry.run_suite(ledger=ledger, quick=True,
+                        inject={target: 1.3})
+    tripped = pgate.check(ledger, workloads=lane)
+    flagged = {v.workload for v in tripped.regressions}
+    assert tripped.failed and f"micro/{target}" in flagged, (
+        f"injected 1.3x slowdown on {target} did not trip the gate: "
+        f"{[vars(v) for v in tripped.verdicts]}")
+    pregistry.run_suite(ledger=ledger, quick=True, inject={})
+    healed = pgate.check(ledger, workloads=lane)
+    assert not healed.failed, (
+        "clean rerun after the injected record still trips",
+        [vars(v) for v in healed.regressions])
+    out["gate_clean_runs"] = clean_runs
+    out["gate_metrics_checked"] = len(full.verdicts)
+    out["gate_tripped_on"] = sorted(flagged)
+
+    # -- part 2: the simulated no-op block_until_ready ---------------------
+    class _NoopBlockValue:
+        """block_until_ready returns instantly; the REAL pull takes the
+        dispatch latency — exactly the r4 tunnel-plugin behavior."""
+
+        def block_until_ready(self):
+            return self
+
+        def __array__(self, dtype=None, copy=None):
+            time.sleep(0.3)  # the "real" dispatch the block hid —
+            # above the 0.25 s suspect floor, like the r4 0.455 s case
+            return _np.zeros(4, dtype=dtype or _np.int32)
+
+    suspects_before = perfwatch.suspect_count()
+    dt = DeviceTimer("bench/suspect_demo")
+    dt.dispatched()
+    dt.pull(_NoopBlockValue())
+    dt.done()
+    assert dt.suspect, "no-op block_until_ready went undetected"
+    assert perfwatch.suspect_count() == suspects_before + 1
+    # ... and a record taken over the suspect window is stamped invalid
+    rec = perfwatch.record_bench(
+        metric="suspect_demo", value=dt.device_s, unit="s", extra={},
+        suspects=perfwatch.suspect_count() - suspects_before,
+        ledger=ledger)
+    assert rec["valid"] is False, rec
+    out["timer_suspects"] = perfwatch.suspect_count() - suspects_before
+    out["suspect_record_valid"] = rec["valid"]
+
+    # -- part 3: chaos hang -> watchdog -> complete black-box bundle -------
+    from gethsharding_tpu.resilience.chaos import (ChaosSchedule,
+                                                   ChaosSigBackend)
+    from gethsharding_tpu.resilience.errors import DeadlineExceeded
+    from gethsharding_tpu.serving import ServingConfig, ServingSigBackend
+    from gethsharding_tpu.sigbackend import PythonSigBackend
+
+    old_env = {k: os.environ.get(k) for k in
+               ("GETHSHARDING_PERFWATCH_DIR", "GETHSHARDING_PERFWATCH_DUMP_S",
+                "GETHSHARDING_PERFWATCH_LEDGER")}
+    os.environ["GETHSHARDING_PERFWATCH_DIR"] = os.path.join(tmp, "blackbox")
+    os.environ["GETHSHARDING_PERFWATCH_DUMP_S"] = "0"
+    os.environ["GETHSHARDING_PERFWATCH_LEDGER"] = ledger.path
+    try:
+        schedule = ChaosSchedule(
+            seed=7, rules={"dispatch.ecrecover_addresses": 1})
+        serving = ServingSigBackend(
+            ChaosSigBackend(PythonSigBackend(), schedule, hang_s=2.0),
+            ServingConfig(flush_us=200.0, watchdog_s=0.2))
+        try:
+            try:
+                serving.ecrecover_addresses([b"\x11" * 32], [b"\x22" * 65])
+                raise AssertionError("hung dispatch did not fail")
+            except DeadlineExceeded:
+                pass  # the watchdog fired — the trigger under test
+            deadline = time.monotonic() + 10.0
+            bundle = None
+            while time.monotonic() < deadline:
+                RECORDER.flush()
+                base = os.environ["GETHSHARDING_PERFWATCH_DIR"]
+                dirs = sorted(os.listdir(base)) if os.path.isdir(base) \
+                    else []
+                if dirs:
+                    bundle = os.path.join(base, dirs[-1])
+                    break
+                time.sleep(0.05)
+            assert bundle is not None, "watchdog fired but no bundle"
+            required = ("manifest.json", "events.json", "spans.json",
+                        "metrics.json", "wire.json", "ledger_tail.jsonl")
+            present = sorted(os.listdir(bundle))
+            missing = [f for f in required if f not in present]
+            assert not missing, f"bundle incomplete: missing {missing}"
+            events = json.load(open(os.path.join(bundle, "events.json")))
+            kinds = {e["kind"] for e in events}
+            assert "watchdog_timeout" in kinds, kinds
+            assert "chaos_decision" in kinds, kinds
+            snapshot = json.load(open(os.path.join(bundle,
+                                                   "metrics.json")))
+            assert "resilience/watchdog/timeouts" in snapshot
+            tail = [json.loads(line) for line in
+                    open(os.path.join(bundle, "ledger_tail.jsonl"))]
+            assert tail, "ledger tail empty in the bundle"
+            out["bundle"] = bundle
+            out["bundle_files"] = present
+            out["bundle_events"] = sorted(kinds)
+            out["bundle_ledger_tail"] = len(tail)
+        finally:
+            serving.close()
+    finally:
+        for key, val in old_env.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+
+    # -- part 4: the hot-path overhead budget ------------------------------
+    serving = ServingSigBackend(PythonSigBackend(),
+                                ServingConfig(flush_us=500.0))
+    try:
+        serving.ecrecover_addresses([], [])  # warm the threads
+        n = 100
+        t0 = time.perf_counter()
+        for i in range(n):
+            serving.ecrecover_addresses(
+                [bytes([i % 251]) * 32], [b"\x00" * 65])
+        per_request_s = (time.perf_counter() - t0) / n
+    finally:
+        serving.close()
+    arr = _np.zeros(8, _np.int32)
+    wire = {"wire_bytes": 1024, "g2_wire_bytes": 0, "pk_hit_bytes": 1024,
+            "pk_rows": 100, "pk_hit_rows": 100, "resident": True,
+            "wire": "i32"}
+    m = 20_000
+    t0 = time.perf_counter()
+    for _ in range(m):
+        dt = DeviceTimer("overhead_probe")
+        dt.dispatched()
+        dt.pull(arr)
+        dt.done()
+        RECORDER.record_wire("overhead_probe", wire)
+    per_dispatch_s = (time.perf_counter() - t0) / m
+    overhead_pct = 100.0 * per_dispatch_s / per_request_s
+    assert overhead_pct < 2.0, (
+        f"perfwatch timer+recorder overhead {overhead_pct:.3f}% of a "
+        f"serving request ({per_dispatch_s * 1e6:.2f}us vs "
+        f"{per_request_s * 1e6:.1f}us) breaches the 2% budget")
+    out["overhead_pct"] = round(overhead_pct, 4)
+    out["per_dispatch_us"] = round(per_dispatch_s * 1e6, 3)
+    out["per_request_us"] = round(per_request_s * 1e6, 1)
+    out["platform"] = "host"
+    assert threading.active_count() < 100  # no thread leak from the loop
+    # the suspect DRILL above (part 2) incremented the process-global
+    # timer_suspect counter on purpose; resync the emitter's mark so
+    # the headline record of this mode is not stamped invalid by its
+    # own demonstration
+    global _SUSPECT_MARK
+    _SUSPECT_MARK = perfwatch.suspect_count()
+    return out
+
+
 # == autotune orchestration ================================================
 
 
@@ -1396,9 +1617,38 @@ def ensure_workload_cache() -> None:
     _load_or_build_vote_sigs(accounts, manager, digests)
 
 
+_SUSPECT_MARK: "int | None" = None
+
+
+def _emit(metric: str, value, unit: str, vs_baseline, extra: dict,
+          workload: "str | None" = None, source: str = "bench") -> None:
+    """THE one result emitter: prints the driver's JSON line AND appends
+    the same measurement to the perfwatch benchmark ledger (one schema,
+    one writer — per-mode extras dicts can no longer drift). A record
+    taken while the device-timer self-check fired (`block_until_ready`
+    no-oped under the measurement — the r4 hazard) is stamped invalid so
+    the regression gate never baselines a lying timing."""
+    global _SUSPECT_MARK
+    print(json.dumps({"metric": metric, "value": value, "unit": unit,
+                      "vs_baseline": vs_baseline, "extra": extra}))
+    try:
+        from gethsharding_tpu.perfwatch import record_bench, suspect_count
+
+        suspects_now = suspect_count()
+        suspects = suspects_now - (_SUSPECT_MARK or 0)
+        _SUSPECT_MARK = suspects_now
+        record_bench(metric=metric, value=value, unit=unit,
+                     vs_baseline=vs_baseline, extra=extra,
+                     workload=workload or metric, source=source,
+                     suspects=suspects)
+    except Exception as exc:  # noqa: BLE001 - the ledger is additive:
+        # a read-only checkout must still print the driver line
+        print(f"# perfwatch ledger write failed: {exc!r}", file=sys.stderr)
+
+
 def _print_metric(sig_rate: float, stats: dict, knobs: str) -> None:
-    """THE one JSON line the driver records (single output contract for
-    the autotuned and fallback paths)."""
+    """The headline metric line (single output contract for the
+    autotuned and fallback paths), routed through `_emit`."""
     extra = {key: val for key, val in stats.items() if key != "sig_rate"}
     try:
         # code provenance: a replayed capture must be attributable to the
@@ -1416,15 +1666,11 @@ def _print_metric(sig_rate: float, stats: dict, knobs: str) -> None:
     # report carries its own capture time
     extra.setdefault("captured_at",
                      time.strftime("%Y-%m-%d %H:%M:%S", time.localtime()))
-    print(json.dumps({
-        "metric": "notary_sig_verifications_per_sec",
-        "value": sig_rate,
-        "unit": (f"sigs/sec (100-shard period audit, on-device 135-vote "
-                 f"BLS aggregation+verification, protocol-generated "
-                 f"workload, opt-ate bn256, {knobs})"),
-        "vs_baseline": round(sig_rate / 100_000.0, 4),
-        "extra": extra,
-    }))
+    _emit("notary_sig_verifications_per_sec", sig_rate,
+          (f"sigs/sec (100-shard period audit, on-device 135-vote "
+           f"BLS aggregation+verification, protocol-generated "
+           f"workload, opt-ate bn256, {knobs})"),
+          round(sig_rate / 100_000.0, 4), extra)
 
 
 def _latest_capture() -> dict | None:
@@ -1502,7 +1748,18 @@ def _replay_capture(reason: str) -> bool:
         return False
     print(f"# {reason}; reporting this round's live TPU capture",
           file=sys.stderr)
+    # the replayed capture keeps its original line shape verbatim AND
+    # lands in the ledger tagged as a replay (not a fresh measurement)
     print(json.dumps(captured))
+    try:
+        from gethsharding_tpu.perfwatch import record_bench
+
+        record_bench(metric=captured["metric"], value=captured["value"],
+                     unit=captured.get("unit"),
+                     vs_baseline=captured.get("vs_baseline"),
+                     extra=captured.get("extra"), source="replay")
+    except Exception as exc:  # noqa: BLE001 - additive, never fatal
+        print(f"# perfwatch ledger write failed: {exc!r}", file=sys.stderr)
     return True
 
 
@@ -1546,20 +1803,16 @@ def main() -> None:
         requests = sum(
             1 for rec in tracing.TRACER.recent_spans()
             if rec["name"].endswith("/request"))
-        print(json.dumps({
-            "metric": "serving_trace_profile",
-            "value": stats["serving_rate"],
-            "unit": (f"verifs/sec ({stats['clients']} concurrent clients, "
-                     f"span-traced serving run, {stats['backend']} "
-                     f"backend)"),
-            "vs_baseline": round(
-                stats["serving_rate"] / max(stats["direct_rate"], 1e-9), 4),
-            "extra": {**{k: v for k, v in stats.items()
-                         if k != "serving_rate"},
-                      "trace_out": out_path,
-                      "trace_events": events,
-                      "traced_requests": requests},
-        }))
+        _emit("serving_trace_profile", stats["serving_rate"],
+              (f"verifs/sec ({stats['clients']} concurrent clients, "
+               f"span-traced serving run, {stats['backend']} "
+               f"backend)"),
+              round(stats["serving_rate"]
+                    / max(stats["direct_rate"], 1e-9), 4),
+              {**{k: v for k, v in stats.items() if k != "serving_rate"},
+               "trace_out": out_path,
+               "trace_events": events,
+               "traced_requests": requests})
         return
 
     if "--resident" in sys.argv:
@@ -1568,35 +1821,27 @@ def main() -> None:
         # when residency is on), the cold/warm delta is the per-dispatch
         # transfer the cache removes
         stats = measure_resident()
-        print(json.dumps({
-            "metric": "audit_warm_wire_bytes_per_dispatch",
-            "value": stats["wire_bytes_warm"],
-            "unit": (f"bytes over the host->device link per warm "
-                     f"100-shard audit dispatch (cold "
-                     f"{stats['wire_bytes_cold']} B; resident="
-                     f"{stats['resident']}, {stats['platform']})"),
-            "vs_baseline": round(
-                stats["wire_bytes_warm"]
-                / max(1, stats["wire_bytes_cold"]), 4),
-            "extra": {k: v for k, v in stats.items()
-                      if k != "wire_bytes_warm"},
-        }))
+        _emit("audit_warm_wire_bytes_per_dispatch",
+              stats["wire_bytes_warm"],
+              (f"bytes over the host->device link per warm "
+               f"100-shard audit dispatch (cold "
+               f"{stats['wire_bytes_cold']} B; resident="
+               f"{stats['resident']}, {stats['platform']})"),
+              round(stats["wire_bytes_warm"]
+                    / max(1, stats["wire_bytes_cold"]), 4),
+              {k: v for k, v in stats.items() if k != "wire_bytes_warm"})
         return
 
     if "--overlap" in sys.argv:
         # sequential vs overlapped audit pipeline (marshal N+1 while N
         # executes); >= 1.0 means the overlap pays for itself
         stats = measure_overlap()
-        print(json.dumps({
-            "metric": "audit_overlap_ratio",
-            "value": stats["overlap_ratio"],
-            "unit": (f"sequential/overlapped wall ratio over "
-                     f"{stats['k_periods']} periods "
-                     f"({stats['platform']})"),
-            "vs_baseline": stats["overlap_ratio"],
-            "extra": {k: v for k, v in stats.items()
-                      if k != "overlap_ratio"},
-        }))
+        _emit("audit_overlap_ratio", stats["overlap_ratio"],
+              (f"sequential/overlapped wall ratio over "
+               f"{stats['k_periods']} periods "
+               f"({stats['platform']})"),
+              stats["overlap_ratio"],
+              {k: v for k, v in stats.items() if k != "overlap_ratio"})
         return
 
     if "--chaos" in sys.argv:
@@ -1610,18 +1855,15 @@ def main() -> None:
             f"({stats['corruptions_detected']} detected)"
             if stats["mode"] == "corrupt"
             else f"{stats['injected_faults']} injected faults")
-        print(json.dumps({
-            "metric": "chaos_availability",
-            "value": stats["chaos_availability"],
-            "unit": (f"fraction of {stats['calls']} calls answered "
-                     f"correctly under seeded chaos (rate "
-                     f"{stats['rate']}, {injected_desc}, "
-                     f"{stats['primary']} primary, "
-                     f"{stats['platform']})"),
-            "vs_baseline": stats["chaos_availability"],
-            "extra": {k: v for k, v in stats.items()
-                      if k != "chaos_availability"},
-        }))
+        _emit("chaos_availability", stats["chaos_availability"],
+              (f"fraction of {stats['calls']} calls answered "
+               f"correctly under seeded chaos (rate "
+               f"{stats['rate']}, {injected_desc}, "
+               f"{stats['primary']} primary, "
+               f"{stats['platform']})"),
+              stats["chaos_availability"],
+              {k: v for k, v in stats.items()
+               if k != "chaos_availability"})
         return
 
     if "--soundness" in sys.argv:
@@ -1630,19 +1872,15 @@ def main() -> None:
         # sample rate) and closed-loop silent-corruption detection
         # within the dispatch budget detection_probability predicts
         stats = measure_soundness()
-        print(json.dumps({
-            "metric": "soundness_overhead_pct",
-            "value": stats["overhead_pct"],
-            "unit": (f"% of a {stats['rows']}-row ecrecover dispatch "
-                     f"spent on the soundness audit at rate "
-                     f"{stats['default_rate']} (corruption tripped the "
-                     f"breaker in {stats['dispatches_to_trip']} of the "
-                     f"predicted {stats['predicted_budget_p999']} "
-                     f"dispatches, {stats['platform']})"),
-            "vs_baseline": round(stats["overhead_pct"] / 2.0, 4),
-            "extra": {k: v for k, v in stats.items()
-                      if k != "overhead_pct"},
-        }))
+        _emit("soundness_overhead_pct", stats["overhead_pct"],
+              (f"% of a {stats['rows']}-row ecrecover dispatch "
+               f"spent on the soundness audit at rate "
+               f"{stats['default_rate']} (corruption tripped the "
+               f"breaker in {stats['dispatches_to_trip']} of the "
+               f"predicted {stats['predicted_budget_p999']} "
+               f"dispatches, {stats['platform']})"),
+              round(stats["overhead_pct"] / 2.0, 4),
+              {k: v for k, v in stats.items() if k != "overhead_pct"})
         return
 
     if "--das" in sys.argv:
@@ -1652,18 +1890,35 @@ def main() -> None:
         # acceptance check: zero body fetches, bytes within the
         # k-sample budget, batched verdicts == scalar.
         stats = measure_das()
-        print(json.dumps({
-            "metric": "das_sampled_bytes_per_collation",
-            "value": stats["sampled_bytes_per_collation"],
-            "unit": (f"bytes fetched per {stats['body_bytes']}-byte "
-                     f"collation at k={stats['k_samples']} sampled "
-                     f"chunks (full fetch: "
-                     f"{stats['full_fetch_bytes_per_collation']} B; "
-                     f"{stats['platform']})"),
-            "vs_baseline": stats["bytes_ratio"],
-            "extra": {key: val for key, val in stats.items()
-                      if key != "sampled_bytes_per_collation"},
-        }))
+        _emit("das_sampled_bytes_per_collation",
+              stats["sampled_bytes_per_collation"],
+              (f"bytes fetched per {stats['body_bytes']}-byte "
+               f"collation at k={stats['k_samples']} sampled "
+               f"chunks (full fetch: "
+               f"{stats['full_fetch_bytes_per_collation']} B; "
+               f"{stats['platform']})"),
+              stats["bytes_ratio"],
+              {key: val for key, val in stats.items()
+               if key != "sampled_bytes_per_collation"})
+        return
+
+    if "--perfwatch" in sys.argv:
+        # the measurement substrate's own acceptance gate: the
+        # regression check trips on an injected 1.3x slowdown (and only
+        # then), a simulated no-op block_until_ready is caught by the
+        # timer self-check and invalidates its record, a chaos-injected
+        # dispatch hang produces a COMPLETE flight-recorder bundle, and
+        # the whole layer stays under the 2% hot-path budget
+        stats = measure_perfwatch()
+        _emit("perfwatch_overhead_pct", stats["overhead_pct"],
+              (f"% of a serving request spent on the perfwatch device "
+               f"timer + flight-recorder ring "
+               f"({stats['per_dispatch_us']}us vs "
+               f"{stats['per_request_us']}us; gate tripped on "
+               f"{','.join(stats['gate_tripped_on'])}, bundle "
+               f"{len(stats['bundle_files'])} files, host)"),
+              round(stats["overhead_pct"] / 2.0, 4),
+              {k: v for k, v in stats.items() if k != "overhead_pct"})
         return
 
     if "--serving" in sys.argv:
@@ -1671,17 +1926,14 @@ def main() -> None:
         # concurrent small-request clients, with the direct-backend
         # baseline riding in the same JSON line
         stats = measure_serving()
-        print(json.dumps({
-            "metric": "serving_coalesced_verifications_per_sec",
-            "value": stats["serving_rate"],
-            "unit": (f"verifs/sec ({stats['clients']} concurrent clients x "
-                     f"single-item ecrecover through the serving tier, "
-                     f"{stats['backend']} backend)"),
-            "vs_baseline": round(
-                stats["serving_rate"] / max(stats["direct_rate"], 1e-9), 4),
-            "extra": {k: v for k, v in stats.items()
-                      if k != "serving_rate"},
-        }))
+        _emit("serving_coalesced_verifications_per_sec",
+              stats["serving_rate"],
+              (f"verifs/sec ({stats['clients']} concurrent clients x "
+               f"single-item ecrecover through the serving tier, "
+               f"{stats['backend']} backend)"),
+              round(stats["serving_rate"]
+                    / max(stats["direct_rate"], 1e-9), 4),
+              {k: v for k, v in stats.items() if k != "serving_rate"})
         return
 
     if "--fleet" in sys.argv:
@@ -1693,21 +1945,17 @@ def main() -> None:
         # re-promotion, catchup_replay sheds first while interactive
         # sees zero sheds and holds its p99 SLO.
         stats = measure_fleet()
-        print(json.dumps({
-            "metric": "fleet_interactive_p99_ms",
-            "value": stats["p99_ms"]["interactive"],
-            "unit": (f"interactive p99 ms over a {stats['replicas']}"
-                     f"-replica routed fleet (SLO "
-                     f"{stats['slo_ms']['interactive']} ms; mid-soak "
-                     f"breaker trip + drain + re-entry; "
-                     f"{stats['clients']} mixed-class clients, "
-                     f"{stats['platform']})"),
-            "vs_baseline": round(
-                stats["p99_ms"]["interactive"]
-                / max(stats["slo_ms"]["interactive"], 1e-9), 4),
-            "extra": {k: v for k, v in stats.items() if k != "p99_ms"}
-            | {"p99_ms": stats["p99_ms"]},
-        }))
+        _emit("fleet_interactive_p99_ms", stats["p99_ms"]["interactive"],
+              (f"interactive p99 ms over a {stats['replicas']}"
+               f"-replica routed fleet (SLO "
+               f"{stats['slo_ms']['interactive']} ms; mid-soak "
+               f"breaker trip + drain + re-entry; "
+               f"{stats['clients']} mixed-class clients, "
+               f"{stats['platform']})"),
+              round(stats["p99_ms"]["interactive"]
+                    / max(stats["slo_ms"]["interactive"], 1e-9), 4),
+              {k: v for k, v in stats.items() if k != "p99_ms"}
+              | {"p99_ms": stats["p99_ms"]})
         return
 
     if "--kperiod" in sys.argv:

@@ -122,6 +122,15 @@ class StatusServer(Service):
 
         if slo_mod.active() is not None:
             payload["slo"] = slo_mod.active().describe()
+        # performance trust at a glance (gethsharding_tpu/perfwatch):
+        # the last benchmark-ledger record, the last in-process
+        # regression verdicts, the device-timer suspect count (nonzero
+        # = some timing this process took could NOT be trusted) and the
+        # flight-recorder state (events buffered, bundles dumped) —
+        # matching perfwatch/* rows ride the Prometheus exposition
+        from gethsharding_tpu import perfwatch
+
+        payload["perf"] = perfwatch.perf_status()
         # span-ring health: a nonzero dropped count means the bounded
         # finished-span ring overwrote spans nobody exported — raise
         # --trace-ring or export more often

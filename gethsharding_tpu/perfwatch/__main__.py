@@ -1,0 +1,95 @@
+"""``python -m gethsharding_tpu.perfwatch`` — run the CPU-quick micro
+suite, check the regression gate, print the measured-history report.
+
+Typical uses::
+
+    # CI gate: run the quick suite, then fail on regression
+    python -m gethsharding_tpu.perfwatch --run --check
+
+    # inspect history + the latest verdicts without running anything
+    python -m gethsharding_tpu.perfwatch --check --report
+
+    # drill: prove the gate trips (exits 1)
+    GETHSHARDING_PERFWATCH_INJECT=keccak_256x64:1.5 \\
+        python -m gethsharding_tpu.perfwatch --run --check
+
+Exit status: 1 when ``--check`` finds a regression, else 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from gethsharding_tpu.perfwatch import gate as gate_mod
+from gethsharding_tpu.perfwatch import registry as registry_mod
+from gethsharding_tpu.perfwatch.ledger import Ledger, default_path
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m gethsharding_tpu.perfwatch",
+        description="perfwatch: micro suite + regression gate + report")
+    parser.add_argument("--run", action="store_true",
+                        help="run the CPU-quick microbench suite "
+                             "(appends to the ledger)")
+    parser.add_argument("--check", action="store_true",
+                        help="run the regression gate; exit 1 on "
+                             "regression")
+    parser.add_argument("--report", action="store_true",
+                        help="print the measured-history tables "
+                             "(markdown)")
+    parser.add_argument("--ledger", default=None, metavar="PATH",
+                        help=f"ledger file (default {default_path()})")
+    parser.add_argument("--window", type=int,
+                        default=gate_mod.DEFAULT_WINDOW,
+                        help="rolling baseline window (records)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable verdicts instead of "
+                             "markdown")
+    args = parser.parse_args(argv)
+    if not (args.run or args.check or args.report):
+        parser.print_help()
+        return 0
+    ledger = Ledger(args.ledger)
+    if args.run:
+        records = registry_mod.run_suite(ledger=ledger, quick=True)
+        for rec in records:
+            print(f"# micro {rec['workload']}: "
+                  f"{rec['metrics'].get('wall_s', 0):.6f} s"
+                  + (" [injected]" if rec.get("extra", {}).get("injected")
+                     else ""), file=sys.stderr)
+    result = None
+    if args.check:
+        result = gate_mod.check(ledger, window=args.window)
+    if args.report:
+        print(gate_mod.report(ledger, result=result))
+    if result is not None:
+        if args.json:
+            print(json.dumps({
+                "failed": result.failed,
+                "groups": result.checked_groups,
+                "verdicts": [vars(v) for v in result.verdicts],
+            }, default=str))
+        else:
+            for v in result.regressions:
+                print(f"REGRESSION {v.group} {v.metric}: {v.latest:g} vs "
+                      f"baseline {v.baseline:g} "
+                      f"({v.delta_pct:+g}% past ±{100 * v.tolerance:g}%)")
+            ok = sum(1 for v in result.verdicts if v.status == "ok")
+            building = sum(1 for v in result.verdicts
+                           if v.status == "baseline_building")
+            better = sum(1 for v in result.verdicts
+                         if v.status == "improvement")
+            print(f"# perfwatch check: {result.checked_groups} group(s), "
+                  f"{ok} ok, {better} improved, {building} building, "
+                  f"{len(result.regressions)} regression(s)",
+                  file=sys.stderr)
+        if result.failed:
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

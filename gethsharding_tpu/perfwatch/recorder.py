@@ -1,0 +1,265 @@
+"""Black-box flight recorder: the last N structured events + a
+post-mortem bundle on the failures that matter.
+
+When a breaker trips, a watchdog fires or a soundness violation
+surfaces, the question is always "what was the node doing in the
+seconds before" — and by the time an operator attaches, the span ring
+has wrapped and the moment is gone. The recorder is the aircraft-style
+answer: an always-on bounded ring of structured events (breaker trips
+and reopens, watchdog fires, chaos decisions, SLO breach onsets,
+soundness violations, timer-suspect readings) plus a ring of the last
+N per-dispatch wire ledgers, and a dump path that freezes everything
+to disk the moment one of the fatal triggers fires.
+
+A bundle directory (under ``GETHSHARDING_PERFWATCH_DIR``, default
+``./perfwatch_blackbox``) contains:
+
+- ``manifest.json`` — reason, wall/monotonic stamps, pid;
+- ``events.json``  — the event ring, oldest first;
+- ``wire.json``    — the last-N dispatch wire ledgers;
+- ``spans.json``   — the tracer's finished-span ring
+  (`tracing.TRACER.recent_spans()` — populated when tracing is on);
+- ``metrics.json`` — a full registry snapshot;
+- ``ledger_tail.jsonl`` — the tail of the benchmark ledger.
+
+Dumps are rate-limited (``GETHSHARDING_PERFWATCH_DUMP_S``, default
+30 s — a flapping breaker must not write a bundle per trip) and old
+bundles are pruned to ``GETHSHARDING_PERFWATCH_BUNDLES`` (default 8).
+Dump IO runs on a short-lived background thread so a trigger firing
+under a caller's lock (the breaker trips inside its own lock) never
+does file IO there. ``GETHSHARDING_PERFWATCH_RECORDER=0`` turns the
+whole recorder off (event appends become no-ops).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from gethsharding_tpu import metrics, tracing
+
+log = logging.getLogger("perfwatch.recorder")
+
+DEFAULT_RING = 256
+DEFAULT_WIRE_RING = 64
+
+_M_EVENTS = metrics.counter("perfwatch/events")
+_M_BUNDLES = metrics.counter("perfwatch/bundles")
+_M_SUPPRESSED = metrics.counter("perfwatch/dumps_suppressed")
+
+
+def _bundle_dir() -> str:
+    return os.environ.get("GETHSHARDING_PERFWATCH_DIR",
+                          os.path.join(os.getcwd(), "perfwatch_blackbox"))
+
+
+def _dump_min_interval_s() -> float:
+    return float(os.environ.get("GETHSHARDING_PERFWATCH_DUMP_S", "30"))
+
+
+def _max_bundles() -> int:
+    return int(os.environ.get("GETHSHARDING_PERFWATCH_BUNDLES", "8"))
+
+
+class FlightRecorder:
+    """Bounded event + wire-ledger rings with a post-mortem dump."""
+
+    def __init__(self, ring: Optional[int] = None,
+                 wire_ring: int = DEFAULT_WIRE_RING,
+                 registry: metrics.Registry = metrics.DEFAULT_REGISTRY):
+        if ring is None:
+            ring = int(os.environ.get("GETHSHARDING_PERFWATCH_RING",
+                                      str(DEFAULT_RING)))
+        self.enabled = os.environ.get(
+            "GETHSHARDING_PERFWATCH_RECORDER", "1") != "0"
+        self.registry = registry
+        self._events: deque = deque(maxlen=max(1, ring))
+        self._wires: deque = deque(maxlen=max(1, wire_ring))
+        self._lock = threading.Lock()
+        self._last_dump = 0.0
+        self._dump_thread: Optional[threading.Thread] = None
+        # pending flag, not is_alive(): a thread ASSIGNED but not yet
+        # started reads not-alive, and two near-simultaneous fatal
+        # triggers would otherwise both spawn dumps
+        self._dump_pending = False
+        self._seq = 0  # bundle-name sequence, advanced under the lock
+        self.bundles = 0
+        self.last_bundle: Optional[str] = None
+        self.last_reason: Optional[str] = None
+
+    # -- producers ---------------------------------------------------------
+
+    def record(self, kind: str, **detail) -> None:
+        """Append one structured event (cheap: one locked deque append;
+        a disabled recorder pays one attribute read)."""
+        if not self.enabled:
+            return
+        event = {"ts": time.time(), "mono": time.monotonic(),
+                 "kind": kind, "detail": detail}
+        with self._lock:
+            self._events.append(event)
+        _M_EVENTS.inc()
+
+    def record_wire(self, op: str, wire: Optional[dict]) -> None:
+        """Append one dispatch's wire ledger to the last-N ring."""
+        if not self.enabled or not wire:
+            return
+        entry = {"ts": time.time(), "op": op, **wire}
+        with self._lock:
+            self._wires.append(entry)
+
+    def trigger(self, kind: str, dump: bool = False, **detail) -> None:
+        """Record `kind` and, for the fatal triggers (breaker trip,
+        watchdog timeout, soundness violation), schedule a post-mortem
+        dump on a background thread — a trigger firing under a caller's
+        lock must never do file IO there."""
+        self.record(kind, **detail)
+        if not dump or not self.enabled:
+            return
+        with self._lock:
+            if self._dump_pending:
+                # a dump is already scheduled or mid-IO; it may have
+                # snapshotted BEFORE this event, so this is a real
+                # suppression — counted, like the rate-limit path, so
+                # an operator finding a violation with no bundle sees
+                # why
+                suppressed = True
+            else:
+                suppressed = False
+                self._dump_pending = True
+                thread = threading.Thread(
+                    target=self._dump_safe, args=(kind,),
+                    name="perfwatch-dump", daemon=True)
+                # started BEFORE publication, still under the lock: a
+                # concurrent flush() must never join() an unstarted
+                # thread (RuntimeError); start() is cheap and the dump
+                # thread's own lock uses wait for this release
+                thread.start()
+                self._dump_thread = thread
+        if suppressed:
+            _M_SUPPRESSED.inc()
+
+    # -- consumers ---------------------------------------------------------
+
+    def events(self, limit: Optional[int] = None) -> List[dict]:
+        with self._lock:
+            out = list(self._events)
+        return out if limit is None else out[-limit:]
+
+    def wires(self, limit: Optional[int] = None) -> List[dict]:
+        with self._lock:
+            out = list(self._wires)
+        return out if limit is None else out[-limit:]
+
+    def describe(self) -> Dict[str, object]:
+        with self._lock:
+            events, wires = len(self._events), len(self._wires)
+        return {"enabled": self.enabled, "events": events,
+                "wire_entries": wires, "bundles": self.bundles,
+                "last_bundle": self.last_bundle,
+                "last_reason": self.last_reason}
+
+    # -- the post-mortem dump ----------------------------------------------
+
+    def _dump_safe(self, reason: str) -> None:
+        try:
+            self.dump(reason)
+        except Exception:  # noqa: BLE001 - a failing dump must never
+            # propagate into the resilience seam that triggered it
+            log.exception("flight-recorder dump failed (reason %s)", reason)
+        finally:
+            with self._lock:
+                self._dump_pending = False
+
+    def dump(self, reason: str, force: bool = False) -> Optional[str]:
+        """Write one bundle directory; returns its path (None when rate
+        -limited or disabled). Snapshots are taken before any file IO so
+        the bundle is one consistent moment."""
+        if not self.enabled and not force:
+            return None
+        now = time.monotonic()
+        with self._lock:
+            if not force and self._last_dump and \
+                    now - self._last_dump < _dump_min_interval_s():
+                _M_SUPPRESSED.inc()
+                return None
+            self._last_dump = now
+            self._seq += 1
+            seq = self._seq  # unique under the lock: two dumps in the
+            # same second can never compute the same directory name
+            events = list(self._events)
+            wires = list(self._wires)
+        spans = tracing.TRACER.recent_spans()
+        snapshot = self.registry.snapshot()
+        # lazy: the ledger is an optional neighbor, not a dependency
+        from gethsharding_tpu.perfwatch import ledger as ledger_mod
+
+        try:
+            tail = ledger_mod.Ledger().tail(32)
+        except Exception:  # noqa: BLE001 - an unreadable ledger must not
+            tail = []      # sink the rest of the post-mortem
+
+        base = _bundle_dir()
+        stamp = time.strftime("%Y%m%d_%H%M%S")
+        name = f"{stamp}_{reason}_{os.getpid()}_{seq}"
+        path = os.path.join(base, name)
+        os.makedirs(path, exist_ok=True)
+        payloads = {
+            "manifest.json": {"reason": reason, "ts": time.time(),
+                              "mono": now, "pid": os.getpid(),
+                              "events": len(events), "spans": len(spans),
+                              "wire_entries": len(wires)},
+            "events.json": events,
+            "wire.json": wires,
+            "spans.json": spans,
+            "metrics.json": snapshot,
+        }
+        for fname, payload in payloads.items():
+            with open(os.path.join(path, fname), "w") as fh:
+                json.dump(payload, fh, indent=1, default=repr)
+        with open(os.path.join(path, "ledger_tail.jsonl"), "w") as fh:
+            for rec in tail:
+                fh.write(json.dumps(rec, sort_keys=True) + "\n")
+        with self._lock:
+            self.bundles += 1
+            self.last_bundle = path
+            self.last_reason = reason
+        _M_BUNDLES.inc()
+        self._prune(base)
+        log.warning("flight-recorder bundle written: %s (%s)", path, reason)
+        return path
+
+    @staticmethod
+    def _prune(base: str) -> None:
+        """Keep only the newest `_max_bundles()` bundle directories."""
+        import shutil
+
+        try:
+            entries = sorted(
+                e for e in os.listdir(base)
+                if os.path.isdir(os.path.join(base, e)))
+        except OSError:
+            return
+        for stale in entries[:-_max_bundles()] if len(entries) \
+                > _max_bundles() else []:
+            shutil.rmtree(os.path.join(base, stale), ignore_errors=True)
+
+    def flush(self, timeout: float = 5.0) -> None:
+        """Wait for an in-flight background dump (tests + shutdown)."""
+        with self._lock:
+            thread = self._dump_thread
+        if thread is not None:
+            thread.join(timeout=timeout)
+
+    def close(self) -> None:
+        self.flush()
+
+
+# THE process recorder (the tracing.TRACER / metrics.DEFAULT_REGISTRY
+# analog): resilience seams and the sig backends record here.
+RECORDER = FlightRecorder()

@@ -1,0 +1,192 @@
+"""Trustworthy device timing: force the pull, distrust the block.
+
+The r4 round proved device timings can LIE: under the tunnel PJRT
+plugin ``block_until_ready()`` silently no-ops, and a stage-breakdown
+probe timed a 0.455 s dispatch at 82 µs. The fix was point-wise then
+("every timing site now forces a device->host pull"); `DeviceTimer`
+generalizes it into the one timing primitive every dispatch site in
+`sigbackend.py`, `serving/` and `bench.py` uses:
+
+- **The pull is the clock.** `pull(x)` materializes the value on the
+  host (`np.asarray`) — the only operation that provably waits for
+  the device — and the device phase closes only after it.
+- **The block is the self-check.** Before pulling, the timer times
+  ``block_until_ready()`` when the value has one. A block that
+  returned near-instantly while the subsequent pull paid the real
+  dispatch latency is the r4 hazard live in production: the timer
+  increments the always-on ``perfwatch/timer_suspect`` counter,
+  stamps itself ``suspect``, and drops a flight-recorder event — and
+  the ledger writer marks any measurement taken over a suspect window
+  ``valid: false`` so the regression gate never baselines a lie.
+- **The rollups ride along.** `dispatched()`/`done()` feed the
+  existing ``sig/marshal_time`` / ``sig/device_time`` registry timers
+  (the fleet federation's "which replica's chip is slow" feed), so
+  adopting the timer is not a second bookkeeping scheme.
+
+Thresholds: a pull under ``GETHSHARDING_PERFWATCH_SUSPECT_FLOOR_S``
+(default 0.25 s) is never suspect; above it, the block must have
+covered at least ``GETHSHARDING_PERFWATCH_SUSPECT_RATIO`` (default
+0.1) of the pull time or the block is judged a no-op. The floor is
+deliberately ABOVE one tunnel link round trip: an overlapped audit
+whose device work finished before the pull still pays ~RTT for the
+verdict-plane transfer with a near-instant block — that is an honest
+reading, not the hazard. The hazard class the check exists for is a
+block hiding the whole DISPATCH (r4: 0.455 s read as 82 µs), which
+clears a 0.25 s floor with room; operators on low-latency local
+devices can lower the floor to tighten the net.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+from gethsharding_tpu import metrics
+
+# registered at import: the /metrics?format=prom row exists from the
+# first scrape, not the first suspect
+_M_SUSPECT = metrics.counter("perfwatch/timer_suspect")
+_M_PULLS = metrics.counter("perfwatch/pulls")
+_T_MARSHAL = metrics.timer("sig/marshal_time")
+_T_DEVICE = metrics.timer("sig/device_time")
+
+
+def _suspect_floor_s() -> float:
+    return float(os.environ.get(
+        "GETHSHARDING_PERFWATCH_SUSPECT_FLOOR_S", "0.25"))
+
+
+def _suspect_ratio() -> float:
+    return float(os.environ.get(
+        "GETHSHARDING_PERFWATCH_SUSPECT_RATIO", "0.1"))
+
+
+def suspect_count() -> int:
+    """Process-lifetime ``perfwatch/timer_suspect`` total (the ledger
+    writer and bench harness diff this around a measurement window)."""
+    return _M_SUSPECT.value
+
+
+def _checked_materialize(value, op: str):
+    """block (timed) -> pull (timed) -> suspect verdict. Returns
+    (host_array, block_s, pull_s, suspect)."""
+    t0 = time.monotonic()
+    block = getattr(value, "block_until_ready", None)
+    if block is not None:
+        block()
+    t1 = time.monotonic()
+    arr = np.asarray(value)
+    t2 = time.monotonic()
+    block_s, pull_s = t1 - t0, t2 - t1
+    _M_PULLS.inc()
+    suspect = (block is not None
+               and pull_s > _suspect_floor_s()
+               and block_s < pull_s * _suspect_ratio())
+    if suspect:
+        _M_SUSPECT.inc()
+        # lazy import: recorder -> ledger -> (nothing heavy); kept lazy
+        # anyway so a timer-only consumer never builds the recorder
+        from gethsharding_tpu.perfwatch.recorder import RECORDER
+
+        RECORDER.record("timer_suspect", op=op,
+                        block_s=round(block_s, 6),
+                        pull_s=round(pull_s, 6))
+    return arr, block_s, pull_s, suspect
+
+
+def checked_pull(value, op: str = "pull") -> np.ndarray:
+    """Materialize a device value on the host with the block-vs-pull
+    self-check, WITHOUT the marshal/device stage rollups — the bench
+    harness's one-shot form (`bench.py` extras, probe scripts)."""
+    arr, _, _, _ = _checked_materialize(value, op)
+    return arr
+
+
+def ensure_host(value, op: str = "dispatch"):
+    """The serving tier's guard: the dispatch-latency clock must close
+    over completed work. A bare device value is checked-pulled; a
+    list/tuple whose ELEMENTS are lazy device scalars (the realistic
+    shape of a backend leaking async buffers through the batch
+    contract) gets one checked pull on its first element as the
+    barrier — all outputs of one dispatch complete together, so one
+    pull forces the batch. Plain host containers pay one isinstance +
+    one hasattr."""
+    if isinstance(value, (list, tuple)):
+        if value and hasattr(value[0], "block_until_ready"):
+            checked_pull(value[0], op=op)
+        return value
+    if value is None:
+        return value
+    if hasattr(value, "block_until_ready") or isinstance(value, np.ndarray):
+        return checked_pull(value, op=op)
+    return value
+
+
+class DeviceTimer:
+    """Per-dispatch stage clock: marshal -> dispatch -> pull.
+
+    Usage at a dispatch site::
+
+        dt = DeviceTimer("bls_committee")   # marshal phase opens
+        ... host marshalling / staging ...
+        dt.dispatched()                     # marshal closes, device opens
+        out = fn(*args)                     # async launch
+        arr = dt.pull(out)                  # block-check + REAL pull
+        dt.done()                           # device closes, rollups fed
+
+    `marshal_s` / `device_s` / `block_s` / `pull_s` / `suspect` are
+    readable afterwards; `t_dispatch` / `t_done` are the monotonic
+    bounds tracer spans should use so span and rollup agree."""
+
+    __slots__ = ("op", "t_start", "t_dispatch", "t_done", "marshal_s",
+                 "device_s", "block_s", "pull_s", "suspect", "_observed")
+
+    def __init__(self, op: str):
+        self.op = op
+        self.t_start = time.monotonic()
+        self.t_dispatch: Optional[float] = None
+        self.t_done: Optional[float] = None
+        self.marshal_s = 0.0
+        self.device_s = 0.0
+        self.block_s = 0.0
+        self.pull_s = 0.0
+        self.suspect = False
+        self._observed = False
+
+    def dispatched(self) -> "DeviceTimer":
+        """Close the marshal phase (feeds ``sig/marshal_time``) and
+        open the device phase."""
+        self.t_dispatch = time.monotonic()
+        self.marshal_s = self.t_dispatch - self.t_start
+        _T_MARSHAL.observe(self.marshal_s)
+        return self
+
+    def pull(self, value) -> np.ndarray:
+        """Materialize `value` on the host with the block-vs-pull
+        self-check; extends the device phase to now. May be called more
+        than once (multi-output dispatches); `done()` closes the
+        phase."""
+        if self.t_dispatch is None:
+            self.dispatched()
+        arr, block_s, pull_s, suspect = _checked_materialize(value, self.op)
+        self.block_s += block_s
+        self.pull_s += pull_s
+        self.suspect = self.suspect or suspect
+        self.t_done = time.monotonic()
+        return arr
+
+    def done(self) -> "DeviceTimer":
+        """Close the device phase (feeds ``sig/device_time``).
+        Idempotent — later calls keep the first observation."""
+        if self._observed:
+            return self
+        if self.t_dispatch is None:
+            self.dispatched()
+        self.t_done = time.monotonic()
+        self.device_s = self.t_done - self.t_dispatch
+        _T_DEVICE.observe(self.device_s)
+        self._observed = True
+        return self

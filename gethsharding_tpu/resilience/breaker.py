@@ -46,6 +46,7 @@ from concurrent.futures import Future
 from typing import Callable, Optional
 
 from gethsharding_tpu import metrics, tracing
+from gethsharding_tpu.perfwatch import RECORDER
 from gethsharding_tpu.resilience.errors import SoundnessViolation
 from gethsharding_tpu.sigbackend import SigBackend, VerdictFuture
 
@@ -189,6 +190,10 @@ class CircuitBreaker:
             self._opened_at = self._clock()
             self._g_state.set(OPEN)
             self._event("reopen")
+        # re-open after a failed probe: ring event only — the trip that
+        # opened this episode already dumped its bundle
+        RECORDER.record("breaker_reopen", breaker=self.name,
+                        mismatch=mismatch, detail=detail)
         log.warning("breaker %s re-opened: probe %s%s", self.name,
                     "MISMATCHED the fallback" if mismatch else "raised",
                     f" ({detail})" if detail else "")
@@ -215,6 +220,11 @@ class CircuitBreaker:
         self._m_trips.inc()
         self._g_state.set(OPEN)
         self._event("trip")
+        # a trip is a black-box moment: event into the flight-recorder
+        # ring + a post-mortem bundle (the dump IO runs on the
+        # recorder's own thread, never under this lock)
+        RECORDER.trigger("breaker_trip", dump=True, breaker=self.name,
+                         reason=reason)
         log.warning("breaker %s open: %s — serving from the scalar "
                     "fallback for %.1fs before probing", self.name,
                     reason, self.reset_s)

@@ -37,6 +37,7 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from gethsharding_tpu import metrics
+from gethsharding_tpu.perfwatch import RECORDER
 from gethsharding_tpu.sigbackend import SigBackend
 
 
@@ -147,6 +148,11 @@ class ChaosSchedule:
             with self._lock:
                 self.injected[seam] = self.injected.get(seam, 0) + 1
             self._m_injected.inc()
+            # every injection decision lands in the flight-recorder
+            # ring: a post-mortem bundle must say whether the chaos
+            # harness, not the device, caused the episode
+            RECORDER.record("chaos_decision", seam=seam, index=idx,
+                            mode=self.mode_for(seam))
         return verdict, idx
 
     def fire(self, seam: str) -> None:

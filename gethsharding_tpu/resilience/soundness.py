@@ -63,6 +63,7 @@ from concurrent import futures
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from gethsharding_tpu import metrics, slo, tracing
+from gethsharding_tpu.perfwatch import RECORDER
 from gethsharding_tpu.resilience.errors import SoundnessViolation
 from gethsharding_tpu.sigbackend import SigBackend, VerdictFuture
 
@@ -338,6 +339,10 @@ class SpotCheckSigBackend(SigBackend):
             now = time.monotonic()
             tracer.record("resilience/soundness/violation", now, now,
                           tags={"op": op, "kind": kind})
+        # silent corruption detected: black-box moment — bundle dumped
+        # (async) with the event/span/wire rings leading up to it
+        RECORDER.trigger("soundness_violation", dump=True, op=op,
+                         violation_kind=kind, detail=detail)
         raise SoundnessViolation(
             f"soundness {kind} on {op}: {detail} "
             f"(backend {self.inner.name} vs reference "

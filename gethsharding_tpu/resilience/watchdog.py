@@ -31,6 +31,7 @@ import threading
 from typing import Callable, Optional
 
 from gethsharding_tpu import metrics
+from gethsharding_tpu.perfwatch import RECORDER
 from gethsharding_tpu.resilience.errors import DeadlineExceeded
 
 log = logging.getLogger("resilience.watchdog")
@@ -87,6 +88,11 @@ class DispatchWatchdog:
             self.timeouts += 1
             self._m_timeouts.inc()
             self._m_restarts.inc()
+            # a hung dispatch is exactly what the black box exists for:
+            # freeze the last-N events/spans/wire ledgers to disk
+            RECORDER.trigger("watchdog_timeout", dump=True,
+                             age_s=round(age, 3),
+                             deadline_s=self.deadline_s)
             log.error("dispatch watchdog fired: %s", exc)
             if self.on_timeout is not None:
                 try:

@@ -53,6 +53,7 @@ from concurrent.futures import Future
 from typing import Dict, List, Optional, Sequence
 
 from gethsharding_tpu import metrics, slo, tracing
+from gethsharding_tpu.perfwatch import ensure_host
 from gethsharding_tpu.serving.classes import (
     ADMISSION_CLASSES,
     class_for,
@@ -299,7 +300,12 @@ class MicroBatcher:
         traced = tracing.TRACER.enabled
         try:
             with met.dispatch_latency.time():
-                out = list(self._dispatch(op, cols))
+                # ensure_host: the dispatch-latency clock must close
+                # over a HOST value — a backend handing back a lazy
+                # device buffer gets the perfwatch checked pull here, so
+                # the serving timing site cannot under-report device
+                # time (the r4 block-no-op hazard, serving-tier form)
+                out = list(ensure_host(self._dispatch(op, cols), op=op))
             if len(out) != rows:
                 raise RuntimeError(
                     f"{op} returned {len(out)} results for {rows} rows")

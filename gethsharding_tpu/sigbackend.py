@@ -45,6 +45,13 @@ from typing import List, Optional, Sequence, Tuple
 from gethsharding_tpu import metrics, tracing
 from gethsharding_tpu.crypto import bn256 as bls
 from gethsharding_tpu.crypto import secp256k1 as ecdsa
+# DeviceTimer is THE timing primitive of every dispatch path below: it
+# forces a real device->host pull (block_until_ready can silently no-op
+# under the tunnel plugin — the r4 hazard), self-checks block-vs-pull
+# divergence into `perfwatch/timer_suspect`, and feeds the
+# sig/{marshal_time,device_time} rollups; RECORDER keeps the last-N
+# dispatch wire ledgers for the flight recorder's post-mortem bundles
+from gethsharding_tpu.perfwatch import RECORDER, DeviceTimer
 from gethsharding_tpu.utils.hexbytes import Address20
 
 
@@ -279,13 +286,10 @@ class JaxSigBackend(SigBackend):
         self._g_dev_bytes = metrics.gauge("jax/pk_device_cache/bytes")
         self._m_wire_bytes = metrics.counter("jax/wire/bytes")
         self._m_pk_hit_bytes = metrics.counter("jax/wire/pk_device_hit_bytes")
-        # device-time attribution rollups (always on — two clock reads
-        # per dispatch): host marshal seconds vs device dispatch seconds
-        # per call, the SIG_TIMING split as registry timers so the fleet
-        # federation can answer "which replica's chip is slow" from a
-        # scrape (p99 under sig/device_time) without a profiler attach
-        self._t_marshal = metrics.timer("sig/marshal_time")
-        self._t_device = metrics.timer("sig/device_time")
+        # device-time attribution rollups (sig/{marshal_time,
+        # device_time}) are fed by the perfwatch DeviceTimer each
+        # dispatch path below constructs — one timing scheme, with the
+        # block-vs-pull self-check built in
         # compile-cache visibility: jax.jit compiles once per argument
         # SHAPE, and every padded bucket this process has not dispatched
         # before is a fresh XLA compile (seconds to minutes). Tracking
@@ -319,7 +323,7 @@ class JaxSigBackend(SigBackend):
         n = len(digests)
         if n == 0:
             return []
-        t_start = time.monotonic()
+        dt = DeviceTimer("ecrecover")
         sigs, valid, host_rows = [], [], []
         for i, sig in enumerate(sigs65):
             sig = bytes(sig)
@@ -342,24 +346,26 @@ class JaxSigBackend(SigBackend):
             [bytes(d) for d in digests] + [b"\x00" * 32] * pad)
         r, s, v = self._sec.sigs_to_limbs(sigs)
         tracer = tracing.TRACER
-        t0 = time.monotonic()
+        dt.dispatched()
         qx, qy, ok = self._recover(
             jnp.asarray(e), jnp.asarray(r), jnp.asarray(s), jnp.asarray(v),
             jnp.asarray(np.asarray(valid)))
-        # limbs_to_pubkeys pulls the device buffers (np.asarray), so the
-        # span closes only after the dispatch has actually executed — on
-        # an async backend recording before materialization would show a
-        # near-zero dispatch span with the device time hidden elsewhere
-        pubs = self._sec.limbs_to_pubkeys(qx, qy, ok)[:n]
-        t1 = time.monotonic()
-        self._t_marshal.observe(t0 - t_start)
-        self._t_device.observe(t1 - t0)
+        # the checked pull on `ok` is the dispatch barrier (block-vs-pull
+        # self-checked); limbs_to_pubkeys then pulls the sibling buffers
+        # of the SAME computation, so the device phase closes only after
+        # the dispatch has actually executed and materialized. The host
+        # `ok` is passed through — pulling it twice would add a second
+        # device->host round trip per dispatch.
+        ok_host = dt.pull(ok)
+        pubs = self._sec.limbs_to_pubkeys(qx, qy, ok_host)[:n]
+        dt.done()
         if tracer.enabled:
-            tracer.record("jax/ecrecover_dispatch", t0, t1,
+            tracer.record("jax/ecrecover_dispatch", dt.t_dispatch, dt.t_done,
                           tags={"rows": n, "bucket": bucket,
                                 "compile": "miss" if fresh else "hit",
-                                "marshal_ms": round((t0 - t_start) * 1e3, 3),
-                                "device_ms": round((t1 - t0) * 1e3, 3)})
+                                "suspect": dt.suspect,
+                                "marshal_ms": round(dt.marshal_s * 1e3, 3),
+                                "device_ms": round(dt.device_s * 1e3, 3)})
         out = [ecdsa.pubkey_to_address(p) if p is not None else None
                for p in pubs]
         for i in host_rows:
@@ -372,13 +378,11 @@ class JaxSigBackend(SigBackend):
         return out
 
     def bls_verify_aggregates(self, messages, agg_sigs, agg_pks):
-        import numpy as np
-
         jnp = self._jnp
         n = len(messages)
         if n == 0:
             return []
-        t_start = time.monotonic()
+        dt = DeviceTimer("bls_aggregate")
         bucket = self._bucket(n)
         fresh = self._note_shape("bls_aggregate", bucket)
         pad = bucket - n
@@ -389,21 +393,21 @@ class JaxSigBackend(SigBackend):
         # infinity signature/key is an outright rejection (scalar parity)
         valid = hok & sok & pok
         tracer = tracing.TRACER
-        t0 = time.monotonic()
+        dt.dispatched()
         out = self._bls(
             jnp.asarray(hx), jnp.asarray(hy), jnp.asarray(sx),
             jnp.asarray(sy), jnp.asarray(pkx), jnp.asarray(pky),
             jnp.asarray(valid))
-        res = [bool(b) for b in np.asarray(out)[:n]]
-        t1 = time.monotonic()
-        self._t_marshal.observe(t0 - t_start)
-        self._t_device.observe(t1 - t0)
+        res = [bool(b) for b in dt.pull(out)[:n]]
+        dt.done()
         if tracer.enabled:
-            tracer.record("jax/bls_aggregate_dispatch", t0, t1,
+            tracer.record("jax/bls_aggregate_dispatch", dt.t_dispatch,
+                          dt.t_done,
                           tags={"rows": n, "bucket": bucket,
                                 "compile": "miss" if fresh else "hit",
-                                "marshal_ms": round((t0 - t_start) * 1e3, 3),
-                                "device_ms": round((t1 - t0) * 1e3, 3)})
+                                "suspect": dt.suspect,
+                                "marshal_ms": round(dt.marshal_s * 1e3, 3),
+                                "device_ms": round(dt.device_s * 1e3, 3)})
         return res
 
     def bls_verify_committees(self, messages, sig_rows, pk_rows,
@@ -426,8 +430,6 @@ class JaxSigBackend(SigBackend):
         bit-identical to the scalar reference because every malformed-
         row rejection is folded into the `valid` plane at marshal time
         (das/proofs.marshal_samples)."""
-        import numpy as np
-
         from gethsharding_tpu.das import proofs as das_proofs
 
         jnp = self._jnp
@@ -435,7 +437,7 @@ class JaxSigBackend(SigBackend):
         if n == 0:
             self.last_wire = None
             return []
-        t_start = time.monotonic()
+        dt = DeviceTimer("das_verify")
         bucket = self._bucket(n)
         fresh = self._note_shape("das_verify", bucket)
         st = das_proofs.marshal_samples(chunks, indices, proofs, roots,
@@ -450,23 +452,24 @@ class JaxSigBackend(SigBackend):
                           "wire_bytes": sample_bytes,
                           "sample_wire_bytes": sample_bytes,
                           "rows": n, "bucket": bucket, "wire": self._wire}
+        RECORDER.record_wire("das_verify_samples", self.last_wire)
         self._m_wire_bytes.inc(sample_bytes)
         tracing.tag_current_add(wire_bytes=sample_bytes,
                                 sample_wire_bytes=sample_bytes)
         tracer = tracing.TRACER
-        t0 = time.monotonic()
+        dt.dispatched()
         out = das_proofs.batch_verifier()(*(jnp.asarray(p) for p in planes))
-        res = [bool(b) for b in np.asarray(out)[:n]]
-        t1 = time.monotonic()
-        self._t_marshal.observe(t0 - t_start)
-        self._t_device.observe(t1 - t0)
+        res = [bool(b) for b in dt.pull(out)[:n]]
+        dt.done()
         if tracer.enabled:
-            tracer.record("jax/das_verify_dispatch", t0, t1,
+            tracer.record("jax/das_verify_dispatch", dt.t_dispatch,
+                          dt.t_done,
                           tags={"rows": n, "bucket": bucket,
                                 "compile": "miss" if fresh else "hit",
                                 "sample_wire_bytes": sample_bytes,
-                                "marshal_ms": round((t0 - t_start) * 1e3, 3),
-                                "device_ms": round((t1 - t0) * 1e3, 3)})
+                                "suspect": dt.suspect,
+                                "marshal_ms": round(dt.marshal_s * 1e3, 3),
+                                "device_ms": round(dt.device_s * 1e3, 3)})
         return res
 
     # -- the staged committee path -----------------------------------------
@@ -488,6 +491,7 @@ class JaxSigBackend(SigBackend):
             # the jax committee path (e.g. an empty batch) must read None,
             # not a stale split from a prior audit in the same process
             self.last_timing = None
+        dt = DeviceTimer("bls_committee")
         t0 = time.perf_counter()
         jnp = self._jnp
         n = len(messages)
@@ -517,6 +521,7 @@ class JaxSigBackend(SigBackend):
         # arithmetic, no device sync) — probe-42 transfer attribution
         # must not require the sync-forcing timing mode
         self.last_wire = wire
+        RECORDER.record_wire("bls_verify_committees", wire)
         self._m_wire_bytes.inc(wire["wire_bytes"])
         self._m_pk_hit_bytes.inc(wire["pk_hit_bytes"])
         # stamp the enclosing caller span (the notary's notary/audit);
@@ -527,8 +532,7 @@ class JaxSigBackend(SigBackend):
               else self._bls_committee)
         tracer = tracing.TRACER
         marshal_s = t1 - t0  # host marshal: limb planes + cache resolve
-        self._t_marshal.observe(marshal_s)
-        td = time.monotonic()
+        dt.dispatched()  # marshal (incl. transfer staging) closes here
         out = fn(*args)  # async dispatch: returns before execution ends
         # finalize must close over SCALARS, not the marshal dict: `st`
         # pins every host limb plane (MBs per dispatch) until result(),
@@ -536,22 +540,24 @@ class JaxSigBackend(SigBackend):
         bucket, width, fresh = st["bucket"], st["width"], st["fresh"]
 
         def finalize():
-            res = [bool(b) for b in np.asarray(out)[:n]]
-            t_done = time.monotonic()
-            self._t_device.observe(t_done - td)
+            # the checked pull is the barrier: block-vs-pull divergence
+            # (the r4 no-op hazard) lands on perfwatch/timer_suspect
+            res = [bool(b) for b in dt.pull(out)[:n]]
+            dt.done()
             if tracer.enabled:
-                # the np.asarray pull above means the span closes only
+                # the checked pull above means the span closes only
                 # after the dispatch actually executed; on the async
                 # path it additionally covers the overlapped wait
                 tracer.record(
-                    "jax/bls_committee_dispatch", td, t_done,
+                    "jax/bls_committee_dispatch", dt.t_dispatch, dt.t_done,
                     tags={"rows": n, "bucket": bucket,
                           "width": width, "wire": self._wire,
                           "compile": "miss" if fresh else "hit",
+                          "suspect": dt.suspect,
                           "wire_bytes": wire["wire_bytes"],
                           "pk_hit_bytes": wire["pk_hit_bytes"],
                           "marshal_ms": round(marshal_s * 1e3, 3),
-                          "device_ms": round((t_done - td) * 1e3, 3)})
+                          "device_ms": round(dt.device_s * 1e3, 3)})
             if timing:
                 t3 = time.perf_counter()
                 # per-instance: two backends in one process must not

@@ -317,6 +317,13 @@ class SLOTracker:
                 series.breached = False
         if fire:
             series.m_breaches.inc()
+            # breach ONSET only (hysteresis-gated above): one flight-
+            # recorder event per episode, not one per gauge refresh
+            from gethsharding_tpu.perfwatch import RECORDER
+
+            RECORDER.record("slo_breach", objective=name,
+                            fast_burn=round(fast, 3),
+                            slow_burn=round(slow, 3))
             log.warning(
                 "SLO breach on %s: fast burn %.1fx budget "
                 "(threshold %.1fx), slow burn %.1fx (threshold "

@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""One-shot migration of the historical perf record into the perfwatch
+ledger: ``BENCH_r01–r05.json`` (driver round artifacts) plus
+``bench_results/*.json`` (the tunnel watcher's live TPU captures) become
+ledger records, so the regression baseline starts from the REAL
+measured history instead of an empty file.
+
+Input shapes handled:
+
+- driver artifacts: ``{"n": round, "cmd": ..., "rc": ..., "tail": ...,
+  "parsed": {metric, value, unit, vs_baseline, extra?}}`` — the parsed
+  metric line is the record, the round number becomes ``round``;
+- capture files: the bare ``{metric, value, unit, vs_baseline, extra}``
+  line shape `bench.py` prints.
+
+Timestamps come from ``extra.captured_at`` when embedded (the honest
+provenance stamp), else the file's mtime. Records that would duplicate
+an already-imported measurement (same metric, value and capture stamp —
+BENCH_r05 re-reports r04's capture, and the capture files are the same
+runs) are skipped, as are records already present in the target ledger,
+so the import is idempotent.
+
+Usage::
+
+    python scripts/ledger_import.py [--ledger PATH] [--dry-run]
+
+then ``python -m gethsharding_tpu.perfwatch --check --report`` renders
+the measured-history table (the machine-generated twin of PERF.md's
+hand-kept one) from what landed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from gethsharding_tpu.perfwatch.ledger import (  # noqa: E402
+    Ledger, build_record)
+
+
+def _parse_ts(extra: dict, path: str) -> float:
+    stamp = (extra or {}).get("captured_at")
+    if stamp:
+        try:
+            return time.mktime(time.strptime(stamp, "%Y-%m-%d %H:%M:%S"))
+        except ValueError:
+            pass
+    return os.path.getmtime(path)
+
+
+def _to_record(parsed: dict, path: str, round_n=None) -> "dict | None":
+    if not isinstance(parsed, dict) or "metric" not in parsed \
+            or "value" not in parsed:
+        return None
+    if not isinstance(parsed["value"], (int, float)):
+        return None
+    extra = parsed.get("extra") or {}
+    # ONE schema adapter (perfwatch.ledger.build_record) — the importer
+    # must never re-implement the extras-splitting rules, or imported
+    # history would drift from live records
+    rec = build_record(
+        metric=parsed["metric"], value=parsed["value"],
+        unit=parsed.get("unit"), vs_baseline=parsed.get("vs_baseline"),
+        extra=extra, source="import")
+    if not isinstance(extra.get("knobs"), dict):
+        # a stamp-less historical record must NOT inherit the importing
+        # process's current knob env (build_record's live default)
+        rec["knobs"] = {}
+    ts_unix = _parse_ts(extra, path)
+    rec["ts_unix"] = ts_unix
+    rec["ts"] = time.strftime("%Y-%m-%d %H:%M:%S",
+                              time.localtime(ts_unix))
+    if round_n is not None:
+        rec["extra"]["round"] = round_n
+    rec["extra"]["imported_from"] = os.path.relpath(path, REPO)
+    return rec
+
+
+def _fingerprint(rec: dict) -> tuple:
+    return (rec.get("workload"), rec.get("metrics", {}).get("value"),
+            rec.get("ts"))
+
+
+def collect() -> list:
+    records = []
+    for path in sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json"))):
+        try:
+            data = json.load(open(path))
+        except (OSError, ValueError) as exc:
+            print(f"# skipping {path}: {exc!r}", file=sys.stderr)
+            continue
+        rec = _to_record(data.get("parsed"), path, round_n=data.get("n"))
+        if rec is None:
+            print(f"# skipping {path}: no parsed metric line",
+                  file=sys.stderr)
+            continue
+        records.append(rec)
+    for path in sorted(glob.glob(os.path.join(REPO, "bench_results",
+                                              "*.json"))):
+        try:
+            data = json.load(open(path))
+        except (OSError, ValueError) as exc:
+            print(f"# skipping {path}: {exc!r}", file=sys.stderr)
+            continue
+        rec = _to_record(data, path)
+        if rec is None:
+            print(f"# skipping {path}: not a metric line", file=sys.stderr)
+            continue
+        records.append(rec)
+    records.sort(key=lambda r: r["ts_unix"])
+    # dedup: a capture re-reported by a later round is ONE measurement
+    seen, unique = set(), []
+    for rec in records:
+        fp = _fingerprint(rec)
+        if fp in seen:
+            print(f"# dedup: {rec['extra']['imported_from']} repeats "
+                  f"{fp[0]}={fp[1]} @ {fp[2]}", file=sys.stderr)
+            continue
+        seen.add(fp)
+        unique.append(rec)
+    return unique
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        description="import BENCH_r*/bench_results history into the "
+                    "perfwatch ledger")
+    parser.add_argument("--ledger", default=None, metavar="PATH",
+                        help="target ledger (default: the perfwatch "
+                             "default path)")
+    parser.add_argument("--dry-run", action="store_true",
+                        help="print what would be appended, write nothing")
+    args = parser.parse_args()
+    ledger = Ledger(args.ledger)
+    existing = {_fingerprint(rec) for rec in ledger.records()}
+    records = [rec for rec in collect()
+               if _fingerprint(rec) not in existing]
+    for rec in records:
+        print(f"{rec['ts']}  {rec['workload']:44s} "
+              f"{rec['metrics']['value']:>12g}  "
+              f"[{rec.get('platform') or 'cpu-era'}] "
+              f"<- {rec['extra']['imported_from']}")
+        if not args.dry_run:
+            ledger.append(rec)
+    verb = "would import" if args.dry_run else "imported"
+    print(f"# {verb} {len(records)} record(s) into {ledger.path}",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -46,6 +46,21 @@ if [ "$up" = 1 ]; then
              "from /metrics?format=prom"
         fail=1
     fi
+    # ... and so must the perfwatch trust counters (timer self-check +
+    # flight recorder), registered at package import
+    if ! echo "$prom" | grep -q "gethsharding_perfwatch_timer_suspect_total"
+    then
+        echo "observability smoke FAILED: perfwatch/timer_suspect missing" \
+             "from /metrics?format=prom"
+        fail=1
+    fi
+    # the /status perf section renders (last ledger record + gate +
+    # recorder state)
+    if ! curl -sf "http://127.0.0.1:$obs_port/status" \
+            | grep -q '"perf"'; then
+        echo "observability smoke FAILED: /status has no perf section"
+        fail=1
+    fi
 else
     echo "observability smoke FAILED: node never answered /healthz"
     fail=1
@@ -365,6 +380,60 @@ else
     fail=1
 fi
 rm -rf "$obsfleet_dir"
+
+# -- perfwatch smoke: the CPU-quick micro suite + the noise-aware
+# regression gate, closed loop — seed a FRESH ledger with clean runs,
+# the gate must pass; inject a labeled 1.5x slowdown into one
+# registered microbench, the gate must trip (exit 1); a clean rerun
+# must pass again (the outlier cannot poison the rolling median)
+echo "== perfwatch smoke (micro suite + regression gate)"
+pw_tmp=$(mktemp -d)
+pw_led="$pw_tmp/ledger.jsonl"
+pw_ok=1
+for _ in 1 2 3 4; do
+    JAX_PLATFORMS=cpu GETHSHARDING_PERFWATCH_LEDGER="$pw_led" \
+        python -m gethsharding_tpu.perfwatch --run --check \
+        >/dev/null 2>&1 || pw_ok=0
+done
+if [ "$pw_ok" != 1 ]; then
+    # one settle retry: a cold/loaded host can scatter the first runs
+    # past the band; a REAL regression persists into the next clean run
+    if JAX_PLATFORMS=cpu GETHSHARDING_PERFWATCH_LEDGER="$pw_led" \
+        python -m gethsharding_tpu.perfwatch --run --check >/dev/null 2>&1
+    then
+        pw_ok=1
+    fi
+fi
+if [ "$pw_ok" != 1 ]; then
+    echo "perfwatch smoke FAILED: clean micro-suite runs tripped the gate"
+    fail=1
+fi
+if JAX_PLATFORMS=cpu GETHSHARDING_PERFWATCH_LEDGER="$pw_led" \
+    GETHSHARDING_PERFWATCH_INJECT="clock_spin_5ms:1.5" \
+    python -m gethsharding_tpu.perfwatch --run --check >/dev/null 2>&1
+then
+    echo "perfwatch smoke FAILED: injected 1.5x slowdown did NOT trip" \
+         "the regression gate"
+    fail=1
+fi
+# the heal step gets the SAME settle allowance as the clean loop: the
+# full-suite check includes the real workload benches, whose ~20% host
+# drift can organically brush the band — a REAL regression persists
+# into a second clean run, a load blip does not
+if ! JAX_PLATFORMS=cpu GETHSHARDING_PERFWATCH_LEDGER="$pw_led" \
+    python -m gethsharding_tpu.perfwatch --run --check >/dev/null 2>&1
+then
+    if ! JAX_PLATFORMS=cpu GETHSHARDING_PERFWATCH_LEDGER="$pw_led" \
+        python -m gethsharding_tpu.perfwatch --run --check >/dev/null 2>&1
+    then
+        echo "perfwatch smoke FAILED: clean rerun after the injected" \
+             "record still trips the gate"
+        fail=1
+    fi
+fi
+rm -rf "$pw_tmp"
+[ "$fail" = 0 ] && echo "perfwatch smoke OK: gate passes clean, trips on" \
+    "the injected slowdown, heals on the clean rerun"
 
 # -- shardlint: the repo-wide static analysis gate (jit-purity,
 # host-sync, lock-order, race-guard, layering, backend-contract,

@@ -33,6 +33,24 @@ from gethsharding_tpu.parallel.virtual import force_virtual_cpu_devices
 
 force_virtual_cpu_devices(8)
 
+# perfwatch hermeticity: the flight recorder dumps post-mortem bundles
+# on every breaker trip / watchdog fire / soundness violation — events
+# the resilience suites trigger ON PURPOSE, hundreds of times. Point
+# the bundle directory and the benchmark ledger at a session temp dir
+# (unless the caller pinned them) so a test run never litters the repo
+# with black-box bundles or appends test noise to the committed
+# measurement history.
+if "GETHSHARDING_PERFWATCH_DIR" not in _os.environ:
+    import tempfile as _tempfile
+
+    _os.environ["GETHSHARDING_PERFWATCH_DIR"] = _tempfile.mkdtemp(
+        prefix="perfwatch_blackbox_")
+if "GETHSHARDING_PERFWATCH_LEDGER" not in _os.environ:
+    import tempfile as _tempfile
+
+    _os.environ["GETHSHARDING_PERFWATCH_LEDGER"] = _os.path.join(
+        _tempfile.mkdtemp(prefix="perfwatch_ledger_"), "ledger.jsonl")
+
 # XLA:CPU deterministically segfaults once a process holds too many
 # compiled programs (~150): r3 faulthandler runs place the crash at the
 # SAME test/program both inside the persistent-cache deserializer

@@ -778,6 +778,32 @@ def test_closed_loop_drain_soak_acceptance():
         assert r0.state == "healthy", router.states()
         assert r0.reentries >= 1
 
+        # deterministic overload coda: the organic flood's pressure is
+        # timing-dependent — on a fast host (or when an early r0 trip
+        # spaces arrivals behind retry backoffs) 12 rounds can drain
+        # without ever overflowing a 16-row queue, and an overload
+        # phase that never overloaded would flake the shed assertions
+        # instead of testing them. If nothing shed organically, drive
+        # one concentrated catchup burst at a single replica beyond its
+        # queue + double-buffer capacity (16 queued + 16 slotted + 16
+        # executing = 48 rows; 10x8 = 80 arriving at once MUST shed),
+        # so shed-by-class is always exercised.
+        if sum(s.batcher.shed_by_class()[CLASS_CATCHUP]
+               for s in servings) + catchup_shed[0] == 0:
+            def burst(k: int) -> None:
+                rows = [cases[(k + j) % len(cases)] for j in range(8)]
+                try:
+                    servings[1].classed(CLASS_CATCHUP).ecrecover_addresses(
+                        [c[0] for c in rows], [c[1] for c in rows])
+                except ServingOverloadError:
+                    catchup_shed[0] += 1
+            burst_threads = [threading.Thread(target=burst, args=(k,))
+                             for k in range(10)]
+            for thread in burst_threads:
+                thread.start()
+            for thread in burst_threads:
+                thread.join(timeout=60)
+
         # shed-by-class: interactive rode through untouched; the
         # catchup flood absorbed the overload
         replica_sheds = {
@@ -787,7 +813,11 @@ def test_closed_loop_drain_soak_acceptance():
                           CLASS_CATCHUP)}
         assert interactive_shed[0] == 0
         assert replica_sheds[CLASS_INTERACTIVE] == 0, replica_sheds
-        assert replica_sheds[CLASS_CATCHUP] > 0, replica_sheds
+        # the overload evidence can land replica-side (displacement /
+        # arrival shed) or caller-side (the retry ladder exhausted) —
+        # the same either-side form bench.py --fleet gates on
+        assert replica_sheds[CLASS_CATCHUP] + catchup_shed[0] > 0, \
+            (replica_sheds, catchup_shed)
 
         # interactive latency SLO (generous for hermetic CPU: the bench
         # --fleet gate owns the tight number)
