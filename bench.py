@@ -1530,6 +1530,215 @@ def measure_perfwatch() -> dict:
     return out
 
 
+# == devscope closed-loop acceptance (bench.py --devscope) =================
+
+
+def measure_devscope() -> dict:
+    """The device-introspection plane's acceptance run, closed-loop:
+
+    1. **The storm detector fires exactly once.** An injected recompile
+       storm (unbucketed traffic widening the compiled-shape set past
+       the window threshold) must raise ONE `recompile_storm` recorder
+       event and one `storms` tick — not one per fresh shape — while a
+       steady-state stream of cache hits plus the occasional genuinely
+       new bucket raises nothing.
+    2. **A near-OOM leaves a census.** A simulated device at 95% HBM
+       utilization must fire the flight recorder's dump path, and the
+       resulting bundle's event ring must contain the `hbm_near_oom`
+       event WITH the buffer census attributing live buffers to their
+       registered owner.
+    3. **It all stays cheap.** The sampling profiler's per-tick cost ×
+       its rate plus the memory poller's per-poll cost ÷ its interval —
+       the fraction of wall time the plane consumes while a serving
+       request runs — is measured against a real serving request and
+       asserted <2% (the same budget bar as tracing/SLO/perfwatch)."""
+    import tempfile
+
+    from gethsharding_tpu import devscope
+    from gethsharding_tpu import metrics as _metrics
+    from gethsharding_tpu.devscope import (CompileWatch, MemoryPoller,
+                                           SamplingProfiler)
+    from gethsharding_tpu.perfwatch.recorder import RECORDER
+
+    out: dict = {}
+    tmp = tempfile.mkdtemp(prefix="bench_devscope_")
+    # the drills run against ISOLATED metric registries: an injected
+    # storm or a fake 15-GiB device must exercise the detectors without
+    # latching this process's real devscope/* rows (recorder events
+    # stay global on purpose — they ARE the acceptance evidence)
+    drill_reg = _metrics.Registry()
+
+    # -- part 1: the recompile-storm detector, exactly once ---------------
+    def _storm_events() -> int:
+        return sum(1 for e in RECORDER.events()
+                   if e["kind"] == "recompile_storm")
+
+    watch = CompileWatch(storm_shapes=8, storm_window_s=30.0,
+                         registry=drill_reg)
+    events_before = _storm_events()
+    for _ in range(64):  # steady state: the same bucketed shape, hits
+        watch.saw("bls_committee", (128, 144), False)
+    watch.saw("bls_committee", (160, 144), True)  # one honest new bucket
+    assert watch.storms == 0, "a single fresh shape must not be a storm"
+    assert _storm_events() == events_before
+    for i in range(16):  # the storm: unbucketed widths flooding in
+        watch.saw("bls_committee", (100 + i, 144), True)
+    assert watch.storms == 1, (
+        f"injected recompile storm raised {watch.storms} times, want 1")
+    for i in range(16, 32):  # an ONGOING storm must not re-raise
+        watch.saw("bls_committee", (100 + i, 144), True)
+    assert watch.storms == 1, "ongoing storm re-raised the detector"
+    storm_events = _storm_events() - events_before
+    assert storm_events == 1, (
+        f"{storm_events} recompile_storm recorder events, want exactly 1")
+    assert watch.storm_active(), "storm gauge should still be latched"
+    out["storm_raised"] = watch.storms
+    out["storm_recorder_events"] = storm_events
+    out["storm_fresh_shapes"] = 33
+
+    # -- part 2: simulated near-OOM -> bundle with the buffer census ------
+    class _Buf:
+        def __init__(self, nbytes, shape):
+            self.nbytes = nbytes
+            self.shape = shape
+            self.dtype = "int32"
+
+    bufs = [_Buf(48 << 20, (1024, 135, 2, 25)),
+            _Buf(16 << 20, (1024, 135, 2, 25)),
+            _Buf(4 << 20, (128, 144))]
+
+    class _HotDevice:
+        id = 0
+        platform = "tpu"
+
+        def memory_stats(self):
+            return {"bytes_in_use": int(15.2 * (1 << 30)),
+                    "peak_bytes_in_use": int(15.4 * (1 << 30)),
+                    "bytes_limit": 16 << 30}
+
+    devscope.register_owner(
+        "bench_demo_plane",
+        claimed_fn=lambda: sum(b.nbytes for b in bufs),
+        buffers_fn=lambda: list(bufs))
+    old_env = {k: os.environ.get(k) for k in
+               ("GETHSHARDING_PERFWATCH_DIR", "GETHSHARDING_PERFWATCH_DUMP_S")}
+    os.environ["GETHSHARDING_PERFWATCH_DIR"] = os.path.join(tmp, "blackbox")
+    os.environ["GETHSHARDING_PERFWATCH_DUMP_S"] = "0"
+    try:
+        poller = MemoryPoller(interval_s=60.0,
+                              devices_fn=lambda: [_HotDevice()],
+                              buffers_fn=lambda: list(bufs),
+                              registry=drill_reg)
+        readings = poller.poll_once()
+        assert readings["d0"]["limit"] == 16 << 30
+        deadline = time.monotonic() + 10.0
+        bundle = None
+        while time.monotonic() < deadline:
+            RECORDER.flush()
+            base = os.environ["GETHSHARDING_PERFWATCH_DIR"]
+            dirs = sorted(os.listdir(base)) if os.path.isdir(base) else []
+            if dirs:
+                bundle = os.path.join(base, dirs[-1])
+                break
+            time.sleep(0.05)
+        assert bundle is not None, "near-OOM fired but no bundle appeared"
+        events = json.load(open(os.path.join(bundle, "events.json")))
+        oom = [e for e in events if e["kind"] == "hbm_near_oom"]
+        assert oom, f"no hbm_near_oom event in the bundle: " \
+                    f"{sorted({e['kind'] for e in events})}"
+        census = oom[-1]["detail"]["census"]
+        assert census["live_buffers"] == len(bufs), census
+        owner_slot = census["by_owner"].get("bench_demo_plane")
+        assert owner_slot and owner_slot["bytes"] == sum(
+            b.nbytes for b in bufs), census["by_owner"]
+        assert not census["owners"]["bench_demo_plane"]["drifted"]
+        # a second poll at the same utilization must NOT re-dump: the
+        # episode latch holds until utilization clears the hysteresis
+        near_oom_before = poller.describe()["near_oom_events"]
+        poller.poll_once()
+        assert poller.describe()["near_oom_events"] == near_oom_before, (
+            "near-OOM re-fired inside one episode")
+        out["bundle"] = bundle
+        out["census_buffers"] = census["live_buffers"]
+        out["census_owned_bytes"] = owner_slot["bytes"]
+    finally:
+        devscope.unregister_owner("bench_demo_plane")
+        for key, val in old_env.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+
+    # -- part 3: sampler + poller overhead vs a serving request -----------
+    from gethsharding_tpu.serving import ServingConfig, ServingSigBackend
+    from gethsharding_tpu.sigbackend import PythonSigBackend
+
+    serving = ServingSigBackend(PythonSigBackend(),
+                                ServingConfig(flush_us=500.0))
+    try:
+        serving.ecrecover_addresses([], [])  # warm the threads
+        n = 100
+        t0 = time.perf_counter()
+        for i in range(n):
+            serving.ecrecover_addresses(
+                [bytes([i % 251]) * 32], [b"\x00" * 65])
+        per_request_s = (time.perf_counter() - t0) / n
+
+        # the sampler's per-tick cost, measured with the serving
+        # threads live (a tick walks EVERY thread's stack — an idle
+        # process would understate it)
+        # default hz — the rate we charge; isolated registry (a probe
+        # loop must not inflate the process sample counter)
+        sampler = SamplingProfiler(registry=drill_reg)
+        m = 500
+        t0 = time.perf_counter()
+        for _ in range(m):
+            sampler.sample_once()
+        tick_s = (time.perf_counter() - t0) / m
+        assert sampler.collapsed(), "sampler collected no stacks"
+    finally:
+        serving.close()
+    class _CoolDevice:
+        # the overhead probe's device sits WELL below the near-OOM
+        # threshold: part 2 already restored the perfwatch env, so a
+        # 95% device here would dump real bundles into cwd and bill
+        # the background dump thread to the poll-cost timing
+        id = 0
+        platform = "tpu"
+
+        def memory_stats(self):
+            return {"bytes_in_use": 8 << 30,
+                    "peak_bytes_in_use": 9 << 30,
+                    "bytes_limit": 16 << 30}
+
+    idle_poller = MemoryPoller(interval_s=None,
+                               devices_fn=lambda: [_CoolDevice()],
+                               buffers_fn=lambda: [],
+                               registry=drill_reg)
+    m = 200
+    t0 = time.perf_counter()
+    for _ in range(m):
+        idle_poller.poll_once()
+    poll_s = (time.perf_counter() - t0) / m
+    # the plane's duty cycle: fraction of any wall interval (and hence
+    # of any serving request running through it) spent in devscope
+    duty = sampler.hz * tick_s + poll_s / idle_poller.interval_s
+    overhead_pct = 100.0 * duty
+    assert overhead_pct < 2.0, (
+        f"devscope sampler+poller overhead {overhead_pct:.3f}% of a "
+        f"serving request (tick {tick_s * 1e6:.1f}us x {sampler.hz}Hz + "
+        f"poll {poll_s * 1e6:.1f}us / {idle_poller.interval_s}s) "
+        f"breaches the 2% budget")
+    out["overhead_pct"] = round(overhead_pct, 4)
+    out["sampler_tick_us"] = round(tick_s * 1e6, 2)
+    out["sampler_hz"] = sampler.hz
+    out["poll_us"] = round(poll_s * 1e6, 2)
+    out["poll_interval_s"] = idle_poller.interval_s
+    out["per_request_us"] = round(per_request_s * 1e6, 1)
+    out["platform"] = "host"
+    return out
+
+
 # == autotune orchestration ================================================
 
 
@@ -1779,6 +1988,17 @@ def _probe_backend(timeout: float = 120.0):
 
 
 def main() -> None:
+    # the device-introspection stamp: every ledger record this process
+    # emits carries the peak-HBM watermark + cumulative compile cost
+    # (devscope.ledger_fields, polled on demand at each append — no
+    # background thread perturbing the measurements)
+    try:
+        from gethsharding_tpu import devscope as _devscope
+
+        _devscope.boot(start_poller=False)
+    except Exception:  # noqa: BLE001 - the stamp is additive
+        pass
+
     if "--single" in sys.argv:
         print(json.dumps(measure_single()))
         return
@@ -1917,6 +2137,25 @@ def main() -> None:
                f"{stats['per_request_us']}us; gate tripped on "
                f"{','.join(stats['gate_tripped_on'])}, bundle "
                f"{len(stats['bundle_files'])} files, host)"),
+              round(stats["overhead_pct"] / 2.0, 4),
+              {k: v for k, v in stats.items() if k != "overhead_pct"})
+        return
+
+    if "--devscope" in sys.argv:
+        # the device-introspection plane's acceptance gate: the
+        # recompile-storm detector raises exactly once on an injected
+        # storm (silent on steady state), a simulated near-OOM leaves a
+        # flight-recorder bundle containing the attributed buffer
+        # census, and the sampler+poller duty cycle stays under the 2%
+        # serving-request budget
+        stats = measure_devscope()
+        _emit("devscope_overhead_pct", stats["overhead_pct"],
+              (f"% of a serving request spent on the devscope sampler "
+               f"({stats['sampler_tick_us']}us/tick x "
+               f"{stats['sampler_hz']}Hz) + memory poller "
+               f"({stats['poll_us']}us / {stats['poll_interval_s']}s); "
+               f"storm raised {stats['storm_raised']}x, census "
+               f"{stats['census_buffers']} buffers, host)"),
               round(stats["overhead_pct"] / 2.0, 4),
               {k: v for k, v in stats.items() if k != "overhead_pct"})
         return

@@ -55,6 +55,7 @@ DEFAULT_SCOPES = (
     "gethsharding_tpu/slo/",
     "gethsharding_tpu/tracing/",
     "gethsharding_tpu/metrics.py",
+    "gethsharding_tpu/devscope/",
 )
 
 _LOCK_CTORS = {"Lock": False, "RLock": True, "Condition": True}

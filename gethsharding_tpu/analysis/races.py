@@ -73,6 +73,7 @@ DEFAULT_SCOPES = (
     "gethsharding_tpu/tracing/",
     "gethsharding_tpu/metrics.py",
     "gethsharding_tpu/rpc/",
+    "gethsharding_tpu/devscope/",
 )
 
 # atomic-by-convention constructor names: attributes holding these are

@@ -317,9 +317,16 @@ def run_cli(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     logging.basicConfig(
         level=getattr(logging, args.verbosity.upper()),
-        format="%(asctime)s %(levelname)-7s %(name)s  %(message)s",
+        format="%(asctime)s %(levelname)-7s %(name)s "
+               "[%(trace_id)s]  %(message)s",
         datefmt="%H:%M:%S",
     )
+    # log <-> trace correlation: every record carries the emitting
+    # context's trace id ('-' when none), so a warning from
+    # sharding.node joins against /trace output by id
+    from gethsharding_tpu import tracing as _tracing
+
+    _tracing.install_log_correlation()
     if args.command == "sharding":
         return run_sharding_node(args)
     if args.command == "attach":
@@ -536,6 +543,14 @@ def run_sharding_node(args) -> int:
     from gethsharding_tpu import slo
 
     slo.tracker()
+    # boot the device introspection plane (gethsharding_tpu/devscope):
+    # the HBM memory poller starts publishing devscope/mem/* gauges and
+    # the near-OOM census trigger arms; the compile watch and the
+    # /profile //shard_profileStart surfaces are passive until used.
+    # GETHSHARDING_DEVSCOPE=0 turns the poller off.
+    from gethsharding_tpu import devscope
+
+    devscope.boot()
 
     node.start()
 
@@ -553,6 +568,7 @@ def run_sharding_node(args) -> int:
         log.info("interrupt received, shutting down")
     finally:
         node.stop()
+        devscope.shutdown()  # poller thread + any live profile session
         if profiling:
             import jax
 

@@ -131,6 +131,14 @@ class StatusServer(Service):
         from gethsharding_tpu import perfwatch
 
         payload["perf"] = perfwatch.perf_status()
+        # device introspection at a glance (gethsharding_tpu/devscope):
+        # HBM gauges + census/drift state from the memory poller,
+        # per-shape compile costs + the recompile-storm verdict, and
+        # the on-demand profiler's session state — the devscope/* rows
+        # ride the Prometheus exposition, /profile toggles sessions
+        from gethsharding_tpu import devscope
+
+        payload["devscope"] = devscope.devscope_status()
         # span-ring health: a nonzero dropped count means the bounded
         # finished-span ring overwrote spans nobody exported — raise
         # --trace-ring or export more often
@@ -157,6 +165,27 @@ class StatusServer(Service):
                 "spans_dropped": tracing.TRACER.spans_dropped,
                 "traces": tracing.TRACER.recent_traces(limit=100)}
 
+    def profile_payload(self, query: dict) -> dict:
+        """The /profile control surface: GET /profile reports the
+        profiler state; ``?action=start`` / ``?action=stop`` toggle a
+        session (``mode=sampler|jax|both``, ``hz=<float>`` for the
+        sampler) — the curl-able twin of the shard_profileStart/Stop
+        RPC methods. Idempotent both ways (profiler.py)."""
+        from gethsharding_tpu.devscope import PROFILER
+
+        action = (query.get("action", [""]) or [""])[0]
+        if action == "start":
+            mode = (query.get("mode", [None]) or [None])[0]
+            hz = (query.get("hz", [None]) or [None])[0]
+            return PROFILER.start(mode=mode,
+                                  hz=None if hz is None else float(hz))
+        if action == "stop":
+            return PROFILER.stop()
+        if action:
+            raise ValueError(f"unknown profile action {action!r}; "
+                             "use action=start or action=stop")
+        return PROFILER.describe()
+
     # -- lifecycle ---------------------------------------------------------
 
     def on_start(self) -> None:
@@ -179,6 +208,35 @@ class StatusServer(Service):
                 if path == "/":
                     self._send(200, "text/html; charset=utf-8",
                                _DASHBOARD_HTML.encode())
+                    return
+                if path == "/profile/stacks":
+                    # the sampling profiler's collapsed stacks as plain
+                    # text: feed to a flamegraph tool or
+                    # scripts/tpu_breakdown.py --stacks
+                    from gethsharding_tpu.devscope import PROFILER
+
+                    try:
+                        body, code = PROFILER.stacks().encode(), 200
+                    except Exception as exc:  # noqa: BLE001
+                        body, code = f"# error: {exc!r}\n".encode(), 500
+                    self._send(code, "text/plain; charset=utf-8", body)
+                    return
+                if path == "/profile":
+                    # control route: acts on the query, then answers
+                    # like the JSON routes below. Caller input errors
+                    # (unknown action/mode, non-numeric hz) are 400 —
+                    # a monitoring probe must not page a 5xx for a typo
+                    try:
+                        body = json.dumps(status.profile_payload(
+                            parse_qs(parsed.query))).encode()
+                        code = 200
+                    except ValueError as exc:
+                        body = json.dumps({"error": str(exc)}).encode()
+                        code = 400
+                    except Exception as exc:  # noqa: BLE001
+                        body = json.dumps({"error": repr(exc)}).encode()
+                        code = 500
+                    self._send(code, "application/json", body)
                     return
                 if path == "/metrics" and "prom" in parse_qs(
                         parsed.query).get("format", []):

@@ -277,6 +277,32 @@ def build_record(metric: str, value: float, unit: Optional[str] = None,
     return record
 
 
+# devscope stamp routing: the peak-HBM watermark is a GATED metric
+# (memory creep flags like latency); the compile totals are
+# process-cumulative — what they measure depends on every mode that
+# ran earlier in the same process, so gating them would flag
+# invocation composition, not compile growth. They ride in `extra`
+# as attribution.
+_DEVSCOPE_GATED = ("peak_hbm_bytes",)
+
+
+def _devscope_fields() -> Dict[str, float]:
+    """The device-introspection stamp every LIVE record carries: the
+    observed peak-HBM watermark (gated — memory creep flags like
+    latency) and the cumulative compile attribution (informational).
+    Lazy + best-effort: a host with no devscope plane (or an
+    import-order edge case) stamps nothing, and the history importer
+    (`scripts/ledger_import.py`) never calls this — replayed history
+    must not wear this process's device state."""
+    try:
+        from gethsharding_tpu.devscope import ledger_fields
+
+        return {k: v for k, v in ledger_fields().items()
+                if isinstance(v, (int, float))}
+    except Exception:  # noqa: BLE001 - the stamp is additive
+        return {}
+
+
 def record_bench(metric: str, value: float, unit: Optional[str] = None,
                  vs_baseline: Optional[float] = None,
                  extra: Optional[dict] = None,
@@ -285,7 +311,20 @@ def record_bench(metric: str, value: float, unit: Optional[str] = None,
                  suspects: int = 0,
                  ledger: Optional[Ledger] = None) -> dict:
     """Build (`build_record`) + append in one step — the live
-    emitters' entry (bench.py `_emit`, the capture replay path)."""
-    return (ledger or Ledger()).append(build_record(
+    emitters' entry (bench.py `_emit`, the capture replay path).
+    LIVE records (source \"bench\") additionally carry the devscope
+    stamp (`_devscope_fields`): peak-HBM into the gated metrics dict,
+    compile attribution into `extra` — ONE schema, stamped by the one
+    writer, never by per-mode extras. Replays and imports are exempt:
+    a capture re-emitted on a tunnel-dead CPU host measured ANOTHER
+    process's device, and stamping this host's peak (0) into the TPU
+    group would poison the gated memory baseline."""
+    record = build_record(
         metric, value, unit=unit, vs_baseline=vs_baseline, extra=extra,
-        workload=workload, source=source, valid=valid, suspects=suspects))
+        workload=workload, source=source, valid=valid, suspects=suspects)
+    if source == "bench":
+        for key, val in _devscope_fields().items():
+            slot = (record["metrics"] if key in _DEVSCOPE_GATED
+                    else record["extra"])
+            slot.setdefault(key, float(val))
+    return (ledger or Ledger()).append(record)

@@ -66,6 +66,23 @@ def _max_bundles() -> int:
     return int(os.environ.get("GETHSHARDING_PERFWATCH_BUNDLES", "8"))
 
 
+def prune_dirs(base: str, keep: int) -> None:
+    """Keep only the newest `keep` subdirectories of `base` (name
+    order — both producers stamp sortable timestamps). Shared by the
+    flight recorder's bundle dir and the devscope profiler's session
+    dir."""
+    import shutil
+
+    try:
+        entries = sorted(e for e in os.listdir(base)
+                         if os.path.isdir(os.path.join(base, e)))
+    except OSError:
+        return
+    keep = max(1, keep)
+    for stale in entries[:-keep] if len(entries) > keep else []:
+        shutil.rmtree(os.path.join(base, stale), ignore_errors=True)
+
+
 class FlightRecorder:
     """Bounded event + wire-ledger rings with a post-mortem dump."""
 
@@ -237,17 +254,7 @@ class FlightRecorder:
     @staticmethod
     def _prune(base: str) -> None:
         """Keep only the newest `_max_bundles()` bundle directories."""
-        import shutil
-
-        try:
-            entries = sorted(
-                e for e in os.listdir(base)
-                if os.path.isdir(os.path.join(base, e)))
-        except OSError:
-            return
-        for stale in entries[:-_max_bundles()] if len(entries) \
-                > _max_bundles() else []:
-            shutil.rmtree(os.path.join(base, stale), ignore_errors=True)
+        prune_dirs(base, _max_bundles())
 
     def flush(self, timeout: float = 5.0) -> None:
         """Wait for an in-flight background dump (tests + shutdown)."""

@@ -83,10 +83,17 @@ def main(argv=None) -> int:
     parser.add_argument("--verbosity", default="warning")
     args = parser.parse_args(argv)
 
-    logging.basicConfig(level=getattr(logging, args.verbosity.upper()))
-    if args.trace or args.trace_out:
-        from gethsharding_tpu import tracing
+    logging.basicConfig(
+        level=getattr(logging, args.verbosity.upper()),
+        format="%(asctime)s %(levelname)-7s %(name)s "
+               "[%(trace_id)s]  %(message)s",
+        datefmt="%H:%M:%S")
+    # log <-> trace correlation (same stamp as the sharding CLI): a
+    # replica's warnings join against its /trace + RPC-stitched spans
+    from gethsharding_tpu import tracing
 
+    tracing.install_log_correlation()
+    if args.trace or args.trace_out:
         tracing.enable(ring_spans=args.trace_ring)
     overrides = {"period_length": args.periodlength}
     if args.quorum is not None:
@@ -129,6 +136,12 @@ def main(argv=None) -> int:
     from gethsharding_tpu import slo
 
     slo.tracker()
+    # device introspection plane: HBM poller + the devscope/* rows this
+    # replica's shard_metrics snapshot federates; shard_profileStart /
+    # shard_profileStop toggle on-demand profiling over the RPC below
+    from gethsharding_tpu import devscope
+
+    devscope.boot()
     server = RPCServer(backend, host=args.host, port=args.port,
                        sig_backend=sig_backend)
     server.start()
@@ -156,6 +169,7 @@ def main(argv=None) -> int:
         if follower is not None:
             follower.stop()
         server.stop()
+        devscope.shutdown()
         # the server never owned the injected composition: drain-and-
         # fail its queued serving futures here so no caller is stranded
         composed.close()

@@ -502,6 +502,45 @@ class RPCServer:
 
         return DEFAULT_REGISTRY.snapshot()
 
+    # -- on-demand profiling (the devscope control surface) ----------------
+
+    def rpc_profileStart(self, mode=None, hz=None):
+        """Begin an on-demand profiling session on THIS process:
+        ``mode`` = ``sampler`` (pure-Python collapsed-stack sampler),
+        ``jax`` (a jax.profiler trace into the bounded devscope
+        profile directory), or ``both`` (the default). Idempotent — a
+        session already running is reported, never doubled. The
+        StatusServer's ``/profile?action=start`` drives the same
+        manager."""
+        from gethsharding_tpu.devscope import PROFILER
+
+        return PROFILER.start(mode=mode,
+                              hz=None if hz is None else float(hz))
+
+    def rpc_profileStop(self):
+        """End the profiling session (no-op when none is running);
+        returns the session summary incl. the jax trace directory and
+        the sampler's sample counts."""
+        from gethsharding_tpu.devscope import PROFILER
+
+        return PROFILER.stop()
+
+    def rpc_profileStacks(self):
+        """The sampler's collapsed-stack text (running session, or the
+        last finished one) — the RPC twin of ``/profile/stacks`` for
+        processes that serve no StatusServer (chain_server replicas)."""
+        from gethsharding_tpu.devscope import PROFILER
+
+        return PROFILER.stacks()
+
+    def rpc_devscopeStatus(self):
+        """The device-introspection snapshot (memory poller, compile
+        watch, profiler) — what a node's /status ``devscope`` section
+        shows, for RPC-only processes."""
+        from gethsharding_tpu.devscope import devscope_status
+
+        return devscope_status()
+
     def rpc_servingStats(self):
         """Dispatch/coalescing counters of the serving tier (None until
         the first submit builds it)."""

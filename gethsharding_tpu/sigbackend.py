@@ -300,16 +300,83 @@ class JaxSigBackend(SigBackend):
         self._shape_lock = threading.Lock()
         self._m_shape_hit = metrics.counter("jax/compile_cache/hits")
         self._m_shape_miss = metrics.counter("jax/compile_cache/misses")
+        # device-memory attribution: the resident pk-plane LRU registers
+        # as a devscope census owner so the poller can cross-check the
+        # cache's OWN byte accounting against what the device actually
+        # holds (drift beyond tolerance -> devscope/mem/drift). The
+        # registration holds a WEAK ref: the owner registry is module-
+        # global and must not pin a discarded backend (and its device
+        # LRU) alive; a dead ref reads as an empty owner. Latest
+        # instance wins the name — the registry backend is a process
+        # singleton (get_backend cache), so replacement only happens in
+        # tests building instances directly.
+        import weakref
+
+        from gethsharding_tpu import devscope
+
+        self._compiles = devscope.COMPILES
+        self_ref = weakref.ref(self)
+
+        def _claimed() -> int:
+            backend = self_ref()
+            return (0 if backend is None
+                    else backend._resident_claimed_bytes())
+
+        def _buffers() -> list:
+            backend = self_ref()
+            return [] if backend is None else backend._resident_buffers()
+
+        devscope.register_owner("pk_plane_lru", claimed_fn=_claimed,
+                                buffers_fn=_buffers)
+
+    def _resident_claimed_bytes(self) -> int:
+        """The resident plane's own accounting — the number the
+        devscope census is cross-checked against. Covers exactly what
+        `_resident_buffers` censuses: cache entries + batch memo +
+        the shared zero rows (never evicted, outside the LRU budget —
+        counting them on one side only would read as permanent
+        drift)."""
+        zero = sum(int(b.nbytes)
+                   for row in self._pk_zero_rows.copy().values()
+                   for b in row)
+        with self._pk_dev_lock:
+            return self._pk_dev_bytes + self._pk_batch_memo_nbytes + zero
+
+    def _resident_buffers(self) -> list:
+        """Every device buffer the resident plane holds (cache rows,
+        the batch memo, the shared zero rows) for census attribution."""
+        out: list = []
+        with self._pk_dev_lock:
+            for entry in self._pk_dev_cache.values():
+                out.extend(entry[:3])
+            memo = self._pk_batch_memo
+        if memo is not None:
+            out.extend(memo[1])
+        # .copy(): atomic snapshot — _zero_pk_row publishes new rows
+        # without the dev lock, and a mid-iteration insert would raise
+        for row in self._pk_zero_rows.copy().values():
+            out.extend(row)
+        return out
 
     def _note_shape(self, op: str, *shape) -> bool:
         """Count a dispatch against the per-shape compile cache; True
-        when this (op, shape) is NEW to the process (an XLA compile)."""
+        when this (op, shape) is NEW to the process (an XLA compile).
+        Fresh sightings also feed the devscope recompile-storm window
+        (compilewatch.py) — hits cost one extra early-returning call."""
         key = (op,) + shape
         with self._shape_lock:
             fresh = key not in self._shape_seen
             if fresh:
                 self._shape_seen.add(key)
         (self._m_shape_miss if fresh else self._m_shape_hit).inc()
+        compiles = getattr(self, "_compiles", None)
+        if compiles is None:
+            # partially-built instances (tests stub the tracking state
+            # via __new__) self-heal onto the process watch; idempotent
+            from gethsharding_tpu import devscope
+
+            compiles = self._compiles = devscope.COMPILES
+        compiles.saw(op, shape, fresh)
         return fresh
 
     # the module-level bucket_size, kept as a staticmethod so kernel
@@ -347,9 +414,13 @@ class JaxSigBackend(SigBackend):
         r, s, v = self._sec.sigs_to_limbs(sigs)
         tracer = tracing.TRACER
         dt.dispatched()
-        qx, qy, ok = self._recover(
-            jnp.asarray(e), jnp.asarray(r), jnp.asarray(s), jnp.asarray(v),
-            jnp.asarray(np.asarray(valid)))
+        # compile_span: a fresh shape's launch wall (trace + XLA compile
+        # + enqueue) lands in the devscope compile ledger; on hits this
+        # is one branch
+        with self._compiles.compile_span("ecrecover", (bucket,), fresh):
+            qx, qy, ok = self._recover(
+                jnp.asarray(e), jnp.asarray(r), jnp.asarray(s),
+                jnp.asarray(v), jnp.asarray(np.asarray(valid)))
         # the checked pull on `ok` is the dispatch barrier (block-vs-pull
         # self-checked); limbs_to_pubkeys then pulls the sibling buffers
         # of the SAME computation, so the device phase closes only after
@@ -394,10 +465,11 @@ class JaxSigBackend(SigBackend):
         valid = hok & sok & pok
         tracer = tracing.TRACER
         dt.dispatched()
-        out = self._bls(
-            jnp.asarray(hx), jnp.asarray(hy), jnp.asarray(sx),
-            jnp.asarray(sy), jnp.asarray(pkx), jnp.asarray(pky),
-            jnp.asarray(valid))
+        with self._compiles.compile_span("bls_aggregate", (bucket,), fresh):
+            out = self._bls(
+                jnp.asarray(hx), jnp.asarray(hy), jnp.asarray(sx),
+                jnp.asarray(sy), jnp.asarray(pkx), jnp.asarray(pky),
+                jnp.asarray(valid))
         res = [bool(b) for b in dt.pull(out)[:n]]
         dt.done()
         if tracer.enabled:
@@ -458,7 +530,9 @@ class JaxSigBackend(SigBackend):
                                 sample_wire_bytes=sample_bytes)
         tracer = tracing.TRACER
         dt.dispatched()
-        out = das_proofs.batch_verifier()(*(jnp.asarray(p) for p in planes))
+        with self._compiles.compile_span("das_verify", (bucket,), fresh):
+            out = das_proofs.batch_verifier()(
+                *(jnp.asarray(p) for p in planes))
         res = [bool(b) for b in dt.pull(out)[:n]]
         dt.done()
         if tracer.enabled:
@@ -533,7 +607,10 @@ class JaxSigBackend(SigBackend):
         tracer = tracing.TRACER
         marshal_s = t1 - t0  # host marshal: limb planes + cache resolve
         dt.dispatched()  # marshal (incl. transfer staging) closes here
-        out = fn(*args)  # async dispatch: returns before execution ends
+        with self._compiles.compile_span(
+                "bls_committee",
+                (st["bucket"], st["width"], self._wire), st["fresh"]):
+            out = fn(*args)  # async dispatch: returns before execution ends
         # finalize must close over SCALARS, not the marshal dict: `st`
         # pins every host limb plane (MBs per dispatch) until result(),
         # and an overlapped K-period pipeline holds K of them at once

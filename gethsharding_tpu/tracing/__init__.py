@@ -23,13 +23,16 @@ from gethsharding_tpu.tracing.export import (
     write_chrome_trace,
 )
 from gethsharding_tpu.tracing.tracer import (
+    LOG_FILTER,
     NOOP_SPAN,
     Span,
     TRACER,
+    TraceContextFilter,
     Tracer,
     current_context,
     disable,
     enable,
+    install_log_correlation,
     request_context,
     span,
     tag_current,
@@ -37,15 +40,18 @@ from gethsharding_tpu.tracing.tracer import (
 )
 
 __all__ = [
+    "LOG_FILTER",
     "NOOP_SPAN",
     "Span",
     "TRACER",
+    "TraceContextFilter",
     "Tracer",
     "chrome_trace_events",
     "clock_offset_us",
     "current_context",
     "disable",
     "enable",
+    "install_log_correlation",
     "request_context",
     "span",
     "tag_current",

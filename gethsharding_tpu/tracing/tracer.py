@@ -341,3 +341,42 @@ def request_context() -> Optional[Tuple[int, int]]:
 # the wire-propagation name: what `RPCClient.call` ships on the JSON-RPC
 # trace envelope is exactly the serving tier's stitching context
 current_context = request_context
+
+
+# == log <-> trace correlation =============================================
+
+
+class TraceContextFilter:
+    """`logging.Filter`-shaped stamp: every record gets the emitting
+    context's trace/span id (``-`` when none), so a warning from
+    ``sharding.node`` joins against ``/trace`` output by id instead of
+    by eyeballing timestamps. Costs one contextvar read per record;
+    with tracing disabled the stack is always empty and the stamp is
+    the constant ``-``."""
+
+    def filter(self, record) -> bool:
+        stack = _SPAN_STACK.get()
+        if stack:
+            top = stack[-1]
+            record.trace_id = str(top.trace_id)
+            record.span_id = str(top.span_id)
+        else:
+            record.trace_id = "-"
+            record.span_id = "-"
+        return True
+
+
+LOG_FILTER = TraceContextFilter()
+
+
+def install_log_correlation() -> None:
+    """Attach the trace-context filter to every root handler (filters
+    on the root LOGGER don't see child-logger records; handlers do —
+    stdlib logging's propagation rule). Idempotent; the composition
+    roots (node CLI, chain_server) call it right after basicConfig,
+    whose format strings reference ``%(trace_id)s``."""
+    import logging
+
+    for handler in logging.getLogger().handlers:
+        if LOG_FILTER not in handler.filters:
+            handler.addFilter(LOG_FILTER)

@@ -435,6 +435,92 @@ rm -rf "$pw_tmp"
 [ "$fail" = 0 ] && echo "perfwatch smoke OK: gate passes clean, trips on" \
     "the injected slowdown, heals on the clean rerun"
 
+# -- devscope smoke: the device introspection plane end to end — an RPC
+# server whose shard_profileStart/Stop toggles a sampling session (the
+# collapsed-stack download must be non-empty), and a StatusServer node
+# whose /profile control route, /profile/stacks download, /status
+# devscope section and devscope/* Prometheus rows all answer
+echo "== devscope smoke (profile toggle over RPC + devscope surfaces)"
+ds_tmp=$(mktemp -d)
+JAX_PLATFORMS=cpu GETHSHARDING_DEVSCOPE_PROFILE_DIR="$ds_tmp/profile" \
+GETHSHARDING_PERFWATCH_DIR="$ds_tmp/blackbox" \
+GETHSHARDING_PERFWATCH_LEDGER="$ds_tmp/ledger.jsonl" \
+python - <<'PY' || fail=1
+import json
+import time
+import urllib.request
+
+# 1. the RPC face: toggle a sampler session on a chain-style RPCServer
+from gethsharding_tpu.params import Config
+from gethsharding_tpu.rpc.client import RPCClient
+from gethsharding_tpu.rpc.server import RPCServer
+from gethsharding_tpu.smc.chain import SimulatedMainchain
+
+server = RPCServer(SimulatedMainchain(config=Config()))
+server.start()
+client = RPCClient(*server.address)
+started = client.call("shard_profileStart", "sampler", 400)
+assert started.get("started"), started
+again = client.call("shard_profileStart", "sampler", 400)
+assert again.get("already_running"), again
+deadline = time.monotonic() + 5.0
+while time.monotonic() < deadline:  # sample the RPC threads themselves
+    client.call("shard_blockNumber")
+    if client.call("shard_profileStacks"):
+        break
+stopped = client.call("shard_profileStop")
+assert stopped.get("stopped"), stopped
+stacks = client.call("shard_profileStacks")
+assert stacks and "gethsharding" in stacks, (
+    f"collapsed-stack download empty or foreign: {stacks[:120]!r}")
+status = client.call("shard_devscopeStatus")
+assert status["profiler"]["sessions"] >= 1, status
+client.close()
+server.stop()
+print("devscope RPC toggle OK:", len(stacks.splitlines()), "stack lines")
+
+# 2. the node face: /profile control + stacks download + prom rows
+from gethsharding_tpu.node.backend import ShardNode
+from gethsharding_tpu.node.http_status import StatusServer
+from gethsharding_tpu import devscope
+
+devscope.boot()
+node = ShardNode(actor="observer", txpool_interval=None, http_port=0)
+node.start()
+try:
+    port = node.service(StatusServer).port
+
+    def get(path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+            return resp.read().decode()
+
+    out = json.loads(get("/profile?action=start&mode=sampler&hz=400"))
+    assert out.get("started"), out
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        get("/status")  # keep threads busy so the sampler sees stacks
+        if get("/profile/stacks"):
+            break
+    out = json.loads(get("/profile?action=stop"))
+    assert out.get("stopped"), out
+    stacks = get("/profile/stacks")
+    assert stacks, "/profile/stacks empty after a sampled session"
+    status = json.loads(get("/status"))
+    assert "devscope" in status, sorted(status)
+    assert status["devscope"]["memory"]["running"], status["devscope"]
+    prom = get("/metrics?format=prom")
+    for row in ("devscope_mem_polls", "devscope_profiler_sessions",
+                "devscope_compile_count"):
+        assert row in prom, f"{row} missing from the prom exposition"
+finally:
+    node.stop()
+    devscope.shutdown()
+print("devscope smoke OK: RPC + /profile toggles, stacks served,"
+      " prom rows present")
+PY
+rm -rf "$ds_tmp"
+
 # -- shardlint: the repo-wide static analysis gate (jit-purity,
 # host-sync, lock-order, race-guard, layering, backend-contract,
 # thread-lifecycle, flag-doc, export-completeness) — fails on any

@@ -16,7 +16,15 @@ Timing uses random in-range limb data: every stage is integer-only with
 static shapes and no data-dependent control flow, so wall-clock does not
 depend on the values. Prints ONE JSON line.
 
+With ``--stacks FILE`` (a collapsed-stack file from the devscope
+sampling profiler — ``/profile/stacks`` or ``shard_profileStacks``)
+the breakdown also prints a HOST-side top-N table next to the device
+stages: self samples per leaf frame plus inclusive samples per frame,
+so "the chip spends 60% in miller" and "the host spends 40% in
+marshalling" read off one artifact.
+
 Usage: python scripts/tpu_breakdown.py [--shards N] [--committee C]
+                                       [--stacks FILE [--stacks-top N]]
 Honors the same GETHSHARDING_TPU_* kernel knobs as bench.py.
 """
 
@@ -31,6 +39,63 @@ import time
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_collapsed(text: str):
+    """Collapsed-stack lines (``frame;frame;frame count``) ->
+    (total_samples, self_counts, inclusive_counts). Malformed lines and
+    the sampler's ``[stacks-over-budget]`` overflow marker are skipped;
+    inclusive counts credit every frame on a stack once per sample (a
+    frame repeated by recursion still counts once)."""
+    total = 0
+    self_counts: dict = {}
+    incl_counts: dict = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("["):
+            continue
+        stack, _, count_s = line.rpartition(" ")
+        try:
+            count = int(count_s)
+        except ValueError:
+            continue
+        if not stack:
+            continue
+        frames = stack.split(";")
+        total += count
+        leaf = frames[-1]
+        self_counts[leaf] = self_counts.get(leaf, 0) + count
+        for frame in set(frames):
+            incl_counts[frame] = incl_counts.get(frame, 0) + count
+    return total, self_counts, incl_counts
+
+
+def host_topn(text: str, n: int = 10):
+    """The host-side top-N rows: ``[{frame, self, self_pct, incl,
+    incl_pct}]`` ordered by self samples — what the --stacks table and
+    the JSON payload carry."""
+    total, self_counts, incl_counts = parse_collapsed(text)
+    rows = []
+    for frame, count in sorted(self_counts.items(),
+                               key=lambda kv: -kv[1])[:n]:
+        rows.append({
+            "frame": frame,
+            "self": count,
+            "self_pct": round(100.0 * count / total, 1) if total else 0.0,
+            "incl": incl_counts.get(frame, count),
+            "incl_pct": round(100.0 * incl_counts.get(frame, count)
+                              / total, 1) if total else 0.0,
+        })
+    return total, rows
+
+
+def _print_host_table(total: int, rows: list) -> None:
+    print(f"# host sampling profile: {total} samples", file=sys.stderr)
+    print(f"# {'self%':>6} {'incl%':>6} {'self':>7}  frame",
+          file=sys.stderr)
+    for row in rows:
+        print(f"# {row['self_pct']:>5.1f}% {row['incl_pct']:>5.1f}% "
+              f"{row['self']:>7}  {row['frame']}", file=sys.stderr)
 
 
 def _time(fn, args, repeats=5):
@@ -69,7 +134,22 @@ def main() -> int:
                         help="force the hermetic CPU backend (a plain "
                              "JAX_PLATFORMS=cpu still hangs on a dead "
                              "accelerator tunnel under the axon site hook)")
+    parser.add_argument("--stacks", default="",
+                        help="collapsed-stack file from the devscope "
+                             "sampling profiler (/profile/stacks); prints "
+                             "a host-side top-N table next to the device "
+                             "breakdown and folds it into the JSON line")
+    parser.add_argument("--stacks-top", type=int, default=10,
+                        help="rows in the host-side table")
     args = parser.parse_args()
+
+    host_total, host_rows = 0, []
+    if args.stacks:
+        # parse BEFORE the device work: a bad path must fail fast, not
+        # after minutes of kernel compiles
+        with open(args.stacks) as fh:
+            host_total, host_rows = host_topn(fh.read(), args.stacks_top)
+        _print_host_table(host_total, host_rows)
 
     from gethsharding_tpu.parallel.virtual import (configure_compile_cache,
                                                    force_virtual_cpu_devices)
@@ -137,7 +217,7 @@ def main() -> int:
         "GETHSHARDING_TPU_LIMB_FORM", "GETHSHARDING_TPU_CARRY",
         "GETHSHARDING_TPU_CONV", "GETHSHARDING_TPU_PAIRCONV",
         "GETHSHARDING_TPU_PALLAS")}
-    print(json.dumps({
+    payload = {
         "platform": platform,
         "shards": B,
         "committee": C,
@@ -148,7 +228,11 @@ def main() -> int:
         "sigs_per_sec_full": round(sigs / timings["full"], 1),
         "full_block_timed_s": round(block_timed, 6),
         "knobs": knobs,
-    }))
+    }
+    if args.stacks:
+        payload["host_samples"] = host_total
+        payload["host_stacks_top"] = host_rows
+    print(json.dumps(payload))
     return 0
 
 
