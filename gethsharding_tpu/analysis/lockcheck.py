@@ -149,6 +149,14 @@ class _TracedLock:
     def locked(self):
         return self._real.locked()
 
+    def _at_fork_reinit(self):
+        # stdlib machinery registers this at-fork hook on bare locks
+        # (concurrent.futures.thread's _global_shutdown_lock at import
+        # time): forward to the real lock so lazily imported stdlib
+        # modules keep working under the recorder
+        self._real._at_fork_reinit()
+        self._count = 0
+
     def __repr__(self):
         return f"<TracedLock {self.label or 'unlabeled'}>"
 

@@ -59,6 +59,8 @@ DEFAULT_CLASSES = (
     "gethsharding_tpu.serving.pipeline:PipelinedDispatcher",
     "gethsharding_tpu.fleet.router:Replica",
     "gethsharding_tpu.fleet.router:FleetRouter",
+    "gethsharding_tpu.fleet.router:RpcReplicaBackend",
+    "gethsharding_tpu.fleet.frontend:FrontendServer",
     "gethsharding_tpu.resilience.breaker:CircuitBreaker",
     "gethsharding_tpu.resilience.watchdog:DispatchWatchdog",
     "gethsharding_tpu.slo.tracker:SLOTracker",

@@ -10,9 +10,18 @@ story on top of it:
   (rendezvous hashing, so the device-resident pk-plane LRU stays warm),
   per-replica health read from the breaker/soundness state, retry-on-
   next-replica through the existing ``resilience/policy`` executors,
-  and breaker-aware draining (a tripped or corrupt-flagged replica
-  stops taking new work, finishes in-flight, and re-enters only after
-  its half-open differential probe re-promotes the primary).
+  breaker-aware draining (a tripped or corrupt-flagged replica stops
+  taking new work, finishes in-flight, and re-enters only after its
+  half-open differential probe re-promotes the primary), and request
+  HEDGING for tail robustness (an interactive call still pending after
+  its class-aware hedge delay is duplicated to the next affinity
+  replica, first verdict wins, losses discarded with accounting).
+
+- ``frontend.py`` — the standalone router process: owns the replica
+  registry, health sweep and drain orchestration, and serves the full
+  serving RPC plane set (ecrecover / aggregates / committees / DAS) to
+  actors over JSON-RPC — the fleet's failure-domain boundary
+  (``python -m gethsharding_tpu.fleet.frontend``).
 
 The admission-class vocabulary (``interactive`` / ``bulk_audit`` /
 ``catchup_replay``: priorities, weighted batch shares, per-class
@@ -44,6 +53,31 @@ from gethsharding_tpu.serving.classes import (
     default_policies,
 )
 
+# the frontend server resolves lazily (PEP 562, the resilience
+# package's idiom): `python -m gethsharding_tpu.fleet.frontend` must
+# not find the module already half-imported by the package (runpy's
+# double-execution warning), and routers that never serve a frontend
+# skip its socketserver machinery
+_LAZY = {
+    "FrontendServer": ("frontend", "FrontendServer"),
+    "build_frontend": ("frontend", "build_frontend"),
+}
+
+
+def __getattr__(name: str):
+    try:
+        module_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    import importlib
+
+    module = importlib.import_module(f"{__name__}.{module_name}")
+    value = getattr(module, attr)
+    globals()[name] = value  # cache: next access skips __getattr__
+    return value
+
+
 __all__ = [
     "ADMISSION_CLASSES",
     "AllReplicasDraining",
@@ -61,4 +95,5 @@ __all__ = [
     "class_for",
     "current_admission",
     "default_policies",
+    *sorted(_LAZY),
 ]

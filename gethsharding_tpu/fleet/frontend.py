@@ -1,0 +1,446 @@
+"""Standalone fleet frontend: the router as its own failure domain.
+
+`python -m gethsharding_tpu.fleet.frontend --replica HOST:PORT ...`
+
+Until this process existed the router lived IN the caller: an actor
+composing `RouterSigBackend` died with its router, and every actor
+process re-learned replica health from scratch. The frontend is the
+reference design's availability boundary made real — actors reach a
+verification plane over RPC (`geth sharding --actor notary` dials a
+node; here they dial the frontend), and the frontend owns:
+
+- the **replica registry** — one `RpcReplicaBackend` per
+  ``--replica HOST:PORT``, redialing lazily after a connection loss so
+  a replica killed and restarted on the same endpoint re-enters
+  without operator action;
+- the **health sweep** — the router's background thread reads
+  ``shard_health``, scrapes ``shard_metrics`` federation snapshots,
+  probes draining replicas, and runs the hedge-storm watch;
+- **drain orchestration** — ``shard_drainReplica`` /
+  ``shard_undrainReplica`` drain one replica through the breaker-probe
+  path, ``shard_drain`` drains the frontend itself (new verification
+  work refused with the typed "replica draining" phrase a PARENT
+  router retries, so frontends can be stacked/fleeted too);
+- **request hedging** — ``--fleet-hedge-ms`` /
+  ``GETHSHARDING_FLEET_HEDGE_MS`` arms the router's tail-cutting
+  duplicate dispatch (fleet/router.py).
+
+The served surface is the FULL serving RPC plane set —
+``shard_ecrecover`` / ``shard_verifyAggregates`` /
+``shard_verifyCommittees`` / ``shard_dasVerify`` — plus the
+``shard_health`` / ``shard_metrics`` / ``shard_fleetStatus`` control
+plane, over the same newline-delimited JSON-RPC 2.0 framing as
+`rpc/server.py`, so `RPCClient` and `RpcReplicaBackend` dial a
+frontend exactly as they dial a chain_server replica. Inbound `trace`
+envelopes are adopted (the caller's span context parents the
+frontend's route/attempt spans, which parent the replica's handler
+spans — one stitched trace across three processes).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import socketserver
+import sys
+import threading
+import time
+from typing import List, Optional
+
+from gethsharding_tpu import metrics, tracing
+from gethsharding_tpu.fleet.router import (
+    AllReplicasDraining,
+    FleetRouter,
+    Replica,
+    RpcReplicaBackend,
+)
+from gethsharding_tpu.resilience.errors import DeadlineExceeded
+from gethsharding_tpu.serving.queue import ServingOverloadError
+
+log = logging.getLogger("fleet.frontend")
+
+METHOD_NOT_FOUND = -32601
+INVALID_REQUEST = -32600
+INTERNAL_ERROR = -32603
+OVERLOAD_CODE = -32010  # typed: shed / all-draining / deadline / drain
+
+# caller-visible failures that are the fleet's WEATHER, not a bug: they
+# ship with their class name on the wire under OVERLOAD_CODE so a
+# caller (and the bench's typed-failure gate) can tell a shed from a
+# crash. ServingOverloadError covers the shed/quota/expiry family.
+TYPED_FAILURES = (AllReplicasDraining, ServingOverloadError,
+                  DeadlineExceeded)
+
+
+class FrontendServer:
+    """Threaded JSON-RPC server over TCP serving a `FleetRouter`'s
+    verification planes (port 0 picks a free one; `.address` reports
+    the bound endpoint). Owns the router: `stop()` closes it, which
+    stops the health sweep and closes every replica backend."""
+
+    def __init__(self, router: FleetRouter, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.router = router
+        # frontend-level drain: refuse NEW verification work with the
+        # typed "replica draining" phrase (a parent router retries its
+        # next frontend) while in-flight requests finish
+        self.draining = False
+        self._inflight = 0
+        self._lock = threading.Lock()
+        self.method_calls: dict = {}
+        server = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self) -> None:
+                server._handle_connection(self)
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._tcp = Server((host, port), Handler)
+        self.address = self._tcp.server_address
+        self._thread: Optional[threading.Thread] = None
+        self._conns: set = set()  # live connection sockets, severed on stop
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._tcp.serve_forever, daemon=True,
+            name="fleet-frontend")
+        self._thread.start()
+        log.info("fleet frontend listening on %s:%d", *self.address)
+
+    def stop(self, grace_s: float = 5.0) -> None:
+        """Graceful shutdown: stop admitting verification work, give
+        in-flight requests a bounded grace, then SEVER the remaining
+        connections (an in-flight caller gets the typed connection
+        loss its retry policy handles — never a response that will
+        silently never come) and close the router (health sweep
+        joined, hedge pool drained, replica backends closed)."""
+        import socket as socket_mod
+
+        self.draining = True
+        deadline = time.monotonic() + grace_s
+        while self._inflight > 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        self._tcp.shutdown()
+        self._tcp.server_close()
+        with self._lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.shutdown(socket_mod.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+        self.router.close()
+
+    # -- connection loop (rpc/server.py framing) ---------------------------
+
+    def _handle_connection(self, handler) -> None:
+        write_lock = threading.Lock()
+        with self._lock:
+            self._conns.add(handler.connection)
+        try:
+            for raw in handler.rfile:
+                raw = raw.strip()
+                if not raw:
+                    continue
+                with self._lock:
+                    self._inflight += 1
+                try:
+                    response = self._dispatch(raw)
+                finally:
+                    with self._lock:
+                        self._inflight -= 1
+                if response is not None:
+                    with write_lock:
+                        handler.wfile.write(
+                            (json.dumps(response) + "\n").encode())
+                        handler.wfile.flush()
+        except (OSError, ValueError):
+            pass
+        finally:
+            with self._lock:
+                self._conns.discard(handler.connection)
+
+    def _dispatch(self, raw: bytes) -> Optional[dict]:
+        try:
+            req = json.loads(raw)
+        except json.JSONDecodeError:
+            return {"jsonrpc": "2.0", "id": None,
+                    "error": {"code": INVALID_REQUEST,
+                              "message": "bad json"}}
+        rid = req.get("id")
+        method = req.get("method", "")
+        params = req.get("params", [])
+        trace_id = None
+        with self._lock:
+            self.method_calls[method] = self.method_calls.get(method, 0) + 1
+        fn = getattr(self, "rpc_" + method.replace("shard_", "", 1), None)
+        if fn is None:
+            return {"jsonrpc": "2.0", "id": rid,
+                    "error": {"code": METHOD_NOT_FOUND,
+                              "message": f"unknown method {method}"}}
+        try:
+            inbound = req.get("trace")
+            ctx = None
+            if isinstance(inbound, dict):
+                ctx = (inbound.get("trace_id"), inbound.get("span_id"))
+            with tracing.span(f"rpc/{method}", ctx=ctx) as handler_span:
+                result = fn(*params)
+            trace_id = handler_span.trace_id
+        except Exception as exc:  # noqa: BLE001 - RPC boundary
+            # typed overload/drain failures keep their class name on
+            # the wire so a caller (or the bench's typed-failure gate)
+            # can tell a shed from a bug; everything else is internal
+            typed = isinstance(exc, TYPED_FAILURES) or (
+                isinstance(exc, RuntimeError)
+                and str(exc).startswith("replica draining"))
+            if not typed:
+                log.exception("frontend rpc %s failed", method)
+            return {"jsonrpc": "2.0", "id": rid,
+                    "error": {"code": OVERLOAD_CODE if typed
+                              else INTERNAL_ERROR,
+                              "message": f"{type(exc).__name__}: {exc}"}}
+        if rid is None:
+            return None
+        response = {"jsonrpc": "2.0", "id": rid, "result": result}
+        if trace_id is not None:
+            response["trace"] = trace_id
+        return response
+
+    # -- the verification planes -------------------------------------------
+
+    def _check_accepting(self, method: str) -> None:
+        if self.draining:
+            # the same phrase rpc/server.py uses: a parent router's
+            # retry ladder keys on it
+            raise RuntimeError(f"replica draining: {method} refused")
+
+    def _route(self, op: str, *args, affinity=None, klass=None,
+               tenant=None, **kwargs):
+        return self.router.call(op, *args, affinity=affinity,
+                                klass=klass, tenant=tenant, **kwargs)
+
+    def rpc_ecrecover(self, digests, sigs, klass=None, tenant=None):
+        from gethsharding_tpu.rpc import codec
+
+        self._check_accepting("shard_ecrecover")
+        out = self._route("ecrecover_addresses",
+                          [codec.dec_bytes(d) for d in digests],
+                          [codec.dec_bytes(s) for s in sigs],
+                          klass=klass, tenant=tenant)
+        return [None if addr is None else codec.enc_bytes(bytes(addr))
+                for addr in out]
+
+    def rpc_verifyAggregates(self, messages, agg_sigs, agg_pks,
+                             klass=None, tenant=None):
+        from gethsharding_tpu.rpc import codec
+
+        self._check_accepting("shard_verifyAggregates")
+        out = self._route("bls_verify_aggregates",
+                          [codec.dec_bytes(m) for m in messages],
+                          [codec.dec_g1(s) for s in agg_sigs],
+                          [codec.dec_g2(p) for p in agg_pks],
+                          klass=klass, tenant=tenant)
+        return [bool(b) for b in out]
+
+    def rpc_verifyCommittees(self, messages, sig_rows, pk_rows,
+                             pk_row_keys=None, klass=None, tenant=None):
+        from gethsharding_tpu.rpc import codec
+
+        self._check_accepting("shard_verifyCommittees")
+        keys = None if pk_row_keys is None else [
+            None if k is None else str(k) for k in pk_row_keys]
+        affinity = None
+        if keys:
+            affinity = next((k for k in keys if k is not None), None)
+        out = self._route("bls_verify_committees",
+                          [codec.dec_bytes(m) for m in messages],
+                          codec.dec_g1_rows(sig_rows),
+                          codec.dec_g2_rows(pk_rows),
+                          pk_row_keys=keys, affinity=affinity,
+                          klass=klass, tenant=tenant)
+        return [bool(b) for b in out]
+
+    def rpc_dasVerify(self, chunks, indices, proofs, roots,
+                      klass=None, tenant=None):
+        from gethsharding_tpu.rpc import codec
+
+        self._check_accepting("shard_dasVerify")
+        args = codec.dec_das_call(chunks, indices, proofs, roots)
+        affinity = args[3][0].hex() if args[3] else None
+        out = self._route("das_verify_samples", *args,
+                          affinity=affinity, klass=klass, tenant=tenant)
+        return [bool(b) for b in out]
+
+    # -- control plane -----------------------------------------------------
+
+    def rpc_health(self):
+        """The same shape a replica's shard_health serves, so a parent
+        router can sweep a fleet OF frontends: the frontend's drain
+        flag, in-flight count, and how many replicas are accepting."""
+        accepting = sum(1 for r in self.router.replicas if r.accepting)
+        return {"draining": self.draining or accepting == 0,
+                "inflight": max(0, self._inflight - 1),
+                "breaker": None,
+                "accepting_replicas": accepting,
+                "replicas": len(self.router.replicas)}
+
+    def rpc_metrics(self):
+        # the ROUTER's registry: build_frontend may wire a private one,
+        # and the fleet/replica/hedge series a parent router federates
+        # live there, not necessarily in the process default
+        return self.router.registry.snapshot()
+
+    def rpc_fleetStatus(self):
+        """The one-glance fleet answer: per-replica states and the
+        hedge ledger (issued/won/wasted/audit_faults/storm)."""
+        return {"replicas": self.router.states(),
+                "hedge": self.router.hedge_stats(),
+                "draining": self.draining}
+
+    def rpc_drain(self):
+        """Drain the FRONTEND: refuse new verification work (typed) so
+        a parent balancer moves on; in-flight requests finish."""
+        self.draining = True
+        return {"draining": True, "inflight": self._inflight}
+
+    def rpc_drainReplica(self, name):
+        """Operator drain of ONE replica through the router's drain
+        path (it re-enters only after `shard_undrainReplica` plus a
+        healthy breaker)."""
+        self.router.drain(str(name))
+        return self.router.states()[str(name)]
+
+    def rpc_undrainReplica(self, name):
+        self.router.undrain(str(name))
+        return self.router.states()[str(name)]
+
+
+def build_frontend(endpoints: List[str], host: str = "127.0.0.1",
+                   port: int = 0, hedge_ms: Optional[float] = None,
+                   health_interval_s: float = 0.25,
+                   chaos=None, timeout_s: float = 30.0,
+                   registry: metrics.Registry = metrics.DEFAULT_REGISTRY,
+                   ) -> FrontendServer:
+    """Dial every ``HOST:PORT`` endpoint as an `RpcReplicaBackend`
+    replica (named ``r0..rN`` in endpoint order) behind a hedging
+    `FleetRouter`, served by a `FrontendServer`. `chaos` (a
+    ChaosSchedule) is consulted at every replica wire's
+    ``fleet.transport`` seam."""
+    replicas = []
+    for i, endpoint in enumerate(endpoints):
+        ep_host, ep_port = endpoint.rsplit(":", 1)
+        backend = RpcReplicaBackend.dial(ep_host, int(ep_port),
+                                         timeout=timeout_s, chaos=chaos)
+        replicas.append(Replica(f"r{i}", backend, health=backend.health,
+                                registry=registry))
+    router = FleetRouter(replicas, health_interval_s=health_interval_s,
+                         hedge_ms=hedge_ms, registry=registry)
+    return FrontendServer(router, host=host, port=port)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="fleet-frontend")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--replica", action="append", default=[],
+                        metavar="HOST:PORT",
+                        help="a chain_server replica to balance "
+                             "(repeatable; at least one required)")
+    parser.add_argument("--fleet-hedge-ms", type=float, default=None,
+                        help="interactive hedge-delay floor in ms "
+                             "(default: GETHSHARDING_FLEET_HEDGE_MS, "
+                             "0 = hedging off): a request still "
+                             "pending after max(this, the primary "
+                             "replica's observed latency quantile) is "
+                             "re-issued to the next affinity replica, "
+                             "first verdict wins")
+    parser.add_argument("--health-interval", type=float, default=0.25,
+                        metavar="SECONDS",
+                        help="background health-sweep period (health + "
+                             "metrics federation + drain probes + "
+                             "hedge-storm watch)")
+    parser.add_argument("--replica-timeout", type=float, default=30.0,
+                        help="per-call RPC timeout against a replica")
+    parser.add_argument("--chaos", default="", metavar="SPEC",
+                        help="seeded chaos at the replica wires' "
+                             "fleet.transport seam (delay/partition "
+                             "modes; resilience/chaos.py)")
+    parser.add_argument("--runtime", type=float, default=0.0,
+                        help="seconds before exit (0 = forever)")
+    parser.add_argument("--trace", action="store_true",
+                        help="collect frontend handler/route/attempt "
+                             "spans in the in-memory tracer")
+    parser.add_argument("--trace-out", default="",
+                        help="write collected spans as Chrome "
+                             "trace_event JSON at exit; implies --trace")
+    parser.add_argument("--trace-ring", type=int, default=4096,
+                        help="finished-span ring capacity")
+    parser.add_argument("--verbosity", default="warning")
+    args = parser.parse_args(argv)
+    if not args.replica:
+        parser.error("at least one --replica HOST:PORT is required")
+
+    logging.basicConfig(
+        level=getattr(logging, args.verbosity.upper()),
+        format="%(asctime)s %(levelname)-7s %(name)s "
+               "[%(trace_id)s]  %(message)s",
+        datefmt="%H:%M:%S")
+    tracing.install_log_correlation()
+    if args.trace or args.trace_out:
+        tracing.enable(ring_spans=args.trace_ring)
+
+    chaos = None
+    if args.chaos:
+        from gethsharding_tpu.resilience.chaos import (parse_spec,
+                                                       unwired_seams)
+
+        chaos = parse_spec(args.chaos)
+        unwired = unwired_seams(chaos, ("fleet",))
+        if unwired:
+            log.warning("chaos spec names seams the frontend never "
+                        "wires: %s (only fleet.transport fires here)",
+                        unwired)
+
+    # the SLO plane boots with the frontend so its shard_metrics
+    # snapshot carries slo/<class> series from the first scrape
+    from gethsharding_tpu import slo
+
+    slo.tracker()
+    server = build_frontend(args.replica, host=args.host, port=args.port,
+                            hedge_ms=args.fleet_hedge_ms,
+                            health_interval_s=args.health_interval,
+                            chaos=chaos, timeout_s=args.replica_timeout)
+    server.start()
+    print(json.dumps({"host": server.address[0],
+                      "port": server.address[1]}), flush=True)
+    deadline = time.monotonic() + args.runtime if args.runtime else None
+    try:
+        while deadline is None or time.monotonic() < deadline:
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+        if args.trace_out:
+            try:
+                tracing.write_chrome_trace(args.trace_out,
+                                           label="frontend")
+            except OSError:
+                log.warning("trace export to %s failed", args.trace_out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -56,7 +56,8 @@ class ShardNode:
                  soundness_rate: Optional[float] = None,
                  da_mode: str = "full",
                  da_samples: int = 16,
-                 da_parity: float = 0.5):
+                 da_parity: float = 0.5,
+                 fleet_frontend: Optional[str] = None):
         if actor not in self.ACTORS:
             raise ValueError(f"unknown actor {actor!r}; pick from {self.ACTORS}")
         if da_mode not in ("full", "sampled"):
@@ -78,6 +79,7 @@ class ShardNode:
         # primary fault that trips the breaker. One instance node-wide:
         # one admission queue per device, one breaker per node.
         self._serving_backend = None
+        self._frontend_backend = None
         self._sig_backend_obj = None
         self.soundness_backend = None
         failover = sig_backend.startswith("failover-")
@@ -87,11 +89,24 @@ class ShardNode:
             raise ValueError("--serving already wraps the backend; use "
                              "the bare backend name with --serving")
         composed = None
-        if chaos is not None:
+        if fleet_frontend is not None:
+            # the actor's whole verification plane goes over the wire
+            # to a standalone fleet frontend (fleet/frontend.py): the
+            # routed/hedged replica fleet owns serving, soundness and
+            # failover; this process composes nothing locally. The
+            # RpcReplicaBackend redials after a connection loss, so a
+            # restarted frontend recovers mid-flight actors through
+            # their ordinary retry policies.
+            from gethsharding_tpu.fleet.router import RpcReplicaBackend
+
+            fe_host, fe_port = fleet_frontend.rsplit(":", 1)
+            composed = RpcReplicaBackend.dial(fe_host, int(fe_port))
+            self._frontend_backend = composed
+        elif chaos is not None:
             from gethsharding_tpu.resilience.chaos import ChaosSigBackend
 
             composed = ChaosSigBackend(get_backend(inner_name), chaos)
-        if serving:
+        if serving and fleet_frontend is None:
             from gethsharding_tpu.serving import (ServingConfig,
                                                   ServingSigBackend)
 
@@ -103,7 +118,7 @@ class ShardNode:
         if soundness_rate is None:
             soundness_rate = float(
                 os.environ.get("GETHSHARDING_SOUNDNESS_RATE", "0") or 0)
-        if soundness_rate > 0:
+        if soundness_rate > 0 and fleet_frontend is None:
             from gethsharding_tpu.resilience.soundness import (
                 SpotCheckSigBackend)
 
@@ -112,7 +127,7 @@ class ShardNode:
                 else get_backend(inner_name),
                 rate=soundness_rate)
             self.soundness_backend = composed
-        if failover:
+        if failover and fleet_frontend is None:
             from gethsharding_tpu.resilience.breaker import (
                 FailoverSigBackend)
 
@@ -304,6 +319,8 @@ class ShardNode:
         if self._serving_backend is not None:
             # after the consumers: a draining actor must still resolve
             self._serving_backend.close()
+        if self._frontend_backend is not None:
+            self._frontend_backend.close()
 
     # -- supervision (failure detection / elastic recovery) ----------------
 

@@ -140,6 +140,18 @@ def build_parser() -> argparse.ArgumentParser:
                                "detected mismatch is a primary fault — "
                                "pair with --sigbackend failover-* so "
                                "silent corruption trips the breaker)")
+    sharding.add_argument("--fleet-frontend", default="",
+                          metavar="HOST:PORT",
+                          help="dial a standalone fleet frontend "
+                               "(python -m gethsharding_tpu.fleet."
+                               "frontend) for ALL signature/DAS "
+                               "verification instead of composing a "
+                               "local backend: the actor's committee "
+                               "audits and sample verdicts go over the "
+                               "wire to the routed, hedged replica "
+                               "fleet (serving/failover/soundness "
+                               "composition then lives in the frontend "
+                               "and its replicas, not in this process)")
     sharding.add_argument("--verbosity", default="info",
                           choices=("debug", "info", "warning", "error"))
     sharding.add_argument("--metrics", action="store_true",
@@ -470,6 +482,15 @@ def run_sharding_node(args) -> int:
                     "GETHSHARDING_CLIENT_RETRIES is unset/0 — injected "
                     "mainchain faults will surface to the actors "
                     "unretried")
+    if args.fleet_frontend and (args.serving or args.chaos
+                                or args.sigbackend != "python"
+                                or soundness_rate > 0):
+        logging.getLogger("sharding.node").warning(
+            "--fleet-frontend replaces the local verification "
+            "composition: --serving/--sigbackend/--chaos/"
+            "--soundness-rate apply inside the frontend's replicas, "
+            "not this actor — local settings ignored for the "
+            "verification planes")
     node = ShardNode(
         actor=args.actor,
         shard_id=args.shardid,
@@ -491,6 +512,7 @@ def run_sharding_node(args) -> int:
         da_mode=args.da_mode,
         da_samples=args.da_samples,
         da_parity=args.da_parity,
+        fleet_frontend=args.fleet_frontend or None,
     )
     if hub is not None:
         # the node's public identity in the relay's peer table

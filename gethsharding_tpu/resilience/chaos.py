@@ -47,8 +47,17 @@ class InjectedFault(ConnectionError):
 
 # a seam rule's failure mode: "fault" raises InjectedFault (the loud
 # default), "corrupt" silently perturbs the result (backend.* seams
-# only — the silent-corruption chaos the soundness audit must catch)
-MODES = ("fault", "corrupt")
+# only — the silent-corruption chaos the soundness audit must catch),
+# "delay" stalls the wire call for `delay_s` before letting it through
+# and "partition" makes the wire unreachable (both on the
+# ``fleet.transport`` seam only — the tail-latency and network-split
+# failure classes request hedging and the router's trip path exist for)
+MODES = ("fault", "corrupt", "delay", "partition")
+
+# the one seam with a wire to delay or partition: the router-side
+# transport in front of a replica (fleet/router.py TransportChaos /
+# RpcReplicaBackend)
+TRANSPORT_SEAM = "fleet.transport"
 
 
 class ChaosSchedule:
@@ -71,14 +80,18 @@ class ChaosSchedule:
     ``modes`` maps a seam (same exact-or-bare-prefix resolution) to a
     failure mode from `MODES`; unmapped seams default to ``"fault"``.
     The schedule stays pure decision logic — `mode_for` only REPORTS
-    the mode, the injector at the seam acts on it.
+    the mode, the injector at the seam acts on it. ``delay_s`` is the
+    stall a ``mode=delay`` transport injector applies per scheduled
+    call (the schedule carries it so one spec replays one timeline).
     """
 
     def __init__(self, seed: int = 0, rules: Optional[Dict] = None,
-                 modes: Optional[Dict[str, str]] = None):
+                 modes: Optional[Dict[str, str]] = None,
+                 delay_s: float = 0.25):
         self.seed = seed
         self.rules = dict(rules or {})
         self.modes = dict(modes or {})
+        self.delay_s = delay_s
         for seam, mode in self.modes.items():
             if mode not in MODES:
                 raise ValueError(
@@ -94,6 +107,13 @@ class ChaosSchedule:
                     f"mode=corrupt is only supported on backend.* seams, "
                     f"not {seam!r} (mainchain/dispatch seams have no "
                     f"result plane to corrupt)")
+            if mode in ("delay", "partition") and seam != TRANSPORT_SEAM:
+                # only the wire has latency to stretch or a link to cut;
+                # a delayed backend op would be dispatch.* hang territory
+                raise ValueError(
+                    f"mode={mode} is only supported on the "
+                    f"{TRANSPORT_SEAM!r} seam, not {seam!r} (only the "
+                    f"replica wire has a transport to {mode})")
         self.injected: Dict[str, int] = {}
         self._counts: Dict[str, int] = {}
         self._lock = threading.Lock()
@@ -179,11 +199,15 @@ def parse_spec(spec: str) -> ChaosSchedule:
     (``backend.ecrecover_addresses:mode=corrupt``); a mode entry with
     no rule of its own defaults the seam's rule to every-call. A seam
     written ``backend.*`` is the bare prefix ``backend`` (every op
-    under it). Malformed mode entries fail fast with the offending
+    under it). ``delay_s=`` names the transport-delay stall for
+    ``fleet.transport:mode=delay`` entries
+    (``"fleet.transport=0.3,fleet.transport:mode=delay,delay_s=0.1"``).
+    Malformed mode entries fail fast with the offending
     token — a typo'd mode silently injecting nothing (or loudly
     instead of silently) would test less than the operator asked for.
     """
     seed = 0
+    delay_s = 0.25
     rules: Dict = {}
     modes: Dict[str, str] = {}
     mode_only: List[str] = []
@@ -195,6 +219,8 @@ def parse_spec(spec: str) -> ChaosSchedule:
             key = key[:-2]
         if key == "seed":
             seed = int(value)
+        elif key == "delay_s":
+            delay_s = float(value)
         elif ":" in key:
             seam, attr = (s.strip() for s in key.split(":", 1))
             if seam.endswith(".*"):
@@ -218,7 +244,63 @@ def parse_spec(spec: str) -> ChaosSchedule:
     for seam in mode_only:
         # a mode entry alone means "every call, in that mode"
         rules.setdefault(seam, True)
-    return ChaosSchedule(seed=seed, rules=rules, modes=modes)
+    return ChaosSchedule(seed=seed, rules=rules, modes=modes,
+                         delay_s=delay_s)
+
+
+def transport_disturb(schedule: Optional[ChaosSchedule]) -> None:
+    """Consume one ``fleet.transport`` slot and act on it: ``delay``
+    stalls the calling (wire) thread `schedule.delay_s` seconds before
+    letting the call proceed — the slow-link tail the router's hedging
+    exists to cut; ``partition`` (and plain ``fault``) raise
+    `InjectedFault`, the unreachable-replica failure the router's
+    consecutive-transport-failure trip absorbs. One seam, both the
+    in-process `TransportChaos` front and `RpcReplicaBackend`'s real
+    wire consult it, so a bench fleet and a cross-process fleet replay
+    the same timeline from the same spec."""
+    if schedule is None or not schedule.has_rule(TRANSPORT_SEAM):
+        return
+    inject, idx = schedule.decide(TRANSPORT_SEAM)
+    if not inject:
+        return
+    mode = schedule.mode_for(TRANSPORT_SEAM)
+    if mode == "delay":
+        time.sleep(schedule.delay_s)
+        return
+    raise InjectedFault(
+        f"chaos: transport {mode} at {TRANSPORT_SEAM} "
+        f"(call {idx}, seed {schedule.seed})")
+
+
+class TransportChaos:
+    """A transport-seam front for an IN-PROCESS replica backend: every
+    public call first consults the ``fleet.transport`` schedule
+    (`transport_disturb`) — a delay stalls it, a partition refuses it
+    with the retryable `InjectedFault` — then passes through. Gives a
+    hermetic bench/test fleet the same wire weather a real
+    `RpcReplicaBackend` sees, without sockets."""
+
+    def __init__(self, target, schedule: ChaosSchedule):
+        self._target = target
+        self._schedule = schedule
+        self.name = f"transport-chaos+{getattr(target, 'name', '?')}"
+
+    @property
+    def inner(self):
+        """Wrapper-chain hop (breaker_of / serving nesting guard)."""
+        return self._target
+
+    def __getattr__(self, name: str):
+        attr = getattr(self._target, name)
+        if name.startswith("_") or name == "close" or not callable(attr):
+            return attr  # lifecycle/local reads never cross the wire
+        schedule = self._schedule
+
+        def over_wire(*args, **kwargs):
+            transport_disturb(schedule)
+            return attr(*args, **kwargs)
+
+        return over_wire
 
 
 class _ChaosProxy:

@@ -15,6 +15,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
 import sys
 import time
 
@@ -71,6 +72,14 @@ def main(argv=None) -> int:
                              "dispatch seams (resilience/chaos.py) — the "
                              "router smoke trips one replica's breaker "
                              "with this")
+    parser.add_argument("--soundness-rate", type=float, default=None,
+                        help="continuous soundness spot-check rate for "
+                             "this replica's serving planes (resilience/"
+                             "soundness.py; default GETHSHARDING_"
+                             "SOUNDNESS_RATE, 0 = off) — pair with "
+                             "--sigbackend failover-* so a detected "
+                             "silent corruption trips the breaker and "
+                             "a fleet frontend drains the replica")
     parser.add_argument("--trace", action="store_true",
                         help="collect RPC-handler + serving-tier spans "
                              "(per-request queue/assembly/dispatch "
@@ -125,6 +134,25 @@ def main(argv=None) -> int:
         watchdog_s=args.serving_watchdog_s,
         tenant_quota_rows=args.serving_quota_rows))
     composed = sig_backend
+    # the node CLI's composition order (node/backend.py): device →
+    # chaos → serving → soundness → failover, so a detected silent
+    # corruption is a primary fault the breaker (and through
+    # shard_health, a fleet frontend) acts on
+    soundness_rate = args.soundness_rate
+    if soundness_rate is None:
+        soundness_rate = float(
+            os.environ.get("GETHSHARDING_SOUNDNESS_RATE", "0") or 0)
+    if soundness_rate > 0:
+        from gethsharding_tpu.resilience.soundness import (
+            SpotCheckSigBackend)
+
+        if not failover:
+            logging.getLogger("chain-server").warning(
+                "--soundness-rate without --sigbackend failover-*: a "
+                "detected corruption raises to the caller instead of "
+                "tripping a breaker")
+        sig_backend = SpotCheckSigBackend(sig_backend,
+                                          rate=soundness_rate)
     if failover:
         from gethsharding_tpu.resilience.breaker import FailoverSigBackend
 

@@ -117,9 +117,19 @@ class RPCClient:
             if ctx is not None:
                 request["trace"] = {"trace_id": ctx[0], "span_id": ctx[1]}
             payload = (json.dumps(request) + "\n").encode()
-            with self._write_lock:
-                self._file.write(payload)
-                self._file.flush()
+            try:
+                with self._write_lock:
+                    self._file.write(payload)
+                    self._file.flush()
+            except (OSError, ValueError):
+                # dead socket (the server was killed/restarted): the
+                # reply will never come — reclaim the pending slot
+                # instead of leaking it, and let the caller's
+                # transport-error handling (e.g. RpcReplicaBackend's
+                # redial) classify the failure
+                with self._pending_lock:
+                    self._pending.pop(rid, None)
+                raise
             if not event.wait(self._timeout):
                 with self._pending_lock:
                     self._pending.pop(rid, None)

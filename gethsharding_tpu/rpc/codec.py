@@ -105,6 +105,64 @@ def dec_record(obj: Optional[dict]):
     )
 
 
+# -- verification plane codecs (the serving RPC surface) -------------------
+# The committee and DAS planes ship RAGGED batches: per-row committee
+# signature/pubkey point lists and per-row merkle sibling paths. The
+# wire forms are plain nested JSON of the scalar codecs above, so a
+# frontend router can balance EVERY SigBackend op cross-process with
+# the same schema-first contract as the rest of the surface.
+
+
+def enc_g1_rows(rows) -> list:
+    """Per-row G1 point lists (committee vote signatures)."""
+    return [[enc_g1(p) for p in row] for row in rows]
+
+
+def dec_g1_rows(rows) -> list:
+    return [[dec_g1(p) for p in row] for row in rows]
+
+
+def enc_g2_rows(rows) -> list:
+    """Per-row G2 point lists (committee member pubkeys)."""
+    return [[enc_g2(p) for p in row] for row in rows]
+
+
+def dec_g2_rows(rows) -> list:
+    return [[dec_g2(p) for p in row] for row in rows]
+
+
+def enc_pk_row_keys(keys) -> Optional[list]:
+    """Optional per-row pk-plane cache keys. Keys are arbitrary
+    hashables caller-side (the notary uses int tuples); the wire form
+    is their `repr` — injective for the int/str/tuple keys in use, so
+    the remote backend's cache key still uniquely determines the row's
+    points, and stable across processes (unlike `hash`, repr does not
+    depend on PYTHONHASHSEED)."""
+    if keys is None:
+        return None
+    return [None if k is None else repr(k) for k in keys]
+
+
+def enc_das_call(chunks, indices, proofs, roots) -> list:
+    """The das_verify_samples argument plane: (chunks, indices,
+    sibling-path rows, roots) — positional, matching the backend op."""
+    return [
+        [enc_bytes(c) for c in chunks],
+        [int(i) for i in indices],
+        [[enc_bytes(node) for node in path] for path in proofs],
+        [enc_bytes(r) for r in roots],
+    ]
+
+
+def dec_das_call(chunks, indices, proofs, roots) -> tuple:
+    return (
+        [dec_bytes(c) for c in chunks],
+        [int(i) for i in indices],
+        [[dec_bytes(node) for node in path] for path in proofs],
+        [dec_bytes(r) for r in roots],
+    )
+
+
 # -- shardp2p message codecs (type-tagged, for the cross-process relay) ----
 
 

@@ -452,6 +452,56 @@ class RPCServer:
             out = serving.bls_verify_aggregates(*args)
         return [bool(b) for b in out]
 
+    def rpc_verifyCommittees(self, messages, sig_rows, pk_rows,
+                             pk_row_keys=None, klass=None, tenant=None):
+        """The committee plane over the wire: batch aggregate-and-
+        verify of per-row vote signatures + member pubkeys through the
+        serving tier (the op the notary's period audit drives — with
+        this RPC a fleet frontend balances audits cross-process
+        instead of pinning them to the caller's device). `pk_row_keys`
+        are the optional per-row pk-plane cache keys (wire form:
+        codec.enc_pk_row_keys), so a repeat committee stays
+        device-resident on the replica exactly as it would in-process.
+        The optional trailing `klass`/`tenant` tag admission like
+        shard_ecrecover's (a notary's bulk_audit context rides the
+        wire as an explicit klass; tenant-only still charges the quota
+        under this op's default class)."""
+        self._check_accepting("shard_verifyCommittees")
+        from gethsharding_tpu.serving.classes import admission_class
+
+        serving = self._serving()
+        args = ([codec.dec_bytes(m) for m in messages],
+                codec.dec_g1_rows(sig_rows),
+                codec.dec_g2_rows(pk_rows))
+        keys = None if pk_row_keys is None else [
+            None if k is None else str(k) for k in pk_row_keys]
+        if klass is not None or tenant is not None:
+            with admission_class(klass or "interactive", tenant):
+                out = serving.bls_verify_committees(*args,
+                                                    pk_row_keys=keys)
+        else:
+            out = serving.bls_verify_committees(*args, pk_row_keys=keys)
+        return [bool(b) for b in out]
+
+    def rpc_dasVerify(self, chunks, indices, proofs, roots,
+                      klass=None, tenant=None):
+        """The DAS sample-verdict plane over the wire: one verdict per
+        (chunk, index, proof path, root) row through the serving tier
+        (serving op `das_verify`, default class bulk_audit via the
+        per-op map). Malformed rows cost a False verdict, never an
+        error — the same hostile-input contract as the in-process op."""
+        self._check_accepting("shard_dasVerify")
+        from gethsharding_tpu.serving.classes import admission_class
+
+        serving = self._serving()
+        args = codec.dec_das_call(chunks, indices, proofs, roots)
+        if klass is not None or tenant is not None:
+            with admission_class(klass or "bulk_audit", tenant):
+                out = serving.das_verify_samples(*args)
+        else:
+            out = serving.das_verify_samples(*args)
+        return [bool(b) for b in out]
+
     def rpc_health(self):
         """The replica-health surface a fleet router sweeps: the drain
         flag, the failover breaker's state (if the injected backend

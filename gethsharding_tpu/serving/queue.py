@@ -29,6 +29,13 @@ of occupancy:
 - per-TENANT row quotas bound any one tenant's queue occupancy
   (`TenantQuotaExceeded`, a `ServingOverloadError`), so a single noisy
   frontend cannot crowd out the fleet;
+- INSIDE a class, the drain is weighted-fair ACROSS TENANTS (deficit
+  round-robin): each batch cycle hands every queued tenant an equal
+  row quantum of the class's share, deficits carried between batches
+  so a tenant whose requests are bigger than one quantum still clears
+  — a heavy tenant below its quota can therefore not starve a light
+  tenant in the same class, it can only consume the shares light
+  tenants leave unused (untenanted traffic is one bucket);
 - a class may carry an EXPIRY deadline: requests queued longer are
   failed with `ClassDeadlineExceeded` instead of occupying capacity
   forever.
@@ -48,6 +55,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 from typing import Dict, List, Optional, Tuple
 
@@ -173,6 +181,11 @@ class AdmissionQueue:
             c: [] for c in ADMISSION_CLASSES}
         self._class_rows: Dict[str, int] = {c: 0 for c in ADMISSION_CLASSES}
         self._tenant_rows: Dict[str, int] = {}
+        # deficit-round-robin state for the tenant-fair drain: per-class
+        # carried row deficits and the rotation cursor (see
+        # _drain_class_locked)
+        self._drr_deficit: Dict[str, Dict[str, int]] = {}
+        self._drr_rotation: Dict[str, int] = {}
         self._rows = 0
         self._count = 0
         self._lock = threading.Lock()
@@ -383,7 +396,9 @@ class AdmissionQueue:
         weight share of `max_batch` in priority order; pass 2 hands any
         leftover capacity out in priority order. Whole requests only; a
         batch always takes at least one request (an oversized caller
-        batch flows through as its own dispatch)."""
+        batch flows through as its own dispatch). Inside a class the
+        take is tenant-fair — `_drain_class_locked`'s deficit
+        round-robin."""
         ordered = sorted(
             (klass for klass in ADMISSION_CLASSES if self._by_class[klass]),
             key=lambda klass: self.policies[klass].priority)
@@ -393,24 +408,115 @@ class AdmissionQueue:
         for klass in ordered:
             budget = max(1, (self.max_batch
                              * self.policies[klass].weight) // total_weight)
-            taken = 0
-            items = self._by_class[klass]
-            while items and (not batch
-                             or (taken < budget
-                                 and rows + items[0].rows <= self.max_batch)):
-                request = items.pop(0)
-                self._unaccount_locked(request)
-                batch.append(request)
-                rows += request.rows
-                taken += request.rows
+            rows = self._drain_class_locked(klass, batch, rows, budget)
         for klass in ordered:  # pass 2: leftovers, priority first
-            items = self._by_class[klass]
-            while items and rows + items[0].rows <= self.max_batch:
-                request = items.pop(0)
-                self._unaccount_locked(request)
-                batch.append(request)
-                rows += request.rows
+            rows = self._drain_class_locked(klass, batch, rows, None)
         return batch
+
+    def _account_take_locked(self, request: Request, batch: List[Request],
+                             rows: int) -> int:
+        """Book one taken request (the caller owns its removal from
+        the class list)."""
+        self._unaccount_locked(request)
+        batch.append(request)
+        return rows + request.rows
+
+    def _drain_class_locked(self, klass: str, batch: List[Request],
+                            rows: int, budget: Optional[int]) -> int:
+        """Drain one class into `batch`, weighted-fair across its
+        queued tenants (`budget` = the class's pass-1 row share; None
+        = pass 2, capacity-bound only). Returns the updated batch row
+        count.
+
+        Single-tenant backlogs drain FIFO (the pre-WFQ behavior, no
+        overhead). With several tenants queued, a deficit round-robin
+        hands each tenant an equal row quantum per cycle, oldest
+        requests first WITHIN a tenant; deficits persist across
+        batches (`_drr_deficit`) so a tenant whose requests are larger
+        than one quantum accumulates the right to clear them instead
+        of starving by size, and the rotation cursor advances each
+        batch so no tenant owns the front of every cycle. Cost: one
+        pass to split the backlog into per-tenant deques, O(1) per
+        take, one pass to rebuild the remainder — the admission lock
+        is never held for a per-take list scan."""
+        items = self._by_class[klass]
+        if not items:
+            return rows
+        cap = self.max_batch
+        taken = 0
+        by_tenant: Dict[str, deque] = {}
+        for request in items:
+            by_tenant.setdefault(request.tenant, deque()).append(request)
+        if len(by_tenant) <= 1:
+            count = 0
+            while count < len(items) \
+                    and (not batch
+                         or ((budget is None or taken < budget)
+                             and rows + items[count].rows <= cap)):
+                request = items[count]
+                taken += request.rows
+                rows = self._account_take_locked(request, batch, rows)
+                count += 1
+            del items[:count]
+            return rows
+        tenants = list(by_tenant)
+        deficits = self._drr_deficit.setdefault(klass, {})
+        for tenant in list(deficits):
+            if tenant not in by_tenant:
+                deficits.pop(tenant)  # drained away: deficit resets
+        n = len(tenants)
+        start = self._drr_rotation.get(klass, 0) % n
+        if budget is not None:
+            # advance once per take_batch (pass 1 only — pass 2 reuses
+            # the same cycle's cursor, else 2-tenant rotations cancel)
+            self._drr_rotation[klass] = start + 1
+        order = tenants[start:] + tenants[:start]
+        quantum = max(1, (cap if budget is None else budget) // n)
+        taken_ids: set = set()
+        remaining = len(items)
+        # a deficit-blocked head clears within head.rows/quantum extra
+        # rounds; the guard only backstops a logic error
+        for _ in range(4 * cap + 4):
+            progress = False
+            deficit_blocked = False
+            for tenant in order:
+                queue = by_tenant[tenant]
+                if not queue:
+                    continue
+                if batch and (rows + queue[0].rows > cap or (
+                        budget is not None and taken >= budget)):
+                    # capacity/budget-walled at cycle start: no
+                    # accrual — classic DRR credits a flow only on a
+                    # genuine sending opportunity, else a walled
+                    # tenant banks unearned quantum every cycle and
+                    # monopolizes later batches
+                    continue
+                deficits[tenant] = min(
+                    deficits.get(tenant, 0) + quantum, cap + quantum)
+                while queue:
+                    head = queue[0]
+                    if batch:
+                        if rows + head.rows > cap or (
+                                budget is not None and taken >= budget):
+                            break  # capacity/budget wall
+                        if deficits[tenant] < head.rows:
+                            deficit_blocked = True
+                            break  # next cycle's quantum may clear it
+                    queue.popleft()
+                    taken_ids.add(id(head))
+                    remaining -= 1
+                    deficits[tenant] = max(
+                        0, deficits.get(tenant, 0) - head.rows)
+                    taken += head.rows
+                    rows = self._account_take_locked(head, batch, rows)
+                    progress = True
+            if remaining == 0 or (budget is not None and taken >= budget):
+                break
+            if not progress and not deficit_blocked:
+                break  # capacity-walled: no quantum can help
+        if taken_ids:
+            items[:] = [r for r in items if id(r) not in taken_ids]
+        return rows
 
     def close(self) -> None:
         """Stop admitting; wake the consumer to drain the remainder and

@@ -381,6 +381,157 @@ else
 fi
 rm -rf "$obsfleet_dir"
 
+# -- fleet frontend smoke: the REAL process topology — 2 chain_server
+# replicas + 1 standalone fleet.frontend balancing them. Verdicts
+# through the frontend must be bit-identical to the scalar backend
+# (ecrecover AND the committee plane over the new shard_verifyCommittees
+# wire), then replica r0 is KILLED mid-traffic (answers must stay
+# correct via the survivor), restarted on the SAME endpoint, and must
+# re-enter the rotation through the frontend's health sweep.
+echo "== fleet frontend smoke (kill + restart a replica under traffic)"
+ff_dir=$(mktemp -d)
+ff_pa=$(python -c "import socket; s = socket.socket(); \
+s.bind(('127.0.0.1', 0)); print(s.getsockname()[1]); s.close()")
+ff_pb=$(python -c "import socket; s = socket.socket(); \
+s.bind(('127.0.0.1', 0)); print(s.getsockname()[1]); s.close()")
+JAX_PLATFORMS=cpu python -m gethsharding_tpu.rpc.chain_server \
+    --sigbackend python --port "$ff_pa" --runtime 120 \
+    --verbosity error > "$ff_dir/ra.json" &
+ff_pid_a=$!
+JAX_PLATFORMS=cpu python -m gethsharding_tpu.rpc.chain_server \
+    --sigbackend python --port "$ff_pb" --runtime 120 \
+    --verbosity error > "$ff_dir/rb.json" &
+ff_pid_b=$!
+for _ in $(seq 1 100); do
+    [ -s "$ff_dir/ra.json" ] && [ -s "$ff_dir/rb.json" ] && break
+    sleep 0.2
+done
+GETHSHARDING_PERFWATCH_DIR="$ff_dir/blackbox" JAX_PLATFORMS=cpu \
+python -m gethsharding_tpu.fleet.frontend \
+    --replica "127.0.0.1:$ff_pa" --replica "127.0.0.1:$ff_pb" \
+    --fleet-hedge-ms 25 --health-interval 0.1 --runtime 120 \
+    --verbosity error > "$ff_dir/fe.json" &
+ff_pid_fe=$!
+for _ in $(seq 1 100); do
+    [ -s "$ff_dir/fe.json" ] && break
+    sleep 0.2
+done
+# phase 1: verdict bit-identity through the frontend (ecrecover + the
+# committee plane), against the scalar reference
+JAX_PLATFORMS=cpu FF_DIR="$ff_dir" python - <<'PYEOF' || fail=1
+import json, os
+
+from gethsharding_tpu.crypto import bn256 as bls
+from gethsharding_tpu.crypto import secp256k1 as ecdsa
+from gethsharding_tpu.crypto.keccak import keccak256
+from gethsharding_tpu.rpc import codec
+from gethsharding_tpu.rpc.client import RPCClient
+from gethsharding_tpu.sigbackend import PythonSigBackend
+
+addr = json.load(open(os.path.join(os.environ["FF_DIR"], "fe.json")))
+rpc = RPCClient(addr["host"], addr["port"])
+py = PythonSigBackend()
+for i in range(8):
+    priv = int.from_bytes(keccak256(b"ffs-%d" % i), "big") % ecdsa.N
+    digest = keccak256(b"ffs-msg-%d" % i)
+    sig = ecdsa.sign(digest, priv).to_bytes65()
+    got = rpc.call("shard_ecrecover", [codec.enc_bytes(digest)],
+                   [codec.enc_bytes(sig)])
+    want = py.ecrecover_addresses([digest], [sig])
+    assert got == [codec.enc_bytes(bytes(want[0]))], (i, got)
+msgs, sig_rows, pk_rows, keys = [], [], [], []
+for i in range(3):
+    tag = b"ffc-%d" % i
+    ks = [bls.bls_keygen(tag + bytes([j])) for j in range(2)]
+    sigs = [bls.bls_sign(tag, sk) for sk, _ in ks]
+    if i == 1:
+        sigs[0] = bls.bls_sign(b"tampered", ks[0][0])
+    msgs.append(tag); sig_rows.append(sigs)
+    pk_rows.append([pk for _, pk in ks]); keys.append(("ff", i))
+want = py.bls_verify_committees(msgs, sig_rows, pk_rows)
+got = rpc.call("shard_verifyCommittees",
+               [codec.enc_bytes(m) for m in msgs],
+               codec.enc_g1_rows(sig_rows), codec.enc_g2_rows(pk_rows),
+               codec.enc_pk_row_keys(keys))
+assert got == want, (got, want)
+rpc.close()
+print("fleet frontend phase 1 OK: ecrecover + committee plane"
+      " bit-identical to scalar")
+PYEOF
+# phase 2: kill replica A under traffic — every answer must keep coming
+# (routed to the survivor), and the frontend must mark r0 unhealthy
+kill -9 "$ff_pid_a" 2>/dev/null
+JAX_PLATFORMS=cpu FF_DIR="$ff_dir" python - <<'PYEOF' || fail=1
+import json, os, time
+
+from gethsharding_tpu.crypto import secp256k1 as ecdsa
+from gethsharding_tpu.crypto.keccak import keccak256
+from gethsharding_tpu.rpc import codec
+from gethsharding_tpu.rpc.client import RPCClient
+
+addr = json.load(open(os.path.join(os.environ["FF_DIR"], "fe.json")))
+rpc = RPCClient(addr["host"], addr["port"])
+for i in range(12):
+    priv = int.from_bytes(keccak256(b"ffk-%d" % i), "big") % ecdsa.N
+    digest = keccak256(b"ffk-msg-%d" % i)
+    sig = ecdsa.sign(digest, priv).to_bytes65()
+    got = rpc.call("shard_ecrecover", [codec.enc_bytes(digest)],
+                   [codec.enc_bytes(sig)])
+    assert got == [codec.enc_bytes(ecdsa.priv_to_address(priv))], (i, got)
+    time.sleep(0.05)
+deadline = time.monotonic() + 10
+state = None
+while time.monotonic() < deadline:
+    state = rpc.call("shard_fleetStatus")["replicas"]["r0"]["state"]
+    if state != "healthy":
+        break
+    time.sleep(0.1)
+assert state != "healthy", f"frontend never noticed the kill: {state}"
+rpc.close()
+print("fleet frontend phase 2 OK: replica killed, answers stayed"
+      " correct, r0 ->", state)
+PYEOF
+# phase 3: restart replica A on the SAME endpoint; the frontend's
+# health sweep must re-enter it, and traffic must stay correct
+JAX_PLATFORMS=cpu python -m gethsharding_tpu.rpc.chain_server \
+    --sigbackend python --port "$ff_pa" --runtime 60 \
+    --verbosity error > "$ff_dir/ra2.json" &
+ff_pid_a2=$!
+JAX_PLATFORMS=cpu FF_DIR="$ff_dir" python - <<'PYEOF' || fail=1
+import json, os, time
+
+from gethsharding_tpu.crypto import secp256k1 as ecdsa
+from gethsharding_tpu.crypto.keccak import keccak256
+from gethsharding_tpu.rpc import codec
+from gethsharding_tpu.rpc.client import RPCClient
+
+addr = json.load(open(os.path.join(os.environ["FF_DIR"], "fe.json")))
+rpc = RPCClient(addr["host"], addr["port"])
+deadline = time.monotonic() + 20
+status = None
+while time.monotonic() < deadline:
+    status = rpc.call("shard_fleetStatus")["replicas"]["r0"]
+    if status["state"] == "healthy":
+        break
+    time.sleep(0.2)
+assert status and status["state"] == "healthy", \
+    f"killed replica never re-entered after restart: {status}"
+assert status["reentries"] >= 1, status
+for i in range(6):
+    priv = int.from_bytes(keccak256(b"ffr-%d" % i), "big") % ecdsa.N
+    digest = keccak256(b"ffr-msg-%d" % i)
+    sig = ecdsa.sign(digest, priv).to_bytes65()
+    got = rpc.call("shard_ecrecover", [codec.enc_bytes(digest)],
+                   [codec.enc_bytes(sig)])
+    assert got == [codec.enc_bytes(ecdsa.priv_to_address(priv))], (i, got)
+rpc.close()
+print("fleet frontend smoke OK: killed replica re-entered after",
+      status["reentries"], "re-entries; verdicts stayed bit-identical")
+PYEOF
+kill "$ff_pid_fe" "$ff_pid_b" "$ff_pid_a2" 2>/dev/null
+wait "$ff_pid_fe" "$ff_pid_b" "$ff_pid_a2" 2>/dev/null
+rm -rf "$ff_dir"
+
 # -- perfwatch smoke: the CPU-quick micro suite + the noise-aware
 # regression gate, closed loop — seed a FRESH ledger with clean runs,
 # the gate must pass; inject a labeled 1.5x slowdown into one
@@ -544,6 +695,7 @@ echo "== lockcheck+racecheck smoke (fleet/serving/concurrency under both recorde
 GETHSHARDING_LOCKCHECK=1 GETHSHARDING_RACECHECK=1 JAX_PLATFORMS=cpu \
     python -m pytest \
     tests/test_concurrency.py tests/test_serving.py tests/test_fleet.py \
+    tests/test_fleet_frontend.py \
     -q --no-header -m 'not slow' || fail=1
 
 for f in tests/test_*.py; do

@@ -32,6 +32,14 @@ Per-class p99 latencies are reported and (when `--slo-interactive-ms`
 etc. are nonzero) GATED: `bench.py --fleet` runs this model with SLOs
 on. Exit code 1 on any divergence, hung client, interactive shed, or
 SLO breach.
+
+Frontend process mode (`--frontend`, with `--replicas N`): the REAL
+topology — N `chain_server` replica processes, one standalone
+`fleet.frontend` process balancing them (hedging armed via
+`--hedge-ms`), M client threads dialing the FRONTEND over JSON-RPC.
+Every answer is verified against the known signer; the summary reports
+the frontend's hedge win/waste rates from `shard_fleetStatus`. Exit 1
+on any divergence or hung client.
 """
 
 from __future__ import annotations
@@ -350,6 +358,120 @@ def run_fleet(args) -> int:
     return 1 if failed else 0
 
 
+def _spawn(cmd, env=None):
+    import subprocess
+
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, text=True,
+                            env=env or os.environ.copy())
+    line = proc.stdout.readline().strip()
+    if not line:
+        proc.terminate()
+        raise RuntimeError(f"{cmd[:4]}... printed no address line")
+    addr = json.loads(line)
+    return proc, addr
+
+
+def run_frontend(args) -> int:
+    """The cross-process topology soak: N chain_server replicas + ONE
+    standalone frontend process, clients dialing the frontend."""
+    from gethsharding_tpu.rpc import codec
+    from gethsharding_tpu.rpc.client import RPCClient, RPCError
+
+    n = max(2, args.replicas)
+    env = {**os.environ}
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    replicas, endpoints = [], []
+    frontend = None
+    try:
+        for _ in range(n):
+            proc, addr = _spawn(
+                [sys.executable, "-m", "gethsharding_tpu.rpc.chain_server",
+                 "--sigbackend", "python", "--verbosity", "error"],
+                env=env)
+            replicas.append(proc)
+            endpoints.append("%s:%d" % (addr["host"], addr["port"]))
+        fe_cmd = [sys.executable, "-m", "gethsharding_tpu.fleet.frontend",
+                  "--verbosity", "error",
+                  "--health-interval", "0.1",
+                  "--fleet-hedge-ms", str(args.hedge_ms)]
+        for endpoint in endpoints:
+            fe_cmd += ["--replica", endpoint]
+        frontend, fe_addr = _spawn(fe_cmd, env=env)
+
+        cases = build_cases(args.cases)
+        done = [0] * args.clients
+        divergences: list = []
+        typed_errors = [0]
+        stop = threading.Event()
+        deadline = time.monotonic() + args.duration
+
+        def client(c: int) -> None:
+            rpc = RPCClient(fe_addr["host"], fe_addr["port"])
+            i = c
+            try:
+                while time.monotonic() < deadline and not stop.is_set():
+                    digest, sig, want = cases[i % len(cases)]
+                    i += args.clients
+                    try:
+                        got = rpc.call("shard_ecrecover",
+                                       [codec.enc_bytes(digest)],
+                                       [codec.enc_bytes(sig)])
+                    except RPCError:
+                        typed_errors[0] += 1
+                        continue
+                    if got != [codec.enc_bytes(want)]:
+                        divergences.append((c, i))
+                        stop.set()
+                        return
+                    done[c] += 1
+            finally:
+                rpc.close()
+
+        threads = [threading.Thread(target=client, args=(c,), daemon=True)
+                   for c in range(args.clients)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=args.duration + 60)
+        hung = [t for t in threads if t.is_alive()]
+        wall = time.monotonic() - t0
+
+        status_rpc = RPCClient(fe_addr["host"], fe_addr["port"])
+        status = status_rpc.call("shard_fleetStatus")
+        status_rpc.close()
+        hedge = status["hedge"]
+        total = sum(done)
+        dispatches = total + hedge["issued"]
+        summary = {
+            "summary": True,
+            "frontend": True,
+            "replicas": n,
+            "clients": args.clients,
+            "wall_s": round(wall, 2),
+            "done": total,
+            "rate": round(total / wall, 1) if wall else 0.0,
+            "typed_errors": typed_errors[0],
+            "divergences": len(divergences),
+            "hung_clients": len(hung),
+            "hedge": hedge,
+            "hedge_win_rate": round(
+                hedge["won"] / max(1, hedge["issued"]), 3),
+            "hedge_waste_rate": round(
+                hedge["wasted"] / max(1, dispatches), 3),
+            "replica_states": {name: s["state"]
+                               for name, s in status["replicas"].items()},
+        }
+        print(json.dumps(summary), flush=True)
+        return 1 if divergences or hung else 0
+    finally:
+        if frontend is not None:
+            frontend.terminate()
+        for proc in replicas:
+            proc.terminate()
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(
         description="soak the serving tier (single backend or fleet)")
@@ -392,6 +514,15 @@ def main() -> int:
                              "device faults on replica r0 starting at "
                              "this dispatch index — trips its breaker "
                              "mid-soak")
+    parser.add_argument("--frontend", action="store_true",
+                        help="cross-process mode: spawn --replicas N "
+                             "chain_server processes plus ONE standalone "
+                             "fleet.frontend process and drive traffic "
+                             "through the frontend over JSON-RPC, "
+                             "reporting hedge win/waste rates")
+    parser.add_argument("--hedge-ms", type=float, default=15.0,
+                        help="frontend mode: the frontend's "
+                             "--fleet-hedge-ms floor")
     parser.add_argument("--chaos-seed", type=int, default=11)
     parser.add_argument("--breaker-reset-s", type=float, default=0.5)
     parser.add_argument("--slo-interactive-ms", type=float, default=0.0,
@@ -401,6 +532,8 @@ def main() -> int:
     parser.add_argument("--slo-catchup-ms", type=float, default=0.0)
     args = parser.parse_args()
 
+    if args.frontend:
+        return run_frontend(args)
     if args.replicas > 0:
         return run_fleet(args)
     return run_single(args)

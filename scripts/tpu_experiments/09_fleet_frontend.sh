@@ -1,0 +1,14 @@
+#!/bin/bash
+# Fleet frontend hardening on the REAL device path: the bench.py
+# --fleet acceptance chain with jax replicas — the traffic-model soak
+# (breaker trip + drain + re-entry), the hedging closed loop (one
+# replica transport-delayed 10x must see interactive p99 improve >=2x
+# at <=15% wasted duplicate dispatches, asserted inside bench), and
+# the partition/kill soak (zero incorrect verdicts, typed failures
+# only). Emits through the perfwatch ledger like every bench mode.
+cd /root/repo || exit 1
+env GETHSHARDING_BENCH_FLEET_BACKEND=jax \
+  GETHSHARDING_PERFWATCH_DIR=/tmp/pw_fleet_probe \
+  timeout 1800 python bench.py --fleet >"$1.out" 2>"$1.err"
+grep -q fleet_hedge_p99_improvement "$1.out" \
+    && grep -q fleet_partition_soak_completed "$1.out"

@@ -202,6 +202,29 @@ def test_tenant_quota_bounds_one_tenant():
     queue.put(_req(1, tenant="noisy"))  # drained: admitted again
 
 
+def test_wfq_tenant_fairness_preserves_class_weighting():
+    """The tenant-fair drain (deficit round-robin, PR 15) nests INSIDE
+    the class-weighted drain: with two tenants queued in every class,
+    the batch still splits 8/3/1 by class weight, and within the
+    interactive share both tenants are served. (The starvation-bound
+    and carried-deficit contracts live in test_fleet_frontend.py.)"""
+    queue = AdmissionQueue(cap_rows=1024, max_batch=12, flush_us=0)
+    for klass in (CLASS_CATCHUP, CLASS_BULK_AUDIT, CLASS_INTERACTIVE):
+        for tenant in ("a", "b"):
+            for _ in range(10):
+                queue.put(_req(1, klass=klass, tenant=tenant))
+    batch, reason = queue.take_batch()
+    assert reason == "full"
+    counts: dict = {}
+    for request in batch:
+        counts[request.klass] = counts.get(request.klass, 0) + 1
+    assert counts == {CLASS_INTERACTIVE: 8, CLASS_BULK_AUDIT: 3,
+                      CLASS_CATCHUP: 1}
+    interactive_tenants = {r.tenant for r in batch
+                           if r.klass == CLASS_INTERACTIVE}
+    assert interactive_tenants == {"a", "b"}
+
+
 def test_put_after_close_fails_fast():
     queue = AdmissionQueue(cap_rows=16, max_batch=16, flush_us=0)
     queue.close()
