@@ -200,7 +200,14 @@ class LightClient(Service):
         selection must not be precomputable — `das/sampler.py`
         documents the soundness split), pulls chunk+proof samples over
         shardp2p and verifies them with the scalar reference (a light
-        client has no device). True iff every sampled chunk proves."""
+        client has no device). True iff every sampled chunk proves.
+
+        Under ``--da-proofs=poly`` the k samples arrive under ONE
+        constant-size polynomial multiproof instead of k sibling paths
+        (das/pcs.py) — `fetch_multiproof` verifies it against the
+        signed poly commitment before admission, so delivery IS the
+        verdict and the wire cost per check drops from k paths to one
+        64-byte point."""
         if self.das is None:
             raise RuntimeError("light client has no DAS service attached")
         import secrets
@@ -222,12 +229,16 @@ class LightClient(Service):
             indices = sample_indices(
                 keccak256(seed + bytes(commitment.das_root)), k,
                 commitment.n)
-            got = self.das.fetch_samples(commitment, indices)
-            verdicts = []
-            for index in indices:
-                chunk, proof = got.get(index, (b"", ()))
-                verdicts.append(verify_sample(commitment.das_root,
-                                              index, chunk, proof))
+            if getattr(self.das, "proof_mode", "merkle") == "poly":
+                got = self.das.fetch_multiproof(commitment, indices)
+                verdicts = [got is not None] * len(indices)
+            else:
+                fetched = self.das.fetch_samples(commitment, indices)
+                verdicts = []
+                for index in indices:
+                    chunk, proof = fetched.get(index, (b"", ()))
+                    verdicts.append(verify_sample(commitment.das_root,
+                                                  index, chunk, proof))
             self.samples_verified += sum(verdicts)
             self.proofs_rejected += len(verdicts) - sum(verdicts)
             self.das.note_verdicts(verdicts)

@@ -849,6 +849,8 @@ class Notary(Service):
         if fresh:
             self.das.prefetch_commitments(
                 [(shard_id, period) for shard_id, period, _ in fresh])
+        if getattr(self.das, "proof_mode", "merkle") == "poly":
+            return self._poly_verdicts(fresh, account, verdicts)
         collected = []
         for shard_id, period, record in fresh:
             rows = self.das.collect_rows(shard_id, period, record,
@@ -885,6 +887,50 @@ class Notary(Service):
         if len(self._da_verdicts) > self._DA_CACHE_MAX:
             # prune oldest periods first: closed periods stop being
             # re-checked once the head loop moves on anyway
+            for key in sorted(self._da_verdicts,
+                              key=lambda sp: sp[1])[:len(self._da_verdicts)
+                                                    - self._DA_CACHE_MAX]:
+                del self._da_verdicts[key]
+        return verdicts
+
+    def _poly_verdicts(self, fresh, account: bytes, verdicts: dict) -> dict:
+        """The --da-proofs=poly phase-3: ONE `das_verify_multiproofs`
+        row per candidate shard (constant-size proof per collation, the
+        whole period folded into one batched pairing dispatch). The
+        same availability semantics as the merkle path: no commitment
+        -> unavailable; a failed or merkle-only fetch was synthesized
+        as an invalid row by `collect_poly_row`, so it scores False."""
+        collected = []
+        for shard_id, period, record in fresh:
+            row = self.das.collect_poly_row(shard_id, period, record,
+                                            account)
+            collected.append((shard_id, period, row))
+        batched = [(shard_id, period, row)
+                   for shard_id, period, row in collected
+                   if row is not None]
+        ok: list = []
+        if batched:
+            with tracing.span("notary/das_poly_verify",
+                              rows=len(batched)):
+                ok = self.sig_backend.das_verify_multiproofs(
+                    [row["poly_commitment"] for _, _, row in batched],
+                    [row["indices"] for _, _, row in batched],
+                    [row["evals"] for _, _, row in batched],
+                    [row["proof"] for _, _, row in batched],
+                    [row["n"] for _, _, row in batched])
+        it = iter(ok)
+        row_verdicts = {shard_id: next(it)
+                        for shard_id, _, _ in batched}
+        for shard_id, period, row in collected:
+            if row is None:
+                verdicts[shard_id] = False  # no commitment: unavailable
+                continue
+            good = bool(row_verdicts.get(shard_id, False))
+            self.das.note_verdicts([good])
+            verdicts[shard_id] = good
+            if good:
+                self._da_verdicts[(shard_id, period)] = True
+        if len(self._da_verdicts) > self._DA_CACHE_MAX:
             for key in sorted(self._da_verdicts,
                               key=lambda sp: sp[1])[:len(self._da_verdicts)
                                                     - self._DA_CACHE_MAX]:

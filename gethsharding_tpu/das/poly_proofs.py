@@ -1,0 +1,120 @@
+"""Batched DAS multiproof verification: scalar truth + fixed-shape planes.
+
+The `das_verify_multiproofs` SigBackend op. One ROW is one sampled
+collation in a period: a 64-byte G1 commitment, the sampled index set,
+the claimed chunk-value evaluations, ONE 64-byte G1 multiproof, and
+the collation's domain size n. The verdict is `pcs.verify_multi` —
+does e(C − [r(τ)]₁, H)·e(−π, [z_S(τ)]₂) == 1.
+
+`verify_multiproofs` is the scalar batch face
+(`PythonSigBackend.das_verify_multiproofs`) and THE differential
+reference. `marshal_multiproofs` folds each row's interpolation and
+vanishing MSMs host-side into three group points per row —
+A = C − [r(τ)]₁ (G1), π (G1), Z = [z_S(τ)]₂ (G2) — exactly the
+(sig, H, pk) slots of the already-jitted two-pair kernel
+`ops/bn256_jax.bls_verify_aggregate_batch`, which computes
+e(sig, G2_GEN)·e(−H, pk) == 1. No new kernel, no new compile shapes.
+
+Bit-identity with the scalar path is BY CONSTRUCTION, the same way
+`das/proofs.py` does it: every scalar rejection (bad shapes, undecodable
+or off-curve wire points) becomes `valid=False` at marshal time, and
+the rare degenerate rows the pairing kernel cannot represent (A, π, or
+Z at infinity — e.g. a constant polynomial's zero quotient) are
+resolved host-side with the scalar verifier itself, substituting a
+trivially-true pairing row when the scalar verdict is True.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from gethsharding_tpu.crypto.bn256 import G2_GEN, G1_GEN, g1_add, g1_neg
+from gethsharding_tpu.das import pcs
+
+# re-exported caps: the service/sampler size their index sets by these
+MAX_MULTIPROOF_INDICES = pcs.MAX_MULTIPROOF_INDICES
+PROOF_BYTES = pcs.PROOF_BYTES
+
+
+def verify_multiproof(commitment: bytes, indices: Sequence[int],
+                      evals: Sequence[int], proof: bytes, n: int,
+                      srs: Optional[pcs.SRS] = None) -> bool:
+    """One row verdict from wire-form (64-byte) G1 points. THE
+    reference semantics: undecodable points are False, never raise."""
+    srs = srs or pcs.dev_srs()
+    try:
+        c_point = pcs.g1_from_bytes(commitment)
+        p_point = pcs.g1_from_bytes(proof)
+    except (TypeError, ValueError):
+        return False
+    return pcs.verify_multi(c_point, indices, evals, p_point, n, srs)
+
+
+def verify_multiproofs(commitments: Sequence[bytes],
+                       index_rows: Sequence[Sequence[int]],
+                       eval_rows: Sequence[Sequence[int]],
+                       proofs: Sequence[bytes],
+                       ns: Sequence[int]) -> List[bool]:
+    """The scalar batch face (`PythonSigBackend.das_verify_multiproofs`)."""
+    srs = pcs.dev_srs()
+    return [verify_multiproof(c, idx, ev, pf, n, srs)
+            for c, idx, ev, pf, n
+            in zip(commitments, index_rows, eval_rows, proofs, ns)]
+
+
+def marshal_multiproofs(commitments: Sequence[bytes],
+                        index_rows: Sequence[Sequence[int]],
+                        eval_rows: Sequence[Sequence[int]],
+                        proofs: Sequence[bytes],
+                        ns: Sequence[int], bucket: int) -> dict:
+    """Rows -> the pairing kernel's fixed (bucket, ...) limb planes.
+
+    Host side per row: decode the two wire points, run the row's
+    interpolation MSM [r(τ)]₁ and vanishing MSM [z_S(τ)]₂ over the SRS
+    power tables, and fold A = C − [r(τ)]₁. The device then checks
+    e(A, G2_GEN)·e(−π, Z) == 1 for the whole bucket in one dispatch.
+
+    Planes: px/py = π limbs (the kernel's H slot, negated on device),
+    ax/ay = A limbs (sig slot), zx/zy = Z limbs (pk slot), valid, rows.
+    """
+    # lazy: scalar users of this module must never pull in jax
+    from gethsharding_tpu.ops.bn256_jax import g1_to_limbs, g2_to_limbs
+
+    srs = pcs.dev_srs()
+    rows = len(commitments)
+    a_points = [None] * bucket
+    p_points = [None] * bucket
+    z_points = [None] * bucket
+    valid = [False] * bucket
+    for b in range(rows):
+        indices = index_rows[b]
+        evals = eval_rows[b]
+        if not pcs.check_shape(indices, evals, ns[b], srs):
+            continue
+        try:
+            c_point = pcs.g1_from_bytes(commitments[b])
+            p_point = pcs.g1_from_bytes(proofs[b])
+        except (TypeError, ValueError):
+            continue
+        xs = [int(i) for i in indices]
+        es = [int(e) for e in evals]
+        r_point = pcs.g1_msm(pcs.lagrange_coeffs(xs, es), srs.g1_powers)
+        z_point = pcs.g2_msm(pcs.vanishing_coeffs(xs), srs.g2_powers)
+        a_point = g1_add(c_point, g1_neg(r_point))
+        if a_point is None or p_point is None or z_point is None:
+            # a point at infinity has no affine limb form; the scalar
+            # pairing skips such pairs, so resolve the row host-side
+            # and ship either a trivially-true pairing or valid=False
+            if pcs.verify_multi(c_point, xs, es, p_point, ns[b], srs):
+                a_point, p_point, z_point = G1_GEN, G1_GEN, G2_GEN
+            else:
+                continue
+        a_points[b] = a_point
+        p_points[b] = p_point
+        z_points[b] = z_point
+        valid[b] = True
+    ax, ay, aok = g1_to_limbs(a_points)
+    px, py, pok = g1_to_limbs(p_points)
+    zx, zy, zok = g2_to_limbs(z_points)
+    return {"px": px, "py": py, "ax": ax, "ay": ay, "zx": zx, "zy": zy,
+            "valid": aok & pok & zok & valid, "rows": rows}

@@ -87,15 +87,36 @@ def detection_probability(samples: int, n: int, k_data: int,
     return 1.0 - miss_one ** max(1, checkers)
 
 
+def proof_bytes(samples: int, mode: str = "merkle") -> int:
+    """Proof bytes ONE checker pulls for `samples` sampled chunks
+    (chunk payload excluded — both modes carry the same chunk bytes).
+    Merkle: a sibling path per sample (<= MAX_PROOF_DEPTH 32-byte
+    hashes). Poly (`--da-proofs=poly`, das/pcs.py): ONE 64-byte
+    multiproof point covering the whole index set — constant in the
+    sample count, which is the entire point of the scheme."""
+    from gethsharding_tpu.das.pcs import PROOF_BYTES
+    from gethsharding_tpu.das.proofs import MAX_PROOF_DEPTH
+
+    if mode == "merkle":
+        return int(samples) * MAX_PROOF_DEPTH * 32
+    if mode == "poly":
+        return PROOF_BYTES if samples > 0 else 0
+    raise ValueError(f"unknown proof mode {mode!r}")
+
+
 def soundness_table(n: int, k_data: int,
                     ks: Sequence[int] = (4, 8, 16, 32),
                     checkers: int = 1) -> List[dict]:
     """Rows for the README soundness table: k vs detection probability
-    (per checker and, when `checkers` > 1, for the committee)."""
+    (per checker and, when `checkers` > 1, for the committee), plus
+    the (samples, proof-bytes, detection) trade-off per proof mode —
+    the table that shows poly mode buys more samples per wire byte."""
     rows = []
     for k in ks:
         row = {"k": k,
-               "p_detect": detection_probability(k, n, k_data)}
+               "p_detect": detection_probability(k, n, k_data),
+               "merkle_proof_bytes": proof_bytes(k, "merkle"),
+               "poly_proof_bytes": proof_bytes(k, "poly")}
         if checkers > 1:
             row["p_detect_committee"] = detection_probability(
                 k, n, k_data, checkers=checkers)

@@ -54,12 +54,16 @@ from gethsharding_tpu.crypto import secp256k1 as ecdsa
 from gethsharding_tpu.crypto.keccak import keccak256
 from gethsharding_tpu.das.erasure import (DAS_CHUNK_SIZE, MAX_TOTAL_CHUNKS,
                                           extend_body)
+from gethsharding_tpu.das import pcs
+from gethsharding_tpu.das.poly_proofs import verify_multiproof
 from gethsharding_tpu.das.proofs import (MAX_PROOF_DEPTH, chunk_leaf,
                                          merkle_levels, merkle_proof,
                                          verify_sample)
 from gethsharding_tpu.das.sampler import sample_indices, sample_seed
 from gethsharding_tpu.p2p.messages import (DASCommitmentRequest,
                                            DASCommitmentResponse,
+                                           DASMultiproofRequest,
+                                           DASMultiproofResponse,
                                            DASampleRequest, DASampleResponse)
 from gethsharding_tpu.resilience.errors import FetchAborted, TransientError
 from gethsharding_tpu.resilience.policy import (DEFAULT_RETRYABLE,
@@ -69,7 +73,11 @@ from gethsharding_tpu.storage.chunker import ChunkStore
 
 # the chaos seam prefix the node CLI wires for --da-mode=sampled specs
 CHAOS_SEAMS = ("das.commitment_fetch", "das.sample_fetch",
-               "das.parity_publish")
+               "das.parity_publish", "das.multiproof_fetch")
+
+# the supported --da-proofs modes: merkle sibling paths (PR 6) or one
+# constant-size polynomial multiproof per sampled collation (das/pcs.py)
+PROOF_MODES = ("merkle", "poly")
 
 # per-request index cap at the serving side: an unauthenticated request
 # stream must not turn one frame into unbounded proof work
@@ -91,6 +99,10 @@ class _SampleMiss(TransientError):
     """Sampled chunks still missing after one fetch attempt."""
 
 
+class _MultiproofMiss(TransientError):
+    """No verified multiproof response within one fetch attempt."""
+
+
 @dataclass(frozen=True)
 class DASCommitment:
     """The proposer's published extension commitment for one
@@ -103,17 +115,23 @@ class DASCommitment:
     k: int
     n: int
     body_len: int
+    # 64-byte G1 polynomial commitment (das/pcs.py) in --da-proofs=poly
+    # mode; empty in merkle-only mode. Signed into the same digest, and
+    # the digest of a merkle-only commitment is BIT-IDENTICAL to the
+    # pre-poly wire format (appending zero bytes appends nothing).
+    poly_commitment: bytes = b""
     signature: bytes = b""
 
     def digest(self) -> bytes:
         return commitment_digest(self.shard_id, self.period,
                                  self.chunk_root, self.das_root,
-                                 self.k, self.n, self.body_len)
+                                 self.k, self.n, self.body_len,
+                                 self.poly_commitment)
 
 
 def commitment_digest(shard_id: int, period: int, chunk_root: bytes,
-                      das_root: bytes, k: int, n: int,
-                      body_len: int) -> bytes:
+                      das_root: bytes, k: int, n: int, body_len: int,
+                      poly_commitment: bytes = b"") -> bytes:
     """What the proposer signs: every field of the commitment, bound to
     the on-chain chunk_root, under a DAS domain tag."""
     return keccak256(_COMMIT_DOMAIN
@@ -122,7 +140,21 @@ def commitment_digest(shard_id: int, period: int, chunk_root: bytes,
                      + bytes(chunk_root) + bytes(das_root)
                      + int(k).to_bytes(2, "big")
                      + int(n).to_bytes(2, "big")
-                     + int(body_len).to_bytes(8, "big"))
+                     + int(body_len).to_bytes(8, "big")
+                     + bytes(poly_commitment))
+
+
+def _poly_commitment_ok(poly_commitment: bytes) -> bool:
+    """Empty (merkle-only publisher) or a decodable on-curve 64-byte G1
+    point — a commitment carrying undecodable poly bytes is rejected
+    outright, before it can poison a multiproof fetch."""
+    if not poly_commitment:
+        return True
+    try:
+        pcs.g1_from_bytes(poly_commitment)
+    except (TypeError, ValueError):
+        return False
+    return True
 
 
 def verify_commitment(commitment: DASCommitment, proposer) -> bool:
@@ -149,10 +181,15 @@ class DASService(Service):
                  chaos=None,
                  poll_interval: float = 0.02,
                  fetch_timeout: float = 3.0,
-                 fetch_attempts: int = 3):
+                 fetch_attempts: int = 3,
+                 proof_mode: str = "merkle"):
         super().__init__()
+        if proof_mode not in PROOF_MODES:
+            raise ValueError(f"unknown DAS proof mode {proof_mode!r}; "
+                             f"choose from {PROOF_MODES}")
         self.client = client
         self.p2p = p2p
+        self.proof_mode = proof_mode
         # the parity-publish sink: extended chunks are filed here under
         # their content address, so a node that ALSO runs a NetStore on
         # the same store serves them over the ordinary chunk protocol
@@ -173,12 +210,18 @@ class DASService(Service):
                                retryable=DEFAULT_RETRYABLE))
         # published state (server side)
         self._blobs: Dict[bytes, tuple] = {}   # das_root -> (xb, levels)
+        self._poly: Dict[bytes, list] = {}     # das_root -> chunk values
         self._commitments: Dict[Tuple[int, int], DASCommitment] = {}
         # fetched state (fetcher side); solicited-only admission
         self._want_commitments: set = set()    # (shard, period)
         self._want_samples: set = set()        # (das_root, index)
+        # (das_root, indices) -> (poly_commitment, n) while a
+        # multiproof fetch is in flight — the pump verifies responses
+        # against exactly what was solicited
+        self._want_multi: Dict[tuple, tuple] = {}
         self._recv_commitments: Dict[tuple, list] = {}
         self._recv_samples: Dict[tuple, tuple] = {}
+        self._recv_multi: Dict[tuple, tuple] = {}
         self._lock = threading.Lock()
         self._subs = []
         # counters (the /status `das` namespace + Prometheus rows)
@@ -191,6 +234,12 @@ class DASService(Service):
         self.m_commitments_rejected = metrics.counter(
             "das/commitments_rejected")
         self.m_samples_rejected = metrics.counter("das/samples_rejected")
+        self.m_multiproofs_served = metrics.counter(
+            "das/multiproofs_served")
+        self.m_multiproofs_fetched = metrics.counter(
+            "das/multiproofs_fetched")
+        self.m_multiproofs_rejected = metrics.counter(
+            "das/multiproofs_rejected")
         self.bytes_fetched = 0
 
     # -- lifecycle ---------------------------------------------------------
@@ -202,7 +251,9 @@ class DASService(Service):
         handlers = ((DASCommitmentRequest, self._on_commitment_request),
                     (DASampleRequest, self._on_sample_request),
                     (DASCommitmentResponse, self._on_commitment_response),
-                    (DASampleResponse, self._on_sample_response))
+                    (DASampleResponse, self._on_sample_response),
+                    (DASMultiproofRequest, self._on_multiproof_request),
+                    (DASMultiproofResponse, self._on_multiproof_response))
         for kind, handler in handlers:
             sub = self.p2p.subscribe(kind)
             self._subs.append(sub)
@@ -247,16 +298,27 @@ class DASService(Service):
             das_root = levels[-1][0]
             for chunk in xb.chunks:
                 self.store.put_chunk(DAS_CHUNK_SIZE, chunk)
+            poly_commitment = b""
+            values = None
+            if self.proof_mode == "poly":
+                # the chunk values ARE the polynomial's evaluations;
+                # the 64-byte commitment rides the same signed digest
+                values = [pcs.chunk_value(c) for c in xb.chunks]
+                poly_commitment = pcs.g1_to_bytes(pcs.commit(values))
             digest = commitment_digest(shard_id, period, bytes(chunk_root),
-                                       das_root, xb.k, xb.n, xb.body_len)
+                                       das_root, xb.k, xb.n, xb.body_len,
+                                       poly_commitment)
             signature = (self.client.sign(digest)
                          if self.client is not None else b"")
             commitment = DASCommitment(
                 shard_id=shard_id, period=period,
                 chunk_root=bytes(chunk_root), das_root=das_root,
-                k=xb.k, n=xb.n, body_len=xb.body_len, signature=signature)
+                k=xb.k, n=xb.n, body_len=xb.body_len,
+                poly_commitment=poly_commitment, signature=signature)
             with self._lock:
                 self._blobs[das_root] = (xb, levels)
+                if values is not None:
+                    self._poly[das_root] = values
                 self._commitments[(shard_id, period)] = commitment
             self.m_published.inc()
             return commitment
@@ -278,6 +340,7 @@ class DASService(Service):
             chunk_root=commitment.chunk_root,
             das_root=commitment.das_root, k=commitment.k,
             n=commitment.n, body_len=commitment.body_len,
+            poly_commitment=commitment.poly_commitment,
             signature=commitment.signature), msg.peer)
 
     def _on_sample_request(self, msg) -> None:
@@ -296,6 +359,27 @@ class DASService(Service):
                 chunk=xb.chunks[index],
                 proof=merkle_proof(levels, index)), msg.peer)
             self.m_samples_served.inc()
+
+    def _on_multiproof_request(self, msg) -> None:
+        req: DASMultiproofRequest = msg.data
+        root = bytes(req.das_root)
+        with self._lock:
+            blob = self._blobs.get(root)
+            values = self._poly.get(root)
+        if blob is None or values is None:
+            return  # not ours to serve, or published merkle-only
+        xb, _levels = blob
+        indices = tuple(int(i) for i in
+                        list(req.indices)[:MAX_SAMPLE_INDICES])
+        if (not indices or len(set(indices)) != len(indices)
+                or any(not 0 <= i < xb.n for i in indices)):
+            return  # malformed request costs the requester its answer
+        proof, _evals = pcs.open_multi(values, indices)
+        self.p2p.send(DASMultiproofResponse(
+            das_root=root, indices=indices,
+            chunks=tuple(xb.chunks[i] for i in indices),
+            proof=pcs.g1_to_bytes(proof)), msg.peer)
+        self.m_multiproofs_served.inc()
 
     # -- fetcher side ------------------------------------------------------
 
@@ -341,6 +425,41 @@ class DASService(Service):
         self.m_sample_wire_bytes.inc(len(chunk) + 32 * len(proof) + 40)
         self.bytes_fetched += len(chunk) + 32 * len(proof) + 40
 
+    def _on_multiproof_response(self, msg) -> None:
+        resp: DASMultiproofResponse = msg.data
+        root = bytes(resp.das_root)
+        indices = tuple(int(i) for i in resp.indices)
+        key = (root, indices)
+        with self._lock:
+            want = self._want_multi.get(key)
+            if want is None or key in self._recv_multi:
+                return  # unsolicited, or already answered
+        poly_commitment, n = want
+        chunks = tuple(bytes(c) for c in resp.chunks)
+        proof = bytes(resp.proof)
+        # content-verified delivery, multiproof edition: the response
+        # is admitted only if the single proof OPENS the solicited poly
+        # commitment to the delivered chunks' derived values. The check
+        # is the scalar PCS verifier — one host pairing per admitted
+        # response, the same cost class as a scalar bls_verify — so a
+        # garbage frame can never occupy the slot an honest answer
+        # needs (first VERIFIED wins).
+        if (len(chunks) != len(indices)
+                or any(len(c) != DAS_CHUNK_SIZE for c in chunks)
+                or not verify_multiproof(
+                    poly_commitment, indices,
+                    [pcs.chunk_value(c) for c in chunks], proof, n)):
+            self.m_multiproofs_rejected.inc()
+            return
+        with self._lock:
+            if key not in self._want_multi or key in self._recv_multi:
+                return  # answered while we were verifying (first wins)
+            self._recv_multi[key] = (chunks, proof)
+        self.m_multiproofs_fetched.inc()
+        wire = sum(len(c) for c in chunks) + len(proof) + 40
+        self.m_sample_wire_bytes.inc(wire)
+        self.bytes_fetched += wire
+
     def fetch_commitment(self, shard_id: int, period: int, chunk_root,
                          proposer) -> Optional[DASCommitment]:
         """The validated commitment for (shard, period): local first,
@@ -372,12 +491,16 @@ class DASService(Service):
                     chunk_root=bytes(resp.chunk_root),
                     das_root=bytes(resp.das_root), k=int(resp.k),
                     n=int(resp.n), body_len=int(resp.body_len),
+                    poly_commitment=bytes(
+                        getattr(resp, "poly_commitment", b"")),
                     signature=bytes(resp.signature))
                 if (commitment.chunk_root != expected_root
                         or not 1 <= commitment.k <= commitment.n
                         or commitment.n > MAX_TOTAL_CHUNKS
                         or not 0 <= commitment.body_len
                         <= commitment.k * DAS_CHUNK_SIZE
+                        or not _poly_commitment_ok(
+                            commitment.poly_commitment)
                         or not verify_commitment(commitment, proposer)):
                     rejected += 1
                     continue
@@ -503,6 +626,69 @@ class DASService(Service):
                        if (root, i) in self._recv_samples}
         return out
 
+    def fetch_multiproof(self, commitment: DASCommitment,
+                         indices) -> Optional[tuple]:
+        """(chunks, proof) for the sampled `indices` under one
+        constant-size multiproof, fetched from peers under the retry
+        policy. Responses are verified against the commitment's poly
+        commitment BEFORE admission (content-verified delivery), so a
+        returned tuple is already proven; None means no peer delivered
+        a verifying answer in time."""
+        indices = tuple(int(i) for i in indices)
+        if not indices:
+            return None
+        root = bytes(commitment.das_root)
+        # locally published blobs answer without a network round trip
+        with self._lock:
+            blob = self._blobs.get(root)
+            values = self._poly.get(root)
+        if blob is not None and values is not None:
+            xb, _levels = blob
+            if any(not 0 <= i < xb.n for i in indices):
+                return None
+            proof, _evals = pcs.open_multi(values, indices)
+            return (tuple(xb.chunks[i] for i in indices),
+                    pcs.g1_to_bytes(proof))
+        if (self.p2p is None or self.stopped()
+                or not commitment.poly_commitment):
+            return None
+        key = (root, indices)
+
+        def take() -> tuple:
+            with self._lock:
+                got = self._recv_multi.get(key)
+            if got is None:
+                raise _MultiproofMiss("no verified response yet")
+            return got
+
+        def attempt() -> tuple:
+            self._fire("das.multiproof_fetch")
+            self.p2p.broadcast(DASMultiproofRequest(das_root=root,
+                                                    indices=indices))
+            got = poll_probe(
+                take, self.wait, interval_s=self.poll_interval,
+                polls=max(1, int(self._attempt_timeout
+                                 / self.poll_interval)),
+                not_ready=(_MultiproofMiss,))
+            if got is POLL_MISS:
+                raise _MultiproofMiss(
+                    f"DAS multiproof for {len(indices)} indices "
+                    f"not delivered")
+            return got
+
+        with self._lock:
+            self._want_multi[key] = (bytes(commitment.poly_commitment),
+                                     int(commitment.n))
+        try:
+            return self._fetch_retry.call(attempt)
+        except (TransientError, FetchAborted, ConnectionError,
+                TimeoutError, OSError):
+            return None
+        finally:
+            with self._lock:
+                self._want_multi.pop(key, None)
+                self._recv_multi.pop(key, None)
+
     # -- the notary-side one-stop ------------------------------------------
 
     def collect_rows(self, shard_id: int, period: int, record,
@@ -532,6 +718,38 @@ class DASService(Service):
                     "proofs": proofs,
                     "roots": [commitment.das_root] * len(indices),
                     "commitment": commitment}
+
+    def collect_poly_row(self, shard_id: int, period: int, record,
+                         account) -> Optional[dict]:
+        """The --da-proofs=poly analog of `collect_rows`: ONE row of
+        the batched `das_verify_multiproofs` op per (shard, period) —
+        the validated commitment, the notary's deterministic sample
+        indices, the chunk-derived evaluations, and the single
+        constant-size proof. A failed fetch (or a merkle-only
+        commitment) becomes a synthesized invalid row (empty proof)
+        so it SCORES as a failed check. None = no commitment."""
+        with tracing.span("das/collect_poly", shard=shard_id,
+                          period=period):
+            commitment = self.fetch_commitment(
+                shard_id, period, record.chunk_root, record.proposer)
+            if commitment is None:
+                return None
+            indices = sample_indices(
+                sample_seed(bytes(account), shard_id, period,
+                            commitment.das_root),
+                self.samples, commitment.n)
+            got = self.fetch_multiproof(commitment, indices)
+            if got is None:
+                chunks: tuple = ()
+                evals = [0] * len(indices)
+                proof = b""
+            else:
+                chunks, proof = got
+                evals = [pcs.chunk_value(c) for c in chunks]
+            return {"poly_commitment": commitment.poly_commitment,
+                    "indices": list(indices), "evals": evals,
+                    "proof": proof, "n": commitment.n,
+                    "chunks": chunks, "commitment": commitment}
 
     def note_verdicts(self, verdicts) -> int:
         """Score one batch's verdicts into the das counters; returns
@@ -563,6 +781,32 @@ class DASService(Service):
                 "chunk": xb.chunks[index],
                 "proof": merkle_proof(levels, index)}
 
+    def get_multiproof(self, shard_id: int, period: int,
+                       indices) -> Optional[dict]:
+        """The locally held multiproof plane (the `shard_getSample`
+        poly body): all requested chunks + ONE 64-byte proof. None
+        when this node never published the blob in poly mode or any
+        index is out of range."""
+        commitment = self.commitment(shard_id, period)
+        if commitment is None:
+            return None
+        indices = tuple(int(i) for i in
+                        list(indices)[:MAX_SAMPLE_INDICES])
+        if (not indices or len(set(indices)) != len(indices)
+                or any(not 0 <= i < commitment.n for i in indices)):
+            return None
+        with self._lock:
+            blob = self._blobs.get(bytes(commitment.das_root))
+            values = self._poly.get(bytes(commitment.das_root))
+        if blob is None or values is None:
+            return None
+        xb, _levels = blob
+        proof, _evals = pcs.open_multi(values, indices)
+        self.m_multiproofs_served.inc()
+        return {"commitment": commitment, "indices": list(indices),
+                "chunks": [xb.chunks[i] for i in indices],
+                "proof": pcs.g1_to_bytes(proof)}
+
     def da_status(self, shard_id: int, period: int) -> dict:
         """The `shard_daStatus` body: is a commitment known for the
         pair, and what shape is the extension?"""
@@ -578,4 +822,6 @@ class DASService(Service):
                 "k": commitment.k, "n": commitment.n,
                 "body_len": commitment.body_len,
                 "holds_blob": holds_blob,
+                "proof_mode": self.proof_mode,
+                "poly_commitment": commitment.poly_commitment.hex(),
                 "default_samples": self.samples}

@@ -283,6 +283,61 @@ class FrontendServer:
                           affinity=affinity, klass=klass, tenant=tenant)
         return [bool(b) for b in out]
 
+    def rpc_dasPolyVerify(self, commitments, index_rows, eval_rows,
+                          proofs, ns, klass=None, tenant=None):
+        from gethsharding_tpu import slo
+        from gethsharding_tpu.rpc import codec
+
+        self._check_accepting("shard_dasPolyVerify")
+        args = codec.dec_das_poly_call(commitments, index_rows,
+                                       eval_rows, proofs, ns)
+        affinity = args[0][0].hex() if args[0] else None
+        started = time.monotonic()
+        try:
+            out = self._route("das_verify_multiproofs", *args,
+                              affinity=affinity, klass=klass,
+                              tenant=tenant)
+        except Exception:
+            if klass == "interactive":
+                slo.record("das_light", ok=False,
+                           latency_s=time.monotonic() - started)
+            raise
+        if klass == "interactive":
+            slo.record("das_light", ok=True,
+                       latency_s=time.monotonic() - started)
+        return [bool(b) for b in out]
+
+    def rpc_getSample(self, shard_id, period, indices):
+        """Light-client sample plane: proxy `shard_getSample` to the
+        first replica that holds the blob (the frontend has no shard
+        state of its own). Rendezvous-ordered on the (shard, period)
+        key so repeated light-client pulls for one collation land on
+        the same replica's cache; a replica without the blob answers
+        None and the walk continues. None = no replica can serve."""
+        from gethsharding_tpu import slo
+
+        self._check_accepting("shard_getSample")
+        started = time.monotonic()
+        ok = False
+        try:
+            affinity = f"sample|{int(shard_id)}|{int(period)}"
+            for replica in self.router.route(affinity=affinity):
+                call = getattr(replica.backend, "_call", None)
+                if call is None:
+                    continue
+                try:
+                    out = call("shard_getSample", int(shard_id),
+                               int(period), [int(i) for i in indices])
+                except Exception:  # noqa: BLE001 - walk to next replica
+                    continue
+                if out is not None:
+                    ok = True
+                    return out
+            return None
+        finally:
+            slo.record("das_light", ok=ok,
+                       latency_s=time.monotonic() - started)
+
     # -- control plane -----------------------------------------------------
 
     def rpc_health(self):

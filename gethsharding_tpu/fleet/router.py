@@ -922,6 +922,16 @@ class RouterSigBackend:
         return self.router.call("das_verify_samples", chunks, indices,
                                 proofs, roots, affinity=affinity)
 
+    def das_verify_multiproofs(self, commitments, index_rows, eval_rows,
+                               proofs, ns):
+        affinity = None
+        if commitments:
+            c = commitments[0]
+            affinity = c.hex() if hasattr(c, "hex") else str(c)
+        return self.router.call("das_verify_multiproofs", commitments,
+                                index_rows, eval_rows, proofs, ns,
+                                affinity=affinity)
+
     def bls_verify_committees_async(self, messages, sig_rows, pk_rows,
                                     pk_row_keys=None):
         from gethsharding_tpu.sigbackend import VerdictFuture
@@ -1137,6 +1147,19 @@ class RpcReplicaBackend:
         out = self._call("shard_dasVerify",
                          *codec.enc_das_call(chunks, indices, proofs,
                                              roots),
+                         klass, tenant)
+        return [bool(b) for b in out]
+
+    def das_verify_multiproofs(self, commitments, index_rows, eval_rows,
+                               proofs, ns):
+        from gethsharding_tpu.rpc import codec
+
+        from gethsharding_tpu.serving.classes import current_admission
+
+        klass, tenant = current_admission()
+        out = self._call("shard_dasPolyVerify",
+                         *codec.enc_das_poly_call(commitments, index_rows,
+                                                  eval_rows, proofs, ns),
                          klass, tenant)
         return [bool(b) for b in out]
 

@@ -57,12 +57,16 @@ class ShardNode:
                  da_mode: str = "full",
                  da_samples: int = 16,
                  da_parity: float = 0.5,
+                 da_proofs: str = "merkle",
                  fleet_frontend: Optional[str] = None):
         if actor not in self.ACTORS:
             raise ValueError(f"unknown actor {actor!r}; pick from {self.ACTORS}")
         if da_mode not in ("full", "sampled"):
             raise ValueError(f"unknown da_mode {da_mode!r}; "
                              "pick 'full' or 'sampled'")
+        if da_proofs not in ("merkle", "poly"):
+            raise ValueError(f"unknown da_proofs {da_proofs!r}; "
+                             "pick 'merkle' or 'poly'")
         self.actor = actor
         self.shard_id = shard_id
         self.config = config
@@ -212,7 +216,7 @@ class ShardNode:
                 store = netstore.store
             das = DASService(client=client, p2p=p2p, store=store,
                              parity_ratio=da_parity, samples=da_samples,
-                             chaos=chaos)
+                             chaos=chaos, proof_mode=da_proofs)
             self._register(das)
             self.das_service = das
 

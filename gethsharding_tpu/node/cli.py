@@ -106,6 +106,16 @@ def build_parser() -> argparse.ArgumentParser:
                                "verified in one batched device "
                                "dispatch (notary) — zero body bytes "
                                "(gethsharding_tpu/das/)")
+    sharding.add_argument("--da-proofs", default="merkle",
+                          choices=("merkle", "poly"),
+                          help="sampled DA proof scheme: 'merkle' "
+                               "ships a sibling path per sampled chunk "
+                               "(keccak verify); 'poly' ships ONE "
+                               "constant-size polynomial multiproof "
+                               "per sampled collation, verified on "
+                               "the batched bn256 pairing path "
+                               "(das/pcs.py; dev SRS pinned by "
+                               "GETHSHARDING_DAS_SRS_SEED)")
     sharding.add_argument("--da-samples", type=int, default=16,
                           help="sampled DA: chunks sampled per "
                                "(shard, period) availability check "
@@ -512,6 +522,7 @@ def run_sharding_node(args) -> int:
         da_mode=args.da_mode,
         da_samples=args.da_samples,
         da_parity=args.da_parity,
+        da_proofs=args.da_proofs,
         fleet_frontend=args.fleet_frontend or None,
     )
     if hub is not None:

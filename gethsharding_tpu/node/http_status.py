@@ -103,6 +103,20 @@ class StatusServer(Service):
         # blobs, samples served/fetched/verified, failures, wire bytes
         das = {name: snap for name, snap in snapshot.items()
                if name.startswith("das/")}
+        das_service = getattr(node, "das_service", None)
+        if das_service is not None:
+            # the (samples, proof-bytes, detection) trade-off for both
+            # proof modes at this node's sampling shape — what k buys
+            # and what it costs on the wire under --da-proofs
+            from gethsharding_tpu.das.erasure import MAX_TOTAL_CHUNKS
+            from gethsharding_tpu.das.sampler import soundness_table
+
+            n = MAX_TOTAL_CHUNKS
+            k_data = max(1, int(n / (1.0 + das_service.parity_ratio)))
+            das["proof_mode"] = das_service.proof_mode
+            das["samples"] = das_service.samples
+            das["soundness"] = soundness_table(
+                n, k_data, ks=sorted({4, 8, das_service.samples}))
         if das:
             payload["das"] = das
         # the fleet router at a glance: per-replica state gauges

@@ -79,6 +79,10 @@ class DASCommitmentResponse:
     k: int
     n: int
     body_len: int
+    # 64-byte G1 polynomial commitment to the extended blob's chunk
+    # values (das/pcs.py) — empty in merkle-only mode; when present it
+    # is signed into the same commitment digest as the merkle root
+    poly_commitment: bytes = b""
     signature: bytes = b""
 
 
@@ -100,3 +104,27 @@ class DASampleResponse:
     index: int
     chunk: bytes
     proof: tuple  # tuple[bytes, ...]
+
+
+@dataclass(frozen=True)
+class DASMultiproofRequest:
+    """Multiproof-mode sampled-chunk pull: the requester wants chunks
+    `indices` of the blob committed at `das_root` plus ONE constant-
+    size polynomial multiproof opening the poly commitment at exactly
+    those indices (das/pcs.open_multi)."""
+
+    das_root: bytes
+    indices: tuple  # tuple[int, ...]
+
+
+@dataclass(frozen=True)
+class DASMultiproofResponse:
+    """All requested chunks + the single 64-byte G1 multiproof — the
+    unit a notary or light client turns into one row of the batched
+    `das_verify_multiproofs` dispatch (evaluations are derived from
+    the chunk bytes host-side, never trusted from the wire)."""
+
+    das_root: bytes
+    indices: tuple  # tuple[int, ...]
+    chunks: tuple  # tuple[bytes, ...], aligned with indices
+    proof: bytes = b""

@@ -427,6 +427,11 @@ class FailoverSigBackend(SigBackend):
         return self._call("das_verify_samples", chunks, indices, proofs,
                           roots)
 
+    def das_verify_multiproofs(self, commitments, index_rows, eval_rows,
+                               proofs, ns):
+        return self._call("das_verify_multiproofs", commitments,
+                          index_rows, eval_rows, proofs, ns)
+
     def bls_verify_committees_async(self, messages, sig_rows, pk_rows,
                                     pk_row_keys=None):
         """The overlapped-audit face: primary-routed submits stay

@@ -435,6 +435,11 @@ class ChaosSigBackend(SigBackend):
         return self._op("das_verify_samples", chunks, indices, proofs,
                         roots)
 
+    def das_verify_multiproofs(self, commitments, index_rows, eval_rows,
+                               proofs, ns):
+        return self._op("das_verify_multiproofs", commitments, index_rows,
+                        eval_rows, proofs, ns)
+
     def bls_verify_committees_async(self, messages, sig_rows, pk_rows,
                                     pk_row_keys=None):
         # fire at submit time: a fault lands where the real device

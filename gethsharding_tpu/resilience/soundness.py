@@ -78,9 +78,10 @@ DEFAULT_ROWS = 4
 
 # the ops carrying consensus verdicts; everything the audit covers
 AUDITED_OPS = ("ecrecover_addresses", "bls_verify_aggregates",
-               "bls_verify_committees", "das_verify_samples")
+               "bls_verify_committees", "das_verify_samples",
+               "das_verify_multiproofs")
 _VERDICT_OPS = ("bls_verify_aggregates", "bls_verify_committees",
-                "das_verify_samples")
+                "das_verify_samples", "das_verify_multiproofs")
 
 
 # == the soundness accounting behind (rate, rows) ==========================
@@ -462,6 +463,17 @@ class SpotCheckSigBackend(SigBackend):
         cols = (list(chunks), list(indices), list(proofs), list(roots))
         out = self.inner.das_verify_samples(*cols)
         self._audit("das_verify_samples", cols, out)
+        return out
+
+    def das_verify_multiproofs(self, commitments, index_rows, eval_rows,
+                               proofs, ns):
+        # the spot check re-verifies sampled rows against the scalar
+        # PCS reference (PythonSigBackend -> das/pcs.verify_multi) —
+        # the batched pairing path has no verdict blind spot
+        cols = (list(commitments), list(index_rows), list(eval_rows),
+                list(proofs), list(ns))
+        out = self.inner.das_verify_multiproofs(*cols)
+        self._audit("das_verify_multiproofs", cols, out)
         return out
 
     def bls_verify_committees_async(self, messages, sig_rows, pk_rows,

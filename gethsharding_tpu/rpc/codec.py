@@ -163,6 +163,32 @@ def dec_das_call(chunks, indices, proofs, roots) -> tuple:
     )
 
 
+def enc_das_poly_call(commitments, index_rows, eval_rows, proofs,
+                      ns) -> list:
+    """The das_verify_multiproofs argument plane: (64-byte G1
+    commitments, per-row sampled index sets, per-row claimed
+    evaluations as hex field elements, 64-byte G1 multiproofs, domain
+    sizes) — positional, matching the backend op."""
+    return [
+        [enc_bytes(c) for c in commitments],
+        [[int(i) for i in row] for row in index_rows],
+        [[hex(int(e)) for e in row] for row in eval_rows],
+        [enc_bytes(p) for p in proofs],
+        [int(n) for n in ns],
+    ]
+
+
+def dec_das_poly_call(commitments, index_rows, eval_rows, proofs,
+                      ns) -> tuple:
+    return (
+        [dec_bytes(c) for c in commitments],
+        [[int(i) for i in row] for row in index_rows],
+        [[int(e, 16) for e in row] for row in eval_rows],
+        [dec_bytes(p) for p in proofs],
+        [int(n) for n in ns],
+    )
+
+
 # -- shardp2p message codecs (type-tagged, for the cross-process relay) ----
 
 
@@ -213,6 +239,7 @@ def enc_p2p(data) -> tuple:
             "k": data.k,
             "n": data.n,
             "bodyLen": data.body_len,
+            "polyCommitment": enc_bytes(data.poly_commitment),
             "signature": enc_bytes(data.signature),
         }
     if isinstance(data, m.DASampleRequest):
@@ -226,6 +253,18 @@ def enc_p2p(data) -> tuple:
             "index": data.index,
             "chunk": enc_bytes(data.chunk),
             "proof": [enc_bytes(node) for node in data.proof],
+        }
+    if isinstance(data, m.DASMultiproofRequest):
+        return "DASMultiproofRequest", {
+            "dasRoot": enc_bytes(data.das_root),
+            "indices": list(data.indices),
+        }
+    if isinstance(data, m.DASMultiproofResponse):
+        return "DASMultiproofResponse", {
+            "dasRoot": enc_bytes(data.das_root),
+            "indices": list(data.indices),
+            "chunks": [enc_bytes(c) for c in data.chunks],
+            "proof": enc_bytes(data.proof),
         }
     from gethsharding_tpu.p2p.whisper import Envelope
 
@@ -317,6 +356,7 @@ def dec_p2p(kind: str, payload: dict):
             k=int(payload["k"]),
             n=int(payload["n"]),
             body_len=int(payload["bodyLen"]),
+            poly_commitment=dec_bytes(payload.get("polyCommitment", "")),
             signature=dec_bytes(payload["signature"]),
         )
     if kind == "DASampleRequest":
@@ -330,6 +370,18 @@ def dec_p2p(kind: str, payload: dict):
             index=int(payload["index"]),
             chunk=dec_bytes(payload["chunk"]),
             proof=tuple(dec_bytes(node) for node in payload["proof"]),
+        )
+    if kind == "DASMultiproofRequest":
+        return m.DASMultiproofRequest(
+            das_root=dec_bytes(payload["dasRoot"]),
+            indices=tuple(int(i) for i in payload["indices"]),
+        )
+    if kind == "DASMultiproofResponse":
+        return m.DASMultiproofResponse(
+            das_root=dec_bytes(payload["dasRoot"]),
+            indices=tuple(int(i) for i in payload["indices"]),
+            chunks=tuple(dec_bytes(c) for c in payload["chunks"]),
+            proof=dec_bytes(payload["proof"]),
         )
     if kind == "WhisperEnvelope":
         from gethsharding_tpu.p2p.whisper import Envelope

@@ -502,6 +502,28 @@ class RPCServer:
             out = serving.das_verify_samples(*args)
         return [bool(b) for b in out]
 
+    def rpc_dasPolyVerify(self, commitments, index_rows, eval_rows,
+                          proofs, ns, klass=None, tenant=None):
+        """The DAS multiproof-verdict plane over the wire: one verdict
+        per sampled collation row (64-byte poly commitment, sampled
+        index set, claimed evaluations, 64-byte multiproof, domain
+        size) through the serving tier (serving op `das_poly_verify`,
+        default class bulk_audit via the per-op map; light clients
+        pass `interactive`). Malformed rows cost a False verdict,
+        never an error."""
+        self._check_accepting("shard_dasPolyVerify")
+        from gethsharding_tpu.serving.classes import admission_class
+
+        serving = self._serving()
+        args = codec.dec_das_poly_call(commitments, index_rows, eval_rows,
+                                       proofs, ns)
+        if klass is not None or tenant is not None:
+            with admission_class(klass or "bulk_audit", tenant):
+                out = serving.das_verify_multiproofs(*args)
+        else:
+            out = serving.das_verify_multiproofs(*args)
+        return [bool(b) for b in out]
+
     def rpc_health(self):
         """The replica-health surface a fleet router sweeps: the drain
         flag, the failover breaker's state (if the injected backend
@@ -631,7 +653,7 @@ class RPCServer:
                           for node in sample["proof"]],
             })
         commitment = self._das.commitment(int(shard_id), int(period))
-        return {
+        out = {
             "dasRoot": codec.enc_bytes(commitment.das_root),
             "chunkRoot": codec.enc_bytes(commitment.chunk_root),
             "k": commitment.k,
@@ -640,6 +662,24 @@ class RPCServer:
             "signature": codec.enc_bytes(commitment.signature),
             "samples": samples,
         }
+        # poly plane: under --da-proofs=poly the k merkle paths above
+        # collapse to ONE constant-size multiproof over the whole set
+        # (das/pcs.py) — the client verifies it against polyCommitment
+        poly = bytes(getattr(commitment, "poly_commitment", b""))
+        if poly:
+            out["polyCommitment"] = codec.enc_bytes(poly)
+        if getattr(self._das, "proof_mode", "merkle") == "poly":
+            multi = self._das.get_multiproof(
+                int(shard_id), int(period),
+                [int(i) for i in list(indices)[:MAX_SAMPLE_INDICES]])
+            if multi is not None:
+                out["multiproof"] = {
+                    "indices": list(multi["indices"]),
+                    "chunks": [codec.enc_bytes(c)
+                               for c in multi["chunks"]],
+                    "proof": codec.enc_bytes(multi["proof"]),
+                }
+        return out
 
     def rpc_daStatus(self, shard_id, period):
         """Is a DAS commitment known for (shard, period), and what

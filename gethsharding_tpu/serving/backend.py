@@ -165,6 +165,15 @@ class ServingSigBackend(SigBackend):
         return self._await(self.submit("das_verify_samples", chunks,
                                        indices, proofs, roots))
 
+    def das_verify_multiproofs(self, commitments, index_rows, eval_rows,
+                               proofs, ns):
+        """The DAS multiproof-verdict op over the coalescing tier:
+        light-client `das_check` rows and the notary's period sweep
+        share one batched pairing dispatch."""
+        return self._await(self.submit("das_verify_multiproofs",
+                                       commitments, index_rows, eval_rows,
+                                       proofs, ns))
+
     def bls_verify_committees_async(self, messages, sig_rows, pk_rows,
                                     pk_row_keys=None):
         """The overlapped-notary face over the serving tier: the
@@ -243,6 +252,12 @@ class ClassedSigBackend(SigBackend):
     def das_verify_samples(self, chunks, indices, proofs, roots):
         return self._await(self.submit("das_verify_samples", chunks,
                                        indices, proofs, roots))
+
+    def das_verify_multiproofs(self, commitments, index_rows, eval_rows,
+                               proofs, ns):
+        return self._await(self.submit("das_verify_multiproofs",
+                                       commitments, index_rows, eval_rows,
+                                       proofs, ns))
 
     def bls_verify_committees_async(self, messages, sig_rows, pk_rows,
                                     pk_row_keys=None):

@@ -70,7 +70,8 @@ from gethsharding_tpu.serving.queue import (
 
 # the SigBackend batch API surface the serving tier coalesces
 SERVING_OPS = ("ecrecover_addresses", "bls_verify_aggregates",
-               "bls_verify_committees", "das_verify_samples")
+               "bls_verify_committees", "das_verify_samples",
+               "das_verify_multiproofs")
 
 # registry-friendly short labels
 _OP_LABELS = {
@@ -78,6 +79,7 @@ _OP_LABELS = {
     "bls_verify_aggregates": "bls_aggregate",
     "bls_verify_committees": "bls_committee",
     "das_verify_samples": "das_verify",
+    "das_verify_multiproofs": "das_poly_verify",
 }
 
 # batch-row histogram buckets: the quarter-pow2 ladder the backend pads
@@ -365,7 +367,8 @@ class MicroBatcher:
     # the ops whose dispatch refreshes the backend's last_wire ledger —
     # for any other op the ledger is a STALE leftover from a previous
     # dispatch and must not be trusted
-    _LEDGER_OPS = ("bls_verify_committees", "das_verify_samples")
+    _LEDGER_OPS = ("bls_verify_committees", "das_verify_samples",
+                   "das_verify_multiproofs")
 
     def _wire_bytes(self, op: str, cols: tuple) -> int:
         """This dispatch's host->device wire bytes for span tags: the

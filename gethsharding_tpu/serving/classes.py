@@ -90,9 +90,12 @@ def default_policies() -> Dict[str, ClassPolicy]:
 
 # ops whose traffic is bulk by nature even when the caller says nothing:
 # the DAS sample-verdict plane is the notary's per-period availability
-# sweep, never a caller-blocking round trip
+# sweep, never a caller-blocking round trip. Multiproof verdicts default
+# the same way — the notary sweep again — but light-client callers pass
+# `interactive` explicitly through the frontend tier.
 DEFAULT_OP_CLASS = {
     "das_verify_samples": CLASS_BULK_AUDIT,
+    "das_verify_multiproofs": CLASS_BULK_AUDIT,
 }
 
 

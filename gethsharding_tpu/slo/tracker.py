@@ -110,6 +110,10 @@ _DEFAULTS = {
     "interactive": (0.999, 8000.0),
     "bulk_audit": (0.99, 30000.0),
     "catchup_replay": (0.95, None),
+    # light-client DAS traffic (shard_getSample / shard_dasPolyVerify
+    # routed interactive) gets its own objective so a breach in bulk
+    # audit load never masks a sampling-tier regression
+    "das_light": (0.999, 8000.0),
     INTEGRITY: (0.9999, None),
 }
 

@@ -123,6 +123,111 @@ for needle in ("gethsharding_das_samples_verified_total",
 print("DAS prometheus exposition OK")
 PYEOF
 
+# -- das-poly smoke: polynomial-multiproof DAS end-to-end on hermetic
+# CPU — a sampled notary under --da-proofs=poly must vote with ZERO
+# body fetches, every sampled set arriving under ONE constant-size
+# multiproof; then a corrupt-multiproof chaos run must trip the
+# breaker through the soundness spot-checker while the verdict stays
+# correct on the scalar fallback
+echo "== das-poly smoke"
+JAX_PLATFORMS=cpu python - <<'PYEOF' || fail=1
+import random
+
+from gethsharding_tpu.actors.notary import Notary
+from gethsharding_tpu.actors.proposer import create_collation
+from gethsharding_tpu.core.shard import Shard
+from gethsharding_tpu.core.types import Transaction
+from gethsharding_tpu.das.service import DASService
+from gethsharding_tpu.db.kv import MemoryKV
+from gethsharding_tpu.mainchain.client import SMCClient
+from gethsharding_tpu.p2p.messages import CollationBodyRequest
+from gethsharding_tpu.p2p.service import Hub, P2PServer
+from gethsharding_tpu.params import Config, ETHER
+from gethsharding_tpu.sigbackend import get_backend
+from gethsharding_tpu.smc.chain import SimulatedMainchain
+
+config = Config(quorum_size=1, period_length=4)
+chain = SimulatedMainchain(config=config)
+prop_client = SMCClient(backend=chain, config=config)
+not_client = SMCClient(backend=chain, config=config)
+chain.fund(prop_client.account(), 2000 * ETHER)
+chain.fund(not_client.account(), 2000 * ETHER)
+hub = Hub()
+watch = P2PServer(hub)
+watch.start()
+body_watch = watch.subscribe(CollationBodyRequest)
+svc_prop = DASService(client=prop_client, p2p=P2PServer(hub), samples=4,
+                      proof_mode="poly", fetch_timeout=4.0)
+svc_not = DASService(client=not_client, p2p=P2PServer(hub), samples=4,
+                     proof_mode="poly", fetch_timeout=4.0)
+svc_prop.start()
+svc_not.start()
+notary = Notary(client=not_client, shard=Shard(0, MemoryKV()),
+                p2p=svc_not.p2p, config=config, deposit_flag=True,
+                all_shards=False, sig_backend=get_backend("python"),
+                das=svc_not, da_mode="sampled")
+notary.start()
+chain.fast_forward(1)
+rng = random.Random(5)
+periods = 2
+try:
+    for _ in range(periods):
+        period = chain.current_period()
+        collation = create_collation(
+            prop_client, 0, period,
+            [Transaction(nonce=period,
+                         payload=bytes(rng.randrange(256)
+                                       for _ in range(20000)))])
+        svc_prop.publish(0, period, collation.header.chunk_root,
+                         collation.body)
+        prop_client.add_header(0, period, collation.header.chunk_root,
+                               collation.header.proposer_signature)
+        chain.commit()
+        notary.notarize_collations(head=chain.block_number)
+        while chain.current_period() == period:
+            chain.commit()
+    assert notary.votes_submitted == periods, notary.errors
+    assert body_watch.try_get() is None, \
+        "a CollationBodyRequest left the poly-sampled notary"
+    assert svc_not.m_multiproofs_fetched.value >= periods
+finally:
+    notary.stop()
+    svc_prop.stop()
+    svc_not.stop()
+    watch.stop()
+print("das-poly e2e OK:", periods, "poly-sampled votes, zero body fetches")
+PYEOF
+JAX_PLATFORMS=cpu python - <<'PYEOF' || fail=1
+import random
+
+from gethsharding_tpu.das import pcs
+from gethsharding_tpu.metrics import DEFAULT_REGISTRY
+from gethsharding_tpu.resilience.breaker import (OPEN, CircuitBreaker,
+                                                 FailoverSigBackend)
+from gethsharding_tpu.resilience.chaos import ChaosSigBackend, parse_spec
+from gethsharding_tpu.resilience.soundness import SpotCheckSigBackend
+from gethsharding_tpu.sigbackend import PythonSigBackend
+
+rng = random.Random(9)
+values = [rng.randrange(pcs.N) for _ in range(8)]
+proof, evals = pcs.open_multi(values, (1, 5))
+cols = ([pcs.g1_to_bytes(pcs.commit(values))], [[1, 5]], [evals],
+        [pcs.g1_to_bytes(proof)], [8])
+schedule = parse_spec("seed=7,backend.das_verify_multiproofs:mode=corrupt")
+breaker = CircuitBreaker(name="das-poly", fault_threshold=1, reset_s=60.0)
+backend = FailoverSigBackend(
+    SpotCheckSigBackend(ChaosSigBackend(PythonSigBackend(), schedule),
+                        rate=1.0, rows=1),
+    PythonSigBackend(), breaker=breaker)
+got = backend.das_verify_multiproofs(*[list(c) for c in cols])
+assert got == [True], got  # detected -> served correct from the fallback
+assert breaker.state == OPEN, breaker.state_name
+assert DEFAULT_REGISTRY.counter(
+    "resilience/soundness/das_verify_multiproofs/mismatches").value >= 1
+print("das-poly chaos OK: corrupt multiproof verdict tripped the"
+      " breaker, verdict stayed correct")
+PYEOF
+
 # -- chaos/failover smoke: a devnet-style notary rides a seeded failure
 # schedule end-to-end — injected device faults mid-audit must trip the
 # breaker, every period's votes must land on the scalar fallback, the

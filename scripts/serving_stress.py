@@ -33,6 +33,14 @@ etc. are nonzero) GATED: `bench.py --fleet` runs this model with SLOs
 on. Exit code 1 on any divergence, hung client, interactive shed, or
 SLO breach.
 
+Light-client traffic model (`--light-clients N`): N threads drive
+1-row `das_verify_multiproofs` requests (polynomial-multiproof DAS,
+das/pcs.py) through the fleet router as interactive-class traffic
+under their own `light` tenant quota bucket. Every row has a KNOWN
+verdict (honest openings and tampered evals interleaved), so the soak
+gates on correctness — one wrong verdict fails the run — as well as
+the das_light p99 when `--slo-interactive-ms` is set.
+
 Frontend process mode (`--frontend`, with `--replicas N`): the REAL
 topology — N `chain_server` replica processes, one standalone
 `fleet.frontend` process balancing them (hedging armed via
@@ -358,6 +366,119 @@ def run_fleet(args) -> int:
     return 1 if failed else 0
 
 
+def build_poly_cases(n_cases: int, k: int):
+    """Known-verdict multiproof rows: honest openings (expected True)
+    interleaved with tampered evals (expected False) — a light-client
+    check whose CORRECTNESS the soak verifies on every response, not
+    just its latency."""
+    import random as _random
+
+    from gethsharding_tpu.das import pcs
+
+    rng = _random.Random(7)
+    cases = []
+    for i in range(n_cases):
+        n = 12
+        values = [rng.randrange(pcs.N) for _ in range(n)]
+        indices = sorted(rng.sample(range(n), min(k, n)))
+        proof, evals = pcs.open_multi(values, indices)
+        commitment = pcs.g1_to_bytes(pcs.commit(values))
+        proof_bytes = pcs.g1_to_bytes(proof)
+        cases.append((commitment, indices, evals, proof_bytes, n, True))
+        if i % 2:
+            bad = list(evals)
+            bad[0] = (bad[0] + 1) % pcs.N
+            cases.append((commitment, indices, bad, proof_bytes, n,
+                          False))
+    return cases
+
+
+def run_light_clients(args) -> int:
+    """The light-client sampling tier under load: M client threads
+    drive 1-row `das_verify_multiproofs` requests through the fleet
+    router as INTERACTIVE traffic under their own tenant quota bucket
+    (`tenant="light"`), every verdict checked against the known truth.
+    Gates: zero incorrect verdicts, zero hung clients, and (when
+    `--slo-interactive-ms` is nonzero) the das_light p99. Latencies
+    also feed the process `das_light` SLO objective (slo/tracker.py),
+    so /status on a long-lived node shows the same series."""
+    from gethsharding_tpu import slo
+    from gethsharding_tpu.fleet import AllReplicasDraining
+
+    router, _back, servings, _replicas, _schedule = build_fleet(args)
+    cases = build_poly_cases(args.cases if args.cases <= 16 else 8,
+                             args.light_k)
+    lat: list = []
+    done = [0]
+    incorrect: list = []
+    shed = [0]
+    stop = threading.Event()
+    t0 = time.monotonic()
+    deadline = t0 + args.duration
+
+    def client(c: int) -> None:
+        i = c
+        while time.monotonic() < deadline and not stop.is_set():
+            commitment, indices, evals, proof, n, want = \
+                cases[i % len(cases)]
+            i += args.light_clients
+            t_req = time.monotonic()
+            try:
+                got = router.call("das_verify_multiproofs",
+                                  [commitment], [indices], [evals],
+                                  [proof], [n],
+                                  affinity=commitment.hex(),
+                                  klass="interactive", tenant="light")
+            except (ServingOverloadError, AllReplicasDraining):
+                shed[0] += 1
+                slo.record("das_light", ok=False)
+                continue
+            elapsed = time.monotonic() - t_req
+            lat.append(elapsed)
+            slo.record("das_light", ok=got == [want],
+                       latency_s=elapsed)
+            if got != [want]:
+                incorrect.append((c, i, got, want))
+                stop.set()
+                return
+            done[0] += 1
+
+    threads = [threading.Thread(target=client, args=(c,), daemon=True)
+               for c in range(args.light_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=args.duration + 60)
+    hung = [t for t in threads if t.is_alive()]
+    stop.set()
+    wall = time.monotonic() - t0
+
+    quota_rejections = sum(s.batcher.quota_rejections()
+                           for s in servings)
+    p99_ms = round(percentile(lat, 0.99) * 1e3, 2)
+    slo_breach = bool(args.slo_interactive_ms > 0
+                      and p99_ms > args.slo_interactive_ms)
+    summary = {
+        "summary": True,
+        "light_clients": args.light_clients,
+        "replicas": args.replicas,
+        "wall_s": round(wall, 2),
+        "done": done[0],
+        "rate": round(done[0] / wall, 2) if wall else 0.0,
+        "shed": shed[0],
+        "quota_rejections": quota_rejections,
+        "p99_ms": p99_ms,
+        "slo_ms": args.slo_interactive_ms,
+        "slo_breach": slo_breach,
+        "incorrect_verdicts": len(incorrect),
+        "hung_clients": len(hung),
+    }
+    print(json.dumps(summary), flush=True)
+    for serving in servings:
+        serving.close()
+    return 1 if incorrect or hung or slo_breach else 0
+
+
 def _spawn(cmd, env=None):
     import subprocess
 
@@ -523,6 +644,16 @@ def main() -> int:
     parser.add_argument("--hedge-ms", type=float, default=15.0,
                         help="frontend mode: the frontend's "
                              "--fleet-hedge-ms floor")
+    parser.add_argument("--light-clients", type=int, default=0,
+                        help="> 0: run the LIGHT-CLIENT soak — this many "
+                             "threads drive 1-row das_verify_multiproofs "
+                             "requests (known verdicts, tenant 'light', "
+                             "interactive class) through a --replicas "
+                             "fleet; exit 1 on any incorrect verdict, "
+                             "hung client, or p99 SLO breach")
+    parser.add_argument("--light-k", type=int, default=2,
+                        help="sampled indices per light-client "
+                             "multiproof row")
     parser.add_argument("--chaos-seed", type=int, default=11)
     parser.add_argument("--breaker-reset-s", type=float, default=0.5)
     parser.add_argument("--slo-interactive-ms", type=float, default=0.0,
@@ -534,6 +665,9 @@ def main() -> int:
 
     if args.frontend:
         return run_frontend(args)
+    if args.light_clients > 0:
+        args.replicas = max(1, args.replicas)
+        return run_light_clients(args)
     if args.replicas > 0:
         return run_fleet(args)
     return run_single(args)

@@ -376,9 +376,11 @@ def test_objective_env_overrides(monkeypatch):
     assert objectives["interactive"].latency_target_s == \
         pytest.approx(0.25)
     assert objectives["interactive"].availability == 0.95
-    # all three admission classes + integrity exist
+    # all three admission classes + integrity + the light-client DAS
+    # sampling tier exist
     assert set(objectives) == {"interactive", "bulk_audit",
-                               "catchup_replay", "integrity"}
+                               "catchup_replay", "das_light",
+                               "integrity"}
 
 
 def test_serving_records_slo_events(fresh_slo):
