@@ -216,6 +216,11 @@ class FrontendServer:
         response = {"jsonrpc": "2.0", "id": rid, "result": result}
         if trace_id is not None:
             response["trace"] = trace_id
+            # full handler context next to the bare id (rpc/server.py's
+            # envelope shape): span_id stitches this exact
+            # request/response pair under retries and hedges
+            response["traceCtx"] = {"trace_id": trace_id,
+                                    "span_id": handler_span.span_id}
         return response
 
     # -- the verification planes -------------------------------------------
@@ -358,11 +363,56 @@ class FrontendServer:
         return self.router.registry.snapshot()
 
     def rpc_fleetStatus(self):
-        """The one-glance fleet answer: per-replica states and the
-        hedge ledger (issued/won/wasted/audit_faults/storm)."""
+        """The one-glance fleet answer: per-replica states, the hedge
+        ledger (issued/won/wasted/audit_faults/storm), and the trace
+        collector's assembly counters when fleettrace is on."""
+        from gethsharding_tpu import fleettrace
+
         return {"replicas": self.router.states(),
                 "hedge": self.router.hedge_stats(),
-                "draining": self.draining}
+                "draining": self.draining,
+                "fleettrace": fleettrace.fleettrace_status()}
+
+    # -- fleet tracing (the collector the replicas export into) -----------
+
+    def rpc_traceHandshake(self):
+        """Clock-offset handshake (rpc/server.py's twin): replicas'
+        exporters measure their wall-clock skew against THIS process —
+        the collector's timeline is the one every span lands on."""
+        import os
+
+        from gethsharding_tpu.tracing.export import clock_offset_us
+
+        return {"wall_us": time.time() * 1e6,
+                "clock_offset_us": clock_offset_us(),
+                "pid": os.getpid()}
+
+    def rpc_traceExport(self, payload):
+        """Span-batch sink: replica exporters ship finished spans here
+        (``accepted: false`` until ``--fleettrace`` boots a collector)."""
+        from gethsharding_tpu import fleettrace
+
+        collector = fleettrace.active()
+        if collector is None:
+            return {"accepted": False, "spans": 0}
+        return collector.ingest_payload(payload)
+
+    def rpc_traceAttribution(self):
+        """Per-class critical-path attribution tables (None when no
+        collector is booted)."""
+        from gethsharding_tpu import fleettrace
+
+        collector = fleettrace.active()
+        return None if collector is None else collector.attribution()
+
+    def rpc_traceExemplars(self, limit=8):
+        """Most recent retained assembled cross-process traces, newest
+        first — full span trees with reasons and attribution."""
+        from gethsharding_tpu import fleettrace
+
+        collector = fleettrace.active()
+        return [] if collector is None else collector.exemplars(
+            limit=int(limit))
 
     def rpc_drain(self):
         """Drain the FRONTEND: refuse new verification work (typed) so
@@ -442,6 +492,13 @@ def main(argv=None) -> int:
                              "trace_event JSON at exit; implies --trace")
     parser.add_argument("--trace-ring", type=int, default=4096,
                         help="finished-span ring capacity")
+    parser.add_argument("--fleettrace", action="store_true",
+                        help="own cross-process trace assembly: boot "
+                             "the fleettrace collector (serves "
+                             "shard_traceExport/shard_traceAttribution/"
+                             "shard_traceExemplars), export this "
+                             "process's own spans into it, and retain "
+                             "tail exemplars; implies --trace")
     parser.add_argument("--verbosity", default="warning")
     args = parser.parse_args(argv)
     if not args.replica:
@@ -473,6 +530,10 @@ def main(argv=None) -> int:
     from gethsharding_tpu import slo
 
     slo.tracker()
+    if args.fleettrace:
+        from gethsharding_tpu import fleettrace
+
+        fleettrace.boot_collector()
     server = build_frontend(args.replica, host=args.host, port=args.port,
                             hedge_ms=args.fleet_hedge_ms,
                             health_interval_s=args.health_interval,
@@ -488,6 +549,10 @@ def main(argv=None) -> int:
         pass
     finally:
         server.stop()
+        if args.fleettrace:
+            from gethsharding_tpu import fleettrace
+
+            fleettrace.shutdown()
         if args.trace_out:
             try:
                 tracing.write_chrome_trace(args.trace_out,

@@ -40,6 +40,7 @@ shared executor.
 
 from __future__ import annotations
 
+import functools
 import hashlib
 import logging
 import os
@@ -511,7 +512,7 @@ class FleetRouter:
     # type (the full snapshots would be thousands of gauges; these are
     # the dashboard-grade fields)
     _FOLD_NAMESPACES = ("serving/", "resilience/", "slo/", "trace/",
-                        "sig/", "jax/", "das/")
+                        "sig/", "jax/", "das/", "fleettrace/")
     _FOLD_FIELDS = {
         "counter": ("count", "rate_1m"),
         "gauge": ("value",),
@@ -784,6 +785,17 @@ class FleetRouter:
             return primary_f.result()  # nowhere to hedge: wait it out
         tried.append(hedge_replica.name)
         self._m_hedge_issued.inc()
+        if tracing.TRACER.enabled:
+            # a hedged request is a tail exemplar by definition: flag
+            # the logical trace for the fleet collector's retention
+            # (one attribute read + a no-op call when fleettrace is
+            # off). This thread is inside the route span, so the
+            # current context IS the logical request's.
+            from gethsharding_tpu import fleettrace
+
+            hedge_ctx = tracing.current_context()
+            if hedge_ctx is not None:
+                fleettrace.mark_trace(hedge_ctx[0], "hedged")
         t_hedge = time.monotonic()
         hedge_f = pool.submit(run_on, hedge_replica, len(tried),
                               True, False)
@@ -816,18 +828,30 @@ class FleetRouter:
                     # the logical request is answered: a loser failing
                     # from here on burns no SLO budget (run_on checks)
                     logical["won"] = True
+                # winner/loser linkage on the logical trace: the route
+                # span names the winner, the loser's discard records a
+                # wasted-work span under the same trace id
+                tracing.tag_current(hedge_winner=winner_replica.name,
+                                    hedge_winner_role=role)
+                discard_ctx = tracing.current_context()
                 for _ in range(failed_early):
                     self._m_hedge_wasted.inc()
                     self._m_hedge_loser_failures.inc()
-                for loser in pending:
-                    loser.add_done_callback(self._discard_loser)
+                for loser, (_, loser_replica, loser_t) in pending.items():
+                    loser.add_done_callback(functools.partial(
+                        self._discard_loser, replica=loser_replica.name,
+                        winner=winner_replica.name, t_sub=loser_t,
+                        ctx=discard_ctx))
                 return future.result()
         # both sides failed: no verdict was discarded (nothing wasted)
         # — the primary's failure drives the ladder (it is the one the
         # un-hedged path would have raised)
         raise primary_f.exception() or failures[0]
 
-    def _discard_loser(self, future) -> None:
+    def _discard_loser(self, future, replica: Optional[str] = None,
+                       winner: Optional[str] = None,
+                       t_sub: Optional[float] = None,
+                       ctx: Optional[tuple] = None) -> None:
         self._m_hedge_wasted.inc()
         exc = future.exception()
         if exc is not None:
@@ -835,6 +859,18 @@ class FleetRouter:
             # answered; run_on recorded the replica-level failure
             self._m_hedge_loser_failures.inc()
             log.debug("hedge loser failed after the verdict: %r", exc)
+        if ctx is not None and t_sub is not None and tracing.TRACER.enabled:
+            # the loser's wall interval as an explicit wasted-work span
+            # on the LOGICAL trace (same trace id as the winner, tagged
+            # with both names): the critical-path analyzer reports it
+            # as the hedge_wasted segment — duplicate work outside the
+            # request's wall-time identity
+            tags = {"replica": replica, "winner": winner, "wasted": True}
+            if exc is not None:
+                tags["error"] = repr(exc)
+            tracing.TRACER.record("fleet/hedge_wasted", t_sub,
+                                  time.monotonic(), trace_id=ctx[0],
+                                  parent_id=ctx[1], tags=tags)
 
     def hedge_stats(self) -> Dict[str, int]:
         return {"issued": self._m_hedge_issued.value,

@@ -1,0 +1,196 @@
+"""fleettrace: cross-process trace assembly, tail-sampled exemplars,
+and critical-path latency attribution.
+
+PR 15 made the serving path multi-process (actor -> frontend router ->
+chain_server replicas -> device); the trace envelope already crosses
+the RPC wire, but spans died in per-process rings — nobody ever
+reassembled a request. This package closes the loop, Dapper-style:
+
+- ``exporter.py``  — each process drains its tracer's finished spans
+  (bounded, batched, drop-counted) to the collector, in-proc or over
+  ``shard_traceExport`` with a per-connection clock-offset handshake;
+- ``collector.py`` — groups spans by trace id into cross-process
+  trees, retains full traces from the TAIL (SLO breaches, hedges,
+  breaker windows, the top latency quantile, plus a deterministic
+  sample), and feeds retained exemplars to the perfwatch flight
+  recorder;
+- ``critical_path.py`` — walks an assembled tree and attributes
+  end-to-end wall time to named segments (wire, frontend route/WFQ,
+  replica queue_wait / batch_assembly / device_dispatch, future_wake,
+  hedge-wasted duplicate work), aggregated into per-class p50/p99
+  tables served by ``shard_traceAttribution``, /status, and
+  ``scripts/fleettrace_report.py``.
+
+Two boot shapes, both idempotent and torn down by `shutdown()`:
+
+- `boot_collector()` — this process OWNS assembly (the fleet frontend;
+  a single-process node with ``--fleettrace``). Starts the sweep, an
+  in-proc exporter for the process's own spans, the SLO breach hook,
+  and the flight-recorder exemplar payload.
+- `boot_exporter("host:port")` — this process only PRODUCES spans
+  (chain_server replicas, actors): ship everything to the collector at
+  the endpoint.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from gethsharding_tpu import tracing
+
+_LAZY = {
+    "RpcExportSink": ("exporter", "RpcExportSink"),
+    "SpanExporter": ("exporter", "SpanExporter"),
+    "TraceCollector": ("collector", "TraceCollector"),
+    "attribute": ("critical_path", "attribute"),
+    "SEGMENTS": ("critical_path", "SEGMENTS"),
+}
+
+
+def __getattr__(name: str):
+    try:
+        module_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    import importlib
+
+    module = importlib.import_module(f"{__name__}.{module_name}")
+    value = getattr(module, attr)
+    globals()[name] = value  # cache: next access skips __getattr__
+    return value
+
+
+_STATE_LOCK = threading.Lock()
+COLLECTOR = None
+EXPORTER = None
+_SINK = None
+_BREACH_HOOK = None
+
+
+def boot_collector(registry=None, *, export_self: bool = True,
+                   start_sweep: bool = True):
+    """Own trace assembly in this process. Enables tracing if it is
+    off (a collector with no spans is a no-op), wires the SLO breach
+    hook, the flight-recorder retention/exemplar hooks, and (by
+    default) an in-proc exporter for this process's own spans."""
+    global COLLECTOR, EXPORTER
+    from gethsharding_tpu import metrics
+    from gethsharding_tpu.fleettrace.collector import TraceCollector
+    from gethsharding_tpu.fleettrace.exporter import SpanExporter
+
+    with _STATE_LOCK:
+        if COLLECTOR is not None:
+            return COLLECTOR
+        if not tracing.TRACER.enabled:
+            tracing.enable()
+        collector = TraceCollector(registry or metrics.DEFAULT_REGISTRY)
+        if start_sweep:
+            collector.start()
+        _wire_hooks(collector)
+        COLLECTOR = collector
+        if export_self and EXPORTER is None:
+            EXPORTER = SpanExporter(
+                sink=collector.ingest_payload,
+                registry=collector.registry,
+                label=f"pid{os.getpid()}").start()
+        return collector
+
+
+def boot_exporter(endpoint: str, registry=None, label: Optional[str] = None):
+    """Produce spans only: ship this process's spans to the collector
+    at ``host:port`` (the fleet frontend). Dial failures are absorbed
+    and retried batch-to-batch — replicas boot before the frontend."""
+    global EXPORTER, _SINK
+    from gethsharding_tpu import metrics
+    from gethsharding_tpu.fleettrace.exporter import RpcExportSink, \
+        SpanExporter
+
+    with _STATE_LOCK:
+        if EXPORTER is not None:
+            return EXPORTER
+        if not tracing.TRACER.enabled:
+            tracing.enable()
+        _SINK = RpcExportSink(endpoint)
+        EXPORTER = SpanExporter(
+            sink=_SINK,
+            registry=registry or metrics.DEFAULT_REGISTRY,
+            label=label or f"pid{os.getpid()}").start()
+        return EXPORTER
+
+
+def _wire_hooks(collector) -> None:
+    """Connect the tail-retention triggers: SLO breach onsets and the
+    flight recorder's fatal events mark exemplars; retained traces ride
+    into every bundle as ``exemplars.json``."""
+    global _BREACH_HOOK
+    from gethsharding_tpu import slo
+    from gethsharding_tpu.perfwatch import RECORDER
+
+    _BREACH_HOOK = collector.on_breach
+    slo.tracker().on_breach(_BREACH_HOOK)
+    RECORDER.add_event_hook(collector.on_recorder_event)
+    RECORDER.add_payload_provider(
+        "exemplars.json", lambda: collector.exemplars(limit=8))
+
+
+def active():
+    """The process's collector, or None — the RPC handlers' guard."""
+    return COLLECTOR
+
+
+def mark_trace(trace_id: Optional[int], reason: str) -> None:
+    """Flag a trace for tail retention (no-op without a collector).
+    The router's hedge path calls this on the request hot path, so it
+    must stay one attribute read when fleettrace is off."""
+    collector = COLLECTOR
+    if collector is not None:
+        collector.mark_trace(trace_id, reason)
+
+
+def fleettrace_status() -> dict:
+    """The /status section: collector + exporter state in one dict."""
+    collector, exporter = COLLECTOR, EXPORTER
+    out = {"active": collector is not None}
+    if collector is not None:
+        out.update(collector.status())
+    if exporter is not None:
+        out["export"] = exporter.stats()
+    return out
+
+
+def shutdown() -> None:
+    """Tear down exporter, collector, and every registered hook (tests
+    boot and unboot repeatedly in one process)."""
+    global COLLECTOR, EXPORTER, _SINK, _BREACH_HOOK
+    with _STATE_LOCK:
+        exporter, EXPORTER = EXPORTER, None
+        sink, _SINK = _SINK, None
+        collector, COLLECTOR = COLLECTOR, None
+        breach_hook, _BREACH_HOOK = _BREACH_HOOK, None
+    if exporter is not None:
+        exporter.close()
+    if sink is not None:
+        sink.close()
+    if collector is not None:
+        collector.close()
+        from gethsharding_tpu import slo
+        from gethsharding_tpu.perfwatch import RECORDER
+
+        if breach_hook is not None:
+            slo.tracker().remove_breach_hook(breach_hook)
+        RECORDER.remove_event_hook(collector.on_recorder_event)
+        RECORDER.remove_payload_provider("exemplars.json")
+
+
+__all__ = [
+    "active",
+    "boot_collector",
+    "boot_exporter",
+    "fleettrace_status",
+    "mark_trace",
+    "shutdown",
+    *sorted(_LAZY),
+]

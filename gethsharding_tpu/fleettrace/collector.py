@@ -1,0 +1,399 @@
+"""Trace collector: cross-process assembly + tail-based sampling.
+
+Receives span batches from `SpanExporter`s (remote processes over
+``shard_traceExport``, the owning process in-proc), rebases every span
+onto the collector's wall clock using the batch's ``clock_offset_us``
+anchor plus the handshake-measured per-connection skew, groups spans
+by trace id, and — once a trace has gone quiet for a linger window —
+assembles it, runs critical-path attribution, and decides retention
+Dapper-style from the TAIL:
+
+- keep every trace somebody flagged (hedged requests, breaker-window
+  traffic, SLO-breach onsets mark recent traces of the breached
+  class);
+- keep the top latency quantile (the exemplars a p99 regression needs);
+- keep a deterministic probabilistic sample of the rest;
+- attribute EVERYTHING before dropping — the per-class segment tables
+  are unbiased even though only exemplars keep their spans.
+
+Retained exemplars live in a bounded ring served to
+``shard_traceExemplars``, the /status section, and the perfwatch
+flight-recorder bundle (``exemplars.json``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional, Set, Tuple
+
+from gethsharding_tpu import metrics
+from gethsharding_tpu.fleettrace import critical_path
+
+# recorder event kinds that open a retain-everything window: each is a
+# fatal trigger whose post-mortem wants full traces, not samples
+RETAIN_EVENT_KINDS = frozenset((
+    "breaker_trip", "watchdog_timeout", "soundness_violation",
+    "hedge_storm",
+))
+
+_ATTR_BUCKETS_MS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+                    100.0, 250.0, 500.0, 1000.0, 2500.0, 10000.0)
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class _TraceBuf:
+    """One in-flight trace: spans seen so far + assembly state."""
+
+    __slots__ = ("spans", "pids", "last_seen", "incomplete", "klass",
+                 "reasons")
+
+    def __init__(self) -> None:
+        self.spans: List[dict] = []
+        self.pids: Set[int] = set()
+        self.last_seen = 0.0
+        self.incomplete = False
+        self.klass: Optional[str] = None
+        self.reasons: Set[str] = set()
+
+
+class TraceCollector:
+    """Span-batch sink + trace assembler + tail sampler.
+
+    Thread-safe: batches arrive on RPC handler threads, marks arrive
+    from the router's hot path, the sweep runs on its own thread, and
+    /status reads concurrently.
+    """
+
+    def __init__(self, registry: metrics.Registry = metrics.DEFAULT_REGISTRY,
+                 *, max_traces: Optional[int] = None,
+                 linger_s: Optional[float] = None,
+                 sample: Optional[float] = None,
+                 quantile: Optional[float] = None,
+                 exemplars: Optional[int] = None,
+                 breach_window_s: Optional[float] = None):
+        self.registry = registry
+        self.max_traces = max_traces if max_traces is not None else \
+            _env_int("GETHSHARDING_FLEETTRACE_TRACES", 512)
+        self.linger_s = linger_s if linger_s is not None else \
+            _env_float("GETHSHARDING_FLEETTRACE_LINGER_S", 1.0)
+        self.sample = sample if sample is not None else \
+            _env_float("GETHSHARDING_FLEETTRACE_SAMPLE", 0.01)
+        self.quantile = quantile if quantile is not None else \
+            _env_float("GETHSHARDING_FLEETTRACE_QUANTILE", 0.99)
+        max_exemplars = exemplars if exemplars is not None else \
+            _env_int("GETHSHARDING_FLEETTRACE_EXEMPLARS", 32)
+        self.breach_window_s = breach_window_s if breach_window_s is not None \
+            else _env_float("GETHSHARDING_FLEETTRACE_BREACH_WINDOW_S", 5.0)
+        self._lock = threading.Lock()
+        self._live: "OrderedDict[int, _TraceBuf]" = OrderedDict()
+        self._marks: "OrderedDict[int, str]" = OrderedDict()
+        self._sources: Dict[Tuple, int] = {}
+        self._durations: deque = deque(maxlen=512)
+        self._breach_until: Dict[str, float] = {}
+        self._window_until = 0.0
+        self._exemplars: deque = deque(maxlen=max(1, max_exemplars))
+        self._attr: Dict[Tuple[str, str], metrics.Histogram] = {}
+        self._sweeper: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        # eager instruments: the observability smoke asserts the
+        # fleettrace/* prom rows exist as soon as the collector boots
+        self._m_spans = registry.counter("fleettrace/ingest/spans")
+        self._m_batches = registry.counter("fleettrace/ingest/batches")
+        self._m_lossy = registry.counter("fleettrace/ingest/lossy_batches")
+        self._m_assembled = registry.counter("fleettrace/traces/assembled")
+        self._m_retained = registry.counter("fleettrace/traces/retained")
+        self._m_sampled_out = registry.counter("fleettrace/traces/sampled_out")
+        self._m_incomplete = registry.counter("fleettrace/traces/incomplete")
+        self._m_evicted = registry.counter("fleettrace/traces/evicted")
+        self._m_marked = registry.counter("fleettrace/marks")
+        self._g_live = registry.gauge("fleettrace/traces/live")
+        self._g_exemplars = registry.gauge("fleettrace/exemplars")
+
+    # -- ingest (the shard_traceExport sink) --------------------------------
+
+    def ingest_payload(self, payload: dict) -> dict:
+        """Accept one exporter batch: decode, rebase to this process's
+        wall clock, fold into per-trace buffers. Returns the ack the
+        RPC handler ships back."""
+        from gethsharding_tpu.rpc import codec
+
+        spans = codec.dec_spans(payload.get("spans") or [])
+        pid = payload.get("pid")
+        label = payload.get("label")
+        shift_s = (float(payload.get("clock_offset_us") or 0.0)
+                   + float(payload.get("skew_us") or 0.0)) / 1e6
+        dropped = int(payload.get("dropped") or 0)
+        now = time.monotonic()
+        source = (pid, label)
+        with self._lock:
+            lossy = dropped > self._sources.get(source, 0)
+            self._sources[source] = dropped
+            if lossy:
+                self._m_lossy.inc()
+            for record in spans:
+                record["pid"] = pid
+                record["src"] = label
+                record["start"] += shift_s
+                record["end"] += shift_s
+                buf = self._live.get(record["trace"])
+                if buf is None:
+                    while len(self._live) >= self.max_traces:
+                        self._live.popitem(last=False)
+                        self._m_evicted.inc()
+                    buf = _TraceBuf()
+                    self._live[record["trace"]] = buf
+                buf.spans.append(record)
+                if pid is not None:
+                    buf.pids.add(pid)
+                buf.last_seen = now
+                if lossy:
+                    # this source admitted losing spans since its last
+                    # batch: any trace it feeds may be missing subtrees
+                    buf.incomplete = True
+                tags = record["tags"]
+                if buf.klass is None and "klass" in tags:
+                    buf.klass = tags["klass"]
+                reason = self._marks.pop(record["trace"], None)
+                if reason is not None:
+                    buf.reasons.add(reason)
+            self._m_spans.inc(len(spans))
+            self._m_batches.inc()
+            self._g_live.set(len(self._live))
+        return {"accepted": True, "spans": len(spans)}
+
+    # -- exemplar marking (tail-retention triggers) -------------------------
+
+    def mark_trace(self, trace_id: Optional[int], reason: str) -> None:
+        """Flag a trace for retention (hedge issued, breaker-adjacent,
+        caller interest). Safe before OR after its spans arrive."""
+        if trace_id is None:
+            return
+        with self._lock:
+            buf = self._live.get(trace_id)
+            if buf is not None:
+                buf.reasons.add(reason)
+            else:
+                self._marks[trace_id] = reason
+                self._marks.move_to_end(trace_id)
+                while len(self._marks) > 4096:
+                    self._marks.popitem(last=False)
+            self._m_marked.inc()
+
+    def on_breach(self, objective: str, fast_burn: float,
+                  slow_burn: float) -> None:
+        """`SLOTracker.on_breach` hook: a breach onset retains every
+        live trace of the breached class and opens a per-class window
+        so the traces that BREACH the objective (not just precede it)
+        are captured too."""
+        now = time.monotonic()
+        with self._lock:
+            self._breach_until[objective] = now + self.breach_window_s
+            for buf in self._live.values():
+                if buf.klass == objective:
+                    buf.reasons.add("slo_breach")
+
+    def on_recorder_event(self, kind: str) -> None:
+        """Flight-recorder event hook: fatal triggers open a global
+        retain-everything window — their post-mortems want whole
+        traces."""
+        if kind in RETAIN_EVENT_KINDS:
+            with self._lock:
+                self._window_until = time.monotonic() + self.breach_window_s
+
+    # -- assembly sweep -----------------------------------------------------
+
+    def start(self) -> None:
+        """Run the assembly sweep on a background thread."""
+        if self._sweeper is not None:
+            return
+        self._stop.clear()
+        self._sweeper = threading.Thread(
+            target=self._sweep_loop, name="fleettrace-sweep", daemon=True)
+        self._sweeper.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        sweeper = self._sweeper
+        if sweeper is not None:
+            sweeper.join(timeout=5.0)
+            self._sweeper = None
+        self.sweep(force=True)
+
+    def _sweep_loop(self) -> None:
+        interval = max(0.1, self.linger_s / 2.0)
+        while not self._stop.wait(interval):
+            try:
+                self.sweep()
+            except Exception:  # noqa: BLE001 - the sweep must survive
+                import logging
+                logging.getLogger("fleettrace").exception("sweep failed")
+
+    def sweep(self, now: Optional[float] = None, force: bool = False) -> int:
+        """Finalize traces quiet for at least the linger window (all of
+        them with `force`, for shutdown and tests). Returns the number
+        of traces assembled."""
+        now = time.monotonic() if now is None else now
+        ready: List[Tuple[int, _TraceBuf]] = []
+        with self._lock:
+            for trace_id, buf in list(self._live.items()):
+                if force or now - buf.last_seen >= self.linger_s:
+                    del self._live[trace_id]
+                    ready.append((trace_id, buf))
+            self._g_live.set(len(self._live))
+        for trace_id, buf in ready:
+            self._finalize(trace_id, buf, now)
+        return len(ready)
+
+    def _finalize(self, trace_id: int, buf: _TraceBuf, now: float) -> None:
+        attr = critical_path.attribute(buf.spans)
+        if attr is None:
+            return
+        klass = attr.get("klass") or buf.klass or "unclassified"
+        attr["klass"] = klass
+        attr["incomplete"] = buf.incomplete
+        self._observe(klass, attr)
+        self._m_assembled.inc()
+        if buf.incomplete:
+            self._m_incomplete.inc()
+        duration = attr["total_s"]
+        reasons = set(buf.reasons)
+        threshold = self._tail_threshold()
+        with self._lock:
+            if self._breach_until.get(klass, 0.0) > now:
+                reasons.add("slo_breach_window")
+            if self._window_until > now:
+                reasons.add("event_window")
+            self._durations.append(duration)
+        if threshold is not None and duration >= threshold:
+            reasons.add("tail_quantile")
+        if not reasons and self.sample > 0.0 and \
+                (trace_id * 2654435761) % (1 << 32) < self.sample * (1 << 32):
+            # deterministic hash sample: the same trace id makes the
+            # same decision on every collector — no RNG in the hot path
+            reasons.add("sampled")
+        if not reasons:
+            self._m_sampled_out.inc()
+            return
+        exemplar = {
+            "trace_id": trace_id,
+            "reasons": sorted(reasons),
+            "incomplete": buf.incomplete,
+            "klass": klass,
+            "attribution": _round_attr(attr),
+            "spans": sorted(buf.spans, key=lambda s: s["start"]),
+        }
+        with self._lock:
+            self._exemplars.append(exemplar)
+            self._g_exemplars.set(len(self._exemplars))
+        self._m_retained.inc()
+
+    def _tail_threshold(self) -> Optional[float]:
+        """Duration above which a trace is a top-quantile exemplar;
+        None until enough history has accumulated to rank against."""
+        with self._lock:
+            history = sorted(self._durations)
+        if len(history) < 16:
+            return None
+        index = min(len(history) - 1, int(self.quantile * len(history)))
+        return history[index]
+
+    def _observe(self, klass: str, attr: dict) -> None:
+        self._hist(klass, "total").observe(attr["total_s"] * 1e3)
+        for segment, seconds in attr["segments"].items():
+            if seconds > 0.0:
+                self._hist(klass, segment).observe(seconds * 1e3)
+        if attr["hedge_wasted_s"] > 0.0:
+            self._hist(klass, critical_path.HEDGE_WASTED).observe(
+                attr["hedge_wasted_s"] * 1e3)
+
+    def _hist(self, klass: str, segment: str) -> metrics.Histogram:
+        key = (klass, segment)
+        hist = self._attr.get(key)
+        if hist is None:
+            hist = self.registry.histogram(
+                f"fleettrace/attr/{klass}/{segment}_ms",
+                buckets=_ATTR_BUCKETS_MS)
+            with self._lock:
+                self._attr[key] = hist
+        return hist
+
+    # -- consumers ----------------------------------------------------------
+
+    def attribution(self) -> dict:
+        """Per-class critical-path tables: segment -> count/p50/p99 ms,
+        the `shard_traceAttribution` / report-script payload."""
+        with self._lock:
+            items = list(self._attr.items())
+        classes: Dict[str, dict] = {}
+        for (klass, segment), hist in items:
+            _, count, total = hist.read()
+            classes.setdefault(klass, {})[segment] = {
+                "count": count,
+                "mean_ms": round(total / count, 3) if count else 0.0,
+                "p50_ms": round(hist.quantile(0.50), 3),
+                "p99_ms": round(hist.quantile(0.99), 3),
+            }
+        return {
+            "classes": classes,
+            "segments": list(critical_path.SEGMENTS)
+            + [critical_path.HEDGE_WASTED, "total"],
+            "traces": {
+                "assembled": self._m_assembled.value,
+                "retained": self._m_retained.value,
+                "sampled_out": self._m_sampled_out.value,
+                "incomplete": self._m_incomplete.value,
+            },
+        }
+
+    def exemplars(self, limit: int = 8) -> List[dict]:
+        """Most recent retained traces, newest first."""
+        with self._lock:
+            out = list(self._exemplars)
+        return list(reversed(out[-max(0, int(limit)):]))
+
+    def status(self) -> dict:
+        with self._lock:
+            live = len(self._live)
+            exemplar_count = len(self._exemplars)
+            pending_marks = len(self._marks)
+            classes = sorted({klass for klass, _ in self._attr})
+        return {
+            "live_traces": live,
+            "exemplars": exemplar_count,
+            "pending_marks": pending_marks,
+            "classes": classes,
+            "spans_ingested": self._m_spans.value,
+            "batches": self._m_batches.value,
+            "assembled": self._m_assembled.value,
+            "retained": self._m_retained.value,
+            "sampled_out": self._m_sampled_out.value,
+            "incomplete": self._m_incomplete.value,
+            "evicted": self._m_evicted.value,
+            "sample": self.sample,
+            "quantile": self.quantile,
+            "linger_s": self.linger_s,
+        }
+
+
+def _round_attr(attr: dict) -> dict:
+    out = dict(attr)
+    out["total_s"] = round(attr["total_s"], 6)
+    out["hedge_wasted_s"] = round(attr["hedge_wasted_s"], 6)
+    out["segments"] = {k: round(v, 6) for k, v in attr["segments"].items()}
+    return out

@@ -1,0 +1,211 @@
+"""Span export plane: ship finished spans to the fleet collector.
+
+Every process that participates in cross-process tracing runs one
+`SpanExporter`: it opens the tracer's bounded export buffer, drains it
+on a short cadence, and ships batches to a sink — either a
+`TraceCollector.ingest_payload` in the same process (single-process
+runs, the frontend's own spans) or an `RpcExportSink` riding the
+existing JSON-RPC framing as ``shard_traceExport``.
+
+The batch envelope carries everything the collector needs to place the
+spans on ONE timeline and to stay honest about loss:
+
+- ``clock_offset_us`` — the producer's wall-minus-monotonic anchor
+  (the same anchor `tracing/export.py` stamps on Chrome dumps);
+- ``skew_us`` — the per-connection handshake-measured wall-clock skew
+  between producer and collector hosts (``shard_traceHandshake``,
+  NTP-style midpoint estimate), so cross-HOST spans land on the
+  collector's timeline, not just cross-process ones;
+- ``dropped`` — the cumulative count of spans this process finished
+  but could not ship (export-buffer evictions + failed sends), so the
+  collector marks the traces this source feeds as incomplete instead
+  of presenting truncated trees as complete.
+
+Ship failures never block or break the traced process: the batch is
+counted lost, the connection is torn down, and the next flush redials
+— the collector may simply not be up yet (replicas boot before the
+frontend in every topology script).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from gethsharding_tpu import metrics, tracing
+from gethsharding_tpu.tracing.export import clock_offset_us
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class RpcExportSink:
+    """Dial-on-demand `shard_traceExport` shipper with the clock
+    handshake. Raises on ship failure (the exporter does the loss
+    accounting); the dead connection is dropped so the next attempt
+    redials."""
+
+    def __init__(self, endpoint: str, timeout_s: float = 5.0):
+        host, _, port = endpoint.rpartition(":")
+        self.host = host or "127.0.0.1"
+        self.port = int(port)
+        self.timeout_s = timeout_s
+        self._client = None
+        self._skew_us = 0.0
+        self._lock = threading.Lock()
+
+    def _ensure(self):
+        from gethsharding_tpu.rpc.client import RPCClient
+
+        with self._lock:
+            if self._client is None:
+                client = RPCClient(self.host, self.port,
+                                   timeout=self.timeout_s)
+                try:
+                    # NTP-style midpoint estimate: the collector's wall
+                    # clock read halfway through the round trip is the
+                    # best single-exchange guess of "its now vs ours"
+                    t0 = time.time()
+                    reply = client.call("shard_traceHandshake")
+                    rtt = time.time() - t0
+                    remote_wall_us = float(reply["wall_us"])
+                    self._skew_us = remote_wall_us - (t0 + rtt / 2.0) * 1e6
+                except Exception:
+                    client.close()
+                    raise
+                self._client = client
+            return self._client, self._skew_us
+
+    def __call__(self, payload: dict) -> None:
+        client, skew_us = self._ensure()
+        payload["skew_us"] = skew_us
+        try:
+            client.call("shard_traceExport", payload)
+        except Exception:
+            self.close()
+            raise
+
+    @property
+    def skew_us(self) -> float:
+        """Handshake-measured wall-clock skew toward the collector
+        host (0.0 until the first successful dial). Feed this to
+        ``scripts/trace_merge.py --skew-us`` when hand-merging Chrome
+        dumps from different hosts."""
+        return self._skew_us
+
+    def close(self) -> None:
+        with self._lock:
+            client, self._client = self._client, None
+        if client is not None:
+            try:
+                client.close()
+            except Exception:  # noqa: BLE001 - teardown must not raise
+                pass
+
+
+class SpanExporter:
+    """Background drain of the tracer's export buffer into a sink."""
+
+    def __init__(self, sink: Callable[[dict], None],
+                 tracer: Optional[tracing.Tracer] = None,
+                 registry: metrics.Registry = metrics.DEFAULT_REGISTRY,
+                 label: Optional[str] = None,
+                 interval_s: Optional[float] = None,
+                 batch_spans: Optional[int] = None,
+                 buffer_spans: Optional[int] = None):
+        self.sink = sink
+        self.tracer = tracer if tracer is not None else tracing.TRACER
+        self.label = label or f"pid{os.getpid()}"
+        self.interval_s = interval_s if interval_s is not None else \
+            _env_float("GETHSHARDING_FLEETTRACE_INTERVAL_MS", 200.0) / 1e3
+        self.batch_spans = batch_spans if batch_spans is not None else \
+            _env_int("GETHSHARDING_FLEETTRACE_BATCH", 512)
+        self.tracer.enable_export(
+            buffer_spans if buffer_spans is not None
+            else _env_int("GETHSHARDING_FLEETTRACE_BUFFER", 8192))
+        self._lost = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._m_spans = registry.counter("fleettrace/export/spans")
+        self._m_batches = registry.counter("fleettrace/export/batches")
+        self._m_failures = registry.counter("fleettrace/export/failures")
+        self._m_lost = registry.counter("fleettrace/export/lost")
+
+    def start(self) -> "SpanExporter":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="fleettrace-export", daemon=True)
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.flush()
+
+    def flush(self) -> int:
+        """Drain and ship everything currently staged. Returns spans
+        shipped; a failed send counts the batch lost (the drop count
+        rides out on the next successful batch) and returns 0."""
+        from gethsharding_tpu.rpc import codec
+
+        shipped = 0
+        while True:
+            batch, dropped = self.tracer.drain_export(self.batch_spans)
+            if not batch:
+                return shipped
+            payload = {
+                "pid": os.getpid(),
+                "label": self.label,
+                "clock_offset_us": clock_offset_us(),
+                "dropped": dropped + self._lost,
+                "spans": codec.enc_spans(batch),
+            }
+            try:
+                self.sink(payload)
+            except Exception:  # noqa: BLE001 - export must never break
+                # the traced process; the collector may not be up yet
+                self._m_failures.inc()
+                self._lost += len(batch)
+                self._m_lost.inc(len(batch))
+                return shipped
+            shipped += len(batch)
+            self._m_spans.inc(len(batch))
+            self._m_batches.inc()
+
+    def close(self) -> None:
+        """Stop the drain thread and ship a final batch."""
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=5.0)
+            self._thread = None
+        try:
+            self.flush()
+        except Exception:  # noqa: BLE001 - shutdown must not raise
+            pass
+        self.tracer.disable_export()
+
+    def stats(self) -> dict:
+        out = {"label": self.label,
+               "spans": self._m_spans.value,
+               "batches": self._m_batches.value,
+               "failures": self._m_failures.value,
+               "lost": self._m_lost.value + self.tracer.export_dropped}
+        skew = getattr(self.sink, "skew_us", None)
+        if skew is not None:
+            out["skew_us"] = skew
+        return out

@@ -202,6 +202,22 @@ def build_parser() -> argparse.ArgumentParser:
     sharding.add_argument("--trace-ring", type=int, default=4096,
                           help="finished-span ring capacity (bounded "
                                "memory: oldest spans fall off)")
+    sharding.add_argument("--fleettrace", action="store_true",
+                          help="boot an in-process fleettrace collector: "
+                               "assembles this node's spans (and any "
+                               "replica exporting to it over "
+                               "shard_traceExport) into cross-process "
+                               "trace trees with tail-sampled SLO "
+                               "exemplars and critical-path attribution; "
+                               "served on /status and /metrics; implies "
+                               "--trace")
+    sharding.add_argument("--fleettrace-export", default=None,
+                          metavar="HOST:PORT",
+                          help="ship finished spans to the fleettrace "
+                               "collector at HOST:PORT (a fleet frontend "
+                               "or node run with --fleettrace); implies "
+                               "--trace (default: GETHSHARDING_"
+                               "FLEETTRACE_EXPORT)")
     attach = sub.add_parser(
         "attach", help="interactive console on a running chain process "
                        "(the geth attach / console analog)")
@@ -562,7 +578,12 @@ def run_sharding_node(args) -> int:
             profiling = True
         except Exception as exc:
             log.warning("JAX profiler unavailable: %s", exc)
-    tracing_on = args.trace or args.trace_out
+    fleettrace_export = args.fleettrace_export
+    if fleettrace_export is None:
+        fleettrace_export = os.environ.get(
+            "GETHSHARDING_FLEETTRACE_EXPORT") or None
+    tracing_on = (args.trace or args.trace_out or args.fleettrace
+                  or bool(fleettrace_export))
     if tracing_on:
         from gethsharding_tpu import tracing
 
@@ -584,6 +605,19 @@ def run_sharding_node(args) -> int:
     from gethsharding_tpu import devscope
 
     devscope.boot()
+    # fleettrace: the collector assembles cross-process trace trees
+    # (tail-sampled exemplars, critical-path attribution) out of this
+    # node's spans plus any replica exporting to it; the exporter ships
+    # this node's spans to a remote collector instead
+    fleettrace_on = args.fleettrace or bool(fleettrace_export)
+    if fleettrace_on:
+        from gethsharding_tpu import fleettrace
+
+        if args.fleettrace:
+            fleettrace.boot_collector()
+        if fleettrace_export:
+            fleettrace.boot_exporter(fleettrace_export,
+                                     label="node-%d" % os.getpid())
 
     node.start()
 
@@ -601,6 +635,10 @@ def run_sharding_node(args) -> int:
         log.info("interrupt received, shutting down")
     finally:
         node.stop()
+        if fleettrace_on:
+            from gethsharding_tpu import fleettrace
+
+            fleettrace.shutdown()  # exporter final flush + sweep drain
         devscope.shutdown()  # poller thread + any live profile session
         if profiling:
             import jax

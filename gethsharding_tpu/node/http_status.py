@@ -153,6 +153,15 @@ class StatusServer(Service):
         from gethsharding_tpu import devscope
 
         payload["devscope"] = devscope.devscope_status()
+        # fleet tracing at a glance (gethsharding_tpu/fleettrace): the
+        # collector's assembly/retention counters, per-segment
+        # critical-path attribution and exemplar depth when this
+        # process booted one (--fleettrace), plus the exporter's
+        # shipped/lost counts when spans are exported to a remote
+        # collector — `active` false means neither is up
+        from gethsharding_tpu import fleettrace
+
+        payload["fleettrace"] = fleettrace.fleettrace_status()
         # span-ring health: a nonzero dropped count means the bounded
         # finished-span ring overwrote spans nobody exported — raise
         # --trace-ring or export more often

@@ -108,6 +108,37 @@ class FlightRecorder:
         self.bundles = 0
         self.last_bundle: Optional[str] = None
         self.last_reason: Optional[str] = None
+        # observer seams (fleettrace et al. subscribe WITHOUT perfwatch
+        # importing them): event hooks see every recorded kind; payload
+        # providers contribute extra bundle files to each dump
+        self._event_hooks: List = []
+        self._payload_providers: Dict[str, object] = {}
+
+    # -- observer seams ----------------------------------------------------
+
+    def add_event_hook(self, hook) -> None:
+        """Call ``hook(kind)`` after every recorded event. Hooks must
+        be cheap and must not raise (failures are swallowed + logged) —
+        they run on the recording thread, sometimes under caller
+        locks."""
+        with self._lock:
+            if hook not in self._event_hooks:
+                self._event_hooks.append(hook)
+
+    def remove_event_hook(self, hook) -> None:
+        with self._lock:
+            if hook in self._event_hooks:
+                self._event_hooks.remove(hook)
+
+    def add_payload_provider(self, fname: str, provider) -> None:
+        """Register ``provider() -> json-able`` written as `fname` into
+        every future bundle (e.g. fleettrace's ``exemplars.json``)."""
+        with self._lock:
+            self._payload_providers[fname] = provider
+
+    def remove_payload_provider(self, fname: str) -> None:
+        with self._lock:
+            self._payload_providers.pop(fname, None)
 
     # -- producers ---------------------------------------------------------
 
@@ -120,6 +151,13 @@ class FlightRecorder:
                  "kind": kind, "detail": detail}
         with self._lock:
             self._events.append(event)
+            hooks = list(self._event_hooks) if self._event_hooks else ()
+        for hook in hooks:
+            try:
+                hook(kind)
+            except Exception:  # noqa: BLE001 - an observer must never
+                # poison the seam that recorded the event
+                log.exception("recorder event hook failed (kind %s)", kind)
         _M_EVENTS.inc()
 
     def record_wire(self, op: str, wire: Optional[dict]) -> None:
@@ -211,6 +249,7 @@ class FlightRecorder:
             # same second can never compute the same directory name
             events = list(self._events)
             wires = list(self._wires)
+            providers = list(self._payload_providers.items())
         spans = tracing.TRACER.recent_spans()
         snapshot = self.registry.snapshot()
         # lazy: the ledger is an optional neighbor, not a dependency
@@ -236,6 +275,12 @@ class FlightRecorder:
             "spans.json": spans,
             "metrics.json": snapshot,
         }
+        for fname, provider in providers:
+            try:
+                payloads[fname] = provider()
+            except Exception:  # noqa: BLE001 - one broken provider must
+                # not sink the rest of the post-mortem
+                log.exception("bundle payload provider %s failed", fname)
         for fname, payload in payloads.items():
             with open(os.path.join(path, fname), "w") as fh:
                 json.dump(payload, fh, indent=1, default=repr)

@@ -89,6 +89,14 @@ def main(argv=None) -> int:
                              "JSON at exit (Perfetto); implies --trace")
     parser.add_argument("--trace-ring", type=int, default=4096,
                         help="finished-span ring capacity")
+    parser.add_argument("--fleettrace-export", default=None,
+                        metavar="HOST:PORT",
+                        help="ship finished spans to the fleettrace "
+                             "collector at HOST:PORT (a fleet frontend "
+                             "run with --fleettrace) so this replica's "
+                             "spans join the cross-process trace trees; "
+                             "implies --trace (default: GETHSHARDING_"
+                             "FLEETTRACE_EXPORT)")
     parser.add_argument("--verbosity", default="warning")
     args = parser.parse_args(argv)
 
@@ -102,7 +110,11 @@ def main(argv=None) -> int:
     from gethsharding_tpu import tracing
 
     tracing.install_log_correlation()
-    if args.trace or args.trace_out:
+    fleettrace_export = args.fleettrace_export
+    if fleettrace_export is None:
+        fleettrace_export = os.environ.get(
+            "GETHSHARDING_FLEETTRACE_EXPORT") or None
+    if args.trace or args.trace_out or fleettrace_export:
         tracing.enable(ring_spans=args.trace_ring)
     overrides = {"period_length": args.periodlength}
     if args.quorum is not None:
@@ -170,6 +182,15 @@ def main(argv=None) -> int:
     from gethsharding_tpu import devscope
 
     devscope.boot()
+    # fleettrace export plane: a background exporter drains this
+    # replica's finished spans to the fleet frontend's collector, which
+    # rebases them onto the frontend clock (handshake-measured skew)
+    # and assembles the cross-process trace trees
+    if fleettrace_export:
+        from gethsharding_tpu import fleettrace
+
+        fleettrace.boot_exporter(fleettrace_export,
+                                 label="chain-%d" % os.getpid())
     server = RPCServer(backend, host=args.host, port=args.port,
                        sig_backend=sig_backend)
     server.start()
@@ -197,6 +218,10 @@ def main(argv=None) -> int:
         if follower is not None:
             follower.stop()
         server.stop()
+        if fleettrace_export:
+            from gethsharding_tpu import fleettrace
+
+            fleettrace.shutdown()
         devscope.shutdown()
         # the server never owned the injected composition: drain-and-
         # fail its queued serving futures here so no caller is stranded

@@ -14,6 +14,7 @@ A background reader thread routes responses by id and dispatches
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import json
 import logging
@@ -110,10 +111,17 @@ class RPCClient:
         # server adopts it as its handler span's trace/parent — one
         # trace id from a router's route span down into the replica's
         # dispatch spans. Extra envelope keys are legal JSON-RPC.
-        with tracing.span(f"rpc/client/{method}") as client_span:
+        # Trace-plane methods get NO span and NO envelope: a span per
+        # shipped batch re-enters the export buffer it ships (see
+        # codec.TRACE_PLANE_METHODS).
+        span_cm = (contextlib.nullcontext()
+                   if method in codec.TRACE_PLANE_METHODS
+                   else tracing.span(f"rpc/client/{method}"))
+        with span_cm as client_span:
             request = {"jsonrpc": "2.0", "id": rid, "method": method,
                        "params": list(params)}
-            ctx = tracing.current_context()
+            ctx = (tracing.current_context()
+                   if client_span is not None else None)
             if ctx is not None:
                 request["trace"] = {"trace_id": ctx[0], "span_id": ctx[1]}
             payload = (json.dumps(request) + "\n").encode()
@@ -134,11 +142,17 @@ class RPCClient:
                 with self._pending_lock:
                     self._pending.pop(rid, None)
                 raise TimeoutError(f"rpc call {method} timed out")
-            if "trace" in slot:
+            if "trace" in slot and client_span is not None:
                 # the server's handler trace id: equal to ours once the
                 # server stitches, the REMOTE id against an older server
                 # — either way caller logs correlate to replica traces
                 client_span.tag(remote_trace=slot["trace"])
+            ctx = slot.get("trace_ctx")
+            if isinstance(ctx, dict) and client_span is not None:
+                # newer servers also return the handler SPAN id: the
+                # exact remote span this call produced, unambiguous
+                # even when retries/hedges reuse one trace id
+                client_span.tag(remote_span=ctx.get("span_id"))
             if "error" in slot:
                 err = slot["error"]
                 if err.get("data") == "SMCRevert":
@@ -192,6 +206,8 @@ class RPCClient:
                         # span's `remote_trace` tag (it was received
                         # and silently discarded before)
                         slot["trace"] = msg["trace"]
+                    if "traceCtx" in msg:
+                        slot["trace_ctx"] = msg["traceCtx"]
                     if "error" in msg:
                         slot["error"] = msg["error"]
                     else:

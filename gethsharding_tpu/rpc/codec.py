@@ -189,6 +189,62 @@ def dec_das_poly_call(commitments, index_rows, eval_rows, proofs,
     )
 
 
+# -- fleettrace span-batch codec (the shard_traceExport plane) -------------
+# Finished tracer records travel as compact positional rows, not
+# keyed objects: an export batch is the highest-volume payload on the
+# control plane (hundreds of spans per flush) and the field names
+# would dominate the wire bytes. `dur_us` is derived, so it is NOT
+# shipped — the decoder recomputes it.
+
+_JSON_SCALARS = (str, int, float, bool, type(None))
+
+# The trace plane is invisible to tracing. A client span around
+# `shard_traceExport` lands in the very export buffer the call is
+# shipping — the drain can never go empty (a self-sustaining feedback
+# loop) — and a handler span per batch floods the collector with
+# meta-traces of its own transport; exemplar polls would evict the
+# exemplars they read. Client and server both skip span creation for
+# these methods.
+TRACE_PLANE_METHODS = frozenset({
+    "shard_traceExport", "shard_traceHandshake",
+    "shard_traceAttribution", "shard_traceExemplars"})
+
+
+def enc_span_tags(tags) -> Optional[dict]:
+    """Span tags with non-JSON values coerced to repr: tags are an
+    open dict (callers stash whatever helps debugging) and one exotic
+    value must not poison a whole export batch at serialization time."""
+    if not tags:
+        return None
+    return {str(k): (v if isinstance(v, _JSON_SCALARS) else repr(v))
+            for k, v in tags.items()}
+
+
+def enc_spans(records) -> list:
+    """Tracer records -> positional rows
+    ``[name, trace, span, parent, start, end, tid, tags]`` (monotonic
+    seconds; the batch envelope carries the producer's clock anchor)."""
+    return [[r["name"], r["trace"], r["span"], r["parent"],
+             r["start"], r["end"], r["tid"], enc_span_tags(r["tags"])]
+            for r in records]
+
+
+def dec_spans(rows) -> list:
+    out = []
+    for name, trace, span, parent, start, end, tid, tags in rows:
+        start = float(start)
+        end = float(end)
+        out.append({
+            "name": str(name), "trace": int(trace), "span": int(span),
+            "parent": None if parent is None else int(parent),
+            "start": start, "end": end,
+            "dur_us": round((end - start) * 1e6, 1),
+            "tid": None if tid is None else int(tid),
+            "tags": dict(tags) if tags else {},
+        })
+    return out
+
+
 # -- shardp2p message codecs (type-tagged, for the cross-process relay) ----
 
 

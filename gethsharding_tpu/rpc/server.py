@@ -213,6 +213,7 @@ class RPCServer:
         method = req.get("method", "")
         params = req.get("params", [])
         trace_id = None
+        handler_span_id = None
         with self._sub_lock:
             self.method_calls[method] = self.method_calls.get(method, 0) + 1
         try:
@@ -265,13 +266,22 @@ class RPCServer:
                 # span joins the remote trace and parents under the
                 # remote span, stitching a router-traced request into
                 # this replica's spans.
-                inbound = req.get("trace")
-                ctx = None
-                if isinstance(inbound, dict):
-                    ctx = (inbound.get("trace_id"), inbound.get("span_id"))
-                with tracing.span(f"rpc/{method}", ctx=ctx) as handler_span:
+                if method in codec.TRACE_PLANE_METHODS:
+                    # the trace plane is invisible to tracing (see
+                    # codec.TRACE_PLANE_METHODS): no handler span, no
+                    # trace fields on the response envelope
                     result = fn(*params)
-                trace_id = handler_span.trace_id
+                else:
+                    inbound = req.get("trace")
+                    ctx = None
+                    if isinstance(inbound, dict):
+                        ctx = (inbound.get("trace_id"),
+                               inbound.get("span_id"))
+                    with tracing.span(f"rpc/{method}",
+                                      ctx=ctx) as handler_span:
+                        result = fn(*params)
+                    trace_id = handler_span.trace_id
+                    handler_span_id = handler_span.span_id
         except SMCRevert as exc:
             return {"jsonrpc": "2.0", "id": rid,
                     "error": {"code": REVERT_CODE, "message": str(exc),
@@ -285,6 +295,12 @@ class RPCServer:
         response = {"jsonrpc": "2.0", "id": rid, "result": result}
         if trace_id is not None:
             response["trace"] = trace_id
+            # the full handler context alongside the bare id (kept for
+            # older clients): span_id lets the caller and the fleet
+            # collector stitch THIS request/response pair exactly —
+            # a trace id alone is ambiguous under retries and hedges
+            response["traceCtx"] = {"trace_id": trace_id,
+                                    "span_id": handler_span_id}
         return response
 
     # -- method surface (shard_* namespace) --------------------------------
@@ -573,6 +589,49 @@ class RPCServer:
         from gethsharding_tpu.metrics import DEFAULT_REGISTRY
 
         return DEFAULT_REGISTRY.snapshot()
+
+    # -- fleet tracing (the fleettrace control surface) --------------------
+
+    def rpc_traceHandshake(self):
+        """Clock-offset handshake: the exporter reads this process's
+        wall clock mid-round-trip (NTP midpoint estimate) to measure
+        the per-connection skew it stamps on every span batch — the
+        cross-HOST extension of the `clock_offset_us` anchor."""
+        import os
+
+        from gethsharding_tpu.tracing.export import clock_offset_us
+
+        return {"wall_us": time.time() * 1e6,
+                "clock_offset_us": clock_offset_us(),
+                "pid": os.getpid()}
+
+    def rpc_traceExport(self, payload):
+        """Span-batch sink: accept one exporter batch into this
+        process's fleettrace collector (``accepted: false`` when no
+        collector is booted — a replica is a producer, not an owner)."""
+        from gethsharding_tpu import fleettrace
+
+        collector = fleettrace.active()
+        if collector is None:
+            return {"accepted": False, "spans": 0}
+        return collector.ingest_payload(payload)
+
+    def rpc_traceAttribution(self):
+        """Per-class critical-path attribution tables (None when no
+        collector is booted)."""
+        from gethsharding_tpu import fleettrace
+
+        collector = fleettrace.active()
+        return None if collector is None else collector.attribution()
+
+    def rpc_traceExemplars(self, limit=8):
+        """Most recent retained (tail-sampled) assembled traces,
+        newest first — full span trees, the post-mortem payload."""
+        from gethsharding_tpu import fleettrace
+
+        collector = fleettrace.active()
+        return [] if collector is None else collector.exemplars(
+            limit=int(limit))
 
     # -- on-demand profiling (the devscope control surface) ----------------
 

@@ -509,4 +509,8 @@ def observe_future_wake(future) -> None:
     trace_id, root, label = request.trace_ids
     tracer.record(f"serving/{label}/future_wake", request.t_done,
                   time.monotonic(), trace_id=trace_id, parent_id=root,
-                  tid=trace_id)
+                  tid=trace_id,
+                  # klass rides on the wake span too: the fleettrace
+                  # per-class attribution tables must classify a trace
+                  # even when only the serving subtree arrived
+                  tags={"klass": request.klass})

@@ -322,12 +322,17 @@ class SLOTracker:
         if fire:
             series.m_breaches.inc()
             # breach ONSET only (hysteresis-gated above): one flight-
-            # recorder event per episode, not one per gauge refresh
+            # recorder event per episode, not one per gauge refresh.
+            # The onset is a dump trigger: the bundle freezes the
+            # moment the budget blew — with a fleettrace collector up,
+            # its exemplars.json carries the assembled cross-process
+            # traces of the breached window (dump IO is rate-limited
+            # and off-thread in the recorder)
             from gethsharding_tpu.perfwatch import RECORDER
 
-            RECORDER.record("slo_breach", objective=name,
-                            fast_burn=round(fast, 3),
-                            slow_burn=round(slow, 3))
+            RECORDER.trigger("slo_breach", dump=True, objective=name,
+                             fast_burn=round(fast, 3),
+                             slow_burn=round(slow, 3))
             log.warning(
                 "SLO breach on %s: fast burn %.1fx budget "
                 "(threshold %.1fx), slow burn %.1fx (threshold "
@@ -355,7 +360,17 @@ class SLOTracker:
         """Register ``hook(objective_name, fast_burn, slow_burn)`` —
         fired once per breach onset (hysteresis-gated)."""
         with self._hooks_lock:
-            self._hooks.append(hook)
+            if hook not in self._hooks:
+                self._hooks.append(hook)
+
+    def remove_breach_hook(
+            self, hook: Callable[[str, float, float], None]) -> None:
+        """Unregister a breach hook (no-op if absent) — subscribers
+        with their own lifecycle (fleettrace's collector) detach on
+        shutdown instead of leaving a dead callback on THE tracker."""
+        with self._hooks_lock:
+            if hook in self._hooks:
+                self._hooks.remove(hook)
 
     # -- introspection ------------------------------------------------------
 

@@ -129,8 +129,17 @@ class Tracer:
         self._lock = threading.Lock()
         self._timers: Dict[str, metrics.Timer] = {}
         self._dropped: Optional[metrics.Counter] = None
+        self._export_dropped_m: Optional[metrics.Counter] = None
+        self._pressure: Optional[metrics.Gauge] = None
         self.spans_recorded = 0
         self.spans_dropped = 0
+        # the export plane's staging buffer (fleettrace): None until a
+        # SpanExporter enables it — processes that never export pay
+        # nothing. Evictions here are counted separately from the
+        # display ring's: a span the /trace ring overwrote may still
+        # have been exported, and vice versa.
+        self._export: Optional[deque] = None
+        self.export_dropped = 0
 
     # -- configuration ------------------------------------------------------
 
@@ -143,10 +152,49 @@ class Tracer:
                 self.registry = registry
                 self._timers = {}
                 self._dropped = None
+                self._export_dropped_m = None
+                self._pressure = None
 
     def clear(self) -> None:
         with self._lock:
             self._ring.clear()
+            if self._export is not None:
+                self._export.clear()
+
+    # -- export plane (fleettrace) ------------------------------------------
+
+    def enable_export(self, buffer_spans: int = 8192) -> None:
+        """Open the export staging buffer: every finished span is also
+        queued for a `SpanExporter` to drain. Bounded — if the exporter
+        falls behind, the oldest staged spans are evicted and counted
+        (`export_dropped` / ``trace/export_dropped``) so shipped batches
+        can carry an honest drop count. Idempotent."""
+        with self._lock:
+            if self._export is None:
+                self._export = deque(maxlen=max(1, int(buffer_spans)))
+
+    def disable_export(self) -> None:
+        with self._lock:
+            self._export = None
+
+    @property
+    def export_enabled(self) -> bool:
+        return self._export is not None
+
+    def drain_export(self, max_spans: int = 512) -> Tuple[List[dict], int]:
+        """Destructively drain up to `max_spans` staged records (oldest
+        first). Returns ``(batch, dropped)`` where `dropped` is the
+        CUMULATIVE count of spans this process finished but can no
+        longer ship (export-buffer evictions) — exporters stamp it on
+        every batch so the collector can mark the traces it assembles
+        from this source as incomplete rather than presenting a
+        truncated tree as the whole request."""
+        with self._lock:
+            if self._export is None:
+                return [], self.export_dropped
+            take = min(int(max_spans), len(self._export))
+            batch = [self._export.popleft() for _ in range(take)]
+            return batch, self.export_dropped
 
     # -- producer API -------------------------------------------------------
 
@@ -248,6 +296,19 @@ class Tracer:
                 if self._dropped is None:
                     self._dropped = self.registry.counter("trace/dropped")
                 self._dropped.inc()
+            if self._pressure is None:
+                self._pressure = self.registry.gauge("trace/ring_pressure")
+            self._pressure.set(len(self._ring) / (self._ring.maxlen or 1))
+            if self._export is not None:
+                if len(self._export) == self._export.maxlen:
+                    # exporter is behind: evict oldest, keep the count —
+                    # the drop rides out on the next batch's envelope
+                    self.export_dropped += 1
+                    if self._export_dropped_m is None:
+                        self._export_dropped_m = self.registry.counter(
+                            "trace/export_dropped")
+                    self._export_dropped_m.inc()
+                self._export.append(record)
 
     # -- consumer API -------------------------------------------------------
 

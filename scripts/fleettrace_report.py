@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Render a fleettrace critical-path report off a live collector.
+
+Dials the process hosting the fleettrace collector (a fleet frontend
+run with ``--fleettrace``, or a node booted the same way), pulls
+``shard_traceAttribution`` + ``shard_traceExemplars`` over the normal
+JSON-RPC framing, and prints the per-class critical-path table —
+where end-to-end wall time actually went, segment by segment
+(actor_queue, wire, frontend_route, queue_wait, batch_assembly,
+device_dispatch, ...) — plus the retained tail exemplars (trace id,
+why it was kept, processes spanned, slowest segments).
+
+Usage::
+
+    python scripts/fleettrace_report.py --port 8545 [--host H]
+        [--exemplars N] [--json]
+
+``--json`` dumps the raw RPC payloads for piping; the default output
+is the human table. Exit code 1 when the target serves no collector
+(``accepted: false`` shape / empty attribution with no traces seen).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from gethsharding_tpu.rpc.client import RPCClient  # noqa: E402
+
+
+def render_attribution(attr: dict) -> str:
+    """Format the per-class segment table (the testable core)."""
+    lines = []
+    traces = attr.get("traces", {})
+    lines.append("traces: assembled=%s retained=%s sampled_out=%s "
+                 "incomplete=%s" % (traces.get("assembled", 0),
+                                    traces.get("retained", 0),
+                                    traces.get("sampled_out", 0),
+                                    traces.get("incomplete", 0)))
+    classes = attr.get("classes", {})
+    if not classes:
+        lines.append("(no attributed traces yet)")
+        return "\n".join(lines)
+    for klass in sorted(classes):
+        lines.append("")
+        lines.append("class %s" % klass)
+        lines.append("  %-18s %7s %10s %10s %10s"
+                     % ("segment", "count", "mean_ms", "p50_ms",
+                        "p99_ms"))
+        segments = classes[klass]
+        order = attr.get("segments") or sorted(segments)
+        for seg in order:
+            row = segments.get(seg)
+            if not row or not row.get("count"):
+                continue
+            lines.append("  %-18s %7d %10.3f %10.3f %10.3f"
+                         % (seg, row["count"], row["mean_ms"],
+                            row["p50_ms"], row["p99_ms"]))
+        extra = [seg for seg in segments if seg not in order]
+        for seg in sorted(extra):
+            row = segments[seg]
+            lines.append("  %-18s %7d %10.3f %10.3f %10.3f"
+                         % (seg, row["count"], row["mean_ms"],
+                            row["p50_ms"], row["p99_ms"]))
+    return "\n".join(lines)
+
+
+def render_exemplars(exemplars: list) -> str:
+    lines = []
+    for ex in exemplars:
+        attr = ex.get("attribution") or {}
+        segs = attr.get("segments") or {}
+        top = sorted(segs.items(), key=lambda kv: kv[1],
+                     reverse=True)[:3]
+        lines.append(
+            "trace %x klass=%s total=%.3fms processes=%s reasons=%s%s"
+            % (int(ex.get("trace_id", 0)),
+               ex.get("klass", "?"),
+               float(attr.get("total_s", 0.0)) * 1e3,
+               attr.get("processes", "?"),
+               ",".join(ex.get("reasons", [])),
+               " INCOMPLETE" if ex.get("incomplete") else ""))
+        for seg, sec in top:
+            if sec > 0:
+                lines.append("    %-18s %10.3f ms" % (seg, sec * 1e3))
+    return "\n".join(lines) if lines else "(no retained exemplars)"
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="fleettrace-report", description=__doc__.splitlines()[0])
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, required=True,
+                        help="RPC port of the collector-hosting process "
+                             "(fleet frontend --fleettrace)")
+    parser.add_argument("--exemplars", type=int, default=8,
+                        help="retained tail exemplars to show")
+    parser.add_argument("--json", action="store_true",
+                        help="dump the raw RPC payloads instead of the "
+                             "human table")
+    args = parser.parse_args(argv)
+    client = RPCClient(args.host, args.port, timeout=10.0)
+    try:
+        attr = client.call("shard_traceAttribution")
+        exemplars = client.call("shard_traceExemplars",
+                                args.exemplars)
+    finally:
+        client.close()
+    if args.json:
+        print(json.dumps({"attribution": attr,
+                          "exemplars": exemplars}, indent=2))
+    else:
+        print(render_attribution(attr or {}))
+        print()
+        print("retained exemplars (newest first):")
+        print(render_exemplars(exemplars or []))
+    active = bool(attr) and (attr.get("classes")
+                             or attr.get("traces", {}).get("assembled"))
+    return 0 if active else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

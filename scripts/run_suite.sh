@@ -20,7 +20,7 @@ obs_port=$(python -c "import socket; s = socket.socket(); \
 s.bind(('127.0.0.1', 0)); print(s.getsockname()[1]); s.close()")
 echo "== observability smoke (http://127.0.0.1:$obs_port)"
 JAX_PLATFORMS=cpu python -m gethsharding_tpu.node.cli sharding \
-    --actor observer --http "$obs_port" --trace --runtime 60 \
+    --actor observer --http "$obs_port" --trace --fleettrace --runtime 60 \
     --blocktime 0.2 --txinterval 1.0 --verbosity error &
 obs_pid=$!
 up=0
@@ -54,11 +54,29 @@ if [ "$up" = 1 ]; then
              "from /metrics?format=prom"
         fail=1
     fi
+    # ... and the fleettrace collector booted by --fleettrace: its
+    # ingest counters must reach the exposition from the first scrape
+    if ! echo "$prom" | grep -q "gethsharding_fleettrace_ingest_spans_total"
+    then
+        echo "observability smoke FAILED: fleettrace/ingest/spans missing" \
+             "from /metrics?format=prom"
+        fail=1
+    fi
     # the /status perf section renders (last ledger record + gate +
     # recorder state)
     if ! curl -sf "http://127.0.0.1:$obs_port/status" \
             | grep -q '"perf"'; then
         echo "observability smoke FAILED: /status has no perf section"
+        fail=1
+    fi
+    # ... and so does the fleettrace section, live (active collector)
+    if ! curl -sf "http://127.0.0.1:$obs_port/status" | python -c "
+import json, sys
+status = json.load(sys.stdin)
+assert status['fleettrace']['active'], status.get('fleettrace')
+"; then
+        echo "observability smoke FAILED: /status fleettrace section" \
+             "missing or inactive under --fleettrace"
         fail=1
     fi
 else
@@ -636,6 +654,98 @@ PYEOF
 kill "$ff_pid_fe" "$ff_pid_b" "$ff_pid_a2" 2>/dev/null
 wait "$ff_pid_fe" "$ff_pid_b" "$ff_pid_a2" 2>/dev/null
 rm -rf "$ff_dir"
+
+# -- fleettrace smoke: cross-process trace assembly on the REAL process
+# topology — 2 chain_server replicas ship spans to a fleet frontend
+# collector over shard_traceExport, this client exports its own spans
+# the same way, and ONE interactive shard_verifyAggregates must come
+# back as ONE assembled trace whose spans carry >= 3 distinct pids
+# (client + frontend + replica), with the interactive class present in
+# the critical-path attribution tables
+echo "== fleettrace smoke (one request -> one trace across 3 processes)"
+ft_dir=$(mktemp -d)
+ft_fe=$(python -c "import socket; s = socket.socket(); \
+s.bind(('127.0.0.1', 0)); print(s.getsockname()[1]); s.close()")
+# replicas first: their export sink absorbs + retries until the
+# frontend (their collector) binds the reserved port
+JAX_PLATFORMS=cpu GETHSHARDING_FLEETTRACE_INTERVAL_MS=50 \
+python -m gethsharding_tpu.rpc.chain_server \
+    --sigbackend python --fleettrace-export "127.0.0.1:$ft_fe" \
+    --runtime 120 --verbosity error > "$ft_dir/ra.json" &
+ft_pid_a=$!
+JAX_PLATFORMS=cpu GETHSHARDING_FLEETTRACE_INTERVAL_MS=50 \
+python -m gethsharding_tpu.rpc.chain_server \
+    --sigbackend python --fleettrace-export "127.0.0.1:$ft_fe" \
+    --runtime 120 --verbosity error > "$ft_dir/rb.json" &
+ft_pid_b=$!
+for _ in $(seq 1 100); do
+    [ -s "$ft_dir/ra.json" ] && [ -s "$ft_dir/rb.json" ] && break
+    sleep 0.2
+done
+ft_ra=$(python -c "import json; a = json.load(open('$ft_dir/ra.json')); \
+print('%s:%s' % (a['host'], a['port']))")
+ft_rb=$(python -c "import json; a = json.load(open('$ft_dir/rb.json')); \
+print('%s:%s' % (a['host'], a['port']))")
+JAX_PLATFORMS=cpu GETHSHARDING_FLEETTRACE_INTERVAL_MS=50 \
+GETHSHARDING_FLEETTRACE_SAMPLE=1.0 GETHSHARDING_FLEETTRACE_LINGER_S=0.4 \
+python -m gethsharding_tpu.fleet.frontend \
+    --port "$ft_fe" --fleettrace --replica "$ft_ra" --replica "$ft_rb" \
+    --runtime 120 --verbosity error > "$ft_dir/fe.json" &
+ft_pid_fe=$!
+for _ in $(seq 1 100); do
+    [ -s "$ft_dir/fe.json" ] && break
+    sleep 0.2
+done
+JAX_PLATFORMS=cpu GETHSHARDING_FLEETTRACE_INTERVAL_MS=50 \
+FT_DIR="$ft_dir" python - <<'PYEOF' || fail=1
+import json, os, time
+
+from gethsharding_tpu import fleettrace, tracing
+from gethsharding_tpu.crypto import bn256 as bls
+from gethsharding_tpu.rpc import codec
+from gethsharding_tpu.rpc.client import RPCClient
+
+addr = json.load(open(os.path.join(os.environ["FT_DIR"], "fe.json")))
+fleettrace.boot_exporter("%s:%s" % (addr["host"], addr["port"]),
+                         label="smoke-client")
+client = RPCClient(addr["host"], addr["port"], timeout=30.0)
+header = b"fleettrace-smoke"
+keys = [bls.bls_keygen(bytes([i + 1])) for i in range(2)]
+agg_sig = bls.bls_aggregate_sigs(
+    [bls.bls_sign(header, sk) for sk, _ in keys])
+agg_pk = bls.bls_aggregate_pks([pk for _, pk in keys])
+call_args = ([codec.enc_bytes(header)], [codec.enc_g1(agg_sig)],
+             [codec.enc_g2(agg_pk)], "interactive")
+assert client.call("shard_verifyAggregates", *call_args) == [True]
+with tracing.span("smoke/fleettrace_request") as probe:
+    assert client.call("shard_verifyAggregates", *call_args) == [True]
+trace_id = probe.trace_id
+fleettrace.EXPORTER.flush()
+exemplar = None
+deadline = time.monotonic() + 30.0
+while time.monotonic() < deadline and exemplar is None:
+    for ex in client.call("shard_traceExemplars", 32):
+        if ex["trace_id"] == trace_id:
+            exemplar = ex
+            break
+    if exemplar is None:
+        time.sleep(0.2)
+assert exemplar is not None, \
+    "the measured request never assembled into a retained trace"
+pids = {span.get("pid") for span in exemplar["spans"]} - {None}
+assert len(pids) >= 3, (
+    "assembled trace spans %d processes, want >= 3 "
+    "(client + frontend + replica): %s" % (len(pids), sorted(pids)))
+attr = client.call("shard_traceAttribution")
+assert attr["classes"].get("interactive"), attr["classes"]
+client.close()
+fleettrace.shutdown()
+print("fleettrace smoke OK: one trace,", len(exemplar["spans"]),
+      "spans across", len(pids), "processes")
+PYEOF
+kill "$ft_pid_fe" "$ft_pid_a" "$ft_pid_b" 2>/dev/null
+wait "$ft_pid_fe" "$ft_pid_a" "$ft_pid_b" 2>/dev/null
+rm -rf "$ft_dir"
 
 # -- perfwatch smoke: the CPU-quick micro suite + the noise-aware
 # regression gate, closed loop — seed a FRESH ledger with clean runs,

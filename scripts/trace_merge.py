@@ -25,6 +25,24 @@ Usage::
 
 Files written by older exports (no ``otherData`` anchor) merge with a
 zero offset and a warning — lanes appear, alignment is best-effort.
+
+Cross-host merging: the ``clock_offset_us`` anchor maps each process's
+monotonic clock onto *its own host's* wall clock, so dumps from two
+hosts still disagree by the inter-host wall-clock skew. The fleettrace
+export plane measures exactly that number per connection — the
+``shard_traceHandshake`` NTP-midpoint exchange — and reports it as
+``skew_us`` on each exporter's stats (``/status`` →
+``fleettrace.export.skew_us`` on the exporting process). Feed it back
+here with a per-file ``--skew-us`` override, one value per input in
+order (missing trailing values default to 0)::
+
+    python scripts/trace_merge.py frontend.json replicaA.json \
+        replicaB.json --skew-us 0 --skew-us 1250 --skew-us -840 \
+        -o merged.json
+
+where 1250/-840 are the handshake-measured skews of replica A/B's
+hosts relative to the frontend host. Same-host merges need no
+override — the anchors already agree.
 """
 
 from __future__ import annotations
@@ -35,19 +53,24 @@ import sys
 from typing import List
 
 
-def merge_traces(payloads: List[dict]) -> dict:
+def merge_traces(payloads: List[dict],
+                 skews_us: List[float] = None) -> dict:
     """Merge loaded Chrome-trace payloads (the testable core).
 
     Timestamps are rebased to wall microseconds via each payload's
-    ``otherData.clock_offset_us``, then shifted so the merged origin is
-    the earliest event (Perfetto renders small positive timestamps
-    better than epoch-sized ones)."""
+    ``otherData.clock_offset_us`` plus an optional per-payload
+    ``skews_us[i]`` (handshake-measured inter-host skew), then shifted
+    so the merged origin is the earliest event (Perfetto renders small
+    positive timestamps better than epoch-sized ones)."""
     merged: List[dict] = []
     used_pids: dict = {}
     rebased: List[tuple] = []
+    skews_us = list(skews_us or [])
     for i, payload in enumerate(payloads):
         other = payload.get("otherData", {}) or {}
         offset = float(other.get("clock_offset_us", 0.0))
+        if i < len(skews_us):
+            offset += float(skews_us[i])
         if "clock_offset_us" not in other:
             print(f"warning: input {i} has no clock anchor; merging "
                   f"with zero offset (lanes align only within it)",
@@ -90,12 +113,23 @@ def main(argv=None) -> int:
     parser.add_argument("inputs", nargs="+",
                         help="per-process Chrome trace JSON files")
     parser.add_argument("-o", "--out", default="merged_trace.json")
+    parser.add_argument("--skew-us", action="append", type=float,
+                        default=[], metavar="US",
+                        help="per-input wall-clock skew override in "
+                             "microseconds, repeatable, matched to "
+                             "INPUTS in order (missing trailing values "
+                             "= 0); use the handshake-measured skew_us "
+                             "from the exporting process's /status "
+                             "fleettrace.export section when merging "
+                             "dumps from different hosts")
     args = parser.parse_args(argv)
+    if len(args.skew_us) > len(args.inputs):
+        parser.error("more --skew-us values than inputs")
     payloads = []
     for path in args.inputs:
         with open(path) as fh:
             payloads.append(json.load(fh))
-    merged = merge_traces(payloads)
+    merged = merge_traces(payloads, skews_us=args.skew_us)
     with open(args.out, "w") as fh:
         json.dump(merged, fh)
     spans = sum(1 for e in merged["traceEvents"] if e.get("ph") == "X")

@@ -494,6 +494,69 @@ def test_hedge_storm_latches_and_lands_in_the_flight_recorder():
         router.close()
 
 
+def test_hedged_spans_link_one_trace_and_attribute_wasted_work(monkeypatch):
+    """Winner and loser of a hedged pair are linked on ONE logical
+    trace: both fleet/attempt spans parent under the route span, the
+    loser's discard records a fleet/hedge_wasted span (replica +
+    winner + wasted tags) with the SAME trace id, the hedge flags that
+    trace for the fleet collector's tail retention, and the critical-
+    path analyzer reports the duplicate as the hedge_wasted segment
+    OUTSIDE the wall-time identity."""
+    from gethsharding_tpu import fleettrace, tracing
+    from gethsharding_tpu.fleettrace.critical_path import attribute
+
+    registry = _registry()
+    tracing.enable(ring_spans=16384)
+    tracing.TRACER.clear()
+    collector = fleettrace.TraceCollector(registry, sample=0.0)
+    monkeypatch.setattr(fleettrace, "COLLECTOR", collector)
+    router, r0, r1 = _slow_fast_fleet(registry)
+    (digest, sig, want), = _ecdsa_cases(1, tag=b"link")
+    key = _r0_key(router)
+    try:
+        assert router.call("ecrecover_addresses", [digest], [sig],
+                           affinity=key) == [want]
+        deadline = time.monotonic() + 3
+        while router.hedge_stats()["wasted"] < 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)  # the loser's discard records the span
+        assert router.hedge_stats()["wasted"] == 1
+        spans = tracing.TRACER.recent_spans()
+        route = next(s for s in spans if s["name"] == "fleet/route")
+        trace = [s for s in spans if s["trace"] == route["trace"]]
+        attempts = [s for s in trace if s["name"] == "fleet/attempt"]
+        # primary + hedge, both under the route span, one trace id
+        assert len(attempts) == 2, [s["name"] for s in trace]
+        assert {a["tags"]["replica"] for a in attempts} == {"r0", "r1"}
+        assert {a["tags"]["hedged"] for a in attempts} == {False, True}
+        assert all(a["parent"] == route["span"] for a in attempts)
+        wasted = next(s for s in trace
+                      if s["name"] == "fleet/hedge_wasted")
+        assert wasted["parent"] == route["span"]
+        assert wasted["tags"]["replica"] == "r0"
+        assert wasted["tags"]["winner"] == "r1"
+        assert wasted["tags"]["wasted"] is True
+        # winner linkage is tagged on the logical request's span
+        assert route["tags"]["hedge_winner"] == "r1"
+        # ... and the hedge flagged the trace for tail retention (the
+        # spans have not reached this collector, so the mark is staged)
+        assert collector._marks.get(route["trace"]) == "hedged"
+        # attribution: the duplicate is its own segment, outside the
+        # telescoping identity (it ran CONCURRENTLY, it is not wall
+        # time), and the tree walk reaches every span
+        attr = attribute(trace)
+        assert attr["root"] == "fleet/route"
+        assert attr["orphan_spans"] == 0
+        assert "hedge_wasted" not in attr["segments"]
+        # the loser sat out the ~0.4 s transport delay after the ~30 ms
+        # hedge verdict: its discarded interval dwarfs the route span
+        assert attr["hedge_wasted_s"] > attr["total_s"]
+    finally:
+        router.close()
+        tracing.TRACER.clear()
+        tracing.disable()
+
+
 # == WFQ: tenant fairness inside a class ====================================
 
 
