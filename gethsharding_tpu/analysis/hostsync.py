@@ -3,8 +3,9 @@
 A `.item()`, `np.asarray(device_array)`, `jax.device_get` or
 `.block_until_ready()` is a synchronous device round trip: the calling
 thread stalls until the device drains. The architecture confines those
-pulls to the designated marshal/finalize stages (`sigbackend.py`, the
-kernel modules under `ops/`, the mesh code under `parallel/`, and the
+pulls to the designated marshal/finalize stages (the `sigbackend/`
+package, the kernel modules under `ops/`, the mesh code under
+`parallel/`, and the
 DAS proof marshaller) — everywhere else a pull on the hot path silently
 serializes dispatch against device execution (the exact failure mode
 PR 3's staging split was built to remove).
@@ -30,7 +31,7 @@ RULE = "host-sync"
 
 # rel-path prefixes (or exact files) where pulls are the job
 ALLOWED_ZONES = (
-    "gethsharding_tpu/sigbackend.py",
+    "gethsharding_tpu/sigbackend/",
     "gethsharding_tpu/ops/",
     "gethsharding_tpu/parallel/",
     "gethsharding_tpu/das/proofs.py",

@@ -1,12 +1,16 @@
 """layering: the package DAG is declared in layers.json and enforced.
 
-ROADMAP item 1 will split the ~2.5k-line ``sigbackend.py`` into
-marshal / device-layout / dispatch / cache modules; without a declared
-dependency structure that refactor (and every PR after it) can quietly
-re-tangle the tree — a serving module importing ``node``, the analysis
-package growing a runtime dependency, ``sigbackend`` importing the
-serving tier at module scope and recreating the import cycle the lazy
-registry factory exists to avoid.
+ROADMAP item 1 split the ~1.2k-line ``sigbackend.py`` into the
+``sigbackend/`` package (marshal / layout / dispatch / cache); without
+a declared dependency structure that refactor (and every PR after it)
+can quietly re-tangle the tree — a serving module importing ``node``,
+the analysis package growing a runtime dependency, ``sigbackend``
+importing the serving tier at module scope and recreating the import
+cycle the lazy registry factory exists to avoid. Units that split into
+packages additionally declare their INTRA-package DAG (the
+``internal`` block): the same two-list contract, one level down, so
+``marshal`` staying the bottom of ``sigbackend`` is enforced, not
+hoped.
 
 ``analysis/layers.json`` is the committed contract: for every
 top-level unit of ``gethsharding_tpu`` (a subpackage, or a single
@@ -28,7 +32,12 @@ Checks, both directions (the flag-doc shape):
   must not accumulate dead permissions);
 - hard bans are structural, not just declarative: ``analysis`` may
   import NO runtime unit in either list, and no unit but the
-  composition roots (``node``, ``cli``) may import ``node``.
+  composition roots (``node``, ``cli``) may import ``node``;
+- units with an ``internal`` block get the same checks one level down
+  (``internal-undeclared-import``/``-lazy``, ``internal-stale``/
+  ``-stale-lazy``), plus: the declared module-scope internal DAG must
+  be acyclic (``internal-cycle``) and every declared submodule must
+  exist (``internal-unknown-module``).
 
 Import facts come from the corpus's parsed ASTs (the same import-alias
 machinery every other rule uses), so string-built importlib calls are
@@ -107,6 +116,150 @@ def collect_import_edges(corpus: Corpus):
                 dest = top if id(node) in toplevel else lazy
                 dest.setdefault((unit, target), (sf.rel, node.lineno))
     return top, lazy
+
+
+def collect_internal_edges(corpus: Corpus, unit: str):
+    """((sub, target) -> first (rel, line)) for module-scope and
+    function-scope imports BETWEEN submodules of one packaged unit.
+    Submodule names are file stems (``__init__`` for the package
+    root); `from gethsharding_tpu.<unit> import X` resolves to the
+    submodule when X is one, else to ``__init__``."""
+    prefix = f"{PACKAGE}/{unit}/"
+    subs = {sf.rel[len(prefix):-3]
+            for sf in corpus.files
+            if sf.rel.startswith(prefix) and sf.rel.endswith(".py")
+            and "/" not in sf.rel[len(prefix):]}
+    top: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    lazy: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    unit_mod = f"{PACKAGE}.{unit}"
+
+    for sf in corpus.files:
+        if sf.tree is None or not sf.rel.startswith(prefix):
+            continue
+        sub = sf.rel[len(prefix):-3]
+        if "/" in sub:
+            continue
+        toplevel = {id(n) for n in sf.tree.body}
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, (ast.Import, ast.ImportFrom)):
+                continue
+            targets: List[str] = []
+            if isinstance(node, ast.Import):
+                targets = [alias.name.split(".")[2]
+                           for alias in node.names
+                           if alias.name.startswith(unit_mod + ".")]
+            else:
+                if node.level:
+                    base = sf.rel.rsplit("/", 1)[0].replace("/", ".")
+                    for _ in range(max(node.level - 1, 0)):
+                        base = base.rsplit(".", 1)[0]
+                    module = (f"{base}.{node.module}" if node.module
+                              else base)
+                else:
+                    module = node.module or ""
+                if module == unit_mod:
+                    # names may be submodules (edge to them) or
+                    # attributes of the package root (edge to __init__)
+                    targets = [alias.name if alias.name in subs
+                               else "__init__"
+                               for alias in node.names]
+                elif module.startswith(unit_mod + "."):
+                    targets = [module.split(".")[2]]
+            for target in targets:
+                if target == sub:
+                    continue
+                dest = top if id(node) in toplevel else lazy
+                dest.setdefault((sub, target), (sf.rel, node.lineno))
+    return top, lazy, subs
+
+
+def _internal_findings(corpus: Corpus, unit: str,
+                       internal: dict) -> List[Finding]:
+    """The two-list contract one level down, for a unit that split into
+    a package: undeclared/stale in both directions, declared-DAG
+    acyclicity, and no phantom submodules."""
+    findings: List[Finding] = []
+    top, lazy, subs = collect_internal_edges(corpus, unit)
+
+    def allowed(sub: str, kind: str) -> Set[str]:
+        entry = internal.get(sub)
+        if entry is None:
+            return set()
+        if kind == "imports":
+            return set(entry.get("imports", ()))
+        return set(entry.get("imports", ())) | set(entry.get("lazy", ()))
+
+    for (sub, target), (rel, line) in sorted(top.items()):
+        if target not in allowed(sub, "imports"):
+            hint = " (declared lazy-only: move the import into the " \
+                   "function that needs it)" \
+                if target in allowed(sub, "lazy") else ""
+            findings.append(Finding(
+                RULE, rel, line,
+                f"module-scope intra-package import `{unit}/{sub} -> "
+                f"{target}` is not in layers.json's "
+                f"`{unit}.internal.{sub}.imports`{hint}",
+                f"internal-undeclared-import:{unit}/{sub}->{target}"))
+    for (sub, target), (rel, line) in sorted(lazy.items()):
+        if target not in allowed(sub, "lazy"):
+            findings.append(Finding(
+                RULE, rel, line,
+                f"function-scope intra-package import `{unit}/{sub} -> "
+                f"{target}` is declared nowhere in "
+                f"`{unit}.internal.{sub}`",
+                f"internal-undeclared-lazy:{unit}/{sub}->{target}"))
+
+    for sub, entry in sorted(internal.items()):
+        if sub not in subs:
+            findings.append(Finding(
+                RULE, LAYERS_REL, 0,
+                f"layers.json declares submodule `{unit}.{sub}` but "
+                f"`{PACKAGE}/{unit}/{sub}.py` does not exist",
+                f"internal-unknown-module:{unit}/{sub}"))
+            continue
+        for target in sorted(entry.get("imports", ())):
+            if (sub, target) not in top:
+                findings.append(Finding(
+                    RULE, LAYERS_REL, 0,
+                    f"layers.json allows `{unit}/{sub} -> {target}` at "
+                    f"module scope but no such import exists — stale "
+                    f"edge",
+                    f"internal-stale:{unit}/{sub}->{target}"))
+        for target in sorted(entry.get("lazy", ())):
+            if (sub, target) not in lazy:
+                findings.append(Finding(
+                    RULE, LAYERS_REL, 0,
+                    f"layers.json allows lazy `{unit}/{sub} -> "
+                    f"{target}` but no function-scope import exists — "
+                    f"stale edge",
+                    f"internal-stale-lazy:{unit}/{sub}->{target}"))
+
+    # the declared MODULE-SCOPE internal DAG must be acyclic: the lazy
+    # list is the sanctioned cycle-breaking idiom, the eager list is
+    # the real import graph and a cycle there deadlocks at import time
+    graph = {sub: set(entry.get("imports", ()))
+             for sub, entry in internal.items()}
+    state: Dict[str, int] = {}  # 1 = on stack, 2 = done
+
+    def visit(sub: str, path: List[str]) -> None:
+        state[sub] = 1
+        for target in sorted(graph.get(sub, ())):
+            if state.get(target) == 1:
+                cycle = path[path.index(target):] + [target] \
+                    if target in path else [sub, target]
+                findings.append(Finding(
+                    RULE, LAYERS_REL, 0,
+                    f"declared internal DAG of `{unit}` has a "
+                    f"module-scope cycle: {' -> '.join(cycle)}",
+                    f"internal-cycle:{unit}:{'->'.join(cycle)}"))
+            elif state.get(target) != 2:
+                visit(target, path + [target])
+        state[sub] = 2
+
+    for sub in sorted(graph):
+        if state.get(sub) != 2:
+            visit(sub, [sub])
+    return findings
 
 
 @rule(RULE, "cross-package imports match the DAG declared in "
@@ -191,6 +344,13 @@ def check(corpus: Corpus) -> List[Finding]:
                     f"layers.json allows lazy `{unit} -> {target}` but "
                     f"no function-scope import exists — stale edge",
                     f"stale-lazy:{unit}->{target}"))
+
+    # packaged units opt into the intra-package DAG with an `internal`
+    # block — same contract, one level down
+    for unit, entry in sorted(declared.items()):
+        if "internal" in entry:
+            findings.extend(
+                _internal_findings(corpus, unit, entry["internal"]))
 
     # structural bans, enforced over the DECLARATION so weakening the
     # file is itself a finding

@@ -66,6 +66,13 @@ def build_parser() -> argparse.ArgumentParser:
                                "failover-* puts the chosen backend behind "
                                "a circuit breaker over the scalar fallback "
                                "(gethsharding_tpu/resilience)")
+    sharding.add_argument("--mesh-devices", type=int, default=None,
+                          help="lay the jax sigbackend over an N-device "
+                               "1-D shard mesh: committee audits run as "
+                               "one pjit'd step with the vote-total "
+                               "allreduce as the only cross-device "
+                               "traffic (sets GETHSHARDING_MESH_DEVICES; "
+                               "1 = single device, the default)")
     sharding.add_argument("--serving", action="store_true",
                           help="run signature verification through the "
                                "micro-batching serving tier: concurrent "
@@ -407,6 +414,10 @@ def run_cli(argv: Optional[List[str]] = None) -> int:
 
 
 def run_sharding_node(args) -> int:
+    if args.mesh_devices is not None:
+        # the backend registry reads the env var at build time, so the
+        # flag must land before any get_backend("jax") in this process
+        os.environ["GETHSHARDING_MESH_DEVICES"] = str(args.mesh_devices)
     config = Config(period_length=args.periodlength,
                     windback_depth=args.windback)
     hub = None

@@ -22,6 +22,47 @@ from typing import Optional
 
 _COUNT_RE = re.compile(r"--xla_force_host_platform_device_count=(\d+)")
 
+# The ONE known-benign stderr class of a virtual-mesh dryrun child:
+# XLA:CPU's AOT loader logs E-severity machine-feature mismatch lines
+# (cpu_aot_loader.cc) when a persistent-cache executable was compiled
+# on a host with ISA features the executing host lacks ("Target
+# machine feature +prefer-no-gather is not supported ... could lead to
+# execution errors such as SIGILL"). Observed in every MULTICHIP_r0x
+# tail WITH rc=0 and bit-identical outputs: the loader recompiles/
+# falls back safely, so the lines are WARN-ONLY — they must never fail
+# a dryrun, and they must never excuse a real failure (rc != 0 fails
+# regardless of what the tail says).
+AOT_MISMATCH_MARKERS = (
+    "cpu_aot_loader",
+    "machine type used for xla:cpu compilation doesn't match",
+    "target machine feature",
+    "could lead to execution errors such as sigill",
+)
+
+
+def is_aot_mismatch_line(line: str) -> bool:
+    """True when a stderr line belongs to the XLA:CPU AOT
+    machine-feature mismatch class (see `AOT_MISMATCH_MARKERS`)."""
+    low = line.lower()
+    return any(marker in low for marker in AOT_MISMATCH_MARKERS)
+
+
+def assert_aot_warn_only(rc: int, tail: str):
+    """The dryrun child verdict: rc decides, the AOT mismatch lines in
+    the captured tail are classified as warn-only noise. Returns the
+    matched lines on success; raises ``RuntimeError`` on rc != 0 —
+    explicitly even when mismatch lines are present, so the benign
+    class can never mask a real crash (e.g. an actual SIGILL exits
+    nonzero and fails here with the tail attached)."""
+    matched = [line for line in tail.splitlines()
+               if is_aot_mismatch_line(line)]
+    if rc != 0:
+        raise RuntimeError(
+            f"virtual-mesh dryrun child failed (rc={rc}); the AOT "
+            f"machine-feature mismatch warning is warn-only and never "
+            f"excuses a failure. stderr tail:\n{tail[-4000:]}")
+    return matched
+
 
 def host_fingerprint() -> str:
     """Short stable id of THIS machine's CPU capabilities. The persistent

@@ -56,6 +56,11 @@ def main(argv=None) -> int:
                              "state on shard_health so a fleet router "
                              "(gethsharding_tpu/fleet/) drains a tripped "
                              "replica")
+    parser.add_argument("--mesh-devices", type=int, default=None,
+                        help="lay the jax sigbackend over an N-device "
+                             "1-D shard mesh (sets GETHSHARDING_MESH_"
+                             "DEVICES before the backend is built; "
+                             "1 = single device, the default)")
     parser.add_argument("--serving-watchdog-s", type=float, default=0.0,
                         help="dispatch watchdog deadline for the serving "
                              "tier (0 = off): a wedged device call fails "
@@ -133,6 +138,10 @@ def main(argv=None) -> int:
     from gethsharding_tpu.serving import ServingConfig, ServingSigBackend
     from gethsharding_tpu.sigbackend import get_backend
 
+    if args.mesh_devices is not None:
+        # the jax factory reads the env var at build time, so the flag
+        # must land before the first get_backend("jax") in this process
+        os.environ["GETHSHARDING_MESH_DEVICES"] = str(args.mesh_devices)
     failover = args.sigbackend.startswith("failover-")
     inner_name = (args.sigbackend[len("failover-"):] if failover
                   else args.sigbackend)

@@ -1,0 +1,740 @@
+"""Device dispatch: jit/pjit launch, DeviceTimer, wire ledger.
+
+`JaxSigBackend` — the batched accelerator backend — composes the other
+three submodules: `marshal` builds the host limb planes, `layout`
+decides where they land (single device, or the 1-D shard mesh), and
+`cache` (mixed in) keeps the recurring pk planes device-resident.
+This module owns what remains: the jitted kernels, the compile-cache
+bookkeeping (`_note_shape` + `compile_span`), the `DeviceTimer`
+attribution of every dispatch, and the per-dispatch wire ledger.
+
+The mesh committee path (`_committee_submit_mesh`) is the tentpole:
+the whole period audit runs as ONE pjit'd step — a `shard_map` whose
+only cross-device traffic is the vote-total `psum` (asserted per
+compiled executable via `layout.count_collectives` over the AOT HLO).
+Everything else — verdict plane, pk planes, fresh-per-period planes —
+stays strictly device-local under `NamedSharding(P('shard'))`.
+
+Never import this module eagerly: `sigbackend/__init__` exposes
+`JaxSigBackend` lazily (PEP 562) so CPU-only control planes never
+initialize an accelerator backend.
+"""
+
+from __future__ import annotations
+
+import os
+
+from gethsharding_tpu import metrics, tracing
+from gethsharding_tpu.crypto import bn256 as bls
+from gethsharding_tpu.crypto import secp256k1 as ecdsa
+# DeviceTimer is THE timing primitive of every dispatch path below: it
+# forces a real device->host pull (block_until_ready can silently no-op
+# under the tunnel plugin — the r4 hazard), self-checks block-vs-pull
+# divergence into `perfwatch/timer_suspect`, and feeds the
+# sig/{marshal_time,device_time} rollups; RECORDER keeps the last-N
+# dispatch wire ledgers for the flight recorder's post-mortem bundles
+from gethsharding_tpu.perfwatch import RECORDER, DeviceTimer
+from gethsharding_tpu.sigbackend import SigBackend, VerdictFuture
+from gethsharding_tpu.sigbackend import layout as layout_mod
+from gethsharding_tpu.sigbackend import marshal
+from gethsharding_tpu.sigbackend.cache import ResidentPkCache
+from gethsharding_tpu.sigbackend.marshal import bucket_size
+
+
+class JaxSigBackend(ResidentPkCache, SigBackend):
+    """Batched accelerator kernels; one dispatch per batch."""
+
+    name = "jax"
+
+    def __init__(self, mesh_devices=None):
+        import jax  # lazy: only sig-verifying processes touch the backend
+        import jax.numpy as jnp
+
+        from gethsharding_tpu.ops import bn256_jax, secp256k1_jax
+
+        self._jax = jax
+        self._jnp = jnp
+        self._bn = bn256_jax
+        self._sec = secp256k1_jax
+        self._recover = jax.jit(secp256k1_jax.ecrecover_batch)
+        self._bls = jax.jit(bn256_jax.bls_verify_aggregate_batch)
+        self._bls_committee = jax.jit(
+            bn256_jax.bls_aggregate_verify_committee_batch)
+        # GETHSHARDING_TPU_WIRE=u16: ship limb planes over the
+        # host->device link as uint16 (12-bit limbs waste 20 of 32 bits;
+        # halves the audit's transfer bytes over the tunnel) and widen
+        # to int32 ON DEVICE before the kernel — value-identical, the
+        # wire format never reaches the arithmetic
+        self._wire_u16 = os.environ.get("GETHSHARDING_TPU_WIRE") == "u16"
+        self._wire = "u16" if self._wire_u16 else "i32"
+
+        def _committee_u16(hx, hy, sx, sy, sm, px, py, pm, hok):
+            i32 = jnp.int32
+            return bn256_jax.bls_aggregate_verify_committee_batch(
+                hx.astype(i32), hy.astype(i32), sx.astype(i32),
+                sy.astype(i32), sm, px.astype(i32), py.astype(i32),
+                pm, hok)
+
+        self._bls_committee_u16 = jax.jit(_committee_u16)
+        # the backend is a process-wide singleton shared by every actor
+        # thread (get_backend caches instances): all cache structures
+        # are lock-guarded (cache.py)
+        self._init_pk_caches()
+        self._m_wire_bytes = metrics.counter("jax/wire/bytes")
+        self._m_pk_hit_bytes = metrics.counter("jax/wire/pk_device_hit_bytes")
+        # device-time attribution rollups (sig/{marshal_time,
+        # device_time}) are fed by the perfwatch DeviceTimer each
+        # dispatch path below constructs — one timing scheme, with the
+        # block-vs-pull self-check built in
+        # compile-cache visibility: jax.jit compiles once per argument
+        # SHAPE, and every padded bucket this process has not dispatched
+        # before is a fresh XLA compile (seconds to minutes). Tracking
+        # (op, bucket-shape) first-sightings makes recompile storms —
+        # e.g. unbucketed traffic widening the shape set — visible as
+        # counters and span tags instead of mystery latency spikes.
+        import threading
+
+        self._shape_seen: set = set()
+        self._shape_lock = threading.Lock()
+        self._m_shape_hit = metrics.counter("jax/compile_cache/hits")
+        self._m_shape_miss = metrics.counter("jax/compile_cache/misses")
+        from gethsharding_tpu import devscope
+
+        self._compiles = devscope.COMPILES
+        # THE layout decision: single-device unless the constructor or
+        # GETHSHARDING_MESH_DEVICES asks for a mesh. Everything below
+        # branches on `self._layout.is_mesh`, nothing else.
+        self._layout = layout_mod.DeviceLayout(
+            layout_mod.mesh_devices_requested(mesh_devices))
+        if self._layout.is_mesh:
+            # per-device cache shards + their devscope census owners
+            self._init_mesh_shards(self._layout)
+            # AOT executables per (bucket, width, wire) — lowering once
+            # through .lower().compile() yields BOTH the executable and
+            # its HLO text, so the one-collective transfer-ledger check
+            # costs no second compilation
+            self._mesh_exec: dict = {}
+            self._mesh_collectives: dict = {}
+            shard_map = layout_mod.get_shard_map()
+            from jax.sharding import PartitionSpec
+
+            mesh = self._layout.mesh
+            spec = self._layout.shard_spec()
+            axis_names = mesh.axis_names
+
+            def _mesh_step(hx, hy, sx, sy, sm, px, py, pm, hok):
+                # the ONE pjit'd audit step: each device verifies its
+                # slab of committees (astype is a no-op on the i32
+                # wire), then the vote total — the ONLY cross-device
+                # value — is psum'd. Everything else stays local.
+                i32 = jnp.int32
+                ok = bn256_jax.bls_aggregate_verify_committee_batch(
+                    hx.astype(i32), hy.astype(i32), sx.astype(i32),
+                    sy.astype(i32), sm, px.astype(i32),
+                    py.astype(i32), pm, hok)
+                votes = jax.lax.psum(jnp.sum(ok.astype(i32)), axis_names)
+                return ok, votes
+
+            self._bls_committee_mesh = jax.jit(shard_map(
+                _mesh_step, mesh=mesh, in_specs=(spec,) * 9,
+                out_specs=(spec, PartitionSpec())))
+        # device-memory attribution: the resident pk-plane LRU (and on
+        # mesh layouts each per-device shard) registers as a devscope
+        # census owner — cache.py holds the weakref plumbing
+        self._register_census_owner()
+
+    def _note_shape(self, op: str, *shape) -> bool:
+        """Count a dispatch against the per-shape compile cache; True
+        when this (op, shape) is NEW to the process (an XLA compile).
+        Fresh sightings also feed the devscope recompile-storm window
+        (compilewatch.py) — hits cost one extra early-returning call."""
+        key = (op,) + shape
+        with self._shape_lock:
+            fresh = key not in self._shape_seen
+            if fresh:
+                self._shape_seen.add(key)
+        (self._m_shape_miss if fresh else self._m_shape_hit).inc()
+        compiles = getattr(self, "_compiles", None)
+        if compiles is None:
+            # partially-built instances (tests stub the tracking state
+            # via __new__) self-heal onto the process watch; idempotent
+            from gethsharding_tpu import devscope
+
+            compiles = self._compiles = devscope.COMPILES
+        compiles.saw(op, shape, fresh)
+        return fresh
+
+    # the module-level bucket_size, kept as a staticmethod so kernel
+    # call sites read as "this backend's padding policy"
+    _bucket = staticmethod(bucket_size)
+
+    def ecrecover_addresses(self, digests, sigs65):
+        import numpy as np
+
+        jnp = self._jnp
+        n = len(digests)
+        if n == 0:
+            return []
+        dt = DeviceTimer("ecrecover")
+        sigs, valid, host_rows = [], [], []
+        for i, sig in enumerate(sigs65):
+            sig = bytes(sig)
+            if len(sig) == 65 and sig[64] in (0, 1):
+                sigs.append(ecdsa.Signature.from_bytes65(sig))
+                valid.append(True)
+            else:
+                if len(sig) == 65 and sig[64] in (2, 3):
+                    # rare r+n overflow recids: scalar host fallback keeps
+                    # exact RecoverPubkey parity
+                    host_rows.append(i)
+                sigs.append(ecdsa.Signature(r=1, s=1, v=0))  # placeholder
+                valid.append(False)
+        bucket = self._bucket(n)
+        fresh = self._note_shape("ecrecover", bucket)
+        pad = bucket - n
+        sigs.extend([ecdsa.Signature(r=1, s=1, v=0)] * pad)
+        valid.extend([False] * pad)
+        e = self._sec.hashes_to_limbs(
+            [bytes(d) for d in digests] + [b"\x00" * 32] * pad)
+        r, s, v = self._sec.sigs_to_limbs(sigs)
+        tracer = tracing.TRACER
+        dt.dispatched()
+        # compile_span: a fresh shape's launch wall (trace + XLA compile
+        # + enqueue) lands in the devscope compile ledger; on hits this
+        # is one branch
+        with self._compiles.compile_span("ecrecover", (bucket,), fresh):
+            qx, qy, ok = self._recover(
+                jnp.asarray(e), jnp.asarray(r), jnp.asarray(s),
+                jnp.asarray(v), jnp.asarray(np.asarray(valid)))
+        # the checked pull on `ok` is the dispatch barrier (block-vs-pull
+        # self-checked); limbs_to_pubkeys then pulls the sibling buffers
+        # of the SAME computation, so the device phase closes only after
+        # the dispatch has actually executed and materialized. The host
+        # `ok` is passed through — pulling it twice would add a second
+        # device->host round trip per dispatch.
+        ok_host = dt.pull(ok)
+        pubs = self._sec.limbs_to_pubkeys(qx, qy, ok_host)[:n]
+        dt.done()
+        if tracer.enabled:
+            tracer.record("jax/ecrecover_dispatch", dt.t_dispatch, dt.t_done,
+                          tags={"rows": n, "bucket": bucket,
+                                "compile": "miss" if fresh else "hit",
+                                "suspect": dt.suspect,
+                                "marshal_ms": round(dt.marshal_s * 1e3, 3),
+                                "device_ms": round(dt.device_s * 1e3, 3)})
+        out = [ecdsa.pubkey_to_address(p) if p is not None else None
+               for p in pubs]
+        for i in host_rows:
+            try:
+                out[i] = ecdsa.ecrecover_address(
+                    bytes(digests[i]),
+                    ecdsa.Signature.from_bytes65(bytes(sigs65[i])))
+            except (ValueError, AssertionError):
+                out[i] = None
+        return out
+
+    def bls_verify_aggregates(self, messages, agg_sigs, agg_pks):
+        jnp = self._jnp
+        n = len(messages)
+        if n == 0:
+            return []
+        dt = DeviceTimer("bls_aggregate")
+        bucket = self._bucket(n)
+        fresh = self._note_shape("bls_aggregate", bucket)
+        pad = bucket - n
+        hashes = [bls.hash_to_g1(bytes(m)) for m in messages] + [None] * pad
+        hx, hy, hok = self._bn.g1_to_limbs(hashes)
+        sx, sy, sok = self._bn.g1_to_limbs(list(agg_sigs) + [None] * pad)
+        pkx, pky, pok = self._bn.g2_to_limbs(list(agg_pks) + [None] * pad)
+        # infinity signature/key is an outright rejection (scalar parity)
+        valid = hok & sok & pok
+        tracer = tracing.TRACER
+        dt.dispatched()
+        with self._compiles.compile_span("bls_aggregate", (bucket,), fresh):
+            out = self._bls(
+                jnp.asarray(hx), jnp.asarray(hy), jnp.asarray(sx),
+                jnp.asarray(sy), jnp.asarray(pkx), jnp.asarray(pky),
+                jnp.asarray(valid))
+        res = [bool(b) for b in dt.pull(out)[:n]]
+        dt.done()
+        if tracer.enabled:
+            tracer.record("jax/bls_aggregate_dispatch", dt.t_dispatch,
+                          dt.t_done,
+                          tags={"rows": n, "bucket": bucket,
+                                "compile": "miss" if fresh else "hit",
+                                "suspect": dt.suspect,
+                                "marshal_ms": round(dt.marshal_s * 1e3, 3),
+                                "device_ms": round(dt.device_s * 1e3, 3)})
+        return res
+
+    def bls_verify_committees(self, messages, sig_rows, pk_rows,
+                              pk_row_keys=None):
+        return self._committee_submit(messages, sig_rows, pk_rows,
+                                      pk_row_keys).result()
+
+    def bls_verify_committees_async(self, messages, sig_rows, pk_rows,
+                                    pk_row_keys=None):
+        """Stage + launch the dispatch NOW; the device executes while
+        the caller marshals the next period. `result()` is the host
+        pull."""
+        return self._committee_submit(messages, sig_rows, pk_rows,
+                                      pk_row_keys)
+
+    def das_verify_samples(self, chunks, indices, proofs, roots):
+        """One batched keccak dispatch for the whole sample batch: BMT
+        recompute of every chunk (128 leaf lanes + 7 pair levels) +
+        path fold, `vmap`-shaped over samples × shards. Verdicts are
+        bit-identical to the scalar reference because every malformed-
+        row rejection is folded into the `valid` plane at marshal time
+        (das/proofs.marshal_samples)."""
+        from gethsharding_tpu.das import proofs as das_proofs
+
+        jnp = self._jnp
+        n = len(chunks)
+        if n == 0:
+            self.last_wire = None
+            return []
+        dt = DeviceTimer("das_verify")
+        bucket = self._bucket(n)
+        fresh = self._note_shape("das_verify", bucket)
+        st = das_proofs.marshal_samples(chunks, indices, proofs, roots,
+                                        bucket)
+        planes = (st["chunks"], st["sibs"], st["bits"], st["levels"],
+                  st["roots"], st["valid"])
+        sample_bytes = sum(int(p.nbytes) for p in planes)
+        # the per-dispatch wire ledger (same contract as the committee
+        # path: pure nbytes arithmetic, no device sync) — the sample
+        # planes ARE this dispatch's host->device bytes
+        self.last_wire = {"op": "das_verify_samples",
+                          "wire_bytes": sample_bytes,
+                          "sample_wire_bytes": sample_bytes,
+                          "rows": n, "bucket": bucket, "wire": self._wire}
+        RECORDER.record_wire("das_verify_samples", self.last_wire)
+        self._m_wire_bytes.inc(sample_bytes)
+        tracing.tag_current_add(wire_bytes=sample_bytes,
+                                sample_wire_bytes=sample_bytes)
+        tracer = tracing.TRACER
+        dt.dispatched()
+        with self._compiles.compile_span("das_verify", (bucket,), fresh):
+            out = das_proofs.batch_verifier()(
+                *(jnp.asarray(p) for p in planes))
+        res = [bool(b) for b in dt.pull(out)[:n]]
+        dt.done()
+        if tracer.enabled:
+            tracer.record("jax/das_verify_dispatch", dt.t_dispatch,
+                          dt.t_done,
+                          tags={"rows": n, "bucket": bucket,
+                                "compile": "miss" if fresh else "hit",
+                                "sample_wire_bytes": sample_bytes,
+                                "suspect": dt.suspect,
+                                "marshal_ms": round(dt.marshal_s * 1e3, 3),
+                                "device_ms": round(dt.device_s * 1e3, 3)})
+        return res
+
+    def das_verify_multiproofs(self, commitments, index_rows, eval_rows,
+                               proofs, ns):
+        """One batched two-pair pairing dispatch for the whole
+        multiproof batch: per row the host folds the interpolation and
+        vanishing MSMs into (A, π, Z) limb planes
+        (das/poly_proofs.marshal_multiproofs) and the device checks
+        e(A, G2_GEN)·e(−π, Z) == 1 through the SAME jitted kernel the
+        aggregate-vote path uses — no new kernel, no new compile
+        shapes beyond the bucket. Verdicts are bit-identical to the
+        scalar PCS reference because every malformed-row rejection and
+        every degenerate (infinity-point) row is resolved into the
+        planes at marshal time.
+
+        On a mesh layout the planes ship pre-sharded along the leading
+        (row) axis and the SAME jitted kernel partitions over them —
+        per-row work, so ZERO collectives; `last_mesh` records the
+        sharded execution for the non-vacuity checks."""
+        from gethsharding_tpu.das import poly_proofs
+
+        jnp = self._jnp
+        lay = self._layout
+        n = len(commitments)
+        if n == 0:
+            self.last_wire = None
+            return []
+        dt = DeviceTimer("das_poly_verify")
+        # mesh buckets round up to a device multiple so the
+        # NamedSharding split is even; padded rows are marshalled
+        # rejections exactly like single-device padding
+        bucket = lay.mesh_bucket(n) if lay.is_mesh else self._bucket(n)
+        shape = (bucket, lay.n_devices) if lay.is_mesh else (bucket,)
+        fresh = self._note_shape("das_poly_verify", *shape)
+        st = poly_proofs.marshal_multiproofs(commitments, index_rows,
+                                             eval_rows, proofs, ns, bucket)
+        planes = (st["px"], st["py"], st["ax"], st["ay"], st["zx"],
+                  st["zy"], st["valid"])
+        proof_bytes = sum(int(p.nbytes) for p in planes)
+        # same wire-ledger contract as the sample path: the marshalled
+        # pairing planes ARE this dispatch's host->device bytes
+        self.last_wire = {"op": "das_verify_multiproofs",
+                          "wire_bytes": proof_bytes,
+                          "sample_wire_bytes": proof_bytes,
+                          "rows": n, "bucket": bucket, "wire": self._wire}
+        RECORDER.record_wire("das_verify_multiproofs", self.last_wire)
+        self._m_wire_bytes.inc(proof_bytes)
+        tracing.tag_current_add(wire_bytes=proof_bytes,
+                                sample_wire_bytes=proof_bytes)
+        tracer = tracing.TRACER
+        ship = lay.place if lay.is_mesh else jnp.asarray
+        dt.dispatched()
+        with self._compiles.compile_span("das_poly_verify", shape, fresh):
+            out = self._bls(*(ship(p) for p in planes))
+        if lay.is_mesh:
+            self.last_mesh = {
+                "op": "das_verify_multiproofs",
+                "n_devices": lay.n_devices, "bucket": bucket,
+                "collectives": 0,
+                "verdict_devices": len(out.sharding.device_set),
+                "vote_total": None,
+            }
+        res = [bool(b) for b in dt.pull(out)[:n]]
+        dt.done()
+        if tracer.enabled:
+            tracer.record("jax/das_poly_verify_dispatch", dt.t_dispatch,
+                          dt.t_done,
+                          tags={"rows": n, "bucket": bucket,
+                                "compile": "miss" if fresh else "hit",
+                                "sample_wire_bytes": proof_bytes,
+                                "suspect": dt.suspect,
+                                "marshal_ms": round(dt.marshal_s * 1e3, 3),
+                                "device_ms": round(dt.device_s * 1e3, 3)})
+        return res
+
+    # -- the staged committee path -----------------------------------------
+    # marshal (host limbs + cache resolution) -> transfer (host->device)
+    # -> dispatch (device, async) -> pull (result()). Explicit stages so
+    # the async form overlaps host staging of batch N+1 with batch N's
+    # device execution, and so the SIG_TIMING ledger can attribute every
+    # boundary.
+
+    def _committee_submit(self, messages, sig_rows, pk_rows,
+                          pk_row_keys) -> VerdictFuture:
+        if self._layout.is_mesh:
+            return self._committee_submit_mesh(messages, sig_rows,
+                                               pk_rows, pk_row_keys)
+        import time
+
+        import numpy as np
+
+        timing = os.environ.get("GETHSHARDING_SIG_TIMING") == "1"
+        if timing:
+            # the split must belong to THIS dispatch: a caller that skips
+            # the jax committee path (e.g. an empty batch) must read None,
+            # not a stale split from a prior audit in the same process
+            self.last_timing = None
+        dt = DeviceTimer("bls_committee")
+        t0 = time.perf_counter()
+        jnp = self._jnp
+        n = len(messages)
+        if n == 0:
+            self.last_wire = None
+            future = VerdictFuture(lambda: [])
+            future.result()
+            return future
+        st = self._committee_marshal(messages, sig_rows, pk_rows,
+                                     pk_row_keys)
+        t1 = time.perf_counter()
+        args, wire = self._committee_transfer(st)
+        if timing:
+            # force EVERY host->device transfer to completion before
+            # timing the dispatch (plain block_until_ready can no-op
+            # under the tunnel plugin). ONE fused pull: stacking a
+            # scalar from each buffer into a single device array and
+            # pulling that once waits on all nine transfers with a
+            # single host round-trip, so transfer_s reflects transfer
+            # bandwidth — a per-buffer pull would add 9 sequential
+            # tunnel RTTs the untimed production path never pays
+            probe = jnp.stack(
+                [a.ravel()[0].astype(jnp.int32) for a in args])
+            np.asarray(probe)
+            t2 = time.perf_counter()
+        # the per-dispatch wire ledger is always on (pure nbytes
+        # arithmetic, no device sync) — probe-42 transfer attribution
+        # must not require the sync-forcing timing mode
+        self.last_wire = wire
+        RECORDER.record_wire("bls_verify_committees", wire)
+        self._m_wire_bytes.inc(wire["wire_bytes"])
+        self._m_pk_hit_bytes.inc(wire["pk_hit_bytes"])
+        # stamp the enclosing caller span (the notary's notary/audit);
+        # SUMMED, so a multi-dispatch span reports total bytes
+        tracing.tag_current_add(wire_bytes=wire["wire_bytes"],
+                                pk_hit_bytes=wire["pk_hit_bytes"])
+        fn = (self._bls_committee_u16 if self._wire_u16
+              else self._bls_committee)
+        tracer = tracing.TRACER
+        marshal_s = t1 - t0  # host marshal: limb planes + cache resolve
+        dt.dispatched()  # marshal (incl. transfer staging) closes here
+        with self._compiles.compile_span(
+                "bls_committee",
+                (st["bucket"], st["width"], self._wire), st["fresh"]):
+            out = fn(*args)  # async dispatch: returns before execution ends
+        # finalize must close over SCALARS, not the marshal dict: `st`
+        # pins every host limb plane (MBs per dispatch) until result(),
+        # and an overlapped K-period pipeline holds K of them at once
+        bucket, width, fresh = st["bucket"], st["width"], st["fresh"]
+
+        def finalize():
+            # the checked pull is the barrier: block-vs-pull divergence
+            # (the r4 no-op hazard) lands on perfwatch/timer_suspect
+            res = [bool(b) for b in dt.pull(out)[:n]]
+            dt.done()
+            if tracer.enabled:
+                # the checked pull above means the span closes only
+                # after the dispatch actually executed; on the async
+                # path it additionally covers the overlapped wait
+                tracer.record(
+                    "jax/bls_committee_dispatch", dt.t_dispatch, dt.t_done,
+                    tags={"rows": n, "bucket": bucket,
+                          "width": width, "wire": self._wire,
+                          "compile": "miss" if fresh else "hit",
+                          "suspect": dt.suspect,
+                          "wire_bytes": wire["wire_bytes"],
+                          "pk_hit_bytes": wire["pk_hit_bytes"],
+                          "marshal_ms": round(marshal_s * 1e3, 3),
+                          "device_ms": round(dt.device_s * 1e3, 3)})
+            if timing:
+                t3 = time.perf_counter()
+                # per-instance: two backends in one process must not
+                # clobber each other's split
+                self.last_timing = {
+                    "prep_s": round(t1 - t0, 4),
+                    "transfer_s": round(t2 - t1, 4),
+                    "dispatch_s": round(t3 - t2, 4),
+                    "rows": n, "width": width,
+                    **wire,
+                }
+            return res
+
+        return VerdictFuture(finalize)
+
+    def _committee_submit_mesh(self, messages, sig_rows, pk_rows,
+                               pk_row_keys) -> VerdictFuture:
+        """The mesh committee audit: the same marshal -> transfer ->
+        dispatch staging, but every plane ships pre-split along the
+        shard axis (each device receives ONLY its slab's bytes, resident
+        pk rows come from ITS cache shard) and the launch is ONE pjit'd
+        `shard_map` step whose vote-total `psum` is the only
+        cross-device traffic — counted per compiled executable from the
+        AOT HLO into `last_mesh["collectives"]`. Verdicts are
+        bit-identical to the single-device path: same kernels, same
+        padding semantics, only placement differs."""
+        import time
+
+        import numpy as np
+
+        timing = os.environ.get("GETHSHARDING_SIG_TIMING") == "1"
+        if timing:
+            self.last_timing = None
+        dt = DeviceTimer("bls_committee_mesh")
+        t0 = time.perf_counter()
+        lay = self._layout
+        n = len(messages)
+        if n == 0:
+            self.last_wire = None
+            self.last_mesh = None
+            future = VerdictFuture(lambda: [])
+            future.result()
+            return future
+        bucket = lay.mesh_bucket(n)
+        pad = bucket - n
+        width = marshal.committee_width(sig_rows, pk_rows)
+        # the compile-cache key includes the device count: re-laying the
+        # same process over a different mesh is a fresh XLA program
+        fresh = self._note_shape("bls_committee_mesh", bucket, width,
+                                 self._wire, lay.n_devices)
+        check = os.environ.get("GETHSHARDING_CHECK") == "1"
+        host = marshal.committee_host_planes(
+            self._bn, messages, sig_rows, pad, width,
+            marshal.wire_dtype(self._wire_u16, check))
+        rows = list(pk_rows) + [[]] * pad
+        keys = marshal.normalize_row_keys(pk_row_keys, len(rows))
+        st = {"n": n, "bucket": bucket, "pad": pad, "width": width,
+              "fresh": fresh, "check": check,
+              "pk_rows": sum(1 for r in rows if r),
+              "hit_rows": 0, "hit_bytes": 0}
+        conv = marshal.wire_converter(self._wire_u16, check)
+        hx, hy = conv(host["hx"]), conv(host["hy"])
+        sx, sy = conv(host["sx"]), conv(host["sy"])
+        sm, hok = host["sm"], host["hok"]
+        wire_bytes = (hx.nbytes + hy.nbytes + sx.nbytes + sy.nbytes
+                      + sm.nbytes + hok.nbytes)
+        resident = self._resident and keys is not None
+        if resident:
+            px, py, pm, g2_bytes = self._mesh_pk_planes(st, rows, keys,
+                                                        lay)
+        else:
+            pxh, pyh, pmh = self._pk_rows_to_limbs(rows, width,
+                                                   row_keys=keys)
+            pxh, pyh = conv(pxh), conv(pyh)
+            g2_bytes = pxh.nbytes + pyh.nbytes + pmh.nbytes
+            px, py, pm = lay.place(pxh), lay.place(pyh), lay.place(pmh)
+        wire_bytes += g2_bytes
+        t1 = time.perf_counter()
+        args = (lay.place(hx), lay.place(hy), lay.place(sx),
+                lay.place(sy), lay.place(sm), px, py, pm,
+                lay.place(hok))
+        if timing:
+            for a in args:
+                a.block_until_ready()
+            t2 = time.perf_counter()
+        wire = {"wire_bytes": int(wire_bytes),
+                "g2_wire_bytes": int(g2_bytes),
+                "pk_hit_bytes": int(st["hit_bytes"]),
+                "pk_rows": int(st["pk_rows"]),
+                "pk_hit_rows": int(st["hit_rows"]),
+                "resident": resident, "wire": self._wire}
+        self.last_wire = wire
+        RECORDER.record_wire("bls_verify_committees", wire)
+        self._m_wire_bytes.inc(wire["wire_bytes"])
+        self._m_pk_hit_bytes.inc(wire["pk_hit_bytes"])
+        tracing.tag_current_add(wire_bytes=wire["wire_bytes"],
+                                pk_hit_bytes=wire["pk_hit_bytes"])
+        tracer = tracing.TRACER
+        marshal_s = t1 - t0
+        exe_key = (bucket, width, self._wire)
+        dt.dispatched()
+        with self._compiles.compile_span(
+                "bls_committee_mesh",
+                (bucket, width, self._wire, lay.n_devices), fresh):
+            exe = self._mesh_exec.get(exe_key)
+            if exe is None:
+                # AOT: one .lower().compile() gives the executable AND
+                # its optimized HLO, so the one-collective assertion is
+                # a free byproduct of the compile we had to do anyway
+                exe = self._bls_committee_mesh.lower(*args).compile()
+                self._mesh_exec[exe_key] = exe
+                self._mesh_collectives[exe_key] = \
+                    layout_mod.count_collectives(exe.as_text())
+            out, votes = exe(*args)
+        collectives = self._mesh_collectives[exe_key]
+        mesh_rec = {"op": "bls_verify_committees",
+                    "n_devices": lay.n_devices, "bucket": bucket,
+                    "width": width, "collectives": collectives,
+                    "verdict_devices": None, "vote_total": None}
+        self.last_mesh = mesh_rec
+
+        def finalize():
+            res = [bool(b) for b in dt.pull(out)[:n]]
+            # non-vacuity evidence for the tests/bench: the verdict
+            # plane really was sharded over the mesh, and the psum'd
+            # vote total agrees with the verdict plane it reduced
+            mesh_rec["verdict_devices"] = len(out.sharding.device_set)
+            mesh_rec["vote_total"] = int(np.asarray(votes))
+            dt.done()
+            if tracer.enabled:
+                tracer.record(
+                    "jax/bls_committee_mesh_dispatch", dt.t_dispatch,
+                    dt.t_done,
+                    tags={"rows": n, "bucket": bucket, "width": width,
+                          "wire": self._wire,
+                          "n_devices": lay.n_devices,
+                          "collectives": collectives,
+                          "compile": "miss" if fresh else "hit",
+                          "suspect": dt.suspect,
+                          "wire_bytes": wire["wire_bytes"],
+                          "pk_hit_bytes": wire["pk_hit_bytes"],
+                          "marshal_ms": round(marshal_s * 1e3, 3),
+                          "device_ms": round(dt.device_s * 1e3, 3)})
+            if timing:
+                t3 = time.perf_counter()
+                self.last_timing = {
+                    "prep_s": round(t1 - t0, 4),
+                    "transfer_s": round(t2 - t1, 4),
+                    "dispatch_s": round(t3 - t2, 4),
+                    "rows": n, "width": width,
+                    **wire,
+                }
+            return res
+
+        return VerdictFuture(finalize)
+
+    def _committee_marshal(self, messages, sig_rows, pk_rows,
+                           pk_row_keys) -> dict:
+        """Stage 1, host only: padding policy, limb marshalling of the
+        fresh-per-period buffers (hashes, signatures, masks), pk-row
+        cache resolution (device hits claimed, misses marshalled)."""
+        n = len(messages)
+        bucket = self._bucket(n)
+        pad = bucket - n
+        width = marshal.committee_width(sig_rows, pk_rows)
+        # the compile-cache key INCLUDES the wire dtype: the u16 wire
+        # compiles a different XLA program for the same (bucket, width),
+        # so counting it against the other wire's entry would book a
+        # real recompile as a hit
+        fresh = self._note_shape("bls_committee", bucket, width, self._wire)
+        check = os.environ.get("GETHSHARDING_CHECK") == "1"
+        host = marshal.committee_host_planes(
+            self._bn, messages, sig_rows, pad, width,
+            marshal.wire_dtype(self._wire_u16, check))
+        rows = list(pk_rows) + [[]] * pad
+        keys = marshal.normalize_row_keys(pk_row_keys, len(rows))
+        st = {"n": n, "bucket": bucket, "pad": pad, "width": width,
+              "fresh": fresh, "check": check,
+              "pk_rows": sum(1 for r in rows if r),
+              "hx": host["hx"], "hy": host["hy"], "hok": host["hok"],
+              "sx": host["sx"], "sy": host["sy"], "sm": host["sm"],
+              "resident": self._resident and keys is not None}
+        if st["resident"]:
+            self._pk_resident_resolve(st, rows, keys)
+        else:
+            px, py, pm = self._pk_rows_to_limbs(rows, width, row_keys=keys)
+            st["px"], st["py"], st["pm"] = px, py, pm
+        return st
+
+    def _committee_transfer(self, st) -> tuple:
+        """Stage 2, host->device: ship the fresh-per-period buffers (+
+        any pk-row misses) and assemble the kernel args. Returns
+        (args, wire_ledger); ledger bytes are LOGICAL wire bytes — what
+        crosses the host->device link for this dispatch. Device-cache
+        hits and on-device stacking contribute zero."""
+        jnp = self._jnp
+        conv = marshal.wire_converter(self._wire_u16, st["check"])
+        hx, hy = conv(st["hx"]), conv(st["hy"])
+        sx, sy = conv(st["sx"]), conv(st["sy"])
+        sm, hok = st["sm"], st["hok"]
+        wire_bytes = (hx.nbytes + hy.nbytes + sx.nbytes + sy.nbytes
+                      + sm.nbytes + hok.nbytes)
+        if st["resident"]:
+            px, py, pm, g2_bytes = self._pk_resident_planes(st)
+            hit_bytes, hit_rows = st["hit_bytes"], st["hit_rows"]
+        else:
+            pxh, pyh, pmh = conv(st["px"]), conv(st["py"]), st["pm"]
+            g2_bytes = pxh.nbytes + pyh.nbytes + pmh.nbytes
+            px, py, pm = (jnp.asarray(pxh), jnp.asarray(pyh),
+                          jnp.asarray(pmh))
+            hit_bytes = hit_rows = 0
+        wire_bytes += g2_bytes
+        args = (jnp.asarray(hx), jnp.asarray(hy), jnp.asarray(sx),
+                jnp.asarray(sy), jnp.asarray(sm), px, py, pm,
+                jnp.asarray(hok))
+        wire = {"wire_bytes": int(wire_bytes),
+                "g2_wire_bytes": int(g2_bytes),
+                "pk_hit_bytes": int(hit_bytes),
+                "pk_rows": int(st["pk_rows"]),
+                "pk_hit_rows": int(hit_rows),
+                "resident": st["resident"], "wire": self._wire}
+        return args, wire
+
+    # populated by bls_verify_committees under GETHSHARDING_SIG_TIMING=1:
+    # host marshalling vs tunnel transfer vs device dispatch of the LAST
+    # audit call (+ the wire ledger) — the split that decides which side
+    # of the dispatch boundary the next optimization belongs to
+    last_timing: dict | None = None
+
+    # populated by EVERY committee dispatch (no sync, pure nbytes
+    # arithmetic): {wire_bytes, g2_wire_bytes, pk_hit_bytes, pk_rows,
+    # pk_hit_rows, resident, wire} — the transfer-attribution ledger
+    # bench.py records per config and the residency tests assert on
+    # (steady state: g2_wire_bytes == 0)
+    last_wire: dict | None = None
+
+    # populated by every MESH dispatch: {op, n_devices, bucket, width,
+    # collectives, verdict_devices, vote_total} — the non-vacuity
+    # evidence (the pjit path really produced sharded arrays; exactly
+    # one cross-device collective per committee step). None on
+    # single-device layouts.
+    last_mesh: dict | None = None

@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Probe acceptance gate: the measurement LANDED, and it is VALID.
+
+The tpu_experiments probes used to pass on a stdout grep alone — a run
+whose record never reached the perfwatch benchmark ledger (or reached
+it stamped ``valid: false`` because the device-timer self-check fired
+mid-measurement, the r4 block_until_ready no-op hazard) still counted
+as green. This gate closes that: a probe passes only when the NEWEST
+ledger record for its workload exists, is stamped valid, and was
+written by this run (``--max-age`` seconds, default one day).
+
+Usage: probe_ledger_check.py WORKLOAD [--max-age SECONDS]
+
+Reads the same ledger the bench emitter writes
+(``GETHSHARDING_PERFWATCH_LEDGER`` or ./perf_ledger.jsonl).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    max_age = 24 * 3600.0
+    if "--max-age" in args:
+        i = args.index("--max-age")
+        max_age = float(args[i + 1])
+        del args[i:i + 2]
+    if len(args) != 1:
+        print(__doc__, file=sys.stderr)
+        return 2
+    workload = args[0]
+
+    from gethsharding_tpu.perfwatch.ledger import Ledger
+
+    ledger = Ledger()
+    recs = ledger.records(workload=workload)
+    if not recs:
+        print(f"probe_ledger_check: no {workload!r} record in "
+              f"{ledger.path} — the probe's emit never landed",
+              file=sys.stderr)
+        return 1
+    rec = recs[-1]
+    age = time.time() - float(rec.get("ts_unix", 0))
+    if age > max_age:
+        print(f"probe_ledger_check: newest {workload!r} record is "
+              f"{age / 3600:.1f}h old (> {max_age / 3600:.1f}h) — this "
+              f"run's emit never landed", file=sys.stderr)
+        return 1
+    if rec.get("valid") is False:
+        print(f"probe_ledger_check: newest {workload!r} record is "
+              f"stamped INVALID (device-timer self-check fired "
+              f"{rec.get('suspects')} time(s) during the measurement): "
+              f"{rec.get('metrics')}", file=sys.stderr)
+        return 1
+    print(f"probe_ledger_check: {workload} ok "
+          f"(valid record, {rec.get('backend') or 'n/a'} backend, "
+          f"{rec.get('platform') or 'n/a'} platform, "
+          f"metrics={rec.get('metrics')})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

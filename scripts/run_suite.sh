@@ -116,6 +116,37 @@ assert jx.last_wire["g2_wire_bytes"] == 0, jx.last_wire  # warm = resident
 print("resident/overlap smoke OK:", jx.last_wire)
 PYEOF
 
+# -- mesh smoke: the multi-chip dispatch core on a 2-device virtual
+# mesh — ONE audit through scalar / single-device / mesh (bench.py
+# --mesh asserts bit-identity, exactly one cross-device collective,
+# sharded verdicts and disjoint per-device cache shards), emitting the
+# multichip_audit record into a THROWAWAY ledger that the probe
+# acceptance gate (scripts/probe_ledger_check.py) must then pass.
+# The virtual-mesh dryrun used to be driver-only; this is its suite
+# home. Compile-heavy (two audit executables, XLA:CPU): the host-keyed
+# persistent compile cache makes repeats fast, the timeout covers cold.
+echo "== mesh smoke (2-device virtual mesh: one audit, bit-identity)"
+mesh_tmp=$(mktemp -d)
+JAX_PLATFORMS=cpu GETHSHARDING_BENCH_MESH_DEVICES=2 \
+GETHSHARDING_BENCH_MESH_ITERS=1 \
+GETHSHARDING_PERFWATCH_LEDGER="$mesh_tmp/ledger.jsonl" \
+GETHSHARDING_PERFWATCH_DIR="$mesh_tmp/blackbox" \
+    timeout 1800 python bench.py --mesh > "$mesh_tmp/mesh.json" || {
+    echo "mesh smoke FAILED: bench.py --mesh exited nonzero"
+    tail -5 "$mesh_tmp/mesh.json" 2>/dev/null; fail=1; }
+grep -q '"collectives_per_step": 1' "$mesh_tmp/mesh.json" || {
+    echo "mesh smoke FAILED: no single-collective step in the output"
+    fail=1; }
+grep -q '"n_devices": 2' "$mesh_tmp/mesh.json" || {
+    echo "mesh smoke FAILED: audit did not run on the 2-device mesh"
+    fail=1; }
+GETHSHARDING_PERFWATCH_LEDGER="$mesh_tmp/ledger.jsonl" JAX_PLATFORMS=cpu \
+    python scripts/probe_ledger_check.py multichip_audit \
+    --max-age 3600 || {
+    echo "mesh smoke FAILED: no valid multichip_audit ledger record"
+    fail=1; }
+rm -rf "$mesh_tmp"
+
 # -- DAS smoke: erasure-extend a body, publish, sampled-vote end-to-end
 # on hermetic CPU — batched das_verify_samples must agree with the
 # scalar reference bit-for-bit, the sampled notary must vote with ZERO

@@ -6,9 +6,30 @@
 # The 4-period signature workload loads from .bench_workload.npz when
 # the 03e/kperiod pre-builder has run (~12 min host build otherwise —
 # hence the long timeout; repeats are cheap).
+#
+# Acceptance runs through the perfwatch ledger, not a stdout grep
+# alone: bench.py --overlap emits audit_overlap_ratio through
+# record_bench with the device-timer validity stamp, and
+# probe_ledger_check.py fails the probe if the record never landed or
+# landed invalid. Until a tunnel window opens,
+# PROBE_VIRTUAL_DEVICES=N runs the SAME closed loop hermetically on
+# the N-device virtual CPU mesh (GETHSHARDING_MESH_DEVICES lays the
+# backend over it; the platform check relaxes to cpu).
 cd /root/repo || exit 1
-env GETHSHARDING_TPU_LIMB_FORM=exact GETHSHARDING_TPU_CARRY=scan \
+PLATFORM='"platform": "tpu'
+VIRT_ENV=()
+if [ -n "$PROBE_VIRTUAL_DEVICES" ]; then
+  PLATFORM='"platform": "cpu'
+  VIRT_ENV=(JAX_PLATFORMS=cpu
+    XLA_FLAGS="--xla_force_host_platform_device_count=$PROBE_VIRTUAL_DEVICES"
+    GETHSHARDING_MESH_DEVICES="$PROBE_VIRTUAL_DEVICES")
+fi
+env "${VIRT_ENV[@]}" \
+    GETHSHARDING_TPU_LIMB_FORM=exact GETHSHARDING_TPU_CARRY=scan \
     GETHSHARDING_TPU_FINALEXP=mega GETHSHARDING_TPU_MILLER=mega \
     GETHSHARDING_BENCH_OVERLAP_K=4 \
   timeout 6900 python bench.py --overlap >"$1.out" 2>"$1.err"
-grep -q overlap_ratio "$1.out" && grep -q '"platform": "tpu' "$1.out"
+grep -q overlap_ratio "$1.out" \
+  && grep -q "$PLATFORM" "$1.out" \
+  && python scripts/probe_ledger_check.py audit_overlap_ratio \
+       --max-age 7200

@@ -5,10 +5,30 @@
 # wall delta bounds the transfer share of the 0.297 s dispatch — the
 # number that closes probe 42's "transfer dominates" branch. u16 wire
 # stacked on top so the fresh-per-period buffers ship narrow too.
+#
+# Acceptance runs through the perfwatch ledger, not a stdout grep
+# alone: bench.py --resident emits audit_warm_wire_bytes_per_dispatch
+# through record_bench with the device-timer validity stamp, and
+# probe_ledger_check.py fails the probe if the record never landed or
+# landed invalid. Until a tunnel window opens,
+# PROBE_VIRTUAL_DEVICES=N runs the SAME closed loop hermetically on
+# the N-device virtual CPU mesh (GETHSHARDING_MESH_DEVICES lays the
+# backend over it; the platform check relaxes to cpu).
 cd /root/repo || exit 1
-env GETHSHARDING_TPU_LIMB_FORM=exact GETHSHARDING_TPU_CARRY=scan \
+PLATFORM='"platform": "tpu'
+VIRT_ENV=()
+if [ -n "$PROBE_VIRTUAL_DEVICES" ]; then
+  PLATFORM='"platform": "cpu'
+  VIRT_ENV=(JAX_PLATFORMS=cpu
+    XLA_FLAGS="--xla_force_host_platform_device_count=$PROBE_VIRTUAL_DEVICES"
+    GETHSHARDING_MESH_DEVICES="$PROBE_VIRTUAL_DEVICES")
+fi
+env "${VIRT_ENV[@]}" \
+    GETHSHARDING_TPU_LIMB_FORM=exact GETHSHARDING_TPU_CARRY=scan \
     GETHSHARDING_TPU_FINALEXP=mega GETHSHARDING_TPU_MILLER=mega \
     GETHSHARDING_TPU_WIRE=u16 GETHSHARDING_TPU_RESIDENT=1 \
   timeout 4800 python bench.py --resident >"$1.out" 2>"$1.err"
 grep -q '"g2_wire_bytes_warm": 0' "$1.out" \
-  && grep -q '"platform": "tpu' "$1.out"
+  && grep -q "$PLATFORM" "$1.out" \
+  && python scripts/probe_ledger_check.py \
+       audit_warm_wire_bytes_per_dispatch --max-age 7200
