@@ -59,6 +59,15 @@ from gethsharding_tpu.ops.limb import LIMB_BITS, LIMB_MASK, int_to_limbs
 
 BLOCK_LANES = 128
 
+
+def block_lanes() -> int:
+    """The mega-kernels' lane-block width — the natural granularity for
+    pipelining precomp Miller lane blocks against finalexp
+    (sigbackend/dispatch aligns GETHSHARDING_PRECOMP_BLOCKS slices to
+    it so a pipelined block never pads down to a partial lane
+    block)."""
+    return BLOCK_LANES
+
 # In-kernel schoolbook-column implementation (GETHSHARDING_TPU_MEGA_CONV):
 # - "shift" (default): 25 shifted-concatenate MACs per conv — each step
 #   materializes a zero-padded copy of the full column block (the

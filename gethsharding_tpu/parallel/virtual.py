@@ -27,8 +27,9 @@ _COUNT_RE = re.compile(r"--xla_force_host_platform_device_count=(\d+)")
 # (cpu_aot_loader.cc) when a persistent-cache executable was compiled
 # on a host with ISA features the executing host lacks ("Target
 # machine feature +prefer-no-gather is not supported ... could lead to
-# execution errors such as SIGILL"). Observed in every MULTICHIP_r0x
-# tail WITH rc=0 and bit-identical outputs: the loader recompiles/
+# execution errors such as SIGILL"). Observed in every recorded
+# dryrun tail (the `multichip_dryrun` ledger records) WITH rc=0 and
+# bit-identical outputs: the loader recompiles/
 # falls back safely, so the lines are WARN-ONLY — they must never fail
 # a dryrun, and they must never excuse a real failure (rc != 0 fails
 # regardless of what the tail says).

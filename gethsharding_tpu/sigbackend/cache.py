@@ -16,6 +16,18 @@ three levels:
   tuple every period, so the stacked kernel planes are reused whole —
   zero transfers AND zero per-dispatch device stacking ops.
 
+Under `GETHSHARDING_PRECOMP` a fourth resident kind joins the SAME
+byte-budgeted device LRU: per-row Miller line-coefficient TABLES
+(`(key, "lines")` entries, `ops/bn256_jax.precompute_lines` output).
+A cold row pays one precompute dispatch; every warm audit then ships
+zero G2 bytes AND skips the fixed-argument point arithmetic entirely.
+Tables are keyed by `pk_row_key` alone — the on-device aggregate is a
+function of row content only, so one table serves every committee
+width and wire dtype. Entries are charged at their TRUE device byte
+count (int32 tables even under the u16 wire — a plane-shape estimate
+would under-charge ~2x and trip devscope's claimed-vs-census drift
+gate).
+
 On a mesh layout the device LRU becomes PER-DEVICE SHARDS
 (`MeshCacheShard`): each mesh slot owns an independent LRU holding
 only the rows its slab consumes, with its own byte budget (an equal
@@ -74,6 +86,11 @@ class ResidentPkCache:
     _PK_ROW_CACHE_MAX = 1024
 
     _pk_batch_memo_nbytes = 0
+    _pk_line_memo_nbytes = 0
+    # class default so census callbacks work on a backend whose
+    # __init__ predates the line memo (devscope's partial-construction
+    # registration path)
+    _pk_line_memo = None
 
     def _init_pk_caches(self) -> None:
         """Construct the cache state (called from the backend's
@@ -89,6 +106,7 @@ class ResidentPkCache:
         self._pk_dev_bytes = 0
         self._pk_dev_lock = threading.Lock()
         self._pk_batch_memo: "tuple | None" = None  # (key, planes, nbytes)
+        self._pk_line_memo: "tuple | None" = None  # (key, (tab, inf), bytes)
         self._pk_zero_rows: dict = {}  # width -> device zero row planes
         self._m_row_hit = metrics.counter("jax/pk_row_cache/hits")
         self._m_row_miss = metrics.counter("jax/pk_row_cache/misses")
@@ -99,6 +117,7 @@ class ResidentPkCache:
         # mesh state (filled by _init_mesh_shards on mesh layouts)
         self._mesh_shards: list = []
         self._mesh_memo: "tuple | None" = None
+        self._mesh_line_memo: "tuple | None" = None
         self._mesh_lock = threading.Lock()
 
     def _register_census_owner(self) -> None:
@@ -140,23 +159,36 @@ class ResidentPkCache:
         zero = sum(int(b.nbytes)
                    for row in self._pk_zero_rows.copy().values()
                    for b in row)
+        gen = getattr(self, "_gen_lines_dev", None)
+        if gen is not None:
+            zero += int(gen.nbytes)
         with self._pk_dev_lock:
-            return self._pk_dev_bytes + self._pk_batch_memo_nbytes + zero
+            return (self._pk_dev_bytes + self._pk_batch_memo_nbytes
+                    + self._pk_line_memo_nbytes + zero)
 
     def _resident_buffers(self) -> list:
         """Every device buffer the resident plane holds (cache rows,
-        the batch memo, the shared zero rows) for census attribution."""
+        the batch/line memos, the shared zero rows, the resident
+        generator line table) for census attribution."""
         out: list = []
         with self._pk_dev_lock:
             for entry in self._pk_dev_cache.values():
-                out.extend(entry[:3])
+                # line-table entries pad slot 2 with None (no third
+                # buffer) to keep the pk-plane entry shape
+                out.extend(b for b in entry[:3] if b is not None)
             memo = self._pk_batch_memo
+            line_memo = self._pk_line_memo
         if memo is not None:
             out.extend(memo[1])
+        if line_memo is not None:
+            out.extend(line_memo[1])
         # .copy(): atomic snapshot — _zero_pk_row publishes new rows
         # without the dev lock, and a mid-iteration insert would raise
         for row in self._pk_zero_rows.copy().values():
             out.extend(row)
+        gen = getattr(self, "_gen_lines_dev", None)
+        if gen is not None:
+            out.append(gen)
         return out
 
     # -- pubkey-row limb cache (host) --------------------------------------
@@ -357,7 +389,8 @@ class ResidentPkCache:
                 self._pk_dev_bytes -= old[3]
                 self._m_dev_evict.inc()
             self._g_dev_bytes.set(
-                self._pk_dev_bytes + self._pk_batch_memo_nbytes)
+                self._pk_dev_bytes + self._pk_batch_memo_nbytes
+                + self._pk_line_memo_nbytes)
 
     def _set_batch_memo(self, key, planes, hit_bytes) -> None:
         px, py, pm = planes
@@ -365,7 +398,8 @@ class ResidentPkCache:
             self._pk_batch_memo = (key, planes, hit_bytes)
             self._pk_batch_memo_nbytes = px.nbytes + py.nbytes + pm.nbytes
             self._g_dev_bytes.set(
-                self._pk_dev_bytes + self._pk_batch_memo_nbytes)
+                self._pk_dev_bytes + self._pk_batch_memo_nbytes
+                + self._pk_line_memo_nbytes)
 
     def _zero_pk_row(self, width: int):
         """Shared on-device zero planes for empty/padded rows (mask all
@@ -384,6 +418,138 @@ class ResidentPkCache:
                    jnp.zeros((width,), bool))
             self._pk_zero_rows[key] = row
         return row
+
+    # -- device-resident line tables (fixed-base precomp) ------------------
+    # The precompute path's residents: per pk_row_key the dense Miller
+    # line-coefficient table (L, 3, 2, nl) int32 + its infinity flag,
+    # sharing the pk-plane LRU (one byte budget, one eviction order).
+    # Entries are (table, inf, None, nbytes): the None pads to the
+    # pk-plane entry shape so the census walks both kinds; nbytes is the
+    # TRUE device byte count of the int32 table (under the u16 wire a
+    # plane-shape estimate would under-charge ~2x and trip the devscope
+    # claimed-vs-census drift gate). Tables are keyed `(key, "lines")` —
+    # content only: the aggregate is width/wire-independent as a GROUP
+    # element, so verdicts are exact for any consumer; the projective
+    # REPRESENTATIVE (and hence raw f bits) matches the recompute path
+    # when the table was built at the same dispatch width.
+
+    def _zero_line_row(self):
+        """Shared on-device zero line table for empty rows: inf=True ->
+        the precomp kernel rejects the row, matching the recompute
+        kernel's `fp2_is_zero(pZ)` rejection (scalar parity)."""
+        import numpy as np
+
+        row = self._pk_zero_rows.get("lines")
+        if row is None:
+            jnp = self._jnp
+            row = (jnp.zeros(self._bn.LINE_TABLE_SHAPE, np.int32),
+                   jnp.asarray(True))
+            self._pk_zero_rows["lines"] = row
+        return row
+
+    def _line_resolve(self, st: dict, rows, keys) -> None:
+        """Host half of the precomp path: claim line-table hits, plan
+        misses (whose pk planes alone are marshalled — hit rows ship
+        NOTHING, not even the pk plane the recompute path would need)."""
+        width = st["width"]
+        if all(k is not None or not row for row, k in zip(rows, keys)):
+            batch_key = (tuple(keys), st["bucket"], "precomp")
+        else:
+            batch_key = None
+        st["line_key"] = batch_key
+        with self._pk_dev_lock:
+            memo = self._pk_line_memo
+        if batch_key is not None and memo is not None \
+                and memo[0] == batch_key:
+            st["line_memo"] = memo[1]
+            st["hit_rows"] = st["pk_rows"]
+            st["hit_bytes"] = memo[2]
+            st["line_miss"] = None
+            self._m_dev_hit.inc(st["pk_rows"])
+            return
+        st["line_memo"] = None
+        plan = []  # per row: ("zero",) | ("hit", entry) | ("miss", j)
+        misses = []  # (row, key)
+        hit_rows = hit_bytes = 0
+        with self._pk_dev_lock:
+            cache = self._pk_dev_cache
+            for row, key in zip(rows, keys):
+                if not row:
+                    plan.append(("zero",))
+                    continue
+                entry = None
+                if key is not None:
+                    entry = cache.get((key, "lines"))
+                    if entry is not None:
+                        cache.move_to_end((key, "lines"))
+                if entry is not None:
+                    plan.append(("hit", entry))
+                    hit_rows += 1
+                    hit_bytes += entry[3]
+                else:
+                    plan.append(("miss", len(misses)))
+                    misses.append((row, key))
+        self._m_dev_hit.inc(hit_rows)
+        self._m_dev_miss.inc(len(misses))
+        st["line_plan"] = plan
+        st["hit_rows"], st["hit_bytes"] = hit_rows, hit_bytes
+        if misses:
+            mx, my, mm = self._pk_rows_to_limbs(
+                [row for row, _ in misses], width,
+                row_keys=[key for _, key in misses])
+            st["line_miss"] = (mx, my, mm)
+            st["line_miss_keys"] = [key for _, key in misses]
+        else:
+            st["line_miss"] = None
+
+    def _line_tables(self, st: dict):
+        """Device half of the precomp path: ONE precompute dispatch
+        walks the fixed-argument point arithmetic for ALL miss rows
+        (cold cost, paid once per key), then hits + misses + zeros stack
+        into the (B, L, 3, 2, nl) table plane + (B,) infinity flags.
+        Returns (table, inf, transferred_g2_bytes)."""
+        jnp = self._jnp
+        if st["line_memo"] is not None:
+            tab, inf = st["line_memo"]
+            return tab, inf, 0
+        miss_dev = []
+        g2_bytes = 0
+        if st["line_miss"] is not None:
+            mx, my, mm = st["line_miss"]
+            if st["check"] and self._wire_u16 and mx.size:
+                marshal.assert_canonical_limbs(mx, my)
+            dmx, dmy, dmm = (jnp.asarray(mx), jnp.asarray(my),
+                             jnp.asarray(mm))
+            g2_bytes = mx.nbytes + my.nbytes + mm.nbytes
+            tabs, infs = self._precompute(dmx, dmy, dmm)
+            for j, key in enumerate(st["line_miss_keys"]):
+                nbytes = int(tabs[j].nbytes) + int(infs[j].nbytes)
+                entry = (tabs[j], infs[j], None, nbytes)
+                if key is not None:
+                    self._pk_dev_insert((key, "lines"), entry)
+                miss_dev.append(entry)
+        zt, zi = self._zero_line_row()
+        ts, fs = [], []
+        for step in st["line_plan"]:
+            if step[0] == "zero":
+                entry = (zt, zi)
+            elif step[0] == "hit":
+                entry = step[1]
+            else:
+                entry = miss_dev[step[1]]
+            ts.append(entry[0])
+            fs.append(entry[1])
+        tab, inf = jnp.stack(ts), jnp.stack(fs)
+        if st["line_key"] is not None:
+            with self._pk_dev_lock:
+                self._pk_line_memo = (st["line_key"], (tab, inf),
+                                      st["hit_bytes"] + g2_bytes)
+                self._pk_line_memo_nbytes = (int(tab.nbytes)
+                                             + int(inf.nbytes))
+                self._g_dev_bytes.set(
+                    self._pk_dev_bytes + self._pk_batch_memo_nbytes
+                    + self._pk_line_memo_nbytes)
+        return tab, inf, g2_bytes
 
     # -- per-device mesh shards --------------------------------------------
 
@@ -424,11 +590,14 @@ class ResidentPkCache:
         with self._mesh_lock:
             total = shard.bytes
             memo = self._mesh_memo
+            line_memo = self._mesh_line_memo
             zero = sum(int(b.nbytes)
                        for row in shard.zero_rows.values() for b in row)
         total += zero
         if memo is not None:
             total += memo[3] // max(1, len(self._mesh_shards))
+        if line_memo is not None:
+            total += line_memo[3] // max(1, len(self._mesh_shards))
         return total
 
     def _mesh_shard_buffers(self, idx: int) -> list:
@@ -439,16 +608,18 @@ class ResidentPkCache:
         out: list = []
         with self._mesh_lock:
             for entry in shard.cache.values():
-                out.extend(entry[:3])
+                out.extend(b for b in entry[:3] if b is not None)
             memo = self._mesh_memo
+            line_memo = self._mesh_line_memo
             zero_rows = list(shard.zero_rows.values())
         for row in zero_rows:
             out.extend(row)
-        if memo is not None:
-            for arr in memo[1]:
-                for piece in arr.addressable_shards:
-                    if piece.device == shard.device:
-                        out.append(piece.data)
+        for m in (memo, line_memo):
+            if m is not None:
+                for arr in m[1]:
+                    for piece in arr.addressable_shards:
+                        if piece.device == shard.device:
+                            out.append(piece.data)
         return out
 
     def _mesh_zero_row(self, shard: MeshCacheShard, width: int):
@@ -601,3 +772,125 @@ class ResidentPkCache:
                 self._mesh_memo = (batch_key, (px, py, pm),
                                    hit_bytes + g2_bytes, nbytes)
         return px, py, pm, g2_bytes
+
+    def _mesh_zero_line(self, shard: MeshCacheShard):
+        """Shard-local zero line table (the `_zero_line_row` contract,
+        committed to the shard's device)."""
+        import numpy as np
+
+        with self._mesh_lock:
+            row = shard.zero_rows.get("lines")
+        if row is None:
+            import jax
+
+            row = (jax.device_put(
+                       np.zeros(self._bn.LINE_TABLE_SHAPE, np.int32),
+                       shard.device),
+                   jax.device_put(np.asarray(True), shard.device))
+            with self._mesh_lock:
+                shard.zero_rows.setdefault("lines", row)
+                row = shard.zero_rows["lines"]
+        return row
+
+    def _mesh_line_tables(self, st: dict, rows, keys, layout):
+        """The mesh precomp path: resolve every batch row's line table
+        against ITS device's cache shard, marshal + precompute misses
+        on their owning device only (committed inputs keep the
+        precompute dispatch device-local), stack per-device slabs and
+        assemble the global sharded (B, L, 3, 2, nl) table + (B,)
+        infinity flags with zero cross-device traffic. Returns
+        (table, inf, transferred g2_bytes)."""
+        import jax
+
+        jnp = self._jnp
+        width, bucket = st["width"], st["bucket"]
+        rpd = layout.rows_per_device(bucket)
+        if keys is not None and all(
+                k is not None or not row for row, k in zip(rows, keys)):
+            batch_key = (tuple(keys), bucket, "precomp",
+                         layout.n_devices)
+        else:
+            batch_key = None
+        st["line_key"] = batch_key
+        with self._mesh_lock:
+            memo = self._mesh_line_memo
+        if batch_key is not None and memo is not None \
+                and memo[0] == batch_key:
+            tab, inf = memo[1]
+            st["hit_rows"] = st["pk_rows"]
+            st["hit_bytes"] = memo[2]
+            self._m_dev_hit.inc(st["pk_rows"])
+            return tab, inf, 0
+
+        per_t, per_i = [], []
+        g2_bytes = hit_rows = hit_bytes = miss_rows = 0
+        for shard in self._mesh_shards:
+            lo = shard.index * rpd
+            s_rows = rows[lo:lo + rpd]
+            s_keys = (keys[lo:lo + rpd] if keys is not None
+                      else [None] * len(s_rows))
+            plan = []  # ("zero",) | ("hit", entry) | ("miss", j)
+            misses = []  # (row, key)
+            with self._mesh_lock:
+                for row, key in zip(s_rows, s_keys):
+                    if not row:
+                        plan.append(("zero",))
+                        continue
+                    entry = None
+                    if key is not None:
+                        entry = shard.cache.get((key, "lines"))
+                        if entry is not None:
+                            shard.cache.move_to_end((key, "lines"))
+                    if entry is not None:
+                        plan.append(("hit", entry))
+                        hit_rows += 1
+                        hit_bytes += entry[3]
+                        shard.m_hit.inc()
+                    else:
+                        plan.append(("miss", len(misses)))
+                        misses.append((row, key))
+                        shard.m_miss.inc()
+            miss_dev = []
+            if misses:
+                mx, my, mm = self._pk_rows_to_limbs(
+                    [row for row, _ in misses], width,
+                    row_keys=[key for _, key in misses])
+                if st["check"] and self._wire_u16 and mx.size:
+                    marshal.assert_canonical_limbs(mx, my)
+                dmx = jax.device_put(mx, shard.device)
+                dmy = jax.device_put(my, shard.device)
+                dmm = jax.device_put(mm, shard.device)
+                g2_bytes += mx.nbytes + my.nbytes + mm.nbytes
+                miss_rows += len(misses)
+                tabs, infs = self._precompute(dmx, dmy, dmm)
+                for j, (row, key) in enumerate(misses):
+                    nbytes = int(tabs[j].nbytes) + int(infs[j].nbytes)
+                    entry = (tabs[j], infs[j], None, nbytes)
+                    if key is not None:
+                        self._mesh_shard_insert(
+                            shard, (key, "lines"), entry)
+                    miss_dev.append(entry)
+            zt, zi = self._mesh_zero_line(shard)
+            ts, fs = [], []
+            for step in plan:
+                if step[0] == "zero":
+                    entry = (zt, zi)
+                elif step[0] == "hit":
+                    entry = step[1]
+                else:
+                    entry = miss_dev[step[1]]
+                ts.append(entry[0])
+                fs.append(entry[1])
+            per_t.append(jnp.stack(ts))
+            per_i.append(jnp.stack(fs))
+        tab = layout.assemble(per_t)
+        inf = layout.assemble(per_i)
+        self._m_dev_hit.inc(hit_rows)
+        self._m_dev_miss.inc(miss_rows)
+        st["hit_rows"], st["hit_bytes"] = hit_rows, hit_bytes
+        if batch_key is not None:
+            nbytes = int(tab.nbytes) + int(inf.nbytes)
+            with self._mesh_lock:
+                self._mesh_line_memo = (batch_key, (tab, inf),
+                                        hit_bytes + g2_bytes, nbytes)
+        return tab, inf, g2_bytes

@@ -76,6 +76,59 @@ class JaxSigBackend(ResidentPkCache, SigBackend):
                 pm, hok)
 
         self._bls_committee_u16 = jax.jit(_committee_u16)
+        # GETHSHARDING_PRECOMP: fixed-base pairing precomputation
+        # (default on). The committee path consumes device-resident
+        # Miller line tables keyed by pk_row_key instead of re-running
+        # the fixed-argument point arithmetic every dispatch — a cold
+        # row pays one precompute dispatch, every warm audit ships zero
+        # G2 bytes AND skips the point-arithmetic half of the Miller
+        # loop. 0 restores today's recompute path.
+        precomp = os.environ.get("GETHSHARDING_PRECOMP", "1")
+        if precomp not in ("0", "1"):
+            raise ValueError(
+                f"GETHSHARDING_PRECOMP={precomp!r}: want 0 or 1")
+        self._precomp = precomp == "1"
+        # GETHSHARDING_PRECOMP_BLOCKS: split the precomp dispatch into
+        # N lane blocks, enqueuing block k+1's Miller stage BEFORE
+        # block k's finalexp so the device overlaps sparse line
+        # evaluation with the previous block's finalexp mega-kernel.
+        # 1 = single fused dispatch (no pipelining).
+        blocks = os.environ.get("GETHSHARDING_PRECOMP_BLOCKS", "2")
+        try:
+            self._precomp_blocks = int(blocks)
+        except ValueError:
+            self._precomp_blocks = 0
+        if self._precomp_blocks < 1:
+            raise ValueError(
+                f"GETHSHARDING_PRECOMP_BLOCKS={blocks!r}: want a"
+                " positive integer")
+
+        def _precompute_planes(px, py, pm):
+            i32 = jnp.int32
+            return bn256_jax.precompute_g2_lines(
+                px.astype(i32), py.astype(i32), pm)
+
+        # one precompute jit serves every layout: committed inputs keep
+        # the dispatch on the owning device (mesh shards included); the
+        # astype is a no-op on the i32 wire
+        self._precompute = jax.jit(_precompute_planes)
+
+        def _precomp_full(hx, hy, sx, sy, sm, tab, inf, hok, gen):
+            i32 = jnp.int32
+            return bn256_jax.bls_verify_committee_precomp_batch(
+                hx.astype(i32), hy.astype(i32), sx.astype(i32),
+                sy.astype(i32), sm, tab, inf, hok, gen_lines=gen)
+
+        def _precomp_miller(hx, hy, sx, sy, sm, tab, inf, hok, gen):
+            i32 = jnp.int32
+            return bn256_jax.bls_committee_precomp_miller(
+                hx.astype(i32), hy.astype(i32), sx.astype(i32),
+                sy.astype(i32), sm, tab, inf, hok, gen_lines=gen)
+
+        self._precomp_full = jax.jit(_precomp_full)
+        self._precomp_miller = jax.jit(_precomp_miller)
+        self._precomp_finalexp = jax.jit(
+            bn256_jax.bls_committee_precomp_finalexp)
         # the backend is a process-wide singleton shared by every actor
         # thread (get_backend caches instances): all cache structures
         # are lock-guarded (cache.py)
@@ -138,6 +191,39 @@ class JaxSigBackend(ResidentPkCache, SigBackend):
             self._bls_committee_mesh = jax.jit(shard_map(
                 _mesh_step, mesh=mesh, in_specs=(spec,) * 9,
                 out_specs=(spec, PartitionSpec())))
+
+            def _mesh_step_precomp(hx, hy, sx, sy, sm, tab, inf, hok,
+                                   gen):
+                # the precomp twin of the ONE pjit'd audit step: line
+                # tables arrive pre-sharded from the per-device cache
+                # shards, the replicated generator table rides along,
+                # and the vote-total psum stays the only collective
+                i32 = jnp.int32
+                ok = bn256_jax.bls_verify_committee_precomp_batch(
+                    hx.astype(i32), hy.astype(i32), sx.astype(i32),
+                    sy.astype(i32), sm, tab, inf, hok, gen_lines=gen)
+                votes = jax.lax.psum(jnp.sum(ok.astype(i32)), axis_names)
+                return ok, votes
+
+            self._bls_committee_mesh_precomp = jax.jit(shard_map(
+                _mesh_step_precomp, mesh=mesh,
+                in_specs=(spec,) * 8 + (PartitionSpec(),),
+                out_specs=(spec, PartitionSpec())))
+        # the G2-generator line table: precomputed at import (host),
+        # shipped ONCE at construction and passed into every precomp
+        # executable as an argument — an embedded constant would
+        # re-materialize per compiled shape. Censused by the resident
+        # owners (cache.py) so devscope attribution stays drift-free.
+        if self._precomp:
+            if self._layout.is_mesh:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                self._gen_lines_mesh = jax.device_put(
+                    bn256_jax.generator_line_table(),
+                    NamedSharding(self._layout.mesh, PartitionSpec()))
+            else:
+                self._gen_lines_dev = jnp.asarray(
+                    bn256_jax.generator_line_table())
         # device-memory attribution: the resident pk-plane LRU (and on
         # mesh layouts each per-device shard) registers as a devscope
         # census owner — cache.py holds the weakref plumbing
@@ -167,6 +253,52 @@ class JaxSigBackend(ResidentPkCache, SigBackend):
     # the module-level bucket_size, kept as a staticmethod so kernel
     # call sites read as "this backend's padding policy"
     _bucket = staticmethod(bucket_size)
+
+    # the device-resident G2-generator line table (single-device /
+    # mesh-replicated) — None when GETHSHARDING_PRECOMP=0 or on
+    # partially-built test instances
+    _gen_lines_dev = None
+    _gen_lines_mesh = None
+
+    def _precomp_nblocks(self, bucket: int) -> int:
+        """Pipeline block count for a precomp dispatch: the largest
+        divisor of `bucket` not above GETHSHARDING_PRECOMP_BLOCKS, and
+        never splitting below the finalexp mega-kernel's lane block
+        (a partial block would pad back to BLOCK_LANES, wasting
+        lanes)."""
+        nb = min(self._precomp_blocks, bucket)
+        if nb > 1 and self._bn.FINALEXP == "mega":
+            from gethsharding_tpu.ops.pallas_finalexp import block_lanes
+
+            nb = min(nb, max(1, bucket // block_lanes()))
+        while nb > 1 and bucket % nb:
+            nb -= 1
+        return nb
+
+    def _precomp_launch(self, args, bucket: int, blocks: int):
+        """Launch the precomp committee dispatch: one fused kernel, or
+        `blocks` pipelined lane blocks. Block k+1's Miller stage is
+        enqueued BEFORE block k's finalexp, so the device overlaps the
+        sparse line evaluations with the previous block's finalexp
+        mega-kernel (every launch is async; the caller's pull is the
+        only barrier). Splitting is along the independent row axis —
+        per-row values, and therefore verdicts, are identical to the
+        fused launch."""
+        jnp = self._jnp
+        gen = self._gen_lines_dev
+        if blocks <= 1:
+            return self._precomp_full(*args, gen)
+        bs = bucket // blocks
+        staged = None
+        outs = []
+        for k in range(blocks):
+            blk = tuple(a[k * bs:(k + 1) * bs] for a in args)
+            nxt = self._precomp_miller(*blk, gen)
+            if staged is not None:
+                outs.append(self._precomp_finalexp(*staged))
+            staged = nxt
+        outs.append(self._precomp_finalexp(*staged))
+        return jnp.concatenate(outs)
 
     def ecrecover_addresses(self, digests, sigs65):
         import numpy as np
@@ -463,15 +595,25 @@ class JaxSigBackend(ResidentPkCache, SigBackend):
         # SUMMED, so a multi-dispatch span reports total bytes
         tracing.tag_current_add(wire_bytes=wire["wire_bytes"],
                                 pk_hit_bytes=wire["pk_hit_bytes"])
-        fn = (self._bls_committee_u16 if self._wire_u16
-              else self._bls_committee)
         tracer = tracing.TRACER
         marshal_s = t1 - t0  # host marshal: limb planes + cache resolve
         dt.dispatched()  # marshal (incl. transfer staging) closes here
-        with self._compiles.compile_span(
-                "bls_committee",
-                (st["bucket"], st["width"], self._wire), st["fresh"]):
-            out = fn(*args)  # async dispatch: returns before execution ends
+        if st["precomp"]:
+            with self._compiles.compile_span(
+                    "bls_committee_precomp",
+                    (st["bucket"], st["width"], self._wire,
+                     st["blocks"]), st["fresh"]):
+                # async launch(es): the pipelined form enqueues Miller
+                # block k+1 before finalexp block k
+                out = self._precomp_launch(args, st["bucket"],
+                                           st["blocks"])
+        else:
+            fn = (self._bls_committee_u16 if self._wire_u16
+                  else self._bls_committee)
+            with self._compiles.compile_span(
+                    "bls_committee",
+                    (st["bucket"], st["width"], self._wire), st["fresh"]):
+                out = fn(*args)  # async dispatch: returns pre-execution
         # finalize must close over SCALARS, not the marshal dict: `st`
         # pins every host limb plane (MBs per dispatch) until result(),
         # and an overlapped K-period pipeline holds K of them at once
@@ -542,16 +684,21 @@ class JaxSigBackend(ResidentPkCache, SigBackend):
         bucket = lay.mesh_bucket(n)
         pad = bucket - n
         width = marshal.committee_width(sig_rows, pk_rows)
+        rows = list(pk_rows) + [[]] * pad
+        keys = marshal.normalize_row_keys(pk_row_keys, len(rows))
+        resident = self._resident and keys is not None
+        precomp = self._precomp and resident
         # the compile-cache key includes the device count: re-laying the
-        # same process over a different mesh is a fresh XLA program
-        fresh = self._note_shape("bls_committee_mesh", bucket, width,
-                                 self._wire, lay.n_devices)
+        # same process over a different mesh is a fresh XLA program (and
+        # the precomp step is its own program again)
+        fresh = self._note_shape(
+            "bls_committee_mesh_precomp" if precomp
+            else "bls_committee_mesh",
+            bucket, width, self._wire, lay.n_devices)
         check = os.environ.get("GETHSHARDING_CHECK") == "1"
         host = marshal.committee_host_planes(
             self._bn, messages, sig_rows, pad, width,
             marshal.wire_dtype(self._wire_u16, check))
-        rows = list(pk_rows) + [[]] * pad
-        keys = marshal.normalize_row_keys(pk_row_keys, len(rows))
         st = {"n": n, "bucket": bucket, "pad": pad, "width": width,
               "fresh": fresh, "check": check,
               "pk_rows": sum(1 for r in rows if r),
@@ -562,8 +709,10 @@ class JaxSigBackend(ResidentPkCache, SigBackend):
         sm, hok = host["sm"], host["hok"]
         wire_bytes = (hx.nbytes + hy.nbytes + sx.nbytes + sy.nbytes
                       + sm.nbytes + hok.nbytes)
-        resident = self._resident and keys is not None
-        if resident:
+        if precomp:
+            tab, inf, g2_bytes = self._mesh_line_tables(st, rows, keys,
+                                                        lay)
+        elif resident:
             px, py, pm, g2_bytes = self._mesh_pk_planes(st, rows, keys,
                                                         lay)
         else:
@@ -574,9 +723,14 @@ class JaxSigBackend(ResidentPkCache, SigBackend):
             px, py, pm = lay.place(pxh), lay.place(pyh), lay.place(pmh)
         wire_bytes += g2_bytes
         t1 = time.perf_counter()
-        args = (lay.place(hx), lay.place(hy), lay.place(sx),
-                lay.place(sy), lay.place(sm), px, py, pm,
-                lay.place(hok))
+        if precomp:
+            args = (lay.place(hx), lay.place(hy), lay.place(sx),
+                    lay.place(sy), lay.place(sm), tab, inf,
+                    lay.place(hok), self._gen_lines_mesh)
+        else:
+            args = (lay.place(hx), lay.place(hy), lay.place(sx),
+                    lay.place(sy), lay.place(sm), px, py, pm,
+                    lay.place(hok))
         if timing:
             for a in args:
                 a.block_until_ready()
@@ -586,7 +740,8 @@ class JaxSigBackend(ResidentPkCache, SigBackend):
                 "pk_hit_bytes": int(st["hit_bytes"]),
                 "pk_rows": int(st["pk_rows"]),
                 "pk_hit_rows": int(st["hit_rows"]),
-                "resident": resident, "wire": self._wire}
+                "resident": resident, "precomp": precomp,
+                "wire": self._wire}
         self.last_wire = wire
         RECORDER.record_wire("bls_verify_committees", wire)
         self._m_wire_bytes.inc(wire["wire_bytes"])
@@ -595,17 +750,21 @@ class JaxSigBackend(ResidentPkCache, SigBackend):
                                 pk_hit_bytes=wire["pk_hit_bytes"])
         tracer = tracing.TRACER
         marshal_s = t1 - t0
-        exe_key = (bucket, width, self._wire)
+        exe_key = (bucket, width, self._wire,
+                   "precomp" if precomp else "recompute")
+        mesh_fn = (self._bls_committee_mesh_precomp if precomp
+                   else self._bls_committee_mesh)
         dt.dispatched()
         with self._compiles.compile_span(
-                "bls_committee_mesh",
+                "bls_committee_mesh_precomp" if precomp
+                else "bls_committee_mesh",
                 (bucket, width, self._wire, lay.n_devices), fresh):
             exe = self._mesh_exec.get(exe_key)
             if exe is None:
                 # AOT: one .lower().compile() gives the executable AND
                 # its optimized HLO, so the one-collective assertion is
                 # a free byproduct of the compile we had to do anyway
-                exe = self._bls_committee_mesh.lower(*args).compile()
+                exe = mesh_fn.lower(*args).compile()
                 self._mesh_exec[exe_key] = exe
                 self._mesh_collectives[exe_key] = \
                     layout_mod.count_collectives(exe.as_text())
@@ -614,6 +773,7 @@ class JaxSigBackend(ResidentPkCache, SigBackend):
         mesh_rec = {"op": "bls_verify_committees",
                     "n_devices": lay.n_devices, "bucket": bucket,
                     "width": width, "collectives": collectives,
+                    "precomp": precomp,
                     "verdict_devices": None, "vote_total": None}
         self.last_mesh = mesh_rec
 
@@ -661,24 +821,39 @@ class JaxSigBackend(ResidentPkCache, SigBackend):
         bucket = self._bucket(n)
         pad = bucket - n
         width = marshal.committee_width(sig_rows, pk_rows)
+        rows = list(pk_rows) + [[]] * pad
+        keys = marshal.normalize_row_keys(pk_row_keys, len(rows))
+        resident = self._resident and keys is not None
+        # the precomp path needs the resident LRU (line tables are its
+        # residents) — keyless or resident-off dispatches fall back to
+        # the recompute kernel, today's path bit-for-bit
+        precomp = self._precomp and resident
+        blocks = self._precomp_nblocks(bucket) if precomp else 0
         # the compile-cache key INCLUDES the wire dtype: the u16 wire
         # compiles a different XLA program for the same (bucket, width),
         # so counting it against the other wire's entry would book a
-        # real recompile as a hit
-        fresh = self._note_shape("bls_committee", bucket, width, self._wire)
+        # real recompile as a hit. The precomp path is its own op (line
+        # tables in, no G2 planes, its own block pipeline).
+        if precomp:
+            fresh = self._note_shape("bls_committee_precomp", bucket,
+                                     width, self._wire, blocks)
+        else:
+            fresh = self._note_shape("bls_committee", bucket, width,
+                                     self._wire)
         check = os.environ.get("GETHSHARDING_CHECK") == "1"
         host = marshal.committee_host_planes(
             self._bn, messages, sig_rows, pad, width,
             marshal.wire_dtype(self._wire_u16, check))
-        rows = list(pk_rows) + [[]] * pad
-        keys = marshal.normalize_row_keys(pk_row_keys, len(rows))
         st = {"n": n, "bucket": bucket, "pad": pad, "width": width,
               "fresh": fresh, "check": check,
               "pk_rows": sum(1 for r in rows if r),
               "hx": host["hx"], "hy": host["hy"], "hok": host["hok"],
               "sx": host["sx"], "sy": host["sy"], "sm": host["sm"],
-              "resident": self._resident and keys is not None}
-        if st["resident"]:
+              "resident": resident, "precomp": precomp,
+              "blocks": blocks}
+        if precomp:
+            self._line_resolve(st, rows, keys)
+        elif resident:
             self._pk_resident_resolve(st, rows, keys)
         else:
             px, py, pm = self._pk_rows_to_limbs(rows, width, row_keys=keys)
@@ -698,25 +873,37 @@ class JaxSigBackend(ResidentPkCache, SigBackend):
         sm, hok = st["sm"], st["hok"]
         wire_bytes = (hx.nbytes + hy.nbytes + sx.nbytes + sy.nbytes
                       + sm.nbytes + hok.nbytes)
-        if st["resident"]:
-            px, py, pm, g2_bytes = self._pk_resident_planes(st)
+        if st["precomp"]:
+            # line tables replace the pk planes entirely: warm rows
+            # ship NOTHING (g2_bytes counts only cold precompute input)
+            tab, inf, g2_bytes = self._line_tables(st)
             hit_bytes, hit_rows = st["hit_bytes"], st["hit_rows"]
+            args = (jnp.asarray(hx), jnp.asarray(hy), jnp.asarray(sx),
+                    jnp.asarray(sy), jnp.asarray(sm), tab, inf,
+                    jnp.asarray(hok))
         else:
-            pxh, pyh, pmh = conv(st["px"]), conv(st["py"]), st["pm"]
-            g2_bytes = pxh.nbytes + pyh.nbytes + pmh.nbytes
-            px, py, pm = (jnp.asarray(pxh), jnp.asarray(pyh),
-                          jnp.asarray(pmh))
-            hit_bytes = hit_rows = 0
+            if st["resident"]:
+                px, py, pm, g2_bytes = self._pk_resident_planes(st)
+                hit_bytes, hit_rows = st["hit_bytes"], st["hit_rows"]
+            else:
+                pxh, pyh, pmh = conv(st["px"]), conv(st["py"]), st["pm"]
+                g2_bytes = pxh.nbytes + pyh.nbytes + pmh.nbytes
+                px, py, pm = (jnp.asarray(pxh), jnp.asarray(pyh),
+                              jnp.asarray(pmh))
+                hit_bytes = hit_rows = 0
+            args = (jnp.asarray(hx), jnp.asarray(hy), jnp.asarray(sx),
+                    jnp.asarray(sy), jnp.asarray(sm), px, py, pm,
+                    jnp.asarray(hok))
         wire_bytes += g2_bytes
-        args = (jnp.asarray(hx), jnp.asarray(hy), jnp.asarray(sx),
-                jnp.asarray(sy), jnp.asarray(sm), px, py, pm,
-                jnp.asarray(hok))
         wire = {"wire_bytes": int(wire_bytes),
                 "g2_wire_bytes": int(g2_bytes),
                 "pk_hit_bytes": int(hit_bytes),
                 "pk_rows": int(st["pk_rows"]),
                 "pk_hit_rows": int(hit_rows),
-                "resident": st["resident"], "wire": self._wire}
+                "resident": st["resident"],
+                "precomp": st["precomp"],
+                "blocks": (int(st["blocks"]) if st["precomp"] else None),
+                "wire": self._wire}
         return args, wire
 
     # populated by bls_verify_committees under GETHSHARDING_SIG_TIMING=1:
@@ -727,9 +914,10 @@ class JaxSigBackend(ResidentPkCache, SigBackend):
 
     # populated by EVERY committee dispatch (no sync, pure nbytes
     # arithmetic): {wire_bytes, g2_wire_bytes, pk_hit_bytes, pk_rows,
-    # pk_hit_rows, resident, wire} — the transfer-attribution ledger
-    # bench.py records per config and the residency tests assert on
-    # (steady state: g2_wire_bytes == 0)
+    # pk_hit_rows, resident, precomp, wire} — the transfer-attribution
+    # ledger bench.py records per config and the residency/precomp
+    # tests assert on (steady state: g2_wire_bytes == 0; precomp True
+    # when the dispatch consumed resident line tables)
     last_wire: dict | None = None
 
     # populated by every MESH dispatch: {op, n_devices, bucket, width,

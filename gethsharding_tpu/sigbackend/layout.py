@@ -63,6 +63,21 @@ def count_collectives(hlo_text: str) -> int:
     return n
 
 
+def count_ops(hlo_text: str, opcode: str) -> int:
+    """Occurrences of one HLO opcode in a compiled module — the op
+    census behind the precomp path's non-vacuity check: the warm
+    precomp executable must carry far fewer `multiply` ops than the
+    recompute executable, proving the fixed-argument Miller point
+    arithmetic really is absent (same contract as `count_collectives`:
+    counted from the optimized AOT text, no second compile)."""
+    n = 0
+    needle = f" {opcode}("
+    for line in hlo_text.splitlines():
+        if needle in line.strip():
+            n += 1
+    return n
+
+
 class DeviceLayout:
     """Resolved placement for one backend instance.
 

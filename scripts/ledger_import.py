@@ -84,8 +84,19 @@ def _to_record(parsed: dict, path: str, round_n=None) -> "dict | None":
 
 
 def _fingerprint(rec: dict) -> tuple:
-    return (rec.get("workload"), rec.get("metrics", {}).get("value"),
-            rec.get("ts"))
+    base = (rec.get("workload"), rec.get("metrics", {}).get("value"))
+    extra = rec.get("extra") or {}
+    if extra.get("captured_at"):
+        # embedded stamp: stable across checkouts, and SHARED by a
+        # round that re-reports an earlier round's capture — exactly
+        # the cross-file dedup the provenance stamp exists for
+        return base + (rec.get("ts"),)
+    # stamp-less history (cpu-era rounds, the retired MULTICHIP
+    # snapshots): mtime is checkout-fragile — a fresh checkout resets
+    # it, and a ts-keyed fingerprint would re-import every stamp-less
+    # record as "new". The source file itself is the stable identity:
+    # one ledger record per imported file, idempotent forever.
+    return base + (extra.get("imported_from"),)
 
 
 def collect() -> list:
@@ -101,6 +112,44 @@ def collect() -> list:
             print(f"# skipping {path}: no parsed metric line",
                   file=sys.stderr)
             continue
+        records.append(rec)
+    for path in sorted(glob.glob(os.path.join(REPO,
+                                              "MULTICHIP_r0*.json"))):
+        # the retired multi-chip dryrun snapshots (PR 18 made
+        # tests/test_multichip_dryrun.py the evidence path): the
+        # rc/ok/tail contract folds into the ledger as the
+        # `multichip_dryrun` workload, value = device count, so the
+        # classifier test keeps real recorded tails to chew on after
+        # the root JSON files are deleted
+        try:
+            data = json.load(open(path))
+        except (OSError, ValueError) as exc:
+            print(f"# skipping {path}: {exc!r}", file=sys.stderr)
+            continue
+        if not isinstance(data, dict) or "n_devices" not in data:
+            print(f"# skipping {path}: not a dryrun snapshot",
+                  file=sys.stderr)
+            continue
+        stem = os.path.basename(path)[len("MULTICHIP_r"):].split(".")[0]
+        try:
+            round_n = int(stem)
+        except ValueError:
+            round_n = None
+        rec = _to_record(
+            {"metric": "multichip_dryrun_devices",
+             "value": data.get("n_devices"),
+             "unit": "devices (dryrun_multichip child snapshot: rc/ok "
+                     "ride as metrics/extra, stderr tail verbatim)",
+             "extra": {"rc": data.get("rc"),
+                       "ok": bool(data.get("ok")),
+                       "skipped": bool(data.get("skipped")),
+                       "tail": data.get("tail", "")}},
+            path, round_n=round_n)
+        if rec is None:
+            print(f"# skipping {path}: malformed snapshot",
+                  file=sys.stderr)
+            continue
+        rec["workload"] = "multichip_dryrun"
         records.append(rec)
     for path in sorted(glob.glob(os.path.join(REPO, "bench_results",
                                               "*.json"))):

@@ -116,6 +116,47 @@ assert jx.last_wire["g2_wire_bytes"] == 0, jx.last_wire  # warm = resident
 print("resident/overlap smoke OK:", jx.last_wire)
 PYEOF
 
+# -- precomp smoke: fixed-base line tables end-to-end on hermetic CPU —
+# ONE audit with precomp on vs off, verdicts bit-identical to the
+# scalar reference (incl. a forged row), the warm dispatch ships zero
+# G2 bytes AND runs from the cached line tables (precomp wire stamp),
+# and the flag-off backend takes today's recompute path unchanged
+echo "== precomp smoke"
+JAX_PLATFORMS=cpu GETHSHARDING_TPU_RESIDENT=1 GETHSHARDING_PRECOMP=1 \
+python - <<'PYEOF' || fail=1
+import os
+
+from gethsharding_tpu.crypto import bn256 as bls
+from gethsharding_tpu.sigbackend import PythonSigBackend
+from gethsharding_tpu.sigbackend.dispatch import JaxSigBackend
+
+py = PythonSigBackend()
+msgs, sig_rows, pk_rows, keys = [], [], [], []
+for i in range(3):
+    tag = b"pre-suite-%d" % i
+    ks = [bls.bls_keygen(tag + bytes([j])) for j in range(2)]
+    sigs = [bls.bls_sign(tag, sk) for sk, _ in ks]
+    if i == 1:
+        sigs[0] = bls.bls_sign(b"tampered", ks[0][0])
+    msgs.append(tag); sig_rows.append(sigs)
+    pk_rows.append([pk for _, pk in ks]); keys.append(("pre-suite", i))
+want = py.bls_verify_committees(msgs, sig_rows, pk_rows)
+on = JaxSigBackend()
+assert on._precomp, "GETHSHARDING_PRECOMP=1 did not engage"
+cold = on.bls_verify_committees(msgs, sig_rows, pk_rows, pk_row_keys=keys)
+warm = on.bls_verify_committees(msgs, sig_rows, pk_rows, pk_row_keys=keys)
+assert cold == warm == want, (cold, warm, want)
+assert on.last_wire["precomp"] is True, on.last_wire
+assert on.last_wire["g2_wire_bytes"] == 0, on.last_wire  # warm line tables
+os.environ["GETHSHARDING_PRECOMP"] = "0"
+off = JaxSigBackend()
+assert not off._precomp
+assert off.bls_verify_committees(
+    msgs, sig_rows, pk_rows, pk_row_keys=keys) == want
+assert off.last_wire["precomp"] is False, off.last_wire
+print("precomp smoke OK:", on.last_wire)
+PYEOF
+
 # -- mesh smoke: the multi-chip dispatch core on a 2-device virtual
 # mesh — ONE audit through scalar / single-device / mesh (bench.py
 # --mesh asserts bit-identity, exactly one cross-device collective,

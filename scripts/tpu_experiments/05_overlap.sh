@@ -32,4 +32,18 @@ env "${VIRT_ENV[@]}" \
 grep -q overlap_ratio "$1.out" \
   && grep -q "$PLATFORM" "$1.out" \
   && python scripts/probe_ledger_check.py audit_overlap_ratio \
-       --max-age 7200
+       --max-age 7200 \
+  || exit 1
+# Composed rider: the overlapped K-period pipeline against warm
+# resident pk planes AND warm fixed-base line tables (bench.py
+# --composed) — overlap's steady-state production shape. Same
+# ledger-gated acceptance as the solo run.
+env "${VIRT_ENV[@]}" \
+    GETHSHARDING_TPU_LIMB_FORM=exact GETHSHARDING_TPU_CARRY=scan \
+    GETHSHARDING_TPU_FINALEXP=mega GETHSHARDING_TPU_MILLER=mega \
+    GETHSHARDING_TPU_RESIDENT=1 GETHSHARDING_BENCH_COMPOSED_K=4 \
+  timeout 6900 python bench.py --composed \
+    >"$1.composed.out" 2>"$1.composed.err"
+grep -q composed_audit_sig_rate "$1.composed.out" \
+  && grep -q "$PLATFORM" "$1.composed.out" \
+  && python scripts/probe_ledger_check.py composed_audit --max-age 7200

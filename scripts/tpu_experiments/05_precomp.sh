@@ -1,14 +1,16 @@
 #!/bin/bash
-# Device-resident pk planes on the real chip: cold-vs-warm wire ledger
-# of the audit dispatch under the champion knobs. The warm dispatch
-# must ship ZERO G2 pubkey bytes (bench asserts it); the cold/warm
-# wall delta bounds the transfer share of the 0.297 s dispatch — the
-# number that closes probe 42's "transfer dominates" branch. u16 wire
-# stacked on top so the fresh-per-period buffers ship narrow too.
+# Fixed-base pairing precomputation on the real chip: the bench.py
+# --precomp closed loop under the champion knobs. Cold audit pays ONE
+# precompute dispatch per new committee row; the warm audit ships ZERO
+# G2 pubkey bytes AND skips the Miller-loop point arithmetic entirely
+# (the HLO multiply census asserts the shrink), with verdicts
+# bit-identical to the scalar twin and the recompute path — including
+# empty rows, infinity points and forged rows. The config-5 stress
+# record rides along on the precomp-era tree.
 #
 # Acceptance runs through the perfwatch ledger, not a stdout grep
-# alone: bench.py --resident emits audit_warm_wire_bytes_per_dispatch
-# through record_bench with the device-timer validity stamp, and
+# alone: bench.py --precomp emits precomp_audit_sig_rate through
+# record_bench with the device-timer validity stamp, and
 # probe_ledger_check.py fails the probe if the record never landed or
 # landed invalid. Until a tunnel window opens,
 # PROBE_VIRTUAL_DEVICES=N runs the SAME closed loop hermetically on
@@ -27,19 +29,21 @@ env "${VIRT_ENV[@]}" \
     GETHSHARDING_TPU_LIMB_FORM=exact GETHSHARDING_TPU_CARRY=scan \
     GETHSHARDING_TPU_FINALEXP=mega GETHSHARDING_TPU_MILLER=mega \
     GETHSHARDING_TPU_WIRE=u16 GETHSHARDING_TPU_RESIDENT=1 \
-  timeout 4800 python bench.py --resident >"$1.out" 2>"$1.err"
+    GETHSHARDING_PRECOMP=1 \
+  timeout 4800 python bench.py --precomp >"$1.out" 2>"$1.err"
 grep -q '"g2_wire_bytes_warm": 0' "$1.out" \
+  && grep -q precomp_audit_sig_rate "$1.out" \
   && grep -q "$PLATFORM" "$1.out" \
-  && python scripts/probe_ledger_check.py \
-       audit_warm_wire_bytes_per_dispatch --max-age 7200 \
+  && python scripts/probe_ledger_check.py precomp_audit --max-age 7200 \
   || exit 1
-# Composed rider: resident + overlap + precomp stacked in the one
-# K-period pipeline (bench.py --composed) — the steady-state production
-# shape. Same ledger-gated acceptance as the solo run.
+# Composed rider: precomp stacked with resident + overlap in the one
+# K-period pipeline (bench.py --composed). Same ledger-gated
+# acceptance as the solo run.
 env "${VIRT_ENV[@]}" \
     GETHSHARDING_TPU_LIMB_FORM=exact GETHSHARDING_TPU_CARRY=scan \
     GETHSHARDING_TPU_FINALEXP=mega GETHSHARDING_TPU_MILLER=mega \
     GETHSHARDING_TPU_WIRE=u16 GETHSHARDING_TPU_RESIDENT=1 \
+    GETHSHARDING_PRECOMP=1 \
   timeout 4800 python bench.py --composed \
     >"$1.composed.out" 2>"$1.composed.err"
 grep -q composed_audit_sig_rate "$1.composed.out" \

@@ -5,10 +5,12 @@ The driver used to snapshot ``dryrun_multichip`` child output into raw
 XLA:CPU AOT loader error (``cpu_aot_loader.cc``: machine-feature
 mismatch, "could lead to execution errors such as SIGILL") next to
 ``rc: 0`` — benign in every observed run, but nothing ASSERTED that.
-These tests pin the contract down:
+The root snapshots are retired: their rc/ok/tail history now lives in
+``perf_ledger.jsonl`` as the ``multichip_dryrun`` workload (imported by
+``scripts/ledger_import.py``), and these tests pin the contract down:
 
 * the classifier in ``parallel.virtual`` recognizes exactly that noise
-  class (checked against the recorded snapshot tails themselves), and
+  class (checked against the imported snapshot tails themselves), and
   never excuses a nonzero rc;
 * the dryrun child, run the same way the driver runs it (clean
   subprocess, forced virtual CPU platform), exits 0 with every stderr
@@ -18,8 +20,6 @@ These tests pin the contract down:
 
 from __future__ import annotations
 
-import glob
-import json
 import os
 import subprocess
 import sys
@@ -65,19 +65,27 @@ def test_classifier_recognizes_aot_mismatch_lines():
 def test_classifier_covers_recorded_snapshot_tails():
     """Every OK run's recorded tail is fully explained by the warn-only
     class — the evidence that made rc-decides-and-tail-is-noise the
-    contract in the first place."""
-    snaps = sorted(glob.glob(os.path.join(REPO, "MULTICHIP_r0*.json")))
+    contract in the first place. The tails live in the committed perf
+    ledger (workload ``multichip_dryrun``, imported from the retired
+    MULTICHIP_r0x.json snapshots by scripts/ledger_import.py); the
+    repo-root path is explicit because conftest points the default
+    ledger at a per-run temp file."""
+    from gethsharding_tpu.perfwatch.ledger import Ledger
+
+    ledger = Ledger(os.path.join(REPO, "perf_ledger.jsonl"))
+    recs = ledger.records(workload="multichip_dryrun")
+    assert recs, "multichip_dryrun history missing from perf_ledger.jsonl"
     checked = 0
-    for path in snaps:
-        with open(path) as fh:
-            snap = json.load(fh)
-        if not snap.get("ok") or snap.get("rc") != 0:
+    for rec in recs:
+        extra = rec.get("extra") or {}
+        if not extra.get("ok") or rec.get("metrics", {}).get("rc") != 0:
             continue
-        for line in snap.get("tail", "").splitlines():
+        src = extra.get("imported_from", rec.get("ts"))
+        for line in extra.get("tail", "").splitlines():
             if line.strip():
-                assert is_aot_mismatch_line(line), (path, line)
+                assert is_aot_mismatch_line(line), (src, line)
                 checked += 1
-    if snaps and not checked:
+    if not checked:
         pytest.skip("no ok-run snapshot tails to check")
 
 

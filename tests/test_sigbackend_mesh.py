@@ -256,9 +256,12 @@ def test_committee_mesh_non_vacuity(backends):
         assert fut.result() == want
         assert info["verdict_devices"] == n
         assert info["vote_total"] == sum(want)
-        # the memoized pk planes are themselves mesh-sharded arrays
-        memo_px = backend._mesh_memo[1][0]
-        assert len(memo_px.sharding.device_set) == n
+        # the memoized planes are themselves mesh-sharded arrays: the
+        # line table under precomp (the default), the pk planes on the
+        # recompute path
+        memo = (backend._mesh_line_memo if backend._precomp
+                else backend._mesh_memo)
+        assert len(memo[1][0].sharding.device_set) == n
 
 
 @pytest.mark.slow

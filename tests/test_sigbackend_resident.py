@@ -110,8 +110,10 @@ def test_warm_device_cache_ships_zero_g2_bytes():
     assert backend.last_wire["g2_wire_bytes"] > 0
     # the committee compile-cache key carries the wire dtype: flipping
     # GETHSHARDING_TPU_WIRE compiles a DIFFERENT program for the same
-    # (bucket, width), which must count as a miss, not a hit
-    assert any(k[0] == "bls_committee" and k[-1] == backend._wire
+    # (bucket, width), which must count as a miss, not a hit (keyed
+    # dispatches run the precomp op when GETHSHARDING_PRECOMP is on)
+    assert any(k[0] in ("bls_committee", "bls_committee_precomp")
+               and backend._wire in k[1:]
                for k in backend._shape_seen)
     warm = backend.bls_verify_committees(
         msgs, sig_rows, pk_rows, pk_row_keys=keys)
