@@ -61,6 +61,8 @@ DEFAULT_CLASSES = (
     "gethsharding_tpu.fleet.router:FleetRouter",
     "gethsharding_tpu.fleet.router:RpcReplicaBackend",
     "gethsharding_tpu.fleet.frontend:FrontendServer",
+    "gethsharding_tpu.fleet.membership:FleetMembership",
+    "gethsharding_tpu.fleet.autoscaler:Autoscaler",
     "gethsharding_tpu.resilience.breaker:CircuitBreaker",
     "gethsharding_tpu.resilience.watchdog:DispatchWatchdog",
     "gethsharding_tpu.slo.tracker:SLOTracker",

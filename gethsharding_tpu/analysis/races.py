@@ -292,8 +292,11 @@ def build_race_model(corpus: Corpus,
     attr_typing = {}
     # (rel, cls) -> attr -> atomic ctor name
     attr_atomic: Dict[Tuple[str, str], Dict[str, str]] = {}
-    # (rel, cls) -> method name -> (rel, cls) return type (annotation)
-    method_returns: Dict[Tuple[str, str], Dict[str, Tuple[str, str]]] = {}
+    # (rel, cls) -> method name -> ('scalar'|'elem', (rel, cls)) return
+    # type from the annotation ('elem' = container of that class, so
+    # `for x in self.members():` types the loop variable)
+    method_returns: Dict[
+        Tuple[str, str], Dict[str, Tuple[str, Tuple[str, str]]]] = {}
     # (rel, cls) -> classes constructed anywhere in its methods
     constructs: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
 
@@ -313,13 +316,13 @@ def build_race_model(corpus: Corpus,
             method_returns[(rel, cls_name)] = {}
             continue
         local_classes = local_classes_of.get(rel, set())
-        returns: Dict[str, Tuple[str, str]] = {}
+        returns: Dict[str, Tuple[str, Tuple[str, str]]] = {}
         for m_name, fn in info.methods.items():
             ret = fn.returns
             hit = _annotation_type(ret, sf, corpus, local_classes) \
                 if ret is not None else None
-            if hit is not None and hit[0] == "scalar":
-                returns[m_name] = hit[1]
+            if hit is not None:
+                returns[m_name] = hit
         method_returns[(rel, cls_name)] = returns
 
     for (rel, cls_name), info in classes.items():
@@ -393,8 +396,8 @@ def build_race_model(corpus: Corpus,
                     if rtype is not None:
                         hit = method_returns.get(rtype, {}).get(
                             value.func.attr)
-                        if hit is not None:
-                            typing.setdefault(tgt.attr, ("scalar", hit))
+                        if hit is not None and hit[0] == "scalar":
+                            typing.setdefault(tgt.attr, hit)
                             continue
                 # self.x = {k: Cls(...) for ...} / [Cls(...) for ...]
                 elt = None
@@ -599,15 +602,15 @@ def build_race_model(corpus: Corpus,
                         if isinstance(func.value, ast.Name) and \
                                 func.value.id == "self":
                             hit = returns.get(func.attr)
-                            if hit is not None:
-                                return hit
+                            if hit is not None and hit[0] == "scalar":
+                                return hit[1]
                         # typed_receiver.m() -> m's return annotation
                         owner = typed(func.value)
                         if owner is not None:
                             hit = method_returns.get(owner, {}) \
                                 .get(func.attr)
-                            if hit is not None:
-                                return hit
+                            if hit is not None and hit[0] == "scalar":
+                                return hit[1]
                     elif isinstance(func, ast.Name):
                         hit2 = _ctor_class(expr, sf, corpus, rel,
                                            local_classes)
@@ -748,6 +751,23 @@ def build_race_model(corpus: Corpus,
                             elem = hit[1]
                     elif sname and sname in local_types:
                         hit = local_types[sname]
+                        if hit is not None and hit[0] == "elem":
+                            elem = hit[1]
+                    elif isinstance(src, ast.Call) and \
+                            isinstance(src.func, ast.Attribute):
+                        # `for replica in self.members():` — a snapshot
+                        # accessor with a container return annotation
+                        # types the loop variable like the container
+                        # attribute would
+                        hit = None
+                        if isinstance(src.func.value, ast.Name) and \
+                                src.func.value.id == "self":
+                            hit = returns.get(src.func.attr)
+                        else:
+                            owner = typed(src.func.value)
+                            if owner is not None:
+                                hit = method_returns.get(owner, {}) \
+                                    .get(src.func.attr)
                         if hit is not None and hit[0] == "elem":
                             elem = hit[1]
                     if elem is not None:

@@ -21,7 +21,20 @@ story on top of it:
   registry, health sweep and drain orchestration, and serves the full
   serving RPC plane set (ecrecover / aggregates / committees / DAS) to
   actors over JSON-RPC — the fleet's failure-domain boundary
-  (``python -m gethsharding_tpu.fleet.frontend``).
+  (``python -m gethsharding_tpu.fleet.frontend``). Frontends replicate:
+  ``--peer`` gossips membership epochs last-writer-wins, and actors
+  fail over between frontends with `rpc.client.FrontendPool`.
+
+- ``membership.py`` — the replica registry as a RUNTIME control plane:
+  ``shard_addReplica`` / ``shard_removeReplica`` /
+  ``shard_fleetReconfigure`` mutate it under affinity-preserving
+  admission (DRAINING→probe→healthy in, drain-then-detach out), every
+  topology change bumps a journaled epoch.
+
+- ``autoscaler.py`` — the SLO-driven controller: scale-out on
+  fast-burn or sustained queue depth, scale-in only when the slow burn
+  is clean and depth is near zero, hysteresis + cooldowns, driving a
+  pluggable `ReplicaSpawner` (subprocess chain_servers for real use).
 
 The admission-class vocabulary (``interactive`` / ``bulk_audit`` /
 ``catchup_replay``: priorities, weighted batch shares, per-class
@@ -61,6 +74,14 @@ from gethsharding_tpu.serving.classes import (
 _LAZY = {
     "FrontendServer": ("frontend", "FrontendServer"),
     "build_frontend": ("frontend", "build_frontend"),
+    "FleetMembership": ("membership", "FleetMembership"),
+    "MembershipJournal": ("membership", "MembershipJournal"),
+    "DuplicateReplicaError": ("membership", "DuplicateReplicaError"),
+    "UnknownReplicaError": ("membership", "UnknownReplicaError"),
+    "Autoscaler": ("autoscaler", "Autoscaler"),
+    "AutoscaleConfig": ("autoscaler", "AutoscaleConfig"),
+    "ReplicaSpawner": ("autoscaler", "ReplicaSpawner"),
+    "ChainServerSpawner": ("autoscaler", "ChainServerSpawner"),
 }
 
 

@@ -35,6 +35,27 @@ frontend exactly as they dial a chain_server replica. Inbound `trace`
 envelopes are adopted (the caller's span context parents the
 frontend's route/attempt spans, which parent the replica's handler
 spans — one stitched trace across three processes).
+
+Elastic additions (ROADMAP item 3):
+
+- **runtime membership** — ``shard_addReplica`` /
+  ``shard_removeReplica`` / ``shard_fleetReconfigure`` /
+  ``shard_membership`` drive the mutable registry
+  (fleet/membership.py): admissions enter DRAINING and earn HEALTHY
+  through the health sweep, removals drain before they detach, and
+  every topology change bumps a journaled epoch
+  (``--membership-journal`` / ``GETHSHARDING_FLEET_EPOCH_JOURNAL``)
+  so a restarted frontend reconverges to the last acked topology;
+- **replicated frontends** — ``--peer HOST:PORT`` names the OTHER
+  frontends of a fleet-of-frontends: a background gossip thread
+  exchanges ``(epoch, endpoints)`` and converges last-writer-wins
+  (``GETHSHARDING_FLEET_EPOCH_GOSSIP_S`` paces it), local mutations
+  push eagerly, and actors fail over between frontends with
+  `rpc.client.FrontendPool` on the same draining/connection-lost
+  taxonomy the router uses against replicas;
+- **autoscaling** — ``--autoscale`` boots the SLO-driven controller
+  (fleet/autoscaler.py) over this frontend's membership plane, with a
+  ``ChainServerSpawner`` creating/reclaiming replica processes.
 """
 
 from __future__ import annotations
@@ -42,6 +63,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
 import socketserver
 import sys
 import threading
@@ -49,6 +71,12 @@ import time
 from typing import List, Optional
 
 from gethsharding_tpu import metrics, tracing
+from gethsharding_tpu.fleet.membership import (
+    DuplicateReplicaError,
+    FleetMembership,
+    MembershipJournal,
+    UnknownReplicaError,
+)
 from gethsharding_tpu.fleet.router import (
     AllReplicasDraining,
     FleetRouter,
@@ -64,6 +92,7 @@ METHOD_NOT_FOUND = -32601
 INVALID_REQUEST = -32600
 INTERNAL_ERROR = -32603
 OVERLOAD_CODE = -32010  # typed: shed / all-draining / deadline / drain
+MEMBERSHIP_CODE = -32011  # typed: duplicate / unknown endpoint
 
 # caller-visible failures that are the fleet's WEATHER, not a bug: they
 # ship with their class name on the wire under OVERLOAD_CODE so a
@@ -71,6 +100,11 @@ OVERLOAD_CODE = -32010  # typed: shed / all-draining / deadline / drain
 # crash. ServingOverloadError covers the shed/quota/expiry family.
 TYPED_FAILURES = (AllReplicasDraining, ServingOverloadError,
                   DeadlineExceeded)
+
+# control-plane mistakes with their own code: an operator (or a peer's
+# gossip) naming an endpoint that is already / never was a member gets
+# the class name back, never a logged internal error
+MEMBERSHIP_FAILURES = (DuplicateReplicaError, UnknownReplicaError)
 
 
 class FrontendServer:
@@ -80,8 +114,13 @@ class FrontendServer:
     stops the health sweep and closes every replica backend."""
 
     def __init__(self, router: FleetRouter, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0,
+                 membership: Optional[FleetMembership] = None,
+                 peers: Optional[List[str]] = None,
+                 gossip_interval_s: Optional[float] = None):
         self.router = router
+        self.membership = membership
+        self.autoscaler = None  # attach_autoscaler wires one
         # frontend-level drain: refuse NEW verification work with the
         # typed "replica draining" phrase (a parent router retries its
         # next frontend) while in-flight requests finish
@@ -89,6 +128,17 @@ class FrontendServer:
         self._inflight = 0
         self._lock = threading.Lock()
         self.method_calls: dict = {}
+        # peer frontends (a fleet OF frontends): membership epochs
+        # gossip between them, last-writer-wins on the epoch counter
+        self.peers = [str(p) for p in (peers or [])]
+        if gossip_interval_s is None:
+            gossip_interval_s = float(os.environ.get(
+                "GETHSHARDING_FLEET_EPOCH_GOSSIP_S", "1.0") or 1.0)
+        self.gossip_interval_s = gossip_interval_s
+        self._peer_clients: dict = {}
+        self._peer_lock = threading.Lock()
+        self._stop_gossip = threading.Event()
+        self._gossip_thread: Optional[threading.Thread] = None
         server = self
 
         class Handler(socketserver.StreamRequestHandler):
@@ -111,11 +161,26 @@ class FrontendServer:
             target=self._tcp.serve_forever, daemon=True,
             name="fleet-frontend")
         self._thread.start()
+        if self.peers and self.membership is not None:
+            self._gossip_thread = threading.Thread(
+                target=self._gossip_loop, daemon=True,
+                name="fleet-gossip")
+            self._gossip_thread.start()
         log.info("fleet frontend listening on %s:%d", *self.address)
 
-    def stop(self, grace_s: float = 5.0) -> None:
-        """Graceful shutdown: stop admitting verification work, give
-        in-flight requests a bounded grace, then SEVER the remaining
+    def attach_autoscaler(self, autoscaler) -> None:
+        """Wire (and start) the SLO-driven autoscale loop over this
+        frontend's membership plane; `stop()` owns its shutdown."""
+        self.autoscaler = autoscaler
+        autoscaler.start()
+
+    def stop(self, grace_s: float = 5.0, notice_s: float = 0.1) -> None:
+        """Graceful shutdown, DRAIN BEFORE SEVER: mark the frontend
+        draining and keep answering for a short notice window
+        (`notice_s`) so callers racing the shutdown get the typed
+        "replica draining" refusal — a `FrontendPool` peer fails over
+        on it without burning a retry on a bare connection reset. Then
+        give in-flight requests a bounded grace and SEVER the remaining
         connections (an in-flight caller gets the typed connection
         loss its retry policy handles — never a response that will
         silently never come) and close the router (health sweep
@@ -123,8 +188,15 @@ class FrontendServer:
         import socket as socket_mod
 
         self.draining = True
-        deadline = time.monotonic() + grace_s
-        while self._inflight > 0 and time.monotonic() < deadline:
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
+        self._stop_gossip.set()
+        now = time.monotonic()
+        notice_deadline = now + max(0.0, notice_s)
+        deadline = now + grace_s
+        while time.monotonic() < deadline:
+            if self._inflight == 0 and time.monotonic() >= notice_deadline:
+                break
             time.sleep(0.01)
         self._tcp.shutdown()
         self._tcp.server_close()
@@ -141,21 +213,107 @@ class FrontendServer:
                 pass
         if self._thread is not None:
             self._thread.join(timeout=2.0)
+        if self._gossip_thread is not None:
+            self._gossip_thread.join(timeout=2.0)
+        with self._peer_lock:
+            clients, self._peer_clients = dict(self._peer_clients), {}
+        for client in clients.values():
+            try:
+                client.close()
+            except Exception:  # noqa: BLE001 - already dead
+                pass
         self.router.close()
+
+    # -- membership gossip (fleet OF frontends) ----------------------------
+
+    def _peer_call(self, peer: str, method: str, *params):
+        """One control-plane RPC against a peer frontend, on a cached
+        (lazily redialed) client; any failure drops the client so the
+        next call redials — a restarted peer re-enters the gossip
+        without operator action."""
+        from gethsharding_tpu.rpc.client import RPCClient
+
+        with self._peer_lock:
+            client = self._peer_clients.get(peer)
+        if client is None:
+            host, port = peer.rsplit(":", 1)
+            client = RPCClient(host, int(port), timeout=5.0)
+            with self._peer_lock:
+                if self._peer_clients.get(peer) is None:
+                    self._peer_clients[peer] = client
+                else:  # lost a benign race with another dialer
+                    client.close()
+                    client = self._peer_clients[peer]
+        try:
+            return client.call(method, *params)
+        except Exception:
+            with self._peer_lock:
+                if self._peer_clients.get(peer) is client:
+                    del self._peer_clients[peer]
+            try:
+                client.close()
+            except Exception:  # noqa: BLE001 - already dead
+                pass
+            raise
+
+    def _gossip_loop(self) -> None:
+        while not self._stop_gossip.wait(self.gossip_interval_s):
+            try:
+                self.gossip_once()
+            except Exception:  # noqa: BLE001 - gossip must survive
+                log.exception("membership gossip failed")
+
+    def gossip_once(self) -> int:
+        """Pull every peer's ``(epoch, endpoints)`` and adopt any
+        strictly newer one (last-writer-wins). Returns the number of
+        adoptions — two frontends that diverged during a partition
+        converge within one gossip interval of it healing."""
+        if self.membership is None:
+            return 0
+        adopted = 0
+        for peer in self.peers:
+            try:
+                snap = self._peer_call(peer, "shard_membership")
+            except Exception:  # noqa: BLE001 - peer down: retry next tick
+                continue
+            if not isinstance(snap, dict):
+                continue
+            try:
+                if self.membership.adopt(int(snap.get("epoch", 0)),
+                                         snap.get("endpoints") or []):
+                    adopted += 1
+            except Exception:  # noqa: BLE001 - a bad payload must not
+                log.exception("adopting gossip from %s failed", peer)
+        return adopted
+
+    def _push_topology(self) -> None:
+        """Eager push after a LOCAL mutation: offer the new epoch to
+        every peer so convergence does not wait for their next pull.
+        Best-effort — a down peer catches up by gossip later."""
+        if self.membership is None or not self.peers:
+            return
+        snap = self.membership.snapshot()
+        for peer in self.peers:
+            try:
+                self._peer_call(peer, "shard_fleetReconfigure",
+                                snap["endpoints"], snap["epoch"])
+            except Exception:  # noqa: BLE001 - peer down: gossip heals
+                log.info("membership push to %s failed (gossip will "
+                         "converge it)", peer)
 
     # -- connection loop (rpc/server.py framing) ---------------------------
 
     def _handle_connection(self, handler) -> None:
+        from gethsharding_tpu.rpc.server import CONN_CONCURRENCY
+
         write_lock = threading.Lock()
+        slots = threading.BoundedSemaphore(max(1, CONN_CONCURRENCY))
+        workers = []
         with self._lock:
             self._conns.add(handler.connection)
-        try:
-            for raw in handler.rfile:
-                raw = raw.strip()
-                if not raw:
-                    continue
-                with self._lock:
-                    self._inflight += 1
+
+        def serve_one(raw: bytes) -> None:
+            try:
                 try:
                     response = self._dispatch(raw)
                 finally:
@@ -166,9 +324,39 @@ class FrontendServer:
                         handler.wfile.write(
                             (json.dumps(response) + "\n").encode())
                         handler.wfile.flush()
+            except (OSError, ValueError):
+                pass  # caller gone mid-response
+            finally:
+                slots.release()
+
+        try:
+            for raw in handler.rfile:
+                raw = raw.strip()
+                if not raw:
+                    continue
+                with self._lock:
+                    self._inflight += 1
+                # an actor-side FrontendPool multiplexes MANY client
+                # threads over this one socket: dispatch each request
+                # on its own worker (bounded — the read loop blocking
+                # on a slot is the backpressure) so one slow routed
+                # call never serializes the connection
+                slots.acquire()
+                worker = threading.Thread(target=serve_one, args=(raw,),
+                                          daemon=True,
+                                          name="frontend-conn-worker")
+                workers.append(worker)
+                worker.start()
+                if len(workers) > CONN_CONCURRENCY:
+                    workers = [w for w in workers if w.is_alive()]
         except (OSError, ValueError):
             pass
         finally:
+            # drain in-flight workers briefly (shared deadline): their
+            # responses are undeliverable once the socket is gone
+            deadline = time.monotonic() + 1.0
+            for worker in workers:
+                worker.join(timeout=max(0.0, deadline - time.monotonic()))
             with self._lock:
                 self._conns.discard(handler.connection)
 
@@ -202,6 +390,11 @@ class FrontendServer:
             # typed overload/drain failures keep their class name on
             # the wire so a caller (or the bench's typed-failure gate)
             # can tell a shed from a bug; everything else is internal
+            if isinstance(exc, MEMBERSHIP_FAILURES):
+                return {"jsonrpc": "2.0", "id": rid,
+                        "error": {"code": MEMBERSHIP_CODE,
+                                  "message":
+                                      f"{type(exc).__name__}: {exc}"}}
             typed = isinstance(exc, TYPED_FAILURES) or (
                 isinstance(exc, RuntimeError)
                 and str(exc).startswith("replica draining"))
@@ -349,12 +542,16 @@ class FrontendServer:
         """The same shape a replica's shard_health serves, so a parent
         router can sweep a fleet OF frontends: the frontend's drain
         flag, in-flight count, and how many replicas are accepting."""
-        accepting = sum(1 for r in self.router.replicas if r.accepting)
-        return {"draining": self.draining or accepting == 0,
-                "inflight": max(0, self._inflight - 1),
-                "breaker": None,
-                "accepting_replicas": accepting,
-                "replicas": len(self.router.replicas)}
+        members = self.router.members()
+        accepting = sum(1 for r in members if r.accepting)
+        health = {"draining": self.draining or accepting == 0,
+                  "inflight": max(0, self._inflight - 1),
+                  "breaker": None,
+                  "accepting_replicas": accepting,
+                  "replicas": len(members)}
+        if self.membership is not None:
+            health["epoch"] = self.membership.epoch
+        return health
 
     def rpc_metrics(self):
         # the ROUTER's registry: build_frontend may wire a private one,
@@ -368,10 +565,63 @@ class FrontendServer:
         collector's assembly counters when fleettrace is on."""
         from gethsharding_tpu import fleettrace
 
-        return {"replicas": self.router.states(),
-                "hedge": self.router.hedge_stats(),
-                "draining": self.draining,
-                "fleettrace": fleettrace.fleettrace_status()}
+        status = {"replicas": self.router.states(),
+                  "hedge": self.router.hedge_stats(),
+                  "draining": self.draining,
+                  "fleettrace": fleettrace.fleettrace_status()}
+        if self.membership is not None:
+            status["membership"] = {"epoch": self.membership.epoch,
+                                    "endpoints":
+                                        self.membership.endpoints(),
+                                    "peers": list(self.peers)}
+        if self.autoscaler is not None:
+            status["autoscale"] = self.autoscaler.status()
+        return status
+
+    # -- membership control plane ------------------------------------------
+
+    def _require_membership(self) -> FleetMembership:
+        if self.membership is None:
+            raise RuntimeError("membership control plane is not "
+                               "enabled on this frontend")
+        return self.membership
+
+    def rpc_addReplica(self, endpoint):
+        """Admit ``HOST:PORT`` as a new replica: it enters DRAINING and
+        earns HEALTHY through the health sweep's half-open probe (no
+        healthy-by-assertion). Bumps and pushes the membership epoch."""
+        out = self._require_membership().add(str(endpoint))
+        self._push_topology()
+        return out
+
+    def rpc_removeReplica(self, endpoint):
+        """Drain-then-detach the member at ``HOST:PORT`` (or a boot
+        replica's name): routing stops immediately, the registry row
+        detaches once its in-flight work finishes."""
+        out = self._require_membership().remove(str(endpoint))
+        self._push_topology()
+        return out
+
+    def rpc_fleetReconfigure(self, endpoints, epoch=None):
+        """Set the full topology in one call. With `epoch` this is the
+        GOSSIP form: adopt iff strictly newer (last-writer-wins), never
+        bump — peers pushing the same epoch back and forth stay
+        convergent. Without, it is the OPERATOR form: diff, apply, and
+        bump."""
+        membership = self._require_membership()
+        endpoints = [str(e) for e in endpoints]
+        if epoch is not None:
+            adopted = membership.adopt(int(epoch), endpoints)
+            return {"adopted": adopted, "epoch": membership.epoch,
+                    "endpoints": membership.endpoints()}
+        out = membership.reconfigure(endpoints)
+        self._push_topology()
+        return out
+
+    def rpc_membership(self):
+        """The gossip payload: ``(epoch, endpoints)`` plus per-replica
+        states for operators."""
+        return self._require_membership().snapshot()
 
     # -- fleet tracing (the collector the replicas export into) -----------
 
@@ -379,8 +629,6 @@ class FrontendServer:
         """Clock-offset handshake (rpc/server.py's twin): replicas'
         exporters measure their wall-clock skew against THIS process —
         the collector's timeline is the one every span lands on."""
-        import os
-
         from gethsharding_tpu.tracing.export import clock_offset_us
 
         return {"wall_us": time.time() * 1e6,
@@ -437,22 +685,55 @@ def build_frontend(endpoints: List[str], host: str = "127.0.0.1",
                    health_interval_s: float = 0.25,
                    chaos=None, timeout_s: float = 30.0,
                    registry: metrics.Registry = metrics.DEFAULT_REGISTRY,
+                   peers: Optional[List[str]] = None,
+                   gossip_interval_s: Optional[float] = None,
+                   membership_journal: Optional[str] = None,
                    ) -> FrontendServer:
     """Dial every ``HOST:PORT`` endpoint as an `RpcReplicaBackend`
     replica (named ``r0..rN`` in endpoint order) behind a hedging
-    `FleetRouter`, served by a `FrontendServer`. `chaos` (a
-    ChaosSchedule) is consulted at every replica wire's
-    ``fleet.transport`` seam."""
+    `FleetRouter`, served by a `FrontendServer` with a runtime
+    membership plane over the same registry. `chaos` (a ChaosSchedule)
+    is consulted at every replica wire's ``fleet.transport`` seam.
+    `membership_journal` (or ``GETHSHARDING_FLEET_EPOCH_JOURNAL``)
+    names a SQLite path persisting ``(epoch, endpoints)``; on boot the
+    journal's last acked topology overrides `endpoints`."""
     replicas = []
+    seed = {}
     for i, endpoint in enumerate(endpoints):
         ep_host, ep_port = endpoint.rsplit(":", 1)
         backend = RpcReplicaBackend.dial(ep_host, int(ep_port),
                                          timeout=timeout_s, chaos=chaos)
         replicas.append(Replica(f"r{i}", backend, health=backend.health,
                                 registry=registry))
+        seed[f"r{i}"] = endpoint
     router = FleetRouter(replicas, health_interval_s=health_interval_s,
                          hedge_ms=hedge_ms, registry=registry)
-    return FrontendServer(router, host=host, port=port)
+
+    def make_replica(endpoint: str) -> Replica:
+        # lazy dial: a just-spawned replica may not be listening yet;
+        # the first routed call (or health probe) dials through the
+        # backend's lazy-redial path, so admission never blocks on a
+        # cold endpoint
+        ep_host, ep_port = endpoint.rsplit(":", 1)
+        backend = RpcReplicaBackend.dial_lazy(
+            ep_host, int(ep_port), timeout=timeout_s, chaos=chaos)
+        return Replica(endpoint, backend, health=backend.health,
+                       registry=registry)
+
+    journal = None
+    journal_path = membership_journal or os.environ.get(
+        "GETHSHARDING_FLEET_EPOCH_JOURNAL", "")
+    if journal_path:
+        from gethsharding_tpu.db.kv import SqliteKV
+
+        journal = MembershipJournal(SqliteKV(journal_path),
+                                    registry=registry)
+    membership = FleetMembership(router, make_replica, journal=journal,
+                                 seed=seed, registry=registry)
+    membership.restore()
+    return FrontendServer(router, host=host, port=port,
+                          membership=membership, peers=peers,
+                          gossip_interval_s=gossip_interval_s)
 
 
 def main(argv=None) -> int:
@@ -463,6 +744,42 @@ def main(argv=None) -> int:
                         metavar="HOST:PORT",
                         help="a chain_server replica to balance "
                              "(repeatable; at least one required)")
+    parser.add_argument("--peer", action="append", default=[],
+                        metavar="HOST:PORT",
+                        help="another frontend of this fleet "
+                             "(repeatable): membership epochs gossip "
+                             "between peers, last-writer-wins")
+    parser.add_argument("--membership-journal", default="",
+                        metavar="PATH",
+                        help="SQLite path persisting the membership "
+                             "(epoch, endpoints); a restarted frontend "
+                             "reconverges to the last acked topology "
+                             "(default: "
+                             "GETHSHARDING_FLEET_EPOCH_JOURNAL)")
+    parser.add_argument("--gossip-interval", type=float, default=None,
+                        metavar="SECONDS",
+                        help="peer membership-gossip period (default: "
+                             "GETHSHARDING_FLEET_EPOCH_GOSSIP_S, 1.0)")
+    parser.add_argument("--autoscale", action="store_true",
+                        help="run the SLO-driven autoscaler "
+                             "(fleet/autoscaler.py) over this "
+                             "frontend's membership plane, spawning/"
+                             "reclaiming chain_server subprocesses "
+                             "(bounds and thresholds from "
+                             "GETHSHARDING_AUTOSCALE_*)")
+    parser.add_argument("--autoscale-backend", default="python",
+                        help="--sigbackend for autoscaler-spawned "
+                             "chain_servers")
+    parser.add_argument("--autoscale-min", type=int, default=None,
+                        help="autoscaler floor (overrides "
+                             "GETHSHARDING_AUTOSCALE_MIN)")
+    parser.add_argument("--autoscale-max", type=int, default=None,
+                        help="autoscaler ceiling (overrides "
+                             "GETHSHARDING_AUTOSCALE_MAX)")
+    parser.add_argument("--autoscale-interval", type=float, default=None,
+                        help="autoscaler control-loop period in "
+                             "seconds (overrides "
+                             "GETHSHARDING_AUTOSCALE_INTERVAL_S)")
     parser.add_argument("--fleet-hedge-ms", type=float, default=None,
                         help="interactive hedge-delay floor in ms "
                              "(default: GETHSHARDING_FLEET_HEDGE_MS, "
@@ -504,6 +821,13 @@ def main(argv=None) -> int:
     if not args.replica:
         parser.error("at least one --replica HOST:PORT is required")
 
+    # SIGTERM must run the drain path (stop() below: typed drain
+    # notice, in-flight grace, autoscaler reclaiming its spawned
+    # chain_servers) — the default handler would orphan the children
+    import signal
+
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
+
     logging.basicConfig(
         level=getattr(logging, args.verbosity.upper()),
         format="%(asctime)s %(levelname)-7s %(name)s "
@@ -537,8 +861,27 @@ def main(argv=None) -> int:
     server = build_frontend(args.replica, host=args.host, port=args.port,
                             hedge_ms=args.fleet_hedge_ms,
                             health_interval_s=args.health_interval,
-                            chaos=chaos, timeout_s=args.replica_timeout)
+                            chaos=chaos, timeout_s=args.replica_timeout,
+                            peers=args.peer,
+                            gossip_interval_s=args.gossip_interval,
+                            membership_journal=args.membership_journal)
     server.start()
+    if args.autoscale:
+        from gethsharding_tpu.fleet.autoscaler import (AutoscaleConfig,
+                                                       Autoscaler,
+                                                       ChainServerSpawner)
+
+        cfg = AutoscaleConfig.from_env()
+        if args.autoscale_min is not None:
+            cfg.min_replicas = args.autoscale_min
+        if args.autoscale_max is not None:
+            cfg.max_replicas = args.autoscale_max
+        if args.autoscale_interval is not None:
+            cfg.interval_s = args.autoscale_interval
+        spawner = ChainServerSpawner(sigbackend=args.autoscale_backend,
+                                     host=args.host)
+        server.attach_autoscaler(
+            Autoscaler(server.membership, spawner, config=cfg))
     print(json.dumps({"host": server.address[0],
                       "port": server.address[1]}), flush=True)
     deadline = time.monotonic() + args.runtime if args.runtime else None

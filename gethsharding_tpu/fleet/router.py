@@ -43,6 +43,7 @@ from __future__ import annotations
 import functools
 import hashlib
 import logging
+import math
 import os
 import threading
 import time
@@ -56,6 +57,7 @@ from gethsharding_tpu import metrics, slo, tracing
 from gethsharding_tpu.perfwatch import RECORDER
 from gethsharding_tpu.serving.classes import (
     ADMISSION_CLASSES,
+    CLASS_BULK_AUDIT,
     CLASS_INTERACTIVE,
     admission_class,
     class_for,
@@ -181,6 +183,11 @@ class Replica:
         self.state = ReplicaState.HEALTHY
         self.in_flight = 0
         self.drain_requested = False
+        # runtime-membership removal intent: drain first, detach only
+        # once nothing is in flight (fleet/membership.py sets it; the
+        # health sweep completes the detach)
+        self.removing = False
+        self.detached = False
         self.drain_events = 0
         self.reentries = 0
         self._consecutive = 0
@@ -296,6 +303,12 @@ class Replica:
         self.state = state
         self._g_state.set(_STATE_GAUGE[state])
 
+    def set_state(self, state: str) -> None:
+        """Direct state entry (runtime admission: a freshly added
+        replica starts DRAINING and earns HEALTHY through the sweep)."""
+        with self._lock:
+            self._set_state_locked(state)
+
     @property
     def accepting(self) -> bool:
         return self.state == ReplicaState.HEALTHY
@@ -310,6 +323,7 @@ class Replica:
                 "routed": self._m_routed.value,
                 "failures": self._m_failures.value,
                 "drain_events": self.drain_events,
+                "removing": self.removing,
                 "reentries": self.reentries}
 
 
@@ -328,10 +342,16 @@ class FleetRouter:
         names = [r.name for r in replicas]
         if len(set(names)) != len(names):
             raise ValueError(f"replica names must be unique: {names}")
+        # the registry is MUTABLE at runtime (fleet/membership.py):
+        # every mutation and every multi-element read goes through
+        # _members_lock; hot-path readers iterate a members() snapshot
+        # so a concurrent add/remove can never invalidate their walk
         self.replicas = list(replicas)
+        self._members_lock = threading.Lock()
         self.health_interval_s = health_interval_s
         self._last_refresh = 0.0
         self._refresh_lock = threading.Lock()
+        self._fixed_policy = retry_policy is not None
         policy = retry_policy or RetryPolicy(
             attempts=max(2, len(replicas)), base_s=0.0, jitter=0.0,
             retryable=ROUTER_RETRYABLE)
@@ -358,6 +378,16 @@ class FleetRouter:
             hedge_storm_pct = float(os.environ.get(
                 "GETHSHARDING_FLEET_HEDGE_STORM_PCT", "30") or 30)
         self.hedge_storm_pct = hedge_storm_pct
+        # budget-aware BULK hedging: keyed bulk_audit planes may hedge
+        # too, but only while the class's SLO budget says the duplicate
+        # dispatch is free — GETHSHARDING_FLEET_HEDGE_BULK_MIN_BUDGET
+        # is the budget_remaining floor (0 = bulk never hedges, the
+        # pre-elastic behavior; e.g. 0.75 = hedge bulk only while at
+        # least 75% of the slow-window error budget is unburned)
+        self.hedge_bulk_min_budget = float(os.environ.get(
+            "GETHSHARDING_FLEET_HEDGE_BULK_MIN_BUDGET", "0") or 0)
+        self._m_hedge_bulk_held = registry.counter(
+            "fleet/hedge/bulk_budget_held")
         self._m_hedge_issued = registry.counter("fleet/hedge/issued")
         self._m_hedge_won = registry.counter("fleet/hedge/won")
         self._m_hedge_wasted = registry.counter("fleet/hedge/wasted")
@@ -380,6 +410,13 @@ class FleetRouter:
         self._g_class_depth = {
             c: registry.gauge(f"fleet/class/{c}/queue_depth")
             for c in ADMISSION_CLASSES}
+        # the serving queue is a sawtooth (it drains to zero on every
+        # take_batch), so an instantaneous scrape aliases against the
+        # sweep cadence and a depth-driven controller would see noise.
+        # The exported gauge holds a short DECAYING PEAK instead: new
+        # value = max(instant sum, previous * exp(-dt/tau))
+        self._class_depth_peak = {c: 0.0 for c in ADMISSION_CLASSES}
+        self._class_depth_peak_at = time.monotonic()
         self._g_worst_p99 = registry.gauge("fleet/worst_replica_p99_s")
         # health sweeps run on a BACKGROUND thread when an interval is
         # set: a slow or dead replica's health read (a full RPC timeout
@@ -406,7 +443,13 @@ class FleetRouter:
     def refresh(self, force: bool = False) -> None:
         """Rate-limited health sweep: read every replica's health, run
         the state machine, and probe draining replicas (one tiny call
-        each, so their half-open differential can re-promote them)."""
+        each, so their half-open differential can re-promote them).
+
+        The sweep iterates a SNAPSHOT of the registry (a health read is
+        a full RPC that may block for its timeout; membership must stay
+        mutable underneath it) but re-checks membership before every
+        side effect on a replica — a replica removed mid-sweep gets no
+        stale probe and no stale fold after its detach."""
         now = time.monotonic()
         with self._refresh_lock:
             if not force and now - self._last_refresh < self.health_interval_s:
@@ -415,7 +458,9 @@ class FleetRouter:
         total_inflight = 0
         class_depth = {c: 0 for c in ADMISSION_CLASSES}
         worst_p99 = 0.0
-        for replica in self.replicas:
+        for replica in self.members():
+            if replica.detached or not self._is_member(replica):
+                continue  # removed since the snapshot: skip, don't probe
             try:
                 health = replica.health()
             except Exception as exc:  # noqa: BLE001 - dead health = dead node
@@ -447,18 +492,40 @@ class FleetRouter:
             if replica.state == ReplicaState.DRAINING \
                     and replica.probe is not None \
                     and health is not None \
-                    and health.get("breaker") == "open":
+                    and health.get("breaker") == "open" \
+                    and self._is_member(replica):
                 # the nudge that lets an idle drained replica recover:
                 # once its cooldown elapses this call becomes the
                 # half-open differential probe; before that it is a
-                # cheap fallback-served request
+                # cheap fallback-served request. Membership re-checked
+                # at probe time: a replica removed while this sweep was
+                # blocked in an earlier health read must not be probed
+                # back to life (the mid-sweep shard_removeReplica case)
                 try:
                     replica.probe()
                 except Exception:  # noqa: BLE001 - probe outcome is the
                     pass  # breaker's business, not ours
+            if replica.removing and replica.in_flight == 0 \
+                    and not replica.accepting:
+                # removal completes here: the drain ran its course
+                # (nothing in flight, no longer accepting), so the
+                # endpoint can finally vanish without any caller seeing
+                # a live request die under it
+                self._detach(replica)
         self._g_inflight.set(total_inflight)
-        for klass, depth in class_depth.items():
-            self._g_class_depth[klass].set(depth)
+        # decaying peak (tau ~1s): a queue that was deep within the
+        # last second still reads deep, a drained trough decays to
+        # zero in a few sweeps — sample-robust for the autoscaler's
+        # sustain clocks in both directions
+        with self._refresh_lock:
+            dt = max(0.0, now - self._class_depth_peak_at)
+            self._class_depth_peak_at = now
+            decay = math.exp(-dt / 1.0)
+            for klass, depth in class_depth.items():
+                peak = max(float(depth),
+                           self._class_depth_peak[klass] * decay)
+                self._class_depth_peak[klass] = peak
+                self._g_class_depth[klass].set(round(peak, 3))
         self._g_worst_p99.set(round(worst_p99, 6))
         self._check_hedge_storm()
         # the sweep doubles as the SLO gauge heartbeat: an idle class's
@@ -559,7 +626,7 @@ class FleetRouter:
         """The preference-ordered accepting replicas for one call: a
         stable rendezvous order for keyed traffic, least-in-flight for
         keyless."""
-        accepting = [r for r in self.replicas if r.accepting]
+        accepting = [r for r in self.members() if r.accepting]
         if affinity is None:
             return sorted(accepting, key=lambda r: (r.in_flight, r.name))
         key = str(affinity)
@@ -590,18 +657,37 @@ class FleetRouter:
                     thread_name_prefix="fleet-hedge")
             return self._hedge_pool
 
-    def _hedge_delay_s(self, replica: Replica, slo_class: str) -> float:
+    def _hedge_delay_s(self, replica: Replica, slo_class: str,
+                       keyed: bool = False) -> float:
         """The class-aware hedge fuse for a call whose primary is
         `replica`: 0 (no hedge) unless hedging is on and the class is
         interactive — bulk/catchup latency budgets are periods, and
         duplicating them would double bulk device load for nothing.
         The fuse adapts to the primary's OBSERVED latency quantile
         (a slow chip earns its reputation), floored by the configured
-        hedge delay so a cold ring cannot hair-trigger."""
-        if self.hedge_s <= 0 or slo_class != CLASS_INTERACTIVE:
+        hedge delay so a cold ring cannot hair-trigger.
+
+        Budget-aware exception: a KEYED bulk_audit call (a committee
+        plane with shard affinity — the duplicate lands cache-warm on
+        the next rendezvous replica) may hedge while the class's SLO
+        budget is nearly whole (``hedge_bulk_min_budget`` > 0 arms it):
+        when the error budget says duplicate dispatches are free, tail
+        bulk audits get cut too; the moment the budget thins, bulk
+        hedging stops FIRST (``fleet/hedge/bulk_budget_held`` counts
+        the holds)."""
+        if self.hedge_s <= 0:
             return 0.0
-        return max(self.hedge_s,
-                   replica.latency_quantile(self.hedge_quantile))
+        if slo_class == CLASS_INTERACTIVE:
+            return max(self.hedge_s,
+                       replica.latency_quantile(self.hedge_quantile))
+        if slo_class == CLASS_BULK_AUDIT and keyed \
+                and self.hedge_bulk_min_budget > 0:
+            if slo.tracker().budget_remaining(CLASS_BULK_AUDIT) \
+                    >= self.hedge_bulk_min_budget:
+                return max(self.hedge_s,
+                           replica.latency_quantile(self.hedge_quantile))
+            self._m_hedge_bulk_held.inc()
+        return 0.0
 
     def call(self, op: str, *args, affinity: Optional[str] = None,
              klass: Optional[str] = None, tenant: Optional[str] = None,
@@ -734,11 +820,13 @@ class FleetRouter:
             if tried:
                 self._m_failovers.inc()
             tried.append(replica.name)
-            hedge_s = self._hedge_delay_s(replica, slo_class)
+            hedge_s = self._hedge_delay_s(replica, slo_class,
+                                          keyed=affinity is not None)
             if hedge_s <= 0:
                 return run_on(replica, len(tried))
             return self._hedged(replica, hedge_s, ladder, tried, run_on,
-                                logical)
+                                logical,
+                                feed_ring=slo_class == CLASS_INTERACTIVE)
 
         t_start = time.monotonic()
         route_tags = {"op": op, "klass": slo_class}
@@ -752,7 +840,8 @@ class FleetRouter:
         return out
 
     def _hedged(self, primary: Replica, hedge_s: float, ladder,
-                tried: List[str], run_on, logical: dict):
+                tried: List[str], run_on, logical: dict,
+                feed_ring: bool = True):
         """One hedged attempt: dispatch to `primary` on the hedge
         pool; if no verdict lands within `hedge_s`, re-issue to the
         next replica in the affinity order and take the FIRST verdict.
@@ -760,7 +849,10 @@ class FleetRouter:
         ``fleet/hedge/wasted`` for a duplicate whose verdict nobody
         consumed, ``fleet/hedge/loser_failures`` when the discard was
         a failure (typed, but charged to no caller). Both failing
-        raises the primary's error into the retry ladder."""
+        raises the primary's error into the retry ladder.
+        `feed_ring=False` for budget-hedged BULK calls: the latency
+        ring sets the INTERACTIVE fuse only, and a multi-second audit
+        winning its race must not inflate it."""
         pool = self._pool()
         started: List[bool] = [False]
         t_primary = time.monotonic()
@@ -768,7 +860,8 @@ class FleetRouter:
                                 False, False, started)
         try:
             out = primary_f.result(timeout=hedge_s)
-            primary.note_latency(time.monotonic() - t_primary)
+            if feed_ring:
+                primary.note_latency(time.monotonic() - t_primary)
             return out
         except FutureTimeout:
             pass  # the hedge case: primary still pending
@@ -823,7 +916,8 @@ class FleetRouter:
                 # latency feeds its replica's hedge-fuse ring.
                 if role == "hedge":
                     self._m_hedge_won.inc()
-                winner_replica.note_latency(time.monotonic() - t_sub)
+                if feed_ring:
+                    winner_replica.note_latency(time.monotonic() - t_sub)
                 with logical["lock"]:
                     # the logical request is answered: a loser failing
                     # from here on burns no SLO budget (run_on checks)
@@ -878,7 +972,84 @@ class FleetRouter:
                 "wasted": self._m_hedge_wasted.value,
                 "audit_faults": self._m_hedge_audit_faults.value,
                 "loser_failures": self._m_hedge_loser_failures.value,
+                "bulk_budget_held": self._m_hedge_bulk_held.value,
                 "storm": int(self._storm_latched)}
+
+    # -- runtime membership (fleet/membership.py drives these) -------------
+
+    def members(self) -> List[Replica]:
+        """A point-in-time snapshot of the registry — the only way the
+        request/sweep paths walk it, so a concurrent add/remove never
+        invalidates an in-progress iteration."""
+        with self._members_lock:
+            return list(self.replicas)
+
+    def _is_member(self, replica: Replica) -> bool:
+        with self._members_lock:
+            return replica in self.replicas
+
+    def _resize_policy_locked(self) -> None:
+        # the failover ladder is as deep as the fleet: keep the retry
+        # budget tracking the live registry size (a caller-injected
+        # policy is the caller's contract and stays fixed)
+        if not self._fixed_policy:
+            self._executor.policy.attempts = max(2, len(self.replicas))
+
+    def add_replica(self, replica: Replica,
+                    initial_state: str = ReplicaState.DRAINING) -> Replica:
+        """Admit a NEW replica at runtime. It enters DRAINING (not
+        healthy-by-assertion): the next health sweep reads its real
+        health and the existing half-open differential path promotes
+        it — exactly how a drained replica re-enters. Duplicate names
+        raise ValueError (the membership plane types this for the
+        wire)."""
+        with self._members_lock:
+            if any(r.name == replica.name for r in self.replicas):
+                raise ValueError(
+                    f"replica {replica.name!r} already registered")
+            replica.set_state(initial_state)
+            self.replicas.append(replica)
+            self._resize_policy_locked()
+        log.info("replica %s admitted (enters %s; the health sweep "
+                 "promotes it)", replica.name, initial_state)
+        return replica
+
+    def remove_replica(self, name: str) -> dict:
+        """Begin removing a replica: drain FIRST (no new work; its
+        in-flight calls finish), then the health sweep detaches it once
+        nothing is in flight. An idle replica detaches immediately.
+        Returns the replica's state at return (``detached`` tells an
+        operator whether the drain already completed)."""
+        replica = self._replica(name)
+        replica.drain_requested = True
+        replica.removing = True
+        # force the state transition now — route() must stop offering
+        # this replica before the next sweep, not after it
+        replica.observe_health({"breaker": None, "draining": True})
+        if replica.in_flight == 0:
+            self._detach(replica)
+        state = replica.describe()
+        state["detached"] = replica.detached
+        return state
+
+    def _detach(self, replica: Replica) -> None:
+        """Final removal: unhook from the registry, then close the
+        backend. Only ever called with the replica drained (nothing in
+        flight), so no live request sees its endpoint vanish."""
+        with self._members_lock:
+            if replica not in self.replicas:
+                return  # lost a benign race with another detacher
+            self.replicas.remove(replica)
+            self._resize_policy_locked()
+            replica.detached = True
+        close = getattr(replica.backend, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception:  # noqa: BLE001 - best-effort shutdown
+                log.exception("closing removed replica %s failed",
+                              replica.name)
+        log.info("replica %s detached (drain complete)", replica.name)
 
     # -- drain lifecycle ---------------------------------------------------
 
@@ -893,7 +1064,7 @@ class FleetRouter:
         self.refresh(force=True)
 
     def _replica(self, name: str) -> Replica:
-        for replica in self.replicas:
+        for replica in self.members():
             if replica.name == name:
                 return replica
         raise KeyError(f"unknown replica {name!r}")
@@ -902,7 +1073,7 @@ class FleetRouter:
 
     def states(self) -> Dict[str, dict]:
         return {replica.name: replica.describe()
-                for replica in self.replicas}
+                for replica in self.members()}
 
     def close(self) -> None:
         self._stop_sweeper.set()
@@ -913,7 +1084,7 @@ class FleetRouter:
             pool, self._hedge_pool = self._hedge_pool, None
         if pool is not None:
             pool.shutdown(wait=True)
-        for replica in self.replicas:
+        for replica in self.members():
             close = getattr(replica.backend, "close", None)
             if close is not None:
                 try:
@@ -1035,6 +1206,19 @@ class RpcReplicaBackend:
         backend = cls(RPCClient(host, port, timeout=timeout),
                       name=f"{host}:{port}", chaos=chaos)
         backend._host, backend._port = host, port
+        backend._timeout = timeout
+        return backend
+
+    @classmethod
+    def dial_lazy(cls, host: str, port: int, timeout: float = 10.0,
+                  chaos=None) -> "RpcReplicaBackend":
+        """Like `dial` without the eager connect: the first call (the
+        health sweep's read, usually) dials through the ordinary redial
+        path. Runtime admission uses this — an endpoint still coming up
+        enters the registry DRAINING and connects when it arrives,
+        instead of failing the control-plane RPC that admitted it."""
+        backend = cls(None, name=f"{host}:{port}", chaos=chaos)
+        backend._host, backend._port = host, int(port)
         backend._timeout = timeout
         return backend
 

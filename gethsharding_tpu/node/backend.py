@@ -97,14 +97,22 @@ class ShardNode:
             # the actor's whole verification plane goes over the wire
             # to a standalone fleet frontend (fleet/frontend.py): the
             # routed/hedged replica fleet owns serving, soundness and
-            # failover; this process composes nothing locally. The
-            # RpcReplicaBackend redials after a connection loss, so a
-            # restarted frontend recovers mid-flight actors through
-            # their ordinary retry policies.
-            from gethsharding_tpu.fleet.router import RpcReplicaBackend
+            # failover; this process composes nothing locally. A
+            # comma-separated list names a fleet OF frontends — the
+            # FrontendPool fails over between them on the typed
+            # draining/connection-lost taxonomy (redialing lazily), so
+            # killing one frontend mid-flight costs the actor a retry,
+            # not its verification plane.
+            if "," in fleet_frontend:
+                from gethsharding_tpu.rpc.client import FrontendPool
 
-            fe_host, fe_port = fleet_frontend.rsplit(":", 1)
-            composed = RpcReplicaBackend.dial(fe_host, int(fe_port))
+                composed = FrontendPool.dial(fleet_frontend)
+            else:
+                from gethsharding_tpu.fleet.router import (
+                    RpcReplicaBackend)
+
+                fe_host, fe_port = fleet_frontend.rsplit(":", 1)
+                composed = RpcReplicaBackend.dial(fe_host, int(fe_port))
             self._frontend_backend = composed
         elif chaos is not None:
             from gethsharding_tpu.resilience.chaos import ChaosSigBackend

@@ -158,7 +158,7 @@ def build_parser() -> argparse.ArgumentParser:
                                "pair with --sigbackend failover-* so "
                                "silent corruption trips the breaker)")
     sharding.add_argument("--fleet-frontend", default="",
-                          metavar="HOST:PORT",
+                          metavar="HOST:PORT[,HOST:PORT...]",
                           help="dial a standalone fleet frontend "
                                "(python -m gethsharding_tpu.fleet."
                                "frontend) for ALL signature/DAS "
@@ -168,7 +168,12 @@ def build_parser() -> argparse.ArgumentParser:
                                "wire to the routed, hedged replica "
                                "fleet (serving/failover/soundness "
                                "composition then lives in the frontend "
-                               "and its replicas, not in this process)")
+                               "and its replicas, not in this process); "
+                               "a comma-separated list names replicated "
+                               "frontends — the actor fails over "
+                               "between them (rpc.client.FrontendPool) "
+                               "on the typed draining/connection-lost "
+                               "taxonomy")
     sharding.add_argument("--verbosity", default="info",
                           choices=("debug", "info", "warning", "error"))
     sharding.add_argument("--metrics", action="store_true",

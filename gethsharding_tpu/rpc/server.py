@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import socket
 import socketserver
 import threading
@@ -37,6 +38,16 @@ REVERT_CODE = 3
 METHOD_NOT_FOUND = -32601
 INVALID_REQUEST = -32600
 INTERNAL_ERROR = -32603
+
+# per-connection dispatch concurrency: one socket carries MANY
+# multiplexed requests (a fleet frontend funnels every routed call for
+# a replica over ONE RPCClient), so handling them serially in the read
+# loop would cap a replica at one in-flight request per upstream and
+# starve the serving tier's coalescing + queue-depth signal. Each
+# request dispatches on its own worker; the bound makes the read loop
+# itself the backpressure once a connection has this many in flight.
+CONN_CONCURRENCY = int(os.environ.get(
+    "GETHSHARDING_RPC_CONN_CONCURRENCY", "64"))
 
 
 class RPCServer:
@@ -174,13 +185,11 @@ class RPCServer:
 
     def _handle_connection(self, handler) -> None:
         write_lock = threading.Lock()
-        try:
-            for raw in handler.rfile:
-                raw = raw.strip()
-                if not raw:
-                    continue
-                with self._sub_lock:
-                    self._inflight += 1
+        slots = threading.BoundedSemaphore(max(1, CONN_CONCURRENCY))
+        workers = []
+
+        def serve_one(raw: bytes) -> None:
+            try:
                 try:
                     response = self._dispatch(raw, handler, write_lock)
                 finally:
@@ -191,9 +200,39 @@ class RPCServer:
                         handler.wfile.write(
                             (json.dumps(response) + "\n").encode())
                         handler.wfile.flush()
+            except (OSError, ValueError):
+                pass  # peer gone mid-response: its client already knows
+            finally:
+                slots.release()
+
+        try:
+            for raw in handler.rfile:
+                raw = raw.strip()
+                if not raw:
+                    continue
+                with self._sub_lock:
+                    self._inflight += 1
+                # concurrent dispatch, bounded: responses multiplex back
+                # by request id (the client's pending map reorders), and
+                # once CONN_CONCURRENCY requests are in flight the read
+                # loop blocks here — TCP backpressure to the sender
+                slots.acquire()
+                worker = threading.Thread(target=serve_one, args=(raw,),
+                                          daemon=True,
+                                          name="rpc-conn-worker")
+                workers.append(worker)
+                worker.start()
+                if len(workers) > CONN_CONCURRENCY:
+                    workers = [w for w in workers if w.is_alive()]
         except (OSError, ValueError):
             pass
         finally:
+            # drain in-flight workers briefly (shared deadline, not
+            # per-thread): their responses are undeliverable now, and
+            # they are daemons — this just keeps teardown orderly
+            deadline = time.monotonic() + 1.0
+            for worker in workers:
+                worker.join(timeout=max(0.0, deadline - time.monotonic()))
             with self._sub_lock:
                 self._subscribers.pop(handler.wfile, None)
                 self._p2p_challenges.pop(handler.wfile, None)

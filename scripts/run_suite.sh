@@ -959,6 +959,121 @@ print("devscope smoke OK: RPC + /profile toggles, stacks served,"
 PY
 rm -rf "$ds_tmp"
 
+# -- elastic fleet smoke: the runtime-membership control plane against
+# real processes — 2 chain_server replicas behind 2 peered frontends,
+# one frontend killed -9 under FrontendPool traffic (actors must fail
+# over), a third replica added LIVE via shard_addReplica on the
+# survivor's peer and gossiped across before the kill; asserts the
+# survivor converged (epoch bumped, added endpoint healthy) with zero
+# wrong answers throughout
+echo "== elastic fleet smoke (2 frontends + 2 replicas, kill one + live add)"
+JAX_PLATFORMS=cpu python - <<'PYEOF' || fail=1
+import os, sys, threading, time
+sys.path.insert(0, "scripts")
+from serving_stress import _spawn, _free_port, build_cases
+
+env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+procs = []
+try:
+    eps = []
+    for _ in range(3):  # 2 registered at boot + 1 added live
+        p, a = _spawn([sys.executable,
+                       "-m", "gethsharding_tpu.rpc.chain_server",
+                       "--sigbackend", "python", "--verbosity", "error"],
+                      env=env)
+        procs.append(p)
+        eps.append("%s:%d" % (a["host"], a["port"]))
+    pa, pb = _free_port(), _free_port()
+
+    def fe(port, peer):
+        return _spawn([sys.executable, "-m",
+                       "gethsharding_tpu.fleet.frontend",
+                       "--verbosity", "error", "--port", str(port),
+                       "--health-interval", "0.1",
+                       "--gossip-interval", "0.25",
+                       "--peer", "127.0.0.1:%d" % peer,
+                       "--replica", eps[0], "--replica", eps[1]],
+                      env=env)
+
+    fa_p, fa = fe(pa, pb)
+    procs.append(fa_p)
+    fb_p, fb = fe(pb, pa)
+    procs.append(fb_p)
+
+    from gethsharding_tpu.rpc.client import FrontendPool, RPCClient
+    # primary on B so the kill is felt by the pool, not just a spare
+    pool = FrontendPool(["%s:%d" % (fb["host"], fb["port"]),
+                         "%s:%d" % (fa["host"], fa["port"])], timeout=10.0)
+    cases = build_cases(32)
+    stop = threading.Event()
+    wrong, done = [], [0]
+
+    def traffic():
+        i = 0
+        while not stop.is_set():
+            d, s, w = cases[i % len(cases)]
+            i += 1
+            try:
+                got = pool.ecrecover_addresses([d], [s])
+            except Exception:
+                continue  # typed refusal/failover window
+            if got != [w]:
+                wrong.append(got)
+                return
+            done[0] += 1
+            time.sleep(0.005)
+
+    t = threading.Thread(target=traffic, daemon=True)
+    t.start()
+    time.sleep(1.0)
+
+    # live add through frontend B (the pool's primary), then assert the
+    # epoch GOSSIPS to frontend A
+    res = pool.call("shard_addReplica", eps[2])
+    assert res["name"] == eps[2] and res["epoch"] >= 1, res
+    ra = RPCClient(fa["host"], fa["port"])
+    snap = {}
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        snap = ra.call("shard_membership")
+        if eps[2] in snap.get("endpoints", []) and snap.get("epoch", 0) >= 1:
+            break
+        time.sleep(0.2)
+    assert eps[2] in snap.get("endpoints", []), snap
+
+    # kill frontend B -9 mid-traffic: actors must fail over to A
+    before = done[0]
+    fb_p.kill()
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline and not (
+            pool.failovers >= 1 and done[0] > before):
+        time.sleep(0.2)
+    assert pool.failovers >= 1, "pool never failed over"
+    assert done[0] > before, "no verified traffic after the kill"
+
+    # convergence on the survivor: the live-added replica reaches
+    # HEALTHY in A's sweep and answers are still correct
+    state = {}
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        status = ra.call("shard_fleetStatus")
+        state = {n: s["state"] for n, s in status["replicas"].items()}
+        if state.get(eps[2]) == "healthy" and len(state) == 3:
+            break
+        time.sleep(0.2)
+    assert state.get(eps[2]) == "healthy", state
+    stop.set()
+    t.join(timeout=10)
+    assert not wrong, wrong
+    ra.close()
+    pool.close()
+    print("elastic smoke OK: add gossiped, kill -9 failed over,"
+          " survivor converged (%d verified)" % done[0])
+finally:
+    for p in procs:
+        p.terminate()
+PYEOF
+
 # -- shardlint: the repo-wide static analysis gate (jit-purity,
 # host-sync, lock-order, race-guard, layering, backend-contract,
 # thread-lifecycle, flag-doc, export-completeness) — fails on any
@@ -982,7 +1097,7 @@ echo "== lockcheck+racecheck smoke (fleet/serving/concurrency under both recorde
 GETHSHARDING_LOCKCHECK=1 GETHSHARDING_RACECHECK=1 JAX_PLATFORMS=cpu \
     python -m pytest \
     tests/test_concurrency.py tests/test_serving.py tests/test_fleet.py \
-    tests/test_fleet_frontend.py \
+    tests/test_fleet_frontend.py tests/test_fleet_elastic.py \
     -q --no-header -m 'not slow' || fail=1
 
 for f in tests/test_*.py; do

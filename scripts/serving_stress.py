@@ -48,6 +48,16 @@ topology — N `chain_server` replica processes, one standalone
 Every answer is verified against the known signer; the summary reports
 the frontend's hedge win/waste rates from `shard_fleetStatus`. Exit 1
 on any divergence or hung client.
+
+Elastic closed-loop mode (`--elastic`): 2 chain_server replicas
+behind TWO peered frontend processes (frontend A runs the SLO-driven
+autoscaler), clients on `rpc.client.FrontendPool` driving a 10x
+diurnal swing; frontend B is killed -9 mid-swing. Gates: zero
+incorrect verdicts, pool failover observed, the autoscaler scales OUT
+at the peak AND back IN during the trough (countered via
+`shard_fleetStatus`), interactive p99 under `--slo-interactive-ms`.
+Emits a `fleet_elastic` workload record through
+`perfwatch.record_bench`.
 """
 
 from __future__ import annotations
@@ -593,6 +603,241 @@ def run_frontend(args) -> int:
             proc.terminate()
 
 
+def _free_port() -> int:
+    """Pre-pick a listening port (bind/release) so two frontends can be
+    started with --peer pointing at each other before either is up."""
+    import socket
+
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    return port
+
+
+def run_elastic(args) -> int:
+    """The elastic closed-loop soak (ISSUE 20 acceptance): 2
+    chain_server replica processes behind TWO peered frontend
+    processes — frontend A runs the SLO-driven autoscaler — while
+    clients on `rpc.client.FrontendPool` drive a 10x diurnal swing
+    (offered load decays 100% -> 10% over the run). Mid-swing frontend
+    B is killed -9; its clients must fail over to A without one
+    incorrect verdict. The autoscaler must be OBSERVED acting in both
+    directions: scale-OUT during the peak (sustained queue depth
+    federated from the replicas' serving gauges) and scale-IN during
+    the trough, both read back COUNTERED from frontend A's
+    `shard_fleetStatus`. Gates: zero incorrect verdicts, zero hung
+    clients, failovers >= 1, out >= 1 AND in >= 1, and (when
+    `--slo-interactive-ms` is set) the interactive p99. The result is
+    emitted as a `fleet_elastic` workload record through
+    `perfwatch.record_bench` into the perf ledger."""
+    from gethsharding_tpu.rpc.client import FrontendPool, RPCClient, RPCError
+
+    n = max(2, args.replicas or 2)
+    env = {**os.environ}
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    procs: list = []
+    frontends: list = []
+    try:
+        endpoints = []
+        for _ in range(n):
+            proc, addr = _spawn(
+                [sys.executable, "-m", "gethsharding_tpu.rpc.chain_server",
+                 "--sigbackend", "python", "--verbosity", "error"],
+                env=env)
+            procs.append(proc)
+            endpoints.append("%s:%d" % (addr["host"], addr["port"]))
+
+        # peered frontends need each other's address BEFORE either is
+        # up: pre-pick both ports
+        ports = (_free_port(), _free_port())
+        scaler_env = {
+            **env,
+            "GETHSHARDING_AUTOSCALE_MIN": str(n),
+            "GETHSHARDING_AUTOSCALE_MAX": str(n + 1),
+            "GETHSHARDING_AUTOSCALE_INTERVAL_S": "0.25",
+            "GETHSHARDING_AUTOSCALE_OUT_DEPTH": str(args.elastic_out_depth),
+            "GETHSHARDING_AUTOSCALE_IN_DEPTH": "2",
+            "GETHSHARDING_AUTOSCALE_SUSTAIN_S": "0.75",
+            "GETHSHARDING_AUTOSCALE_COOLDOWN_S": "2.0",
+        }
+
+        def fe_cmd(port: int, peer_port: int, autoscale: bool):
+            cmd = [sys.executable, "-m", "gethsharding_tpu.fleet.frontend",
+                   "--verbosity", "error", "--port", str(port),
+                   "--health-interval", "0.1",
+                   "--gossip-interval", "0.25",
+                   "--peer", "127.0.0.1:%d" % peer_port]
+            for endpoint in endpoints:
+                cmd += ["--replica", endpoint]
+            if autoscale:
+                cmd += ["--autoscale", "--autoscale-backend", "python"]
+            return cmd
+
+        fe_a, addr_a = _spawn(fe_cmd(ports[0], ports[1], True),
+                              env=scaler_env)
+        frontends.append(fe_a)
+        fe_b, addr_b = _spawn(fe_cmd(ports[1], ports[0], False), env=env)
+        frontends.append(fe_b)
+        ep_a = "%s:%d" % (addr_a["host"], addr_a["port"])
+        ep_b = "%s:%d" % (addr_b["host"], addr_b["port"])
+
+        cases = build_cases(args.cases)
+        done = [0] * args.clients
+        lat: list = []
+        lat_lock = threading.Lock()
+        divergences: list = []
+        typed_errors = [0]
+        stop = threading.Event()
+        t0 = time.monotonic()
+        deadline = t0 + args.duration
+        # half the clients hold B as their sticky primary so the kill
+        # actually exercises pool failover, not just a spare
+        pools = (FrontendPool([ep_a, ep_b], timeout=15.0),
+                 FrontendPool([ep_b, ep_a], timeout=15.0))
+
+        def active_fraction(now: float) -> float:
+            # one peak->trough half-cycle: 100% offered at t0 decaying
+            # to 10% at the deadline — the 10x diurnal swing the
+            # autoscaler must absorb (out near the peak, in during the
+            # trough)
+            phase = min(1.0, max(0.0, (now - t0) / args.duration))
+            return 0.55 + 0.45 * math.cos(math.pi * phase)
+
+        def client(c: int) -> None:
+            pool = pools[c % 2]
+            i = c
+            while time.monotonic() < deadline and not stop.is_set():
+                if (c / max(1, args.clients)) > active_fraction(
+                        time.monotonic()):
+                    time.sleep(0.02)
+                    continue
+                digest, sig, want = cases[i % len(cases)]
+                i += args.clients
+                t_req = time.monotonic()
+                try:
+                    got = pool.ecrecover_addresses([digest], [sig])
+                except (ConnectionError, TimeoutError, RPCError, OSError):
+                    typed_errors[0] += 1
+                    continue
+                with lat_lock:
+                    lat.append(time.monotonic() - t_req)
+                if got != [want]:
+                    divergences.append((c, i))
+                    stop.set()
+                    return
+                done[c] += 1
+
+        threads = [threading.Thread(target=client, args=(c,), daemon=True)
+                   for c in range(args.clients)]
+        for t in threads:
+            t.start()
+
+        killed = False
+        last_report = t0
+        while time.monotonic() < deadline and not stop.is_set():
+            time.sleep(0.1)
+            now = time.monotonic()
+            if not killed and now - t0 >= args.duration / 2:
+                fe_b.kill()  # SIGKILL: no drain notice, no goodbyes
+                killed = True
+                print(json.dumps({"killed_frontend": ep_b,
+                                  "t_s": round(now - t0, 1)}), flush=True)
+            if now - last_report >= args.report_interval:
+                last_report = now
+                print(json.dumps({
+                    "t_s": round(now - t0, 1),
+                    "active_fraction": round(active_fraction(now), 2),
+                    "done": sum(done),
+                    "typed_errors": typed_errors[0],
+                    "failovers": sum(p.failovers for p in pools),
+                }), flush=True)
+
+        for t in threads:
+            t.join(timeout=args.duration + 60)
+        hung = [t for t in threads if t.is_alive()]
+        stop.set()
+        wall = time.monotonic() - t0
+
+        # give the controller a calm tail to finish the scale-in leg
+        # (trough depth ~0 once the clients stop) and reap the drained
+        # spawn, then read the countered evidence off frontend A
+        status = None
+        status_rpc = RPCClient(addr_a["host"], addr_a["port"])
+        try:
+            settle_deadline = time.monotonic() + 15.0
+            while time.monotonic() < settle_deadline:
+                status = status_rpc.call("shard_fleetStatus")
+                scale = status.get("autoscale") or {}
+                if scale.get("out", 0) >= 1 and scale.get("in", 0) >= 1 \
+                        and not scale.get("retiring"):
+                    break
+                time.sleep(0.25)
+        finally:
+            status_rpc.close()
+        scale = (status or {}).get("autoscale") or {}
+        membership = (status or {}).get("membership") or {}
+
+        total = sum(done)
+        failovers = sum(p.failovers for p in pools)
+        for pool in pools:
+            pool.close()
+        p99_ms = round(percentile(lat, 0.99) * 1e3, 2)
+        slo_breach = bool(args.slo_interactive_ms > 0
+                          and p99_ms > args.slo_interactive_ms)
+        summary = {
+            "summary": True,
+            "elastic": True,
+            "replicas": n,
+            "clients": args.clients,
+            "wall_s": round(wall, 2),
+            "done": total,
+            "rate": round(total / wall, 1) if wall else 0.0,
+            "typed_errors": typed_errors[0],
+            "divergences": len(divergences),
+            "hung_clients": len(hung),
+            "frontend_killed": killed,
+            "failovers": failovers,
+            "scale_out": scale.get("out", 0),
+            "scale_in": scale.get("in", 0),
+            "scale_held": scale.get("held", 0),
+            "epoch": membership.get("epoch", 0),
+            "endpoints": membership.get("endpoints", []),
+            "p99_ms": p99_ms,
+            "slo_ms": args.slo_interactive_ms,
+            "slo_breach": slo_breach,
+        }
+        print(json.dumps(summary), flush=True)
+
+        failed = bool(divergences or hung or slo_breach
+                      or failovers < 1
+                      or summary["scale_out"] < 1
+                      or summary["scale_in"] < 1)
+        try:  # the perfwatch gate's fleet_elastic workload record
+            from gethsharding_tpu.perfwatch import record_bench
+
+            record_bench(
+                "fleet_elastic_interactive_p99_ms", p99_ms, unit="ms",
+                vs_baseline=(round(p99_ms / args.slo_interactive_ms, 4)
+                             if args.slo_interactive_ms > 0 else None),
+                workload="fleet_elastic", valid=not failed,
+                extra={k: v for k, v in summary.items()
+                       if k not in ("summary", "p99_ms", "endpoints")})
+        except Exception as exc:  # noqa: BLE001 - ledger is best-effort
+            print(json.dumps({"ledger_error": repr(exc)}), flush=True)
+        return 1 if failed else 0
+    finally:
+        for proc in frontends:
+            proc.terminate()
+        for proc in frontends:
+            try:
+                proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                proc.kill()
+        for proc in procs:
+            proc.terminate()
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(
         description="soak the serving tier (single backend or fleet)")
@@ -644,6 +889,19 @@ def main() -> int:
     parser.add_argument("--hedge-ms", type=float, default=15.0,
                         help="frontend mode: the frontend's "
                              "--fleet-hedge-ms floor")
+    parser.add_argument("--elastic", action="store_true",
+                        help="elastic closed-loop soak: 2 chain_server "
+                             "replicas behind TWO peered frontends "
+                             "(frontend A autoscaling), FrontendPool "
+                             "clients riding a 10x diurnal swing, one "
+                             "frontend killed -9 mid-swing; gates on "
+                             "zero incorrect verdicts, pool failover, "
+                             "and the autoscaler scaling out AND in")
+    parser.add_argument("--elastic-out-depth", type=float, default=3.0,
+                        help="elastic mode: the autoscaler's scale-out "
+                             "queue-depth threshold "
+                             "(GETHSHARDING_AUTOSCALE_OUT_DEPTH for "
+                             "the spawned frontend)")
     parser.add_argument("--light-clients", type=int, default=0,
                         help="> 0: run the LIGHT-CLIENT soak — this many "
                              "threads drive 1-row das_verify_multiproofs "
@@ -663,6 +921,8 @@ def main() -> int:
     parser.add_argument("--slo-catchup-ms", type=float, default=0.0)
     args = parser.parse_args()
 
+    if args.elastic:
+        return run_elastic(args)
     if args.frontend:
         return run_frontend(args)
     if args.light_clients > 0:
